@@ -1,0 +1,48 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+early PaddlePaddle (reference: zhoudaqing/Paddle, v1 gserver engine + v2 API),
+re-architected on JAX/XLA: topologies compile to single jitted XLA programs,
+distribution is a jax.sharding Mesh with ICI collectives (no parameter
+server), sequences are padded lax.scan loops.
+
+User surface mirrors ``paddle.v2``::
+
+    import paddle_tpu as paddle
+    paddle.init()
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    ...
+    trainer = paddle.trainer.SGD(cost, parameters, paddle.optimizer.Momentum(...))
+    trainer.train(paddle.batch(paddle.dataset.mnist.train(), 128), ...)
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import activation  # noqa: F401
+from paddle_tpu import attr  # noqa: F401
+from paddle_tpu import dataset  # noqa: F401
+from paddle_tpu import event  # noqa: F401
+from paddle_tpu import layers as layer  # noqa: F401
+from paddle_tpu import optimizer  # noqa: F401
+from paddle_tpu import parallel  # noqa: F401
+from paddle_tpu import parameters  # noqa: F401
+from paddle_tpu import pooling  # noqa: F401
+from paddle_tpu import reader  # noqa: F401
+from paddle_tpu import trainer  # noqa: F401
+from paddle_tpu.core import data_types as data_type  # noqa: F401
+from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
+from paddle_tpu.core.topology import Topology  # noqa: F401
+from paddle_tpu.minibatch import batch  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def init(use_tpu: bool = True, trainer_count: int = 1, seed: int = 0, **kwargs) -> None:
+    """paddle.init equivalent (reference: paddle/utils/Util.h initMain via
+    swig initPaddle).  JAX needs no global init; `use_tpu`/`trainer_count`
+    are accepted for config compatibility — device selection and parallelism
+    come from the jax platform and the mesh instead."""
+    import random
+
+    import numpy as np
+
+    random.seed(seed)
+    np.random.seed(seed)

@@ -1,0 +1,37 @@
+"""Parameter / layer extra attributes — the ``paddle.v2.attr`` surface
+(reference: python/paddle/trainer_config_helpers/attrs.py ParameterAttribute,
+ExtraLayerAttribute)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ParamAttr:
+    """Per-parameter attributes (reference ParameterAttribute, attrs.py:52).
+    learning_rate/decay multipliers feed the optimizer's per-param scaling;
+    initial_std overrides the default 1/sqrt(fan_in)."""
+
+    name: Optional[str] = None
+    initial_std: Optional[float] = None
+    initial_mean: Optional[float] = None
+    learning_rate: float = 1.0
+    l2_rate: Optional[float] = None
+    l1_rate: Optional[float] = None
+    is_static: bool = False
+    sparse_update: bool = False
+
+
+@dataclasses.dataclass
+class ExtraAttr:
+    """Extra layer attributes (reference ExtraLayerAttribute, attrs.py:390)."""
+
+    drop_rate: float = 0.0
+    # Mesh-axis hint replacing the reference's per-layer `device`.
+    shard_axis: Optional[str] = None
+
+
+ParameterAttribute = ParamAttr
+ExtraLayerAttribute = ExtraAttr

@@ -1,0 +1,87 @@
+"""Batch value types — the TPU-native replacement for the reference's
+``Argument`` (reference: paddle/parameter/Argument.h:29-157).
+
+The reference packs variable-length sequences into CSR form (`value` rows +
+`sequenceStartPositions`).  Static XLA shapes want padded tensors, so the
+in-graph value type is :class:`SeqTensor`: a padded array plus optional
+per-sample lengths (and sub-sequence segment ids for nested sequences).
+All layer implementations consume and produce SeqTensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SeqTensor:
+    """A (possibly sequential) batch value.
+
+    data:      [B, ...] for plain samples, or [B, T, ...] padded when seq.
+    lengths:   [B] int32 valid-timestep counts, or None for non-sequence.
+    sub_starts:[B, S] int32 start offsets of nested subsequences (padded with
+               `lengths`), or None — replaces subSequenceStartPositions
+               (reference Argument.h:88).
+    """
+
+    def __init__(self, data, lengths=None, sub_starts=None):
+        self.data = data
+        self.lengths = lengths
+        self.sub_starts = sub_starts
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        children = (self.data, self.lengths, self.sub_starts)
+        return children, None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    # -- helpers ------------------------------------------------------------
+    @property
+    def is_seq(self) -> bool:
+        return self.lengths is not None
+
+    @property
+    def batch_size(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        assert self.is_seq
+        return self.data.shape[1]
+
+    def mask(self, dtype=jnp.float32):
+        """[B, T] 1/0 validity mask from lengths."""
+        assert self.is_seq
+        t = jnp.arange(self.max_len, dtype=jnp.int32)
+        return (t[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def masked_data(self):
+        """data with padding timesteps zeroed."""
+        if not self.is_seq:
+            return self.data
+        m = self.mask(self.data.dtype)
+        return self.data * m.reshape(m.shape + (1,) * (self.data.ndim - 2))
+
+    def with_data(self, data) -> "SeqTensor":
+        return SeqTensor(data, self.lengths, self.sub_starts)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        shp = getattr(self.data, "shape", None)
+        return f"SeqTensor(shape={shp}, seq={self.is_seq})"
+
+
+Batch = Dict[str, SeqTensor]  # slot name -> value, the feeder's output
+
+
+def non_seq(data) -> SeqTensor:
+    return SeqTensor(jnp.asarray(data))
+
+
+def seq(data, lengths) -> SeqTensor:
+    return SeqTensor(jnp.asarray(data), jnp.asarray(lengths, dtype=jnp.int32))

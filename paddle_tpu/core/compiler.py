@@ -1,0 +1,150 @@
+"""Topology → pure JAX function compiler.
+
+This replaces the reference's runtime layer-graph interpreter
+(``NeuralNetwork::forward`` looping over C++ Layer objects, reference:
+paddle/gserver/gradientmachines/NeuralNetwork.cpp:235-292) with a trace-time
+loop: :meth:`CompiledNetwork.apply` walks the topology **while being traced by
+jax.jit**, so the emitted program is one fused XLA computation per step —
+the OpDesc→HLO lowering the north star asks for.  Gradients come from
+``jax.grad`` over the whole step instead of per-layer ``backward``.
+
+State handling: trainable parameters and non-trainable state (batch-norm
+moving stats — the reference mutates these inside forward,
+paddle/gserver/layers/BatchNormBaseLayer.h) are separate pytrees; ``apply``
+returns updated state functionally.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.batch import Batch, SeqTensor
+from paddle_tpu.core.topology import Topology
+from paddle_tpu.layers.base import ApplyContext, get_layer_impl
+from paddle_tpu.ops.activations import apply_activation
+
+Params = Dict[str, Dict[str, Any]]
+NetState = Dict[str, Dict[str, Any]]
+
+
+class CompiledNetwork:
+    """init/apply view over a Topology."""
+
+    def __init__(self, topology: Topology, dtype=jnp.float32):
+        self.topology = topology
+        self.dtype = dtype
+        # Resolve implementations eagerly so unknown types fail at build.
+        self._impls = {
+            name: get_layer_impl(conf.type)
+            for name, conf in topology.layers.items()
+        }
+
+    # ------------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Params:
+        params: Params = {}
+        for name in self.topology.order:
+            conf = self.topology.layers[name]
+            impl = self._impls[name]
+            in_confs = [self.topology.layers[i] for i in conf.inputs]
+            layer_rng = jax.random.fold_in(rng, hash(name) & 0x7FFFFFFF)
+            p = impl.init(conf, in_confs, layer_rng)
+            if p:
+                params[name] = p
+        return params
+
+    def init_state(self) -> NetState:
+        state: NetState = {}
+        for name in self.topology.order:
+            conf = self.topology.layers[name]
+            impl = self._impls[name]
+            if impl.init_state is not None:
+                in_confs = [self.topology.layers[i] for i in conf.inputs]
+                s = impl.init_state(conf, in_confs)
+                if s:
+                    state[name] = s
+        return state
+
+    def init(self, rng: jax.Array) -> Tuple[Params, NetState]:
+        return self.init_params(rng), self.init_state()
+
+    # ------------------------------------------------------------------
+    def apply(
+        self,
+        params: Params,
+        batch: Batch,
+        *,
+        state: Optional[NetState] = None,
+        train: bool = True,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[Dict[str, SeqTensor], NetState]:
+        """Run the whole graph; returns every layer's output by name plus the
+        functionally-updated state."""
+        ctx = ApplyContext(train=train, rng=rng, state=state or {}, dtype=self.dtype)
+        for name in self.topology.order:
+            conf = self.topology.layers[name]
+            impl = self._impls[name]
+            if conf.type == "data":
+                if name not in batch:
+                    raise KeyError(f"batch is missing data slot {name!r}")
+                ctx.outputs[name] = batch[name]
+                continue
+            ins = [ctx.outputs[i] for i in conf.inputs]
+            out = impl.apply(conf, params.get(name, {}), ins, ctx)
+            if impl.auto_activation and conf.act not in ("identity", "linear", ""):
+                if conf.act == "softmax":
+                    # Stash pre-activation logits so downstream cross_entropy
+                    # fuses into log-softmax CE (numerically stable); XLA
+                    # dead-code-eliminates this when unused.
+                    ctx.outputs[name + "@logits"] = out
+                mask = out.mask() if (out.is_seq and conf.act == "sequence_softmax") else None
+                out = out.with_data(apply_activation(conf.act, out.data, mask))
+            if impl.auto_dropout and conf.drop_rate > 0.0 and train:
+                drop_rng = ctx.layer_rng(name + "/dropout")
+                if drop_rng is not None:
+                    keep = 1.0 - conf.drop_rate
+                    m = jax.random.bernoulli(drop_rng, keep, out.data.shape)
+                    out = out.with_data(
+                        jnp.where(m, out.data / keep, jnp.zeros_like(out.data))
+                    )
+            ctx.outputs[name] = out
+        new_state = dict(ctx.state)
+        new_state.update(ctx.new_state)
+        return ctx.outputs, new_state
+
+    # ------------------------------------------------------------------
+    def forward(
+        self,
+        params: Params,
+        batch: Batch,
+        *,
+        state: Optional[NetState] = None,
+        train: bool = True,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[SeqTensor, Dict[str, SeqTensor], NetState]:
+        """First declared output, the full output dict, and updated state."""
+        outs, new_state = self.apply(params, batch, state=state, train=train, rng=rng)
+        return outs[self.topology.output_names[0]], outs, new_state
+
+    def cost(
+        self,
+        params: Params,
+        batch: Batch,
+        *,
+        state: Optional[NetState] = None,
+        rng: Optional[jax.Array] = None,
+        train: bool = True,
+    ):
+        """(scalar mean cost, (outputs, new_state)) — the differentiable
+        quantity (replaces GradientMachine::backward's sum-of-cost seeding,
+        reference: paddle/gserver/gradientmachines/GradientMachine.h:72)."""
+        out, outs, new_state = self.forward(
+            params, batch, state=state, train=train, rng=rng
+        )
+        return jnp.mean(out.data), (outs, new_state)
+
+
+def count_params(params: Params) -> int:
+    return sum(int(jnp.size(x)) for x in jax.tree_util.tree_leaves(params))

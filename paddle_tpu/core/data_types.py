@@ -1,0 +1,89 @@
+"""Input slot type declarations — the user-facing equivalent of the reference's
+``paddle.trainer.PyDataProvider2`` input_types (reference:
+python/paddle/trainer/PyDataProvider2.py:140-260).
+
+The reference expresses variable-length data as CSR-packed rows plus
+``sequenceStartPositions`` (reference: paddle/parameter/Argument.h:84-93).  On
+TPU we instead declare a static-shape contract up front: every sequence slot is
+padded to a bucketed max length and carried as ``[B, T, ...]`` plus a
+``lengths[B]`` vector, so the whole step stays jit-compilable with static
+shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+
+class SlotKind(enum.Enum):
+    DENSE = "dense"
+    SPARSE_BINARY = "sparse_binary"
+    SPARSE_FLOAT = "sparse_float"
+    INDEX = "index"
+
+
+class SeqLevel(enum.IntEnum):
+    NONE = 0  # one value per sample
+    SEQ = 1  # a sequence of values per sample
+    SUB_SEQ = 2  # a nested sequence (sequence of sequences)
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Declares shape/semantics of one data slot."""
+
+    dim: int
+    kind: SlotKind
+    seq: SeqLevel = SeqLevel.NONE
+    # Number of non-zero entries to keep per timestep for sparse slots when
+    # densified into gather-friendly id/value buffers.
+    max_nnz: Optional[int] = None
+
+    @property
+    def is_seq(self) -> bool:
+        return self.seq != SeqLevel.NONE
+
+
+def dense_vector(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE)
+
+
+def dense_vector_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE, SeqLevel.SEQ)
+
+
+def integer_value(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX)
+
+
+def integer_value_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX, SeqLevel.SEQ)
+
+
+def integer_value_sub_sequence(value_range: int) -> InputType:
+    return InputType(value_range, SlotKind.INDEX, SeqLevel.SUB_SEQ)
+
+
+def sparse_binary_vector(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY, SeqLevel.NONE, max_nnz)
+
+
+def sparse_binary_vector_sequence(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY, SeqLevel.SEQ, max_nnz)
+
+
+def sparse_float_vector(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_FLOAT, SeqLevel.NONE, max_nnz)
+
+
+def sparse_float_vector_sequence(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_FLOAT, SeqLevel.SEQ, max_nnz)
+
+
+# Aliases matching the reference naming.
+dense_array = dense_vector
+sparse_vector = sparse_float_vector
+sparse_non_value_slot = sparse_binary_vector
+index_slot = integer_value

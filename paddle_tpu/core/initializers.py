@@ -1,0 +1,40 @@
+"""Parameter initialization, matching the reference defaults
+(reference: paddle/parameter/Parameter.cpp randomize + config_parser.py
+default initial_std = 1/sqrt(fan_in) gaussian, initial_mean = 0)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def default_std(fan_in: int) -> float:
+    return 1.0 / math.sqrt(max(fan_in, 1))
+
+
+def normal(rng, shape: Sequence[int], std: Optional[float] = None, dtype=jnp.float32):
+    if std is None:
+        fan_in = shape[0] if len(shape) >= 2 else shape[-1]
+        std = default_std(int(fan_in))
+    return std * jax.random.normal(rng, tuple(shape), dtype)
+
+
+def uniform(rng, shape: Sequence[int], scale: float, dtype=jnp.float32):
+    return jax.random.uniform(rng, tuple(shape), dtype, -scale, scale)
+
+
+def zeros(shape: Sequence[int], dtype=jnp.float32):
+    return jnp.zeros(tuple(shape), dtype)
+
+
+def ones(shape: Sequence[int], dtype=jnp.float32):
+    return jnp.ones(tuple(shape), dtype)
+
+
+def conv_normal(rng, shape: Sequence[int], dtype=jnp.float32):
+    """For conv kernels laid out [kh, kw, cin, cout]: std over receptive field."""
+    kh, kw, cin, _ = shape
+    return normal(rng, shape, default_std(kh * kw * cin), dtype)

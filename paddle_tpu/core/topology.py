@@ -1,0 +1,164 @@
+"""Typed topology IR — the TPU-native replacement for the reference's
+``ModelConfig`` protobuf graph (reference: proto/ModelConfig.proto:608,
+LayerConfig:326) and the config_parser that builds it (reference:
+python/paddle/trainer/config_parser.py:3669).
+
+Design: instead of a proto compiled by a global-state parser and then
+interpreted layer-by-layer at runtime (reference:
+paddle/gserver/gradientmachines/NeuralNetwork.cpp:235), the DSL builds an
+immutable dataclass graph.  ``paddle_tpu.core.compiler`` traces it **once**
+into a pure JAX function, so the whole model becomes a single XLA computation
+— the graph exists only at trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.core.data_types import InputType
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerConf:
+    """One node of the model graph (reference LayerConfig,
+    proto/ModelConfig.proto:326).  ``attrs`` carries per-type configuration
+    (kernel sizes, dropout rate, ...) keeping this class closed."""
+
+    name: str
+    type: str
+    size: int  # output feature dimension (last-axis width)
+    inputs: Tuple[str, ...] = ()  # parent layer names, ordered
+    act: str = "identity"
+    bias: bool = True
+    # Static per-type attributes; must be hashable-friendly plain data.
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Input slot type for data layers.
+    input_type: Optional[InputType] = None
+    # Dropout applied to the layer output during training (reference
+    # attrs.py ExtraAttr drop_rate).
+    drop_rate: float = 0.0
+    # Mesh-axis hint for model-parallel sharding of this layer's parameters
+    # (replaces the reference's per-layer `device` attribute,
+    # ParallelNeuralNetwork.h:34).
+    shard_axis: Optional[str] = None
+
+    def attr(self, key: str, default: Any = None) -> Any:
+        return self.attrs.get(key, default)
+
+
+class LayerOutput:
+    """Functional DSL handle returned by every layer function — mirrors
+    trainer_config_helpers.layers.LayerOutput (reference:
+    python/paddle/trainer_config_helpers/layers.py:320-400) but carries the
+    actual conf + parents so the graph is collected by traversal instead of
+    mutable global state."""
+
+    def __init__(self, conf: LayerConf, parents: Sequence["LayerOutput"] = ()):
+        self.conf = conf
+        self.parents: Tuple[LayerOutput, ...] = tuple(parents)
+
+    @property
+    def name(self) -> str:
+        return self.conf.name
+
+    @property
+    def size(self) -> int:
+        return self.conf.size
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"LayerOutput({self.conf.type}:{self.conf.name}, size={self.conf.size})"
+
+
+class Topology:
+    """Whole-model graph in topological order.
+
+    Equivalent of the v2 Topology (reference: python/paddle/v2/topology.py:25)
+    that serializes to ModelConfig; here it *is* the model description handed
+    to the compiler.
+    """
+
+    def __init__(self, outputs: Sequence[LayerOutput]):
+        if isinstance(outputs, LayerOutput):
+            outputs = [outputs]
+        self.outputs: Tuple[LayerOutput, ...] = tuple(outputs)
+        self.layers: Dict[str, LayerConf] = {}
+        order: List[str] = []
+        seen: set = set()
+
+        def visit(lo: LayerOutput) -> None:
+            if lo.conf.name in seen:
+                existing = self.layers.get(lo.conf.name)
+                if existing is not None and existing != lo.conf:
+                    raise ValueError(
+                        f"two different layers share the name {lo.conf.name!r}"
+                    )
+                return
+            seen.add(lo.conf.name)
+            for p in lo.parents:
+                visit(p)
+            self.layers[lo.conf.name] = lo.conf
+            order.append(lo.conf.name)
+
+        for out in self.outputs:
+            visit(out)
+        self.order: Tuple[str, ...] = tuple(order)
+
+    @property
+    def output_names(self) -> Tuple[str, ...]:
+        return tuple(o.conf.name for o in self.outputs)
+
+    def data_layers(self) -> Dict[str, LayerConf]:
+        """Data layers in declaration order (the feeding contract)."""
+        return {
+            name: conf
+            for name, conf in self.layers.items()
+            if conf.type == "data"
+        }
+
+    def data_types(self) -> List[Tuple[str, InputType]]:
+        """[(name, InputType)] — same contract as v2 Topology.data_type()
+        (reference: python/paddle/v2/topology.py:84-100)."""
+        out = []
+        for name, conf in self.data_layers().items():
+            assert conf.input_type is not None, f"data layer {name} missing input_type"
+            out.append((name, conf.input_type))
+        return out
+
+    def get(self, name: str) -> LayerConf:
+        return self.layers[name]
+
+    def serialize(self) -> str:
+        """Deterministic text form used for golden-snapshot tests (the
+        protostr-equality tests of the reference,
+        python/paddle/trainer_config_helpers/tests/configs/)."""
+        lines = []
+        for name in self.order:
+            c = self.layers[name]
+            attrs = ", ".join(f"{k}={c.attrs[k]!r}" for k in sorted(c.attrs))
+            lines.append(
+                f"{c.type} {name} size={c.size} act={c.act} bias={c.bias}"
+                f" inputs={list(c.inputs)}"
+                + (f" drop={c.drop_rate}" if c.drop_rate else "")
+                + (f" [{attrs}]" if attrs else "")
+            )
+        lines.append(f"outputs={list(self.output_names)}")
+        return "\n".join(lines)
+
+
+_AUTO_NAMES: Dict[str, int] = {}
+
+
+def auto_name(prefix: str) -> str:
+    """Deterministic unique layer names, mirroring the reference DSL's
+    `__fc_layer_0__` style counters (trainer_config_helpers/default_decorators
+    wrap_name_default)."""
+    idx = _AUTO_NAMES.get(prefix, 0)
+    _AUTO_NAMES[prefix] = idx + 1
+    return f"__{prefix}_{idx}__"
+
+
+def reset_auto_names() -> None:
+    """Reset the name counters (call between independently-built models in
+    tests so golden snapshots are stable)."""
+    _AUTO_NAMES.clear()

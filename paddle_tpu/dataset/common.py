@@ -1,0 +1,17 @@
+"""Dataset cache helpers (reference: python/paddle/v2/dataset/common.py)."""
+
+from __future__ import annotations
+
+import os
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA", os.path.expanduser("~/.cache/paddle_tpu/dataset")
+)
+
+
+def data_path(*parts: str) -> str:
+    return os.path.join(DATA_HOME, *parts)
+
+
+def exists(*parts: str) -> bool:
+    return os.path.exists(data_path(*parts))

@@ -1,0 +1,908 @@
+"""Layer DSL — the user surface equivalent of
+``paddle.trainer_config_helpers.layers`` + ``paddle.v2.layer`` (reference:
+python/paddle/trainer_config_helpers/layers.py, python/paddle/v2/layer.py).
+
+Each function returns a :class:`LayerOutput` handle; the graph is gathered by
+parent traversal when a :class:`Topology` is built (no mutable global config,
+unlike the reference's config_parser).  Output-size bookkeeping (conv
+arithmetic, implicit flatten) mirrors config_parser.py cnn_output_size.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+from paddle_tpu import activation as _act_mod
+from paddle_tpu.activation import act_name
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+from paddle_tpu.core.data_types import InputType
+from paddle_tpu.core.topology import LayerConf, LayerOutput, Topology, auto_name
+from paddle_tpu.pooling import pool_name
+
+# Make the implementation registries import (registers layer types).
+from paddle_tpu.layers import base as _base  # noqa: F401
+from paddle_tpu.layers import basic as _basic  # noqa: F401
+from paddle_tpu.layers import conv as _conv  # noqa: F401
+from paddle_tpu.layers import cost as _cost  # noqa: F401
+from paddle_tpu.layers import sequence as _sequence  # noqa: F401
+
+Inputish = Union[LayerOutput, Sequence[LayerOutput]]
+
+
+def _as_list(x: Inputish) -> list:
+    if isinstance(x, LayerOutput):
+        return [x]
+    return list(x)
+
+
+def _extra(layer_attr: Optional[ExtraAttr]):
+    drop = layer_attr.drop_rate if layer_attr else 0.0
+    shard = layer_attr.shard_axis if layer_attr else None
+    return drop, shard
+
+
+def _param_std(param_attr: Optional[ParamAttr]):
+    return param_attr.initial_std if param_attr else None
+
+
+def cnn_output_size(
+    img_size: int, filter_size: int, padding: int, stride: int, caffe_mode: bool = True
+) -> int:
+    """reference: python/paddle/trainer/config_parser.py cnn_output_size."""
+    output = (2 * padding + img_size - filter_size) / float(stride)
+    if caffe_mode:
+        return 1 + int(math.floor(output))
+    return 1 + int(math.ceil(output))
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+
+def data(name: str, type: InputType, height: int = 0, width: int = 0) -> LayerOutput:
+    """Declare an input slot (reference data_layer, layers.py)."""
+    attrs = {}
+    if height and width:
+        attrs.update(in_h=height, in_w=width, in_c=max(type.dim // (height * width), 1))
+    conf = LayerConf(
+        name=name, type="data", size=type.dim, input_type=type, attrs=attrs, bias=False
+    )
+    return LayerOutput(conf)
+
+
+data_layer = data
+
+
+# ---------------------------------------------------------------------------
+# fc
+# ---------------------------------------------------------------------------
+
+
+def fc(
+    input: Inputish,
+    size: int,
+    act=None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    ins = _as_list(input)
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("fc_layer"),
+        type="fc",
+        size=size,
+        inputs=tuple(i.name for i in ins),
+        act=act_name(act if act is not None else _act_mod.Tanh()),
+        bias=bool(bias_attr),
+        attrs={"param_std": _param_std(param_attr)},
+        drop_rate=drop,
+        shard_axis=shard,
+    )
+    return LayerOutput(conf, ins)
+
+
+fc_layer = fc
+
+
+def embedding(
+    input: LayerOutput,
+    size: int,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("embedding"),
+        type="embedding",
+        size=size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"param_std": _param_std(param_attr)},
+    )
+    return LayerOutput(conf, [input])
+
+
+embedding_layer = embedding
+
+
+def addto(
+    input: Inputish,
+    act=None,
+    bias_attr: Union[bool, ParamAttr] = False,
+    name: Optional[str] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+) -> LayerOutput:
+    ins = _as_list(input)
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("addto"),
+        type="addto",
+        size=ins[0].size,
+        inputs=tuple(i.name for i in ins),
+        act=act_name(act),
+        bias=bool(bias_attr),
+        drop_rate=drop,
+        shard_axis=shard,
+    )
+    return LayerOutput(conf, ins)
+
+
+addto_layer = addto
+
+
+def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None) -> LayerOutput:
+    ins = _as_list(input)
+    conf = LayerConf(
+        name=name or auto_name("concat"),
+        type="concat",
+        size=sum(i.size for i in ins),
+        inputs=tuple(i.name for i in ins),
+        act=act_name(act),
+        bias=False,
+    )
+    return LayerOutput(conf, ins)
+
+
+concat_layer = concat
+
+
+def dropout(input: LayerOutput, dropout_rate: float, name: Optional[str] = None) -> LayerOutput:
+    """Standalone dropout = addto with drop_rate (reference dropout_layer is
+    sugar over ExtraAttr.drop_rate)."""
+    conf = LayerConf(
+        name=name or auto_name("dropout"),
+        type="addto",
+        size=input.size,
+        inputs=(input.name,),
+        bias=False,
+        drop_rate=dropout_rate,
+    )
+    return LayerOutput(conf, [input])
+
+
+dropout_layer = dropout
+
+
+# ---------------------------------------------------------------------------
+# image layers
+# ---------------------------------------------------------------------------
+
+
+def _img_attrs(input: LayerOutput, num_channels: Optional[int]):
+    a = input.conf.attrs
+    in_c = num_channels or a.get("channels") or a.get("in_c")
+    in_h = a.get("out_h") or a.get("in_h")
+    in_w = a.get("out_w") or a.get("in_w")
+    if in_h is None:
+        # flat input: assume square image, CHW order
+        assert in_c, f"num_channels required for flat input {input.name}"
+        hw = input.size // in_c
+        side = int(math.isqrt(hw))
+        assert side * side == hw, f"cannot infer square image from size {input.size}"
+        in_h = in_w = side
+    return int(in_c), int(in_h), int(in_w)
+
+
+def img_conv(
+    input: LayerOutput,
+    filter_size: int,
+    num_filters: int,
+    stride: int = 1,
+    padding: int = 0,
+    groups: int = 1,
+    num_channels: Optional[int] = None,
+    act=None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    trans: bool = False,
+    caffe_mode: bool = True,
+    filter_size_y: Optional[int] = None,
+    stride_y: Optional[int] = None,
+    padding_y: Optional[int] = None,
+    name: Optional[str] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+) -> LayerOutput:
+    """reference img_conv_layer (layers.py) → ExpandConvLayer/CudnnConvLayer."""
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    fh = filter_size_y or filter_size
+    fw = filter_size
+    sh = stride_y or stride
+    sw = stride
+    ph = padding_y if padding_y is not None else padding
+    pw = padding
+    if trans:
+        out_h = (in_h - 1) * sh + fh - 2 * ph
+        out_w = (in_w - 1) * sw + fw - 2 * pw
+    else:
+        out_h = cnn_output_size(in_h, fh, ph, sh, caffe_mode)
+        out_w = cnn_output_size(in_w, fw, pw, sw, caffe_mode)
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("conv"),
+        type="convt" if trans else "conv",
+        size=out_h * out_w * num_filters,
+        inputs=(input.name,),
+        act=act_name(act if act is not None else _act_mod.Relu()),
+        bias=bool(bias_attr),
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "filter_h": fh,
+            "filter_w": fw,
+            "stride_h": sh,
+            "stride_w": sw,
+            "pad_h": ph,
+            "pad_w": pw,
+            "groups": groups,
+            "channels": num_filters,
+            "out_h": out_h,
+            "out_w": out_w,
+        },
+        drop_rate=drop,
+        shard_axis=shard,
+    )
+    return LayerOutput(conf, [input])
+
+
+img_conv_layer = img_conv
+
+
+def img_pool(
+    input: LayerOutput,
+    pool_size: int,
+    stride: int = 1,
+    padding: int = 0,
+    pool_type=None,
+    num_channels: Optional[int] = None,
+    pool_size_y: Optional[int] = None,
+    stride_y: Optional[int] = None,
+    padding_y: Optional[int] = None,
+    ceil_mode: bool = True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference img_pool_layer → PoolLayer; v1 uses ceil output sizing."""
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    kh = pool_size_y or pool_size
+    kw = pool_size
+    sh = stride_y or stride
+    sw = stride
+    ph = padding_y if padding_y is not None else padding
+    pw = padding
+    out_h = cnn_output_size(in_h, kh, ph, sh, caffe_mode=not ceil_mode)
+    out_w = cnn_output_size(in_w, kw, pw, sw, caffe_mode=not ceil_mode)
+    conf = LayerConf(
+        name=name or auto_name("pool"),
+        type="pool",
+        size=out_h * out_w * in_c,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "filter_h": kh,
+            "filter_w": kw,
+            "stride_h": sh,
+            "stride_w": sw,
+            "pad_h": ph,
+            "pad_w": pw,
+            "pool_type": pool_name(pool_type),
+            "channels": in_c,
+            "out_h": out_h,
+            "out_w": out_w,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+img_pool_layer = img_pool
+
+
+def batch_norm(
+    input: LayerOutput,
+    act=None,
+    num_channels: Optional[int] = None,
+    epsilon: float = 1e-5,
+    moving_average_fraction: float = 0.9,
+    use_global_stats: Optional[bool] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    a = input.conf.attrs
+    img = (a.get("out_h") or a.get("in_h")) is not None
+    if img:
+        in_c, in_h, in_w = _img_attrs(input, num_channels)
+        attrs = {
+            "channels": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "in_c": in_c,
+            "out_h": in_h,
+            "out_w": in_w,
+        }
+    else:
+        attrs = {"channels": num_channels or input.size}
+    attrs.update(
+        epsilon=epsilon,
+        moving_average_fraction=moving_average_fraction,
+        use_global_stats=bool(use_global_stats),
+    )
+    conf = LayerConf(
+        name=name or auto_name("batch_norm"),
+        type="batch_norm",
+        size=input.size,
+        inputs=(input.name,),
+        act=act_name(act),
+        bias=False,
+        attrs=attrs,
+    )
+    return LayerOutput(conf, [input])
+
+
+batch_norm_layer = batch_norm
+
+
+def maxout(
+    input: LayerOutput,
+    groups: int,
+    num_channels: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    out_c = in_c // groups
+    conf = LayerConf(
+        name=name or auto_name("maxout"),
+        type="maxout",
+        size=in_h * in_w * out_c,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "groups": groups,
+            "channels": out_c,
+            "out_h": in_h,
+            "out_w": in_w,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+maxout_layer = maxout
+
+
+def spp(
+    input: LayerOutput,
+    pyramid_height: int = 3,
+    pool_type=None,
+    num_channels: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    size = in_c * sum((2**l) * (2**l) for l in range(pyramid_height))
+    conf = LayerConf(
+        name=name or auto_name("spp"),
+        type="spp",
+        size=size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "pyramid_height": pyramid_height,
+            "pool_type": pool_name(pool_type),
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+spp_layer = spp
+
+
+def bilinear_interp(
+    input: LayerOutput,
+    out_size_x: int,
+    out_size_y: int,
+    num_channels: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    conf = LayerConf(
+        name=name or auto_name("bilinear_interp"),
+        type="bilinear_interp",
+        size=out_size_x * out_size_y * in_c,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "out_h": out_size_y,
+            "out_w": out_size_x,
+            "channels": in_c,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+bilinear_interp_layer = bilinear_interp
+
+
+def img_pad(
+    input: LayerOutput,
+    pad_c=(0, 0),
+    pad_h=(0, 0),
+    pad_w=(0, 0),
+    num_channels: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    out_c = in_c + sum(pad_c)
+    out_h = in_h + sum(pad_h)
+    out_w = in_w + sum(pad_w)
+    conf = LayerConf(
+        name=name or auto_name("pad"),
+        type="pad",
+        size=out_c * out_h * out_w,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_c": in_c,
+            "in_h": in_h,
+            "in_w": in_w,
+            "pad_c": tuple(pad_c),
+            "pad_h_pair": tuple(pad_h),
+            "pad_w_pair": tuple(pad_w),
+            "channels": out_c,
+            "out_h": out_h,
+            "out_w": out_w,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+pad_layer = img_pad
+
+
+# ---------------------------------------------------------------------------
+# simple math layers
+# ---------------------------------------------------------------------------
+
+
+def _unary(type_: str, input: LayerOutput, size=None, name=None, **attrs) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name(type_),
+        type=type_,
+        size=size if size is not None else input.size,
+        inputs=(input.name,),
+        bias=False,
+        attrs=attrs,
+    )
+    return LayerOutput(conf, [input])
+
+
+def slope_intercept(input, slope=1.0, intercept=0.0, name=None):
+    return _unary("slope_intercept", input, name=name, slope=slope, intercept=intercept)
+
+
+slope_intercept_layer = slope_intercept
+
+
+def scaling(weight: LayerOutput, input: LayerOutput, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("scaling"),
+        type="scaling",
+        size=input.size,
+        inputs=(weight.name, input.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [weight, input])
+
+
+scaling_layer = scaling
+
+
+def interpolation(
+    weight: LayerOutput, input1: LayerOutput, input2: LayerOutput, name=None
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("interpolation"),
+        type="interpolation",
+        size=input1.size,
+        inputs=(weight.name, input1.name, input2.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [weight, input1, input2])
+
+
+interpolation_layer = interpolation
+
+
+def sum_to_one_norm(input, name=None):
+    return _unary("sum_to_one_norm", input, name=name)
+
+
+sum_to_one_norm_layer = sum_to_one_norm
+
+
+def row_l2_norm(input, name=None):
+    return _unary("row_l2_norm", input, name=name)
+
+
+row_l2_norm_layer = row_l2_norm
+
+
+def clip(input, min=-1.0, max=1.0, name=None):
+    return _unary("clip", input, name=name, min=min, max=max)
+
+
+clip_layer = clip
+
+
+def maxid(input, name=None):
+    return _unary("maxid", input, size=1, name=name)
+
+
+maxid_layer = maxid
+
+
+def trans(input, height: int, name=None):
+    return _unary("trans", input, name=name, height=height)
+
+
+trans_layer = trans
+
+
+def resize(input, size: int, name=None):
+    return _unary("resize", input, size=size, name=name)
+
+
+resize_layer = resize
+
+
+def multiplex(input: Sequence[LayerOutput], name=None) -> LayerOutput:
+    ins = _as_list(input)
+    conf = LayerConf(
+        name=name or auto_name("multiplex"),
+        type="multiplex",
+        size=ins[1].size,
+        inputs=tuple(i.name for i in ins),
+        bias=False,
+    )
+    return LayerOutput(conf, ins)
+
+
+multiplex_layer = multiplex
+
+
+def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0, name=None):
+    conf = LayerConf(
+        name=name or auto_name("dotmul"),
+        type="dotmul",
+        size=a.size,
+        inputs=(a.name, b.name),
+        bias=False,
+        attrs={"scale": scale},
+    )
+    return LayerOutput(conf, [a, b])
+
+
+def out_prod(input1: LayerOutput, input2: LayerOutput, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("out_prod"),
+        type="out_prod",
+        size=input1.size * input2.size,
+        inputs=(input1.name, input2.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [input1, input2])
+
+
+out_prod_layer = out_prod
+
+
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("cos_sim"),
+        type="cos",
+        size=1,
+        inputs=(a.name, b.name),
+        bias=False,
+        attrs={"scale": scale},
+    )
+    return LayerOutput(conf, [a, b])
+
+
+def tensor(
+    input1: LayerOutput,
+    input2: LayerOutput,
+    size: int,
+    act=None,
+    bias_attr=True,
+    name=None,
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("tensor"),
+        type="tensor",
+        size=size,
+        inputs=(input1.name, input2.name),
+        act=act_name(act),
+        bias=bool(bias_attr),
+    )
+    return LayerOutput(conf, [input1, input2])
+
+
+tensor_layer = tensor
+
+
+# ---------------------------------------------------------------------------
+# cost layers
+# ---------------------------------------------------------------------------
+
+
+def _cost2(type_: str, input: LayerOutput, label: LayerOutput, name=None, **attrs):
+    conf = LayerConf(
+        name=name or auto_name(type_),
+        type=type_,
+        size=1,
+        inputs=(input.name, label.name),
+        bias=False,
+        attrs=attrs,
+    )
+    return LayerOutput(conf, [input, label])
+
+
+def classification_cost(input: LayerOutput, label: LayerOutput, name=None) -> LayerOutput:
+    """reference classification_cost: softmax output + cross-entropy (the
+    compiler fuses into log-softmax CE when the input's act is softmax)."""
+    return _cost2("cross_entropy", input, label, name=name)
+
+
+def cross_entropy_cost(input, label, name=None):
+    return _cost2("cross_entropy", input, label, name=name)
+
+
+def cross_entropy_with_selfnorm_cost(input, label, softmax_selfnorm_alpha=0.1, name=None):
+    return _cost2(
+        "cross_entropy_with_selfnorm",
+        input,
+        label,
+        name=name,
+        softmax_selfnorm_alpha=softmax_selfnorm_alpha,
+    )
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None):
+    return _cost2("multi_binary_label_cross_entropy", input, label, name=name)
+
+
+def soft_binary_class_cross_entropy_cost(input, label, name=None):
+    return _cost2("soft_binary_class_cross_entropy", input, label, name=name)
+
+
+def square_error_cost(input, label, name=None):
+    return _cost2("square_error", input, label, name=name)
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def smooth_l1_cost(input, label, name=None):
+    return _cost2("smooth_l1", input, label, name=name)
+
+
+def huber_regression_cost(input, label, delta=1.0, name=None):
+    return _cost2("huber_regression", input, label, name=name, delta=delta)
+
+
+def huber_classification_cost(input, label, name=None):
+    return _cost2("huber_classification", input, label, name=name)
+
+
+def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput, name=None):
+    conf = LayerConf(
+        name=name or auto_name("rank_cost"),
+        type="rank_cost",
+        size=1,
+        inputs=(left.name, right.name, label.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [left, right, label])
+
+
+def sum_cost(input: LayerOutput, name=None):
+    return _unary("sum_cost", input, size=1, name=name)
+
+
+# ---------------------------------------------------------------------------
+# sequence layers
+# ---------------------------------------------------------------------------
+
+
+def pooling(
+    input: LayerOutput,
+    pooling_type=None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Pool a sequence over time (reference pooling_layer → SequencePoolLayer)."""
+    conf = LayerConf(
+        name=name or auto_name("seqpool"),
+        type="seqpool",
+        size=input.size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"pool_type": pool_name(pooling_type)},
+    )
+    return LayerOutput(conf, [input])
+
+
+pooling_layer = pooling
+
+
+def last_seq(input: LayerOutput, name: Optional[str] = None) -> LayerOutput:
+    return _unary("seqlastins", input, name=name, select_first=False)
+
+
+def first_seq(input: LayerOutput, name: Optional[str] = None) -> LayerOutput:
+    return _unary("seqlastins", input, name=name, select_first=True)
+
+
+def expand(
+    input: LayerOutput, expand_as: LayerOutput, name: Optional[str] = None
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("expand"),
+        type="expand",
+        size=input.size,
+        inputs=(input.name, expand_as.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [input, expand_as])
+
+
+expand_layer = expand
+
+
+def seq_reshape(input: LayerOutput, reshape_size: int, name=None) -> LayerOutput:
+    return _unary("seqreshape", input, size=reshape_size, name=name)
+
+
+seq_reshape_layer = seq_reshape
+
+
+def seq_concat(a: LayerOutput, b: LayerOutput, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("seqconcat"),
+        type="seqconcat",
+        size=a.size,
+        inputs=(a.name, b.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [a, b])
+
+
+seq_concat_layer = seq_concat
+
+
+def lstmemory(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    reverse: bool = False,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    bias_attr=True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference lstmemory (layers.py): input must be pre-projected to 4×size
+    (typically by an fc/mixed layer)."""
+    size = size or input.size // 4
+    assert input.size == 4 * size, (
+        f"lstmemory input size {input.size} must be 4*size ({4 * size})"
+    )
+    conf = LayerConf(
+        name=name or auto_name("lstmemory"),
+        type="lstmemory",
+        size=size,
+        inputs=(input.name,),
+        bias=bool(bias_attr),
+        attrs={
+            "reverse": reverse,
+            "active_type": act_name(act if act is not None else _act_mod.Tanh()),
+            "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+            "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+def grumemory(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    reverse: bool = False,
+    act=None,
+    gate_act=None,
+    bias_attr=True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference grumemory: input pre-projected to 3×size."""
+    size = size or input.size // 3
+    assert input.size == 3 * size
+    conf = LayerConf(
+        name=name or auto_name("gru"),
+        type="gru",
+        size=size,
+        inputs=(input.name,),
+        bias=bool(bias_attr),
+        attrs={
+            "reverse": reverse,
+            "active_type": act_name(act if act is not None else _act_mod.Tanh()),
+            "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+def recurrent(
+    input: LayerOutput,
+    act=None,
+    reverse: bool = False,
+    bias_attr=True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("recurrent"),
+        type="recurrent",
+        size=input.size,
+        inputs=(input.name,),
+        act=act_name(act if act is not None else _act_mod.Tanh()),
+        bias=bool(bias_attr),
+        attrs={"reverse": reverse},
+    )
+    return LayerOutput(conf, [input])
+
+
+recurrent_layer = recurrent
+
+
+def sampling_id(input: LayerOutput, name=None) -> LayerOutput:
+    return _unary("sampling_id", input, size=1, name=name)
+
+
+sampling_id_layer = sampling_id
+
+
+def eos(input: LayerOutput, eos_id: int, name=None) -> LayerOutput:
+    return _unary("eos_id", input, size=1, name=name, eos_id=eos_id)
+
+
+eos_layer = eos
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,118 @@
+"""Layer implementation registry.
+
+Replaces the reference's ``Layer::create`` + ``REGISTER_LAYER`` machinery
+(reference: paddle/gserver/layers/Layer.h:31,348,452).  A layer here is not a
+stateful C++ object but a pair of pure functions:
+
+  * ``init(conf, in_confs, rng) -> params``   — build the parameter pytree
+  * ``apply(conf, params, inputs, ctx) -> SeqTensor`` — trace the forward op
+
+``apply`` runs under ``jax.jit`` tracing; there is no per-layer dispatch at
+execution time, and the backward pass is derived by ``jax.grad`` over the
+whole network instead of per-layer ``backward`` methods.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import LayerConf
+
+
+@dataclasses.dataclass
+class ApplyContext:
+    """Trace-time context threaded through layer application."""
+
+    train: bool
+    rng: Optional[jax.Array] = None  # folded per-layer for dropout etc.
+    # All layer outputs computed so far (lets agent/memory layers peek).
+    outputs: Dict[str, SeqTensor] = dataclasses.field(default_factory=dict)
+    # Non-trainable per-layer state (e.g. batch-norm moving stats): read from
+    # `state`, write updates into `new_state` (functional, no mutation).
+    state: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+    new_state: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
+    # Default parameter dtype for compute (bfloat16-friendly).
+    dtype: Any = jnp.float32
+
+    def layer_rng(self, name: str) -> Optional[jax.Array]:
+        if self.rng is None:
+            return None
+        return jax.random.fold_in(self.rng, hash(name) & 0x7FFFFFFF)
+
+
+InitFn = Callable[[LayerConf, List[LayerConf], jax.Array], Dict[str, Any]]
+ApplyFn = Callable[
+    [LayerConf, Dict[str, Any], List[SeqTensor], ApplyContext], SeqTensor
+]
+
+
+StateInitFn = Callable[[LayerConf, List[LayerConf]], Dict[str, Any]]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerImpl:
+    type: str
+    init: InitFn
+    apply: ApplyFn
+    # Builds initial non-trainable state (moving stats); None = stateless.
+    init_state: Optional[StateInitFn] = None
+    # If True the compiler applies conf.act after `apply`; cost layers and
+    # layers that handle activation internally opt out.
+    auto_activation: bool = True
+    # If True the compiler applies dropout (conf.drop_rate) after activation.
+    auto_dropout: bool = True
+
+
+_LAYERS: Dict[str, LayerImpl] = {}
+
+
+def no_params(conf, in_confs, rng) -> Dict[str, Any]:
+    return {}
+
+
+def register_layer(
+    type_name: str,
+    init: Optional[InitFn] = None,
+    *,
+    init_state: Optional[StateInitFn] = None,
+    auto_activation: bool = True,
+    auto_dropout: bool = True,
+):
+    """Decorator over the apply function:
+
+        @register_layer("fc", init=fc_init)
+        def fc_apply(conf, params, inputs, ctx): ...
+    """
+
+    def deco(apply: ApplyFn) -> ApplyFn:
+        if type_name in _LAYERS:
+            raise ValueError(f"duplicate layer type {type_name!r}")
+        _LAYERS[type_name] = LayerImpl(
+            type=type_name,
+            init=init or no_params,
+            apply=apply,
+            init_state=init_state,
+            auto_activation=auto_activation,
+            auto_dropout=auto_dropout,
+        )
+        return apply
+
+    return deco
+
+
+def get_layer_impl(type_name: str) -> LayerImpl:
+    try:
+        return _LAYERS[type_name]
+    except KeyError:
+        raise KeyError(
+            f"unknown layer type {type_name!r}; registered: {sorted(_LAYERS)}"
+        ) from None
+
+
+def registered_layer_types() -> List[str]:
+    return sorted(_LAYERS)

@@ -1,0 +1,323 @@
+"""Core layer implementations: data, fc, embedding, addto, concat, scaling,
+slope_intercept, interpolation, sum_to_one_norm, row_l2_norm, maxid, multiplex.
+
+Reference counterparts live in paddle/gserver/layers/ (FullyConnectedLayer.cpp,
+TableProjection.cpp, AddtoLayer.cpp, ConcatenateLayer.cpp, ScalingLayer.cpp,
+SlopeInterceptLayer.cpp, InterpolationLayer.cpp, NormLayer.cpp, MaxIdLayer.cpp,
+MultiplexLayer.cpp).  Here each is a pure jnp trace; matmuls map onto the MXU
+and elementwise ops fuse into them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import LayerConf
+from paddle_tpu.layers.base import ApplyContext, register_layer
+
+
+def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
+    """Collapse trailing dims: [B, ...] -> [B, prod(...)] (the reference keeps
+    everything logically flat between layers, Matrix rows = batch)."""
+    if x.ndim == 2:
+        return x
+    return x.reshape(x.shape[0], -1)
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+
+@register_layer("data")
+def data_apply(conf, params, inputs, ctx):  # pragma: no cover - handled by compiler
+    raise RuntimeError("data layers are fed directly by the compiler")
+
+
+# ---------------------------------------------------------------------------
+# fc — FullyConnectedLayer.cpp; one weight per input, shared bias
+# ---------------------------------------------------------------------------
+
+
+def fc_init(conf: LayerConf, in_confs: List[LayerConf], rng) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    for i, ic in enumerate(in_confs):
+        std = conf.attr("param_std")
+        params[f"w{i}"] = init.normal(
+            jax.random.fold_in(rng, i), (ic.size, conf.size), std
+        )
+    if conf.bias:
+        params["b"] = init.zeros((conf.size,))
+    return params
+
+
+@register_layer("fc", init=fc_init)
+def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTensor:
+    acc = None
+    lengths = None
+    for i, t in enumerate(inputs):
+        x = t.data
+        if t.is_seq:
+            lengths = t.lengths
+            if x.ndim > 3:
+                x = x.reshape(x.shape[0], x.shape[1], -1)
+        else:
+            x = _flat2d(x)
+        y = jnp.matmul(x, params[f"w{i}"])
+        acc = y if acc is None else acc + y
+    if "b" in params:
+        acc = acc + params["b"]
+    return SeqTensor(acc, lengths)
+
+
+# ---------------------------------------------------------------------------
+# embedding — TableProjection / table_projection (embedding_layer in DSL)
+# ---------------------------------------------------------------------------
+
+
+def embedding_init(conf, in_confs, rng):
+    vocab = in_confs[0].size
+    std = conf.attr("param_std")
+    return {"w": init.normal(rng, (vocab, conf.size), std)}
+
+
+@register_layer("embedding", init=embedding_init)
+def embedding_apply(conf, params, inputs, ctx):
+    ids = inputs[0]
+    idx = ids.data.astype(jnp.int32)
+    if idx.ndim >= 2 and idx.shape[-1] == 1:
+        idx = idx[..., 0]
+    out = jnp.take(params["w"], idx, axis=0)
+    return SeqTensor(out, ids.lengths)
+
+
+# ---------------------------------------------------------------------------
+# addto — AddtoLayer.cpp: elementwise sum of equally-sized inputs (+ bias)
+# ---------------------------------------------------------------------------
+
+
+def addto_init(conf, in_confs, rng):
+    return {"b": init.zeros((conf.size,))} if conf.bias else {}
+
+
+@register_layer("addto", init=addto_init)
+def addto_apply(conf, params, inputs, ctx):
+    acc = inputs[0].data
+    for t in inputs[1:]:
+        acc = acc + t.data
+    if "b" in params:
+        acc = acc + params["b"]
+    return SeqTensor(acc, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# concat — ConcatenateLayer.cpp: feature-axis concat
+# ---------------------------------------------------------------------------
+
+
+@register_layer("concat")
+def concat_apply(conf, params, inputs, ctx):
+    datas = []
+    lengths = None
+    for t in inputs:
+        x = t.data
+        if t.is_seq:
+            lengths = t.lengths
+        elif x.ndim > 2:
+            x = _flat2d(x)
+        datas.append(x)
+    return SeqTensor(jnp.concatenate(datas, axis=-1), lengths)
+
+
+# ---------------------------------------------------------------------------
+# scaling — ScalingLayer.cpp: y = weight_scalar_per_row * x
+# ---------------------------------------------------------------------------
+
+
+@register_layer("scaling")
+def scaling_apply(conf, params, inputs, ctx):
+    w, x = inputs  # w: [B,1], x: [B,D]
+    return x.with_data(x.data * w.data)
+
+
+# ---------------------------------------------------------------------------
+# slope_intercept — SlopeInterceptLayer.cpp: y = slope * x + intercept
+# ---------------------------------------------------------------------------
+
+
+@register_layer("slope_intercept")
+def slope_intercept_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    slope = conf.attr("slope", 1.0)
+    intercept = conf.attr("intercept", 0.0)
+    return x.with_data(slope * x.data + intercept)
+
+
+# ---------------------------------------------------------------------------
+# interpolation — InterpolationLayer.cpp: y = w*x1 + (1-w)*x2
+# ---------------------------------------------------------------------------
+
+
+@register_layer("interpolation")
+def interpolation_apply(conf, params, inputs, ctx):
+    w, x1, x2 = inputs  # w: [B,1]
+    lam = w.data
+    return x1.with_data(lam * x1.data + (1.0 - lam) * x2.data)
+
+
+# ---------------------------------------------------------------------------
+# sum_to_one_norm / row_l2_norm — NormLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+@register_layer("sum_to_one_norm")
+def sum_to_one_norm_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    s = jnp.sum(x.data, axis=-1, keepdims=True)
+    return x.with_data(x.data / jnp.where(s == 0, 1.0, s))
+
+
+@register_layer("row_l2_norm")
+def row_l2_norm_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    n = jnp.linalg.norm(x.data, axis=-1, keepdims=True)
+    return x.with_data(x.data / jnp.maximum(n, 1e-12))
+
+
+# ---------------------------------------------------------------------------
+# maxid — MaxIdLayer.cpp: argmax over features
+# ---------------------------------------------------------------------------
+
+
+@register_layer("maxid")
+def maxid_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    return SeqTensor(
+        jnp.argmax(x.data, axis=-1).astype(jnp.int32), x.lengths
+    )
+
+
+# ---------------------------------------------------------------------------
+# multiplex — MultiplexLayer.cpp: per-row select among inputs by index input
+# ---------------------------------------------------------------------------
+
+
+@register_layer("multiplex")
+def multiplex_apply(conf, params, inputs, ctx):
+    sel = inputs[0].data.astype(jnp.int32).reshape(-1)  # [B]
+    stacked = jnp.stack([t.data for t in inputs[1:]], axis=0)  # [K, B, D]
+    return SeqTensor(stacked[sel, jnp.arange(sel.shape[0])], inputs[1].lengths)
+
+
+# ---------------------------------------------------------------------------
+# trans — TransLayer.cpp: matrix transpose of the feature block
+# ---------------------------------------------------------------------------
+
+
+@register_layer("trans")
+def trans_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    h = conf.attr("height")
+    b = x.data.shape[0]
+    m = x.data.reshape(b, h, -1)
+    return SeqTensor(jnp.swapaxes(m, 1, 2).reshape(b, -1), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# resize — ResizeLayer.cpp: reshape rows to a new width
+# ---------------------------------------------------------------------------
+
+
+@register_layer("resize")
+def resize_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    return SeqTensor(x.data.reshape(-1, conf.size), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# clip — ClipLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+@register_layer("clip")
+def clip_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    return x.with_data(
+        jnp.clip(x.data, conf.attr("min", -1.0), conf.attr("max", 1.0))
+    )
+
+
+# ---------------------------------------------------------------------------
+# dotmul — DotMulOperator/DotMulProjection: elementwise product
+# ---------------------------------------------------------------------------
+
+
+def dotmul_init(conf, in_confs, rng):
+    # dotmul projection owns a [1, D] scale vector.
+    if conf.attr("projection", False):
+        return {"w": init.normal(rng, (conf.size,), 1.0 / max(conf.size, 1))}
+    return {}
+
+
+@register_layer("dotmul", init=dotmul_init)
+def dotmul_apply(conf, params, inputs, ctx):
+    if "w" in params:
+        x = inputs[0]
+        return x.with_data(x.data * params["w"])
+    a, b = inputs
+    return a.with_data(a.data * b.data)
+
+
+# ---------------------------------------------------------------------------
+# out_prod — OuterProdLayer.cpp: per-row outer product flattened
+# ---------------------------------------------------------------------------
+
+
+@register_layer("out_prod")
+def out_prod_apply(conf, params, inputs, ctx):
+    a, b = inputs
+    out = jnp.einsum("bi,bj->bij", a.data, b.data)
+    return SeqTensor(out.reshape(out.shape[0], -1), a.lengths)
+
+
+# ---------------------------------------------------------------------------
+# cos — CosSimLayer.cpp: row-wise cosine similarity * scale
+# ---------------------------------------------------------------------------
+
+
+@register_layer("cos")
+def cos_apply(conf, params, inputs, ctx):
+    a, b = inputs
+    scale = conf.attr("scale", 1.0)
+    num = jnp.sum(a.data * b.data, axis=-1, keepdims=True)
+    den = jnp.linalg.norm(a.data, axis=-1, keepdims=True) * jnp.linalg.norm(
+        b.data, axis=-1, keepdims=True
+    )
+    return SeqTensor(scale * num / jnp.maximum(den, 1e-12), a.lengths)
+
+
+# ---------------------------------------------------------------------------
+# tensor — TensorLayer.cpp: y_k = x1 W_k x2^T (bilinear)
+# ---------------------------------------------------------------------------
+
+
+def tensor_init(conf, in_confs, rng):
+    d1, d2 = in_confs[0].size, in_confs[1].size
+    p = {"w": init.normal(rng, (conf.size, d1, d2), init.default_std(d1))}
+    if conf.bias:
+        p["b"] = init.zeros((conf.size,))
+    return p
+
+
+@register_layer("tensor", init=tensor_init)
+def tensor_apply(conf, params, inputs, ctx):
+    a, b = inputs
+    out = jnp.einsum("bi,kij,bj->bk", a.data, params["w"], b.data)
+    if "b" in params:
+        out = out + params["b"]
+    return SeqTensor(out, a.lengths)

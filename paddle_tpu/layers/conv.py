@@ -1,0 +1,288 @@
+"""Image layers: conv, conv-transpose, pooling, batch_norm, maxout, pad, crop,
+bilinear_interp, spp.
+
+Reference counterparts: paddle/gserver/layers/{ExpandConvLayer,CudnnConvLayer,
+PoolLayer,CudnnPoolLayer,BatchNormalizationLayer,MaxOutLayer,PadLayer,CropLayer,
+BilinearInterpLayer,SpatialPyramidPoolLayer}.cpp and the hl_cnn.h HAL kernels.
+
+TPU-native design: tensors flow NHWC (the layout XLA tiles best onto the MXU
+for convolutions), whereas the reference flattens NCHW rows between layers.
+A flat [B, C*H*W] input (e.g. straight from a data layer) is reshaped
+CHW-order — matching the reference's memory layout — then transposed to NHWC
+once; conv chains stay 4D throughout.  ``lax.conv_general_dilated`` handles
+conv/conv-transpose, ``lax.reduce_window`` pooling; XLA fuses bias/activation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+
+
+def to_nhwc(x: jnp.ndarray, h: int, w: int, c: int) -> jnp.ndarray:
+    """Accept [B, C*H*W] flat (CHW order) or already-4D NHWC."""
+    if x.ndim == 4:
+        return x
+    b = x.shape[0]
+    return x.reshape(b, c, h, w).transpose(0, 2, 3, 1)
+
+
+# ---------------------------------------------------------------------------
+# conv / convt
+# ---------------------------------------------------------------------------
+
+
+def conv_init(conf, in_confs, rng) -> Dict[str, Any]:
+    a = conf.attrs
+    kh, kw = a["filter_h"], a["filter_w"]
+    cin, cout = a["in_c"], a["channels"]
+    groups = a.get("groups", 1)
+    if conf.type == "convt":
+        shape = (kh, kw, cout // groups, cin)  # transpose conv: out feature dim
+        w = init.normal(rng, shape, init.default_std(kh * kw * max(cin // groups, 1)))
+    else:
+        shape = (kh, kw, cin // groups, cout)
+        w = init.conv_normal(rng, shape)
+    p = {"w": w}
+    if conf.bias:
+        p["b"] = init.zeros((cout,))
+    return p
+
+
+@register_layer("conv", init=conv_init)
+def conv_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    out = lax.conv_general_dilated(
+        x,
+        params["w"],
+        window_strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
+        padding=[
+            (a.get("pad_h", 0), a.get("pad_h", 0)),
+            (a.get("pad_w", 0), a.get("pad_w", 0)),
+        ],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=a.get("groups", 1),
+    )
+    if "b" in params:
+        out = out + params["b"]
+    return SeqTensor(out, inputs[0].lengths)
+
+
+@register_layer("convt", init=conv_init)
+def convt_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    out = lax.conv_transpose(
+        x,
+        params["w"],
+        strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
+        padding=[
+            (a.get("pad_h", 0), a.get("pad_h", 0)),
+            (a.get("pad_w", 0), a.get("pad_w", 0)),
+        ],
+        dimension_numbers=("NHWC", "HWOI", "NHWC"),
+        transpose_kernel=True,
+    )
+    if "b" in params:
+        out = out + params["b"]
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# pool (max / avg), global variants
+# ---------------------------------------------------------------------------
+
+
+@register_layer("pool")
+def pool_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    kh, kw = a["filter_h"], a["filter_w"]
+    sh, sw = a.get("stride_h", 1), a.get("stride_w", 1)
+    ph, pw = a.get("pad_h", 0), a.get("pad_w", 0)
+    kind = a.get("pool_type", "max")
+    window = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    if kind.startswith("max"):
+        out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        # Average over the true window size incl. padding contribution,
+        # matching the reference's avg pooling (hl_cnn.h avgpool counts the
+        # full k*k window).
+        out = summed / float(kh * kw)
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# batch_norm — running stats live in layer state; train uses batch stats
+# ---------------------------------------------------------------------------
+
+
+def bn_init(conf, in_confs, rng):
+    c = conf.attrs["channels"]
+    return {"scale": init.ones((c,)), "beta": init.zeros((c,))}
+
+
+def bn_init_state(conf, in_confs):
+    c = conf.attrs["channels"]
+    return {"mean": init.zeros((c,)), "var": init.ones((c,))}
+
+
+@register_layer("batch_norm", init=bn_init, init_state=bn_init_state)
+def batch_norm_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    eps = a.get("epsilon", 1e-5)
+    momentum = a.get("moving_average_fraction", 0.9)
+    img = a.get("in_h") is not None
+    x = inputs[0].data
+    if img:
+        x = to_nhwc(x, a["in_h"], a["in_w"], a["channels"])
+        axes = (0, 1, 2)
+    else:
+        axes = (0,)
+    st = ctx.state.get(conf.name, {})
+    use_global = (not ctx.train) or a.get("use_global_stats", False)
+    if use_global and st:
+        mean, var = st["mean"], st["var"]
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        if ctx.train and st:
+            ctx.new_state[conf.name] = {
+                "mean": momentum * st["mean"] + (1 - momentum) * mean,
+                "var": momentum * st["var"] + (1 - momentum) * var,
+            }
+    inv = lax.rsqrt(var + eps)
+    out = (x - mean) * inv * params["scale"] + params["beta"]
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# maxout — MaxOutLayer.cpp: max over groups of channels
+# ---------------------------------------------------------------------------
+
+
+@register_layer("maxout")
+def maxout_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    g = a["groups"]
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    b, h, w, c = x.shape
+    out = jnp.max(x.reshape(b, h, w, c // g, g), axis=-1)
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# pad — PadLayer.cpp: zero-pad C/H/W
+# ---------------------------------------------------------------------------
+
+
+@register_layer("pad")
+def pad_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    pc, ph, pw = a.get("pad_c", (0, 0)), a.get("pad_h_pair", (0, 0)), a.get(
+        "pad_w_pair", (0, 0)
+    )
+    out = jnp.pad(x, ((0, 0), tuple(ph), tuple(pw), tuple(pc)))
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# crop — CropLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+@register_layer("crop")
+def crop_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    oh, ow = a["out_h"], a["out_w"]
+    oc = a.get("out_c", a["in_c"])
+    offh, offw = a.get("offset_h", 0), a.get("offset_w", 0)
+    offc = a.get("offset_c", 0)
+    out = x[:, offh : offh + oh, offw : offw + ow, offc : offc + oc]
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# bilinear_interp — BilinearInterpLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+@register_layer("bilinear_interp")
+def bilinear_interp_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    b, h, w, c = x.shape
+    oh, ow = a["out_h"], a["out_w"]
+    out = jax.image.resize(x, (b, oh, ow, c), method="bilinear")
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# spp — SpatialPyramidPoolLayer.cpp: pyramid of pools concatenated
+# ---------------------------------------------------------------------------
+
+
+@register_layer("spp")
+def spp_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    b, h, w, c = x.shape
+    levels = a.get("pyramid_height", 3)
+    kind = a.get("pool_type", "max")
+    outs = []
+    for lvl in range(levels):
+        bins = 2**lvl
+        # Split H/W into `bins` cells via strided reduce_window.
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        pad_h = kh * bins - h
+        pad_w = kw * bins - w
+        if kind.startswith("max"):
+            xp = jnp.pad(
+                x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+                constant_values=-jnp.inf,
+            )
+            pooled = lax.reduce_window(
+                xp, -jnp.inf, lax.max, (1, kh, kw, 1), (1, kh, kw, 1), "VALID"
+            )
+        else:
+            xp = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+            pooled = (
+                lax.reduce_window(
+                    xp, 0.0, lax.add, (1, kh, kw, 1), (1, kh, kw, 1), "VALID"
+                )
+                / (kh * kw)
+            )
+        outs.append(pooled.reshape(b, -1))
+    return SeqTensor(jnp.concatenate(outs, axis=-1), inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# featmap_expand — FeatureMapExpandLayer.cpp
+# ---------------------------------------------------------------------------
+
+
+@register_layer("featmap_expand")
+def featmap_expand_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    num_filters = conf.attrs["num_filters"]
+    as_row = conf.attrs.get("as_row_vector", True)
+    b = x.data.shape[0]
+    flat = x.data.reshape(b, -1)
+    if as_row:
+        out = jnp.tile(flat[:, None, :], (1, num_filters, 1)).reshape(b, -1)
+    else:
+        out = jnp.tile(flat[:, :, None], (1, 1, num_filters)).reshape(b, -1)
+    return SeqTensor(out, x.lengths)

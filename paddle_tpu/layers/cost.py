@@ -1,0 +1,170 @@
+"""Cost layers — reference: paddle/gserver/layers/CostLayer.cpp (cross-entropy
+family, SumOfSquaresCostLayer, HuberCost, RankingCost, SmoothL1Cost, SumCost).
+
+Every cost layer emits a per-sample cost column [B, 1]; the train step takes
+the batch mean (the reference sums per-sample costs then divides by batch,
+trainer/TrainerInternal.cpp:131 Argument::sum).  Sequence costs mask padding
+and sum over valid timesteps.  jax.grad over the mean replaces each cost
+layer's hand-written backward.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+
+_EPS = 1e-10
+
+
+def _per_sample(cost: jnp.ndarray, tensor: SeqTensor) -> SeqTensor:
+    """Reduce a per-timestep cost [B, T] to per-*token-summed* [B, 1] with
+    masking, or pass through [B] -> [B, 1]."""
+    if tensor.is_seq and cost.ndim == 2:
+        cost = jnp.sum(cost * tensor.mask(cost.dtype), axis=1)
+    return SeqTensor(cost[:, None])
+
+
+def _label_ids(label: SeqTensor) -> jnp.ndarray:
+    ids = label.data.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return ids
+
+
+# ---------------------------------------------------------------------------
+
+
+@register_layer("cross_entropy", auto_activation=False)
+def cross_entropy_apply(conf, params, inputs, ctx):
+    """-log p[label]; input is a probability distribution (softmax output),
+    reference MultiClassCrossEntropy (CostLayer.cpp).  When the producing
+    layer's activation was softmax, the compiler exposes its pre-activation
+    as `<name>@logits` and we fuse into log-softmax CE instead (stable, one
+    less kernel)."""
+    prob, label = inputs[0], inputs[1]
+    ids = _label_ids(label)
+    logits = ctx.outputs.get(conf.inputs[0] + "@logits")
+    if logits is not None:
+        logp = jax.nn.log_softmax(logits.data, axis=-1)
+        cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+        return _per_sample(cost, prob)
+    p = jnp.take_along_axis(prob.data, ids[..., None], axis=-1)[..., 0]
+    cost = -jnp.log(jnp.maximum(p, _EPS))
+    return _per_sample(cost, prob)
+
+
+@register_layer("softmax_with_cost", auto_activation=False)
+def softmax_with_cost_apply(conf, params, inputs, ctx):
+    """Fused log-softmax cross-entropy from *logits* — numerically stable
+    TPU-native fast path the DSL uses for classification_cost when the input
+    activation is softmax (fuses the reference's softmax + cross_entropy
+    pair into one lax reduction)."""
+    logits, label = inputs[0], inputs[1]
+    ids = _label_ids(label)
+    logp = jax.nn.log_softmax(logits.data, axis=-1)
+    cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
+    return _per_sample(cost, logits)
+
+
+@register_layer("soft_binary_class_cross_entropy", auto_activation=False)
+def soft_bce_apply(conf, params, inputs, ctx):
+    """Per-dim BCE with soft targets (SoftBinaryClassCrossEntropy)."""
+    prob, label = inputs[0], inputs[1]
+    p = jnp.clip(prob.data, _EPS, 1.0 - _EPS)
+    t = label.data
+    cost = -jnp.sum(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p), axis=-1)
+    return _per_sample(cost, prob)
+
+
+@register_layer("multi_binary_label_cross_entropy", auto_activation=False)
+def multi_binary_label_ce_apply(conf, params, inputs, ctx):
+    """BCE where the label is a multi-hot vector (MultiBinaryLabelCrossEntropy).
+    The label slot arrives densified to multi-hot [B, D] by the feeder."""
+    prob, label = inputs[0], inputs[1]
+    p = jnp.clip(prob.data, _EPS, 1.0 - _EPS)
+    t = label.data
+    cost = -jnp.sum(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p), axis=-1)
+    return _per_sample(cost, prob)
+
+
+@register_layer("square_error", auto_activation=False)
+def square_error_apply(conf, params, inputs, ctx):
+    """0.5 * sum((x - y)^2) per sample (SumOfSquaresCostLayer)."""
+    x, y = inputs[0], inputs[1]
+    d = x.data - y.data
+    cost = 0.5 * jnp.sum(jnp.square(d), axis=-1)
+    return _per_sample(cost, x)
+
+
+@register_layer("smooth_l1", auto_activation=False)
+def smooth_l1_apply(conf, params, inputs, ctx):
+    """SmoothL1Cost: 0.5 d^2 if |d|<1 else |d|-0.5, summed per sample."""
+    x, y = inputs[0], inputs[1]
+    d = x.data - y.data
+    a = jnp.abs(d)
+    cost = jnp.sum(jnp.where(a < 1.0, 0.5 * d * d, a - 0.5), axis=-1)
+    return _per_sample(cost, x)
+
+
+@register_layer("huber_regression", auto_activation=False)
+def huber_regression_apply(conf, params, inputs, ctx):
+    delta = conf.attr("delta", 1.0)
+    x, y = inputs[0], inputs[1]
+    a = jnp.abs(x.data - y.data)
+    cost = jnp.sum(
+        jnp.where(a <= delta, 0.5 * a * a, delta * (a - 0.5 * delta)), axis=-1
+    )
+    return _per_sample(cost, x)
+
+
+@register_layer("huber_classification", auto_activation=False)
+def huber_classification_apply(conf, params, inputs, ctx):
+    """HuberTwoClassification: labels {0,1} -> y in {-1,+1},
+    cost = 0 if y*f>1, (1-y*f)^2 if -1<=y*f<=1, -4*y*f if y*f<-1."""
+    x, label = inputs[0], inputs[1]
+    f = x.data[..., 0] if x.data.ndim >= 2 else x.data
+    y = 2.0 * _label_ids(label).astype(f.dtype) - 1.0
+    z = y * f
+    cost = jnp.where(z > 1.0, 0.0, jnp.where(z < -1.0, -4.0 * z, jnp.square(1.0 - z)))
+    return _per_sample(cost, x)
+
+
+@register_layer("rank_cost", auto_activation=False)
+def rank_cost_apply(conf, params, inputs, ctx):
+    """RankingCost: pairwise logistic loss on score difference
+    (CostLayer.cpp RankingCost::forwardImp)."""
+    left, right, label = inputs[0], inputs[1], inputs[2]
+    o = left.data[..., 0] - right.data[..., 0]
+    t = label.data
+    t = t[..., 0] if t.ndim >= 2 else t
+    t = t.astype(o.dtype)
+    cost = jax.nn.softplus(o) - t * o
+    return _per_sample(cost, left)
+
+
+@register_layer("sum_cost", auto_activation=False)
+def sum_cost_apply(conf, params, inputs, ctx):
+    """SumCostLayer: cost = sum of input row."""
+    x = inputs[0]
+    cost = jnp.sum(x.data, axis=-1)
+    if x.is_seq:
+        cost = jnp.sum(cost * x.mask(cost.dtype), axis=-1) if cost.ndim == 2 else cost
+    return _per_sample(cost, x)
+
+
+@register_layer("cross_entropy_with_selfnorm", auto_activation=False)
+def ce_selfnorm_apply(conf, params, inputs, ctx):
+    """MultiClassCrossEntropyWithSelfNorm: CE + alpha * log(Z)^2 where Z is
+    the row sum of the (softmax) output."""
+    prob, label = inputs[0], inputs[1]
+    alpha = conf.attr("softmax_selfnorm_alpha", 0.1)
+    ids = _label_ids(label)
+    z = jnp.sum(prob.data, axis=-1)
+    p = jnp.take_along_axis(prob.data, ids[..., None], axis=-1)[..., 0] / jnp.maximum(
+        z, _EPS
+    )
+    cost = -jnp.log(jnp.maximum(p, _EPS)) + alpha * jnp.square(jnp.log(jnp.maximum(z, _EPS)))
+    return _per_sample(cost, prob)

@@ -1,0 +1,272 @@
+"""Sequence layers: pooling over time, first/last instance, expand, lstmemory,
+gru, simple recurrent, seqreshape, seqconcat, sampling_id, eos detection.
+
+Reference counterparts: paddle/gserver/layers/{SequencePoolLayer,
+SequenceLastInstanceLayer,ExpandLayer,LstmLayer,GatedRecurrentLayer,
+RecurrentLayer,SequenceReshapeLayer,SequenceConcatLayer,SamplingIdLayer,
+EosIdCheckLayer}.cpp.
+
+All operate on padded [B, T, ...] SeqTensors with length masks instead of the
+reference's CSR `sequenceStartPositions` (Argument.h:84).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+from paddle_tpu.ops import rnn as rnn_ops
+
+
+# ---------------------------------------------------------------------------
+# sequence pooling — SequencePoolLayer (max/average/sum/sqrt_n over time)
+# ---------------------------------------------------------------------------
+
+
+@register_layer("seqpool")
+def seqpool_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq, f"{conf.name}: seqpool input must be a sequence"
+    kind = conf.attr("pool_type", "max")
+    m = x.mask(x.data.dtype)[..., None]  # [B, T, 1]
+    if kind == "max":
+        data = jnp.where(m > 0, x.data, -jnp.inf)
+        out = jnp.max(data, axis=1)
+        # all-padding rows (len 0) -> 0
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    else:
+        s = jnp.sum(x.data * m, axis=1)
+        if kind == "sum":
+            out = s
+        else:
+            n = jnp.maximum(x.lengths.astype(x.data.dtype), 1.0)[:, None]
+            if kind == "sqrt_n":
+                out = s / jnp.sqrt(n)
+            else:  # average
+                out = s / n
+    return SeqTensor(out)
+
+
+# ---------------------------------------------------------------------------
+# last / first instance — SequenceLastInstanceLayer (select_first flag)
+# ---------------------------------------------------------------------------
+
+
+@register_layer("seqlastins")
+def seqlastins_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    if conf.attr("select_first", False):
+        out = x.data[:, 0]
+    else:
+        idx = jnp.maximum(x.lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x.data, idx[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+    return SeqTensor(out)
+
+
+# ---------------------------------------------------------------------------
+# expand — ExpandLayer: broadcast per-sample value across a sequence's steps
+# ---------------------------------------------------------------------------
+
+
+@register_layer("expand")
+def expand_apply(conf, params, inputs, ctx):
+    x, pattern = inputs  # x: [B, D] non-seq; pattern: [B, T, ...] seq
+    assert pattern.is_seq
+    t = pattern.max_len
+    out = jnp.broadcast_to(
+        x.data[:, None, :], (x.data.shape[0], t, x.data.shape[-1])
+    )
+    return SeqTensor(out, pattern.lengths)
+
+
+# ---------------------------------------------------------------------------
+# seqreshape — SequenceReshapeLayer: change feature width, T' = T*D/D'
+# ---------------------------------------------------------------------------
+
+
+@register_layer("seqreshape")
+def seqreshape_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    b, t, d = x.data.shape
+    d2 = conf.size
+    out = x.masked_data().reshape(b, t * d // d2, d2)
+    new_len = (x.lengths * d) // d2
+    return SeqTensor(out, new_len)
+
+
+# ---------------------------------------------------------------------------
+# seqconcat — SequenceConcatLayer: concat two sequences along time
+# ---------------------------------------------------------------------------
+
+
+@register_layer("seqconcat")
+def seqconcat_apply(conf, params, inputs, ctx):
+    a, b = inputs
+    assert a.is_seq and b.is_seq
+    ta = a.max_len
+    # Place b's valid steps right after a's valid steps, per row.
+    total = ta + b.max_len
+    out_len = a.lengths + b.lengths
+    pos = jnp.arange(total, dtype=jnp.int32)[None, :]  # [1, Ttot]
+    from_a = pos < a.lengths[:, None]
+    b_idx = jnp.clip(pos - a.lengths[:, None], 0, b.max_len - 1)
+    a_idx = jnp.clip(pos, 0, ta - 1)
+    ga = jnp.take_along_axis(a.data, a_idx[..., None], axis=1)
+    gb = jnp.take_along_axis(b.data, b_idx[..., None], axis=1)
+    out = jnp.where(from_a[..., None], ga, gb)
+    mask = pos < out_len[:, None]
+    out = out * mask[..., None].astype(out.dtype)
+    return SeqTensor(out, out_len)
+
+
+# ---------------------------------------------------------------------------
+# lstmemory — LstmLayer.cpp: input already projected to 4H by preceding layer
+# ---------------------------------------------------------------------------
+
+
+def lstmemory_init(conf, in_confs, rng):
+    h = conf.size
+    r1, r2 = jax.random.split(rng)
+    p = {"w_h": init.normal(r1, (h, 4 * h))}
+    if conf.bias:
+        # Reference packs gate bias + 3 peephole vectors into one 7H bias
+        # (LstmLayer.cpp bias_ layout); we keep them named.
+        p["b"] = init.zeros((4 * h,))
+        p["w_ci"] = init.normal(jax.random.fold_in(r2, 0), (h,), 1.0)
+        p["w_cf"] = init.normal(jax.random.fold_in(r2, 1), (h,), 1.0)
+        p["w_co"] = init.normal(jax.random.fold_in(r2, 2), (h,), 1.0)
+    return p
+
+
+@register_layer("lstmemory", init=lstmemory_init, auto_activation=False)
+def lstmemory_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq, "lstmemory input must be a sequence"
+    hs, _ = rnn_ops.lstm_scan(
+        x.data,
+        params["w_h"],
+        params.get("b"),
+        params.get("w_ci"),
+        params.get("w_cf"),
+        params.get("w_co"),
+        x.lengths,
+        gate_act=conf.attr("gate_act", "sigmoid"),
+        act=conf.attr("active_type", conf.act or "tanh"),
+        state_act=conf.attr("state_act", "tanh"),
+        reverse=conf.attr("reverse", False),
+    )
+    return SeqTensor(hs, x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# gru — GatedRecurrentLayer.cpp: input projected to 3H
+# ---------------------------------------------------------------------------
+
+
+def gru_init(conf, in_confs, rng):
+    h = conf.size
+    r1, r2 = jax.random.split(rng)
+    p = {
+        "w_h": init.normal(r1, (h, 2 * h)),
+        "w_c": init.normal(r2, (h, h)),
+    }
+    if conf.bias:
+        p["b"] = init.zeros((3 * h,))
+    return p
+
+
+@register_layer("gru", init=gru_init, auto_activation=False)
+def gru_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq, "gru input must be a sequence"
+    hs, _ = rnn_ops.gru_scan(
+        x.data,
+        params["w_h"],
+        params["w_c"],
+        params.get("b"),
+        x.lengths,
+        gate_act=conf.attr("gate_act", "sigmoid"),
+        act=conf.attr("active_type", conf.act or "tanh"),
+        reverse=conf.attr("reverse", False),
+    )
+    return SeqTensor(hs, x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# recurrent — RecurrentLayer.cpp: h_t = act(x_t + W h₋)
+# ---------------------------------------------------------------------------
+
+
+def recurrent_init(conf, in_confs, rng):
+    h = conf.size
+    p = {"w_h": init.normal(rng, (h, h))}
+    if conf.bias:
+        p["b"] = init.zeros((h,))
+    return p
+
+
+@register_layer("recurrent", init=recurrent_init, auto_activation=False)
+def recurrent_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    hs, _ = rnn_ops.simple_rnn_scan(
+        x.data,
+        params["w_h"],
+        params.get("b"),
+        x.lengths,
+        act=conf.act or "tanh",
+        reverse=conf.attr("reverse", False),
+    )
+    return SeqTensor(hs, x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# sampling_id — SamplingIdLayer.cpp: sample an id from each row's distribution
+# ---------------------------------------------------------------------------
+
+
+@register_layer("sampling_id", auto_activation=False)
+def sampling_id_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    rng = ctx.layer_rng(conf.name)
+    if rng is None:
+        out = jnp.argmax(x.data, axis=-1)
+    else:
+        out = jax.random.categorical(rng, jnp.log(jnp.maximum(x.data, 1e-10)), axis=-1)
+    return SeqTensor(out.astype(jnp.int32), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# eos_id — EosIdCheckLayer.cpp: 1 where id == eos
+# ---------------------------------------------------------------------------
+
+
+@register_layer("eos_id", auto_activation=False)
+def eos_id_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    eos = conf.attrs["eos_id"]
+    ids = x.data.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return SeqTensor((ids == eos).astype(jnp.float32), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# subseq/get_output-style helpers
+# ---------------------------------------------------------------------------
+
+
+@register_layer("slice_time")
+def slice_time_apply(conf, params, inputs, ctx):
+    """Take timestep `offset` of a sequence as a non-seq row (used by memory
+    boot and attention wiring)."""
+    x = inputs[0]
+    off = conf.attr("offset", 0)
+    return SeqTensor(x.data[:, off])

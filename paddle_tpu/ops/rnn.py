@@ -1,0 +1,197 @@
+"""Recurrent cells as lax.scan loops — the TPU-native replacement for the
+reference's fused CUDA LSTM/GRU kernels (reference: paddle/cuda/src/
+hl_cuda_lstm.cu, hl_gpu_gru.cuh, consumed by paddle/gserver/layers/
+{LstmLayer,GatedRecurrentLayer}.cpp via SequenceToBatch reordering).
+
+Instead of reordering variable-length sequences into shrinking per-timestep
+batches (SequenceToBatch.h), we keep a fixed [B, T, ...] padded layout and
+scan over T with a carry-through mask: padded steps propagate the previous
+state unchanged.  XLA unrolls the per-step gate math into fused HLO while the
+big input projections (x @ W) stay *outside* the scan as one [B*T] matmul on
+the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.activations import get_activation
+
+
+def _time_major(x):
+    """[B, T, D] -> [T, B, D] for scan."""
+    return jnp.swapaxes(x, 0, 1)
+
+
+def _mask_seq(lengths: Optional[jnp.ndarray], max_len: int, reverse: bool):
+    """[T, B, 1] carry mask; for reverse scans the *flipped* positions are
+    valid when t >= T - len."""
+    if lengths is None:
+        return None
+    t = jnp.arange(max_len, dtype=jnp.int32)[:, None]
+    if reverse:
+        valid = t >= (max_len - lengths[None, :])
+    else:
+        valid = t < lengths[None, :]
+    return valid[..., None]
+
+
+def lstm_scan(
+    gates: jnp.ndarray,  # [B, T, 4H] pre-computed input projections (i,f,g,o)
+    w_h: jnp.ndarray,  # [H, 4H] recurrent weight
+    bias: Optional[jnp.ndarray],  # [4H]
+    w_ci: Optional[jnp.ndarray],  # [H] peephole input-gate
+    w_cf: Optional[jnp.ndarray],  # [H] peephole forget-gate
+    w_co: Optional[jnp.ndarray],  # [H] peephole output-gate
+    lengths: Optional[jnp.ndarray] = None,
+    *,
+    gate_act: str = "sigmoid",
+    act: str = "tanh",
+    state_act: str = "tanh",
+    reverse: bool = False,
+    h0: Optional[jnp.ndarray] = None,
+    c0: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Paddle-v1 LSTM with peepholes (LstmLayer.cpp forwardSequence):
+        i = σ(a_i + w_ci∘c₋)   f = σ(a_f + w_cf∘c₋)
+        c = f∘c₋ + i∘act(a_g)  o = σ(a_o + w_co∘c)   h = o∘state_act(c)
+    Returns ([B, T, H] hidden sequence, (h_last, c_last))."""
+    b, t, g4 = gates.shape
+    h = g4 // 4
+    f_gate = get_activation(gate_act)
+    f_act = get_activation(act)
+    f_state = get_activation(state_act)
+
+    xs = _time_major(gates)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = _mask_seq(lengths, t, reverse)
+
+    h_prev = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
+    c_prev = c0 if c0 is not None else jnp.zeros((b, h), gates.dtype)
+
+    def step(carry, inp):
+        h_p, c_p = carry
+        if mask is None:
+            x_t, m = inp, None
+        else:
+            x_t, m = inp
+        a = x_t + h_p @ w_h
+        if bias is not None:
+            a = a + bias
+        a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)
+        if w_ci is not None:
+            a_i = a_i + w_ci * c_p
+            a_f = a_f + w_cf * c_p
+        i_t = f_gate(a_i)
+        f_t = f_gate(a_f)
+        c_t = f_t * c_p + i_t * f_act(a_g)
+        a_o = a_o + (w_co * c_t if w_co is not None else 0.0)
+        o_t = f_gate(a_o)
+        h_t = o_t * f_state(c_t)
+        if m is not None:
+            h_t = jnp.where(m, h_t, h_p)
+            c_t = jnp.where(m, c_t, c_p)
+        return (h_t, c_t), h_t
+
+    inputs = xs if mask is None else (xs, mask)
+    (h_last, c_last), hs = lax.scan(step, (h_prev, c_prev), inputs)
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return jnp.swapaxes(hs, 0, 1), (h_last, c_last)
+
+
+def gru_scan(
+    gates: jnp.ndarray,  # [B, T, 3H] input projections (u, r, c)
+    w_h: jnp.ndarray,  # [H, 2H] recurrent weight for update+reset
+    w_c: jnp.ndarray,  # [H, H] recurrent weight for candidate
+    bias: Optional[jnp.ndarray],  # [3H]
+    lengths: Optional[jnp.ndarray] = None,
+    *,
+    gate_act: str = "sigmoid",
+    act: str = "tanh",
+    reverse: bool = False,
+    h0: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Paddle-v1 GRU (GatedRecurrentLayer.cpp / hl_cpu_gru.cuh):
+        u = σ(x_u + U_u h₋)   r = σ(x_r + U_r h₋)
+        c = act(x_c + r∘(U_c h₋))
+        h = u∘h₋ + (1-u)∘c
+    Returns ([B, T, H], h_last)."""
+    b, t, g3 = gates.shape
+    h = g3 // 3
+    f_gate = get_activation(gate_act)
+    f_act = get_activation(act)
+
+    xs = _time_major(gates)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = _mask_seq(lengths, t, reverse)
+
+    h_prev = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
+
+    def step(h_p, inp):
+        if mask is None:
+            x_t, m = inp, None
+        else:
+            x_t, m = inp
+        if bias is not None:
+            x_t = x_t + bias
+        x_u, x_r, x_c = jnp.split(x_t, 3, axis=-1)
+        ur = h_p @ w_h
+        u_t = f_gate(x_u + ur[:, :h])
+        r_t = f_gate(x_r + ur[:, h:])
+        c_t = f_act(x_c + r_t * (h_p @ w_c))
+        h_t = u_t * h_p + (1.0 - u_t) * c_t
+        if m is not None:
+            h_t = jnp.where(m, h_t, h_p)
+        return h_t, h_t
+
+    inputs = xs if mask is None else (xs, mask)
+    h_last, hs = lax.scan(step, h_prev, inputs)
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return jnp.swapaxes(hs, 0, 1), h_last
+
+
+def simple_rnn_scan(
+    x: jnp.ndarray,  # [B, T, H] input projections
+    w_h: jnp.ndarray,  # [H, H]
+    bias: Optional[jnp.ndarray],
+    lengths: Optional[jnp.ndarray] = None,
+    *,
+    act: str = "tanh",
+    reverse: bool = False,
+    h0: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Plain recurrence h_t = act(x_t + h₋ W) (RecurrentLayer.cpp)."""
+    b, t, h = x.shape
+    f_act = get_activation(act)
+    xs = _time_major(x)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = _mask_seq(lengths, t, reverse)
+    h_prev = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
+
+    def step(h_p, inp):
+        if mask is None:
+            x_t, m = inp, None
+        else:
+            x_t, m = inp
+        a = x_t + h_p @ w_h
+        if bias is not None:
+            a = a + bias
+        h_t = f_act(a)
+        if m is not None:
+            h_t = jnp.where(m, h_t, h_p)
+        return h_t, h_t
+
+    inputs = xs if mask is None else (xs, mask)
+    h_last, hs = lax.scan(step, h_prev, inputs)
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return jnp.swapaxes(hs, 0, 1), h_last

@@ -1,0 +1,392 @@
+"""Optimizers — TPU-native rebuild of the reference optimizer registry
+(reference: paddle/parameter/FirstOrderOptimizer.h:23-331,
+TrainingAlgorithmOp.cu fused kernels, OptimizerConfig.proto, and the
+``paddle.v2.optimizer`` surface python/paddle/v2/optimizer.py).
+
+Each optimizer is an optax-style pure pair (init, update) over the parameter
+pytree; the whole update runs inside the jitted train step, so XLA fuses it —
+the moral equivalent of the reference's hand-fused TrainingAlgorithmOp.cu
+kernels for free.  Learning-rate schedules mirror
+paddle/parameter/LearningRateScheduler.cpp:43-115.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+OptState = Dict[str, Any]
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]  # step -> lr multiplier
+
+
+# ---------------------------------------------------------------------------
+# learning-rate schedules (LearningRateScheduler.cpp)
+# ---------------------------------------------------------------------------
+
+
+def constant_schedule() -> Schedule:
+    return lambda step: jnp.asarray(1.0, jnp.float32)
+
+
+def poly_schedule(a: float, b: float) -> Schedule:
+    """lr * (1 + gamma*t)^-power — reference "poly" with a=gamma, b=power."""
+    return lambda step: jnp.power(1.0 + a * step, -b)
+
+
+def caffe_poly_schedule(a: float, b: float, max_steps: float) -> Schedule:
+    return lambda step: jnp.power(1.0 - jnp.minimum(step, max_steps) / max_steps, b)
+
+
+def exp_schedule(a: float, b: float) -> Schedule:
+    """lr * a^(t/b) — reference "exp"."""
+    return lambda step: jnp.power(a, step / b)
+
+
+def discexp_schedule(a: float, b: float) -> Schedule:
+    """lr * a^floor(t/b) — reference "discexp"."""
+    return lambda step: jnp.power(a, jnp.floor(step / b))
+
+
+def linear_schedule(a: float, b: float) -> Schedule:
+    """max(lr - a*t, b) — reference "linear"."""
+    return lambda step: jnp.maximum(1.0 - a * step, b)
+
+
+def manual_schedule(boundaries, multipliers) -> Schedule:
+    """Piecewise-constant by step (reference "manual"/"pass_manual")."""
+    bs = jnp.asarray(boundaries, jnp.float32)
+    ms = jnp.asarray(multipliers, jnp.float32)
+
+    def fn(step):
+        idx = jnp.sum((step >= bs).astype(jnp.int32))
+        return ms[jnp.minimum(idx, ms.shape[0] - 1)]
+
+    return fn
+
+
+def make_schedule(name: str, a: float = 0.0, b: float = 0.0, max_steps: float = 0.0) -> Schedule:
+    if name in ("constant", "fixed", ""):
+        return constant_schedule()
+    if name == "poly":
+        return poly_schedule(a, b)
+    if name == "caffe_poly":
+        return caffe_poly_schedule(a, b, max_steps)
+    if name == "exp":
+        return exp_schedule(a, b)
+    if name == "discexp":
+        return discexp_schedule(a, b)
+    if name == "linear":
+        return linear_schedule(a, b)
+    raise ValueError(f"unknown learning_rate_schedule {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# regularization (paddle/parameter/Regularizer.cpp) & clipping
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class L2Regularization:
+    rate: float
+
+
+@dataclasses.dataclass(frozen=True)
+class L1Regularization:
+    rate: float
+
+
+# ---------------------------------------------------------------------------
+# optimizer base
+# ---------------------------------------------------------------------------
+
+
+def _zeros_like_tree(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+class Optimizer:
+    """Base: subclasses implement slot init + the per-leaf update rule.
+
+    The v2 surface keywords match python/paddle/v2/optimizer.py:
+    learning_rate, learning_rate_decay_a/b, learning_rate_schedule,
+    regularization, gradient_clipping_threshold, model_average.
+    """
+
+    def __init__(
+        self,
+        learning_rate: float = 0.001,
+        learning_rate_schedule: str = "constant",
+        learning_rate_decay_a: float = 0.0,
+        learning_rate_decay_b: float = 0.0,
+        learning_rate_max_steps: float = 1.0,
+        regularization: Optional[Any] = None,
+        gradient_clipping_threshold: float = 0.0,
+        model_average: Optional["ModelAverage"] = None,
+    ):
+        self.learning_rate = learning_rate
+        self.schedule = make_schedule(
+            learning_rate_schedule,
+            learning_rate_decay_a,
+            learning_rate_decay_b,
+            learning_rate_max_steps,
+        )
+        self.regularization = regularization
+        self.clip = gradient_clipping_threshold
+        self.model_average = model_average
+
+    # -- slots ---------------------------------------------------------
+    def init_slots(self, params) -> Dict[str, Any]:
+        return {}
+
+    def init(self, params) -> OptState:
+        state: OptState = {"step": jnp.zeros((), jnp.int32)}
+        state.update(self.init_slots(params))
+        if self.model_average is not None:
+            state["avg"] = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+            state["avg_count"] = jnp.zeros((), jnp.float32)
+        return state
+
+    # -- update --------------------------------------------------------
+    def rule(self, g, p, lr, state_leaves, step):
+        """Per-leaf update; returns (delta, new_state_leaves)."""
+        raise NotImplementedError
+
+    def slot_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def update(self, grads, state: OptState, params) -> Tuple[Any, OptState]:
+        step = state["step"]
+        lr = self.learning_rate * self.schedule(step.astype(jnp.float32))
+
+        # global gradient clipping by value threshold (reference
+        # gradient_clipping_threshold clips elementwise per parameter).
+        if self.clip > 0:
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -self.clip, self.clip), grads
+            )
+
+        # decoupled-style L2: reference folds decay into the gradient
+        # (Regularizer applied before the update rule).
+        if isinstance(self.regularization, L2Regularization) and self.regularization.rate:
+            rate = self.regularization.rate
+            grads = jax.tree_util.tree_map(lambda g, p: g + rate * p, grads, params)
+
+        names = self.slot_names()
+        slot_trees = [state[n] for n in names]
+
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_p = treedef.flatten_up_to(params)
+        leaves_slots = [treedef.flatten_up_to(s) for s in slot_trees]
+
+        new_p_leaves = []
+        new_slot_leaves = [[] for _ in names]
+        for i, (g, p) in enumerate(zip(leaves_g, leaves_p)):
+            sl = tuple(s[i] for s in leaves_slots)
+            new_p, new_sl = self.rule(g, p, lr, sl, step)
+            new_p_leaves.append(new_p)
+            for j, v in enumerate(new_sl):
+                new_slot_leaves[j].append(v)
+
+        new_params = jax.tree_util.tree_unflatten(treedef, new_p_leaves)
+        new_state = dict(state)
+        new_state["step"] = step + 1
+        for j, n in enumerate(names):
+            new_state[n] = jax.tree_util.tree_unflatten(treedef, new_slot_leaves[j])
+
+        # L1: proximal shrink after the step (reference applyL1).
+        if isinstance(self.regularization, L1Regularization) and self.regularization.rate:
+            lam = lr * self.regularization.rate
+            new_params = jax.tree_util.tree_map(
+                lambda p: jnp.sign(p) * jnp.maximum(jnp.abs(p) - lam, 0.0), new_params
+            )
+
+        if self.model_average is not None:
+            window = self.model_average.average_window
+            new_state["avg"] = jax.tree_util.tree_map(
+                lambda a, p: (1.0 - window) * a + window * p, state["avg"], new_params
+            )
+            new_state["avg_count"] = state["avg_count"] + 1.0
+
+        return new_params, new_state
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelAverage:
+    """reference AverageOptimizer (parameter averaging for eval),
+    paddle/parameter/AverageOptimizer.cpp.  Exponential window here."""
+
+    average_window: float = 0.01
+
+
+# ---------------------------------------------------------------------------
+# concrete optimizers
+# ---------------------------------------------------------------------------
+
+
+class Momentum(Optimizer):
+    """SGD with (optionally Nesterov) momentum — SgdOptimizer/
+    sgd_optimizer.cc."""
+
+    def __init__(self, momentum: float = 0.0, nesterov: bool = False, **kw):
+        super().__init__(**kw)
+        self.momentum = momentum
+        self.nesterov = nesterov
+
+    def slot_names(self):
+        return ("m",) if self.momentum else ()
+
+    def init_slots(self, params):
+        return {"m": _zeros_like_tree(params)} if self.momentum else {}
+
+    def rule(self, g, p, lr, slots, step):
+        if not self.momentum:
+            return p - lr * g, ()
+        (m,) = slots
+        m = self.momentum * m - lr * g
+        if self.nesterov:
+            delta = self.momentum * m - lr * g
+        else:
+            delta = m
+        return p + delta, (m,)
+
+
+SGD = Momentum
+
+
+class AdaGrad(Optimizer):
+    """AdagradParameterOptimizer (FirstOrderOptimizer.h:44)."""
+
+    def __init__(self, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.epsilon = epsilon
+
+    def slot_names(self):
+        return ("accum",)
+
+    def init_slots(self, params):
+        return {"accum": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        (acc,) = slots
+        acc = acc + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self.epsilon), (acc,)
+
+
+class AdaDelta(Optimizer):
+    """AdaDeltaParameterOptimizer (FirstOrderOptimizer.h:82): rho/epsilon."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def slot_names(self):
+        return ("accum_g", "accum_x")
+
+    def init_slots(self, params):
+        return {"accum_g": _zeros_like_tree(params), "accum_x": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        eg, ex = slots
+        eg = self.rho * eg + (1 - self.rho) * jnp.square(g)
+        dx = -jnp.sqrt((ex + self.epsilon) / (eg + self.epsilon)) * g
+        ex = self.rho * ex + (1 - self.rho) * jnp.square(dx)
+        return p + lr * dx, (eg, ex)
+
+
+class RMSProp(Optimizer):
+    """RMSPropParameterOptimizer (FirstOrderOptimizer.h:124): maintains both
+    E[g^2] and E[g] (centered variant, as the reference does)."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def slot_names(self):
+        return ("ms", "mg")
+
+    def init_slots(self, params):
+        return {"ms": _zeros_like_tree(params), "mg": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        ms, mg = slots
+        ms = self.rho * ms + (1 - self.rho) * jnp.square(g)
+        mg = self.rho * mg + (1 - self.rho) * g
+        return (
+            p - lr * g / jnp.sqrt(ms - jnp.square(mg) + self.epsilon),
+            (ms, mg),
+        )
+
+
+class DecayedAdaGrad(Optimizer):
+    """DecayedAdagradParameterOptimizer (FirstOrderOptimizer.h:166)."""
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6, **kw):
+        super().__init__(**kw)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def slot_names(self):
+        return ("accum",)
+
+    def init_slots(self, params):
+        return {"accum": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        (acc,) = slots
+        acc = self.rho * acc + (1 - self.rho) * jnp.square(g)
+        return p - lr * g / jnp.sqrt(acc + self.epsilon), (acc,)
+
+
+class Adam(Optimizer):
+    """AdamParameterOptimizer (FirstOrderOptimizer.h:205) with bias
+    correction, matching adam_optimizer.cc."""
+
+    def __init__(
+        self, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8, **kw
+    ):
+        super().__init__(**kw)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def slot_names(self):
+        return ("m", "v")
+
+    def init_slots(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        m, v = slots
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(self.beta1, t))
+        vhat = v / (1 - jnp.power(self.beta2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
+
+
+class AdaMax(Optimizer):
+    """AdamaxParameterOptimizer (FirstOrderOptimizer.h:255)."""
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999, **kw):
+        super().__init__(**kw)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def slot_names(self):
+        return ("m", "u")
+
+    def init_slots(self, params):
+        return {"m": _zeros_like_tree(params), "u": _zeros_like_tree(params)}
+
+    def rule(self, g, p, lr, slots, step):
+        m, u = slots
+        t = step.astype(jnp.float32) + 1.0
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        return p - (lr / (1 - jnp.power(self.beta1, t))) * m / (u + 1e-12), (m, u)

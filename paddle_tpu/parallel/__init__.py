@@ -1,0 +1,9 @@
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    batch_sharding,
+    get_default_mesh,
+    make_mesh,
+    replicated,
+    set_default_mesh,
+    shard_batch,
+)

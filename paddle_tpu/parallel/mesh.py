@@ -1,0 +1,81 @@
+"""Device mesh & sharding — the replacement for the reference's entire
+distribution stack: the intra-node thread-ring of MultiGradientMachine
+(reference: paddle/gserver/gradientmachines/MultiGradientMachine.h:44-120) and
+the inter-node parameter servers (reference: paddle/pserver/ParameterServer2.h,
+go/pserver).
+
+Design: one global `jax.sharding.Mesh` with named axes
+
+    data   — data parallelism (batch axis).  Gradient psum rides ICI
+             AllReduce; there is no parameter server to push/pull.
+    model  — tensor/model parallelism for wide layers & sharded embeddings
+             (replaces ParallelNeuralNetwork per-layer device placement and
+             the row-sharded sparse tables on pservers).
+
+Parameters/optimizer state are replicated over `data` (or sharded over
+`model` when a layer opts in); batches are sharded over `data` on the leading
+axis.  XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int = -1  # -1 = all remaining devices
+    model: int = 1
+
+
+_default_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    data: int = -1,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if data == -1:
+        assert n % model == 0, f"{n} devices not divisible by model={model}"
+        data = n // model
+    assert data * model == n, f"mesh {data}x{model} != {n} devices"
+    arr = np.array(devs).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Optional[Mesh]:
+    return _default_mesh
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis across the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def shard_batch(batch, mesh: Optional[Mesh]):
+    """Place a Batch pytree so every leaf's leading axis is split over the
+    data axis (the feeder guarantees batch % data-size == 0)."""
+    if mesh is None:
+        return batch
+    sh = batch_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)

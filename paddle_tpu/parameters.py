@@ -1,0 +1,116 @@
+"""Parameters — the ``paddle.v2.parameters`` surface (reference:
+python/paddle/v2/parameters.py) plus reference-compatible tar checkpoints.
+
+The tar layout matches the reference so v1/v2 checkpoints interoperate:
+one member per parameter whose payload is the v1 binary header
+(int32 version=0, uint32 value_size=4, uint64 num_elements) followed by raw
+float32 data (reference: paddle/parameter/Parameter.cpp save/load:~250-340,
+python/paddle/v2/parameters.py to_tar/from_tar).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import tarfile
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.compiler import CompiledNetwork, NetState, Params
+from paddle_tpu.core.topology import Topology
+
+
+class Parameters:
+    """Holds the parameter pytree + non-trainable state for a topology."""
+
+    def __init__(self, network: CompiledNetwork, params: Params, state: NetState):
+        self.network = network
+        self.params = params
+        self.state = state
+
+    # -- dict-like numpy access (name = "layer.slot") -------------------
+    def names(self):
+        return [
+            f"{layer}.{slot}"
+            for layer, slots in self.params.items()
+            for slot in slots
+        ]
+
+    def keys(self):
+        return self.names()
+
+    def _split(self, key: str) -> Tuple[str, str]:
+        layer, _, slot = key.rpartition(".")
+        return layer, slot
+
+    def get(self, key: str) -> np.ndarray:
+        layer, slot = self._split(key)
+        return np.asarray(self.params[layer][slot])
+
+    __getitem__ = get
+
+    def set(self, key: str, value: np.ndarray) -> None:
+        import jax.numpy as jnp
+
+        layer, slot = self._split(key)
+        old = self.params[layer][slot]
+        value = jnp.asarray(value, dtype=old.dtype).reshape(old.shape)
+        self.params[layer][slot] = value
+
+    __setitem__ = set
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.names())
+
+    def __len__(self) -> int:
+        return len(self.names())
+
+    # -- tar checkpoints ------------------------------------------------
+    def to_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name in self.names():
+                arr = self.get(name).astype(np.float32)
+                payload = (
+                    struct.pack("<iIQ", 0, 4, arr.size) + arr.tobytes()
+                )
+                info = tarfile.TarInfo(name=name)
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+
+    def from_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                buf = tar.extractfile(member).read()
+                version, value_size, size = struct.unpack("<iIQ", buf[:16])
+                assert value_size == 4, "only float32 checkpoints supported"
+                arr = np.frombuffer(buf[16 : 16 + 4 * size], dtype=np.float32)
+                if member.name in set(self.names()):
+                    self.set(member.name, arr)
+
+    @staticmethod
+    def from_tar_new(network: CompiledNetwork, f) -> "Parameters":
+        import jax
+
+        p = create_from_network(network, seed=0)
+        p.from_tar(f)
+        return p
+
+
+def create(cost_or_topology, seed: int = 0, dtype=None) -> Parameters:
+    """paddle.parameters.create(cost) equivalent."""
+    from paddle_tpu.core.topology import LayerOutput
+
+    if isinstance(cost_or_topology, Topology):
+        topo = cost_or_topology
+    else:
+        topo = Topology(cost_or_topology)
+    network = CompiledNetwork(topo, dtype=dtype) if dtype else CompiledNetwork(topo)
+    return create_from_network(network, seed)
+
+
+def create_from_network(network: CompiledNetwork, seed: int = 0) -> Parameters:
+    rng = jax.random.PRNGKey(seed)
+    params, state = network.init(rng)
+    return Parameters(network, params, state)

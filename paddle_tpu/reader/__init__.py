@@ -1,0 +1,11 @@
+from paddle_tpu.reader.decorator import (  # noqa: F401
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401

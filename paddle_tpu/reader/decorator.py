@@ -1,0 +1,194 @@
+"""Reader decorators — same surface as ``paddle.v2.reader`` (reference:
+python/paddle/v2/reader/decorator.py).  A *reader creator* is a zero-arg
+callable returning an iterable of samples; decorators wrap creators.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Any, Callable, Iterable, List
+
+Reader = Callable[[], Iterable[Any]]
+
+
+def map_readers(func, *readers: Reader) -> Reader:
+    """Apply func element-wise over zipped readers (decorator.py:30)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader: Reader, buf_size: int) -> Reader:
+    """Buffered shuffle (decorator.py:60)."""
+
+    def shuffled():
+        buf: List[Any] = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return shuffled
+
+
+def chain(*readers: Reader) -> Reader:
+    """Concatenate readers (decorator.py:90)."""
+
+    def chained():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return chained
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
+    """Zip readers into flat tuples (decorator.py:118)."""
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            return item
+        return (item,)
+
+    def composed():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*rs):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "readers of compose() have different lengths"
+                    )
+                yield sum((_flatten(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((_flatten(i) for i in items), ())
+
+    return composed
+
+
+def buffered(reader: Reader, size: int) -> Reader:
+    """Background-thread prefetch queue (decorator.py:160) — the host-side
+    double-buffering that replaces the reference DataProvider's async load
+    thread (paddle/gserver/dataproviders/DataProvider.h DoubleBuffer)."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                return
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader: Reader, n: int) -> Reader:
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return firstn_reader
+
+
+def cache(reader: Reader) -> Reader:
+    """Materialize once in memory, replay after (the CACHE_PASS_IN_MEM mode of
+    PyDataProvider2, reference PyDataProvider2.cpp:69)."""
+    holder: List[Any] = []
+    done = [False]
+
+    def cached():
+        if done[0]:
+            for e in holder:
+                yield e
+            return
+        for e in reader():
+            holder.append(e)
+            yield e
+        done[0] = True
+
+    return cached
+
+
+def xmap_readers(mapper, reader: Reader, process_num: int, buffer_size: int, order: bool = False) -> Reader:
+    """Parallel map over a thread pool (decorator.py:230)."""
+
+    class _End:
+        pass
+
+    def xreader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _End:
+                finished += 1
+                continue
+            if not order:
+                yield item[1]
+            else:
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader

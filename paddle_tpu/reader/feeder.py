@@ -1,0 +1,114 @@
+"""DataFeeder — python samples → padded static-shape device batches.
+
+Replaces the reference's DataProviderConverter (reference:
+paddle/py_paddle/dataprovider_converter.py:247) which packed samples into
+CSR Arguments.  TPU-native contract instead: every sequence slot is padded to
+a *bucketed* max length (rounded up to a multiple of ``seq_multiple``) so jit
+sees a small, bounded set of shapes; lengths ride alongside as int32 vectors
+(SeqTensor).  Sparse slots are densified to multi-hot rows (gather-sharded
+embedding inputs use INDEX slots instead, which stay ids).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.data_types import InputType, SeqLevel, SlotKind
+
+
+def _round_up(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+class DataFeeder:
+    """feeding: [(slot_name, InputType)] in sample-tuple order, or a dict
+    {slot_name: index_in_sample} combined with `data_types`."""
+
+    def __init__(
+        self,
+        data_types: Sequence[Tuple[str, InputType]],
+        feeding: Optional[Union[Dict[str, int], Sequence[str]]] = None,
+        seq_multiple: int = 8,
+        min_seq_len: int = 8,
+        dtype=np.float32,
+    ):
+        self.data_types = list(data_types)
+        if feeding is None:
+            self.index = {name: i for i, (name, _) in enumerate(self.data_types)}
+        elif isinstance(feeding, dict):
+            self.index = dict(feeding)
+        else:
+            self.index = {name: i for i, name in enumerate(feeding)}
+        self.seq_multiple = seq_multiple
+        self.min_seq_len = min_seq_len
+        self.dtype = dtype
+
+    # ------------------------------------------------------------------
+    def __call__(self, batch_data: List[Any]) -> Dict[str, SeqTensor]:
+        return self.convert(batch_data)
+
+    def convert(self, batch_data: List[Any]) -> Dict[str, SeqTensor]:
+        out: Dict[str, SeqTensor] = {}
+        for name, itype in self.data_types:
+            col = [sample[self.index[name]] for sample in batch_data]
+            out[name] = self._convert_slot(col, itype)
+        return out
+
+    # ------------------------------------------------------------------
+    def _bucket_len(self, max_len: int) -> int:
+        return max(_round_up(max_len, self.seq_multiple), self.min_seq_len)
+
+    def _convert_slot(self, col: List[Any], itype: InputType) -> SeqTensor:
+        if itype.seq == SeqLevel.NONE:
+            return self._convert_plain(col, itype)
+        if itype.seq == SeqLevel.SEQ:
+            return self._convert_seq(col, itype)
+        raise NotImplementedError("sub-sequence slots land with the nested-seq engine")
+
+    def _convert_plain(self, col, itype: InputType) -> SeqTensor:
+        b = len(col)
+        if itype.kind == SlotKind.DENSE:
+            arr = np.asarray(col, dtype=self.dtype).reshape(b, itype.dim)
+            return SeqTensor(arr)
+        if itype.kind == SlotKind.INDEX:
+            return SeqTensor(np.asarray(col, dtype=np.int32).reshape(b))
+        # sparse -> dense multi-hot
+        arr = np.zeros((b, itype.dim), dtype=self.dtype)
+        for i, ids in enumerate(col):
+            if itype.kind == SlotKind.SPARSE_BINARY:
+                arr[i, np.asarray(ids, dtype=np.int64)] = 1.0
+            else:
+                idx, vals = zip(*ids) if ids else ((), ())
+                arr[i, np.asarray(idx, dtype=np.int64)] = np.asarray(vals, self.dtype)
+        return SeqTensor(arr)
+
+    def _convert_seq(self, col, itype: InputType) -> SeqTensor:
+        b = len(col)
+        lengths = np.asarray([len(s) for s in col], dtype=np.int32)
+        t = self._bucket_len(int(lengths.max()) if b else 1)
+        if itype.kind == SlotKind.INDEX:
+            arr = np.zeros((b, t), dtype=np.int32)
+            for i, s in enumerate(col):
+                arr[i, : len(s)] = np.asarray(s, dtype=np.int32)
+            return SeqTensor(arr, lengths)
+        if itype.kind == SlotKind.DENSE:
+            arr = np.zeros((b, t, itype.dim), dtype=self.dtype)
+            for i, s in enumerate(col):
+                if len(s):
+                    arr[i, : len(s)] = np.asarray(s, dtype=self.dtype)
+            return SeqTensor(arr, lengths)
+        # sparse sequence -> [B, T, dim] multi-hot
+        arr = np.zeros((b, t, itype.dim), dtype=self.dtype)
+        for i, s in enumerate(col):
+            for j, ids in enumerate(s):
+                if itype.kind == SlotKind.SPARSE_BINARY:
+                    arr[i, j, np.asarray(ids, dtype=np.int64)] = 1.0
+                else:
+                    idx, vals = zip(*ids) if ids else ((), ())
+                    arr[i, j, np.asarray(idx, dtype=np.int64)] = np.asarray(
+                        vals, self.dtype
+                    )
+        return SeqTensor(arr, lengths)

@@ -1,0 +1,55 @@
+"""Metric builders attached to the train/eval step — the in-graph half of the
+reference evaluator framework (reference: paddle/gserver/evaluators/
+Evaluator.cpp classification_error:995, sum:996, precision_recall:584).
+
+Metrics here are computed *inside* the jitted step from layer outputs (no
+host sync), then averaged across batches on the host by the trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import Topology
+
+_CLS_COST_TYPES = {"softmax_with_cost", "cross_entropy"}
+
+
+def default_metrics_fn(topology: Topology) -> Optional[Callable]:
+    """Build an extra_metrics fn: for classification costs in the topology,
+    emit classification_error (argmax(pred) != label), masked over sequences
+    — reference ClassificationErrorEvaluator (Evaluator.cpp:70-160)."""
+    cls = [
+        conf
+        for conf in topology.layers.values()
+        if conf.type in _CLS_COST_TYPES
+    ]
+    if not cls:
+        return None
+
+    def metrics(outs: Dict[str, SeqTensor]) -> Dict[str, jnp.ndarray]:
+        m: Dict[str, jnp.ndarray] = {}
+        for conf in cls:
+            pred_name, label_name = conf.inputs[0], conf.inputs[1]
+            pred, label = outs[pred_name], outs[label_name]
+            ids = label.data.astype(jnp.int32)
+            if ids.ndim >= 2 and ids.shape[-1] == 1:
+                ids = ids[..., 0]
+            err = (jnp.argmax(pred.data, axis=-1) != ids).astype(jnp.float32)
+            if pred.is_seq and err.ndim == 2:
+                mask = pred.mask()
+                err = jnp.sum(err * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            else:
+                err = jnp.mean(err)
+            key = (
+                "classification_error"
+                if len(cls) == 1
+                else f"classification_error/{conf.name}"
+            )
+            m[key] = err
+        return m
+
+    return metrics

@@ -1,0 +1,147 @@
+"""The training driver — ``paddle.v2.trainer.SGD`` surface (reference:
+python/paddle/v2/trainer.py:24-177) over the jitted step.
+
+Differences from the reference by design: one fused XLA step replaces the
+forwardBackward + per-parameter updater loop; data parallelism is the mesh
+`data` axis (gradients psum over ICI) instead of MultiGradientMachine threads
+or remote parameter servers — `is_local` is accepted for API compatibility
+but there is nothing remote to talk to.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu import event as v2_event
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import LayerOutput, Topology
+from paddle_tpu.optimizer import Optimizer
+from paddle_tpu.parameters import Parameters, create_from_network
+from paddle_tpu.parallel.mesh import get_default_mesh, shard_batch
+from paddle_tpu.reader.feeder import DataFeeder
+from paddle_tpu.trainer.evaluators import default_metrics_fn
+from paddle_tpu.trainer.step import make_eval_step, make_train_step
+from paddle_tpu.utils.timers import stat_timer
+
+
+class SGD:
+    """paddle.v2.trainer.SGD(cost, parameters, update_equation, ...)"""
+
+    def __init__(
+        self,
+        cost,
+        parameters: Optional[Parameters] = None,
+        update_equation: Optional[Optimizer] = None,
+        extra_layers: Optional[Sequence[LayerOutput]] = None,
+        is_local: bool = True,  # kept for surface compat; always "local"
+        mesh=None,
+        seed: int = 0,
+    ):
+        outputs: List[LayerOutput] = [cost] if isinstance(cost, LayerOutput) else list(cost)
+        if extra_layers:
+            outputs += list(extra_layers)
+        self.topology = Topology(outputs)
+        if parameters is not None and parameters.network.topology.order == self.topology.order:
+            self.network = parameters.network
+            self.parameters = parameters
+        else:
+            self.network = CompiledNetwork(self.topology)
+            self.parameters = parameters or create_from_network(self.network, seed)
+        assert update_equation is not None, "update_equation (an Optimizer) is required"
+        self.optimizer = update_equation
+        self.mesh = mesh if mesh is not None else get_default_mesh()
+        self._metrics_fn = default_metrics_fn(self.topology)
+        self._train_step = make_train_step(
+            self.network, self.optimizer, self.mesh, self._metrics_fn
+        )
+        self._eval_step = make_eval_step(self.network, self.mesh, self._metrics_fn)
+        self._opt_state = self.optimizer.init(self.parameters.params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _make_feeder(self, feeding) -> DataFeeder:
+        return DataFeeder(self.topology.data_types(), feeding)
+
+    def train(
+        self,
+        reader: Callable,
+        num_passes: int = 1,
+        event_handler: Optional[Callable] = None,
+        feeding=None,
+    ) -> None:
+        if event_handler is None:
+            event_handler = lambda e: None
+        feeder = self._make_feeder(feeding)
+        params, state = self.parameters.params, self.parameters.state
+        opt_state = self._opt_state
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_costs: List[float] = []
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                with stat_timer("feed"):
+                    batch = feeder(data_batch)
+                    batch = shard_batch(batch, self.mesh)
+                with stat_timer("train_step"):
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    params, state, opt_state, metrics = self._train_step(
+                        params, state, opt_state, batch, step_rng
+                    )
+                self._step_count += 1
+                cost = float(metrics["cost"])
+                pass_costs.append(cost)
+                evaluator = {
+                    k: float(v) for k, v in metrics.items() if k != "cost"
+                }
+                event_handler(
+                    v2_event.EndIteration(pass_id, batch_id, cost, evaluator)
+                )
+            # persist latest values so checkpoints/test see them
+            self.parameters.params, self.parameters.state = params, state
+            self._opt_state = opt_state
+            event_handler(
+                v2_event.EndPass(
+                    pass_id,
+                    {"mean_cost": float(np.mean(pass_costs)) if pass_costs else 0.0},
+                )
+            )
+        self.parameters.params, self.parameters.state = params, state
+        self._opt_state = opt_state
+
+    # ------------------------------------------------------------------
+    def test(self, reader: Callable, feeding=None) -> v2_event.TestResult:
+        feeder = self._make_feeder(feeding)
+        costs: List[float] = []
+        sums: Dict[str, float] = {}
+        n = 0
+        for data_batch in reader():
+            batch = shard_batch(feeder(data_batch), self.mesh)
+            metrics = self._eval_step(
+                self.parameters.params, self.parameters.state, batch
+            )
+            costs.append(float(metrics["cost"]))
+            for k, v in metrics.items():
+                if k != "cost":
+                    sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        avg = {k: v / max(n, 1) for k, v in sums.items()}
+        return v2_event.TestResult(avg, float(np.mean(costs)) if costs else 0.0)
+
+    # ------------------------------------------------------------------
+    def save_parameter_to_tar(self, f) -> None:
+        self.parameters.to_tar(f)
+
+    def save_pass(self, save_dir: str, pass_id: int) -> str:
+        """Write pass-%05d/params.tar (reference pass-%05d dirs,
+        paddle/trainer/ParamUtil.cpp)."""
+        d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "params.tar"), "wb") as f:
+            self.parameters.to_tar(f)
+        return d

@@ -1,0 +1,1 @@
+from paddle_tpu.utils.timers import StatSet, global_stats, stat_timer  # noqa: F401

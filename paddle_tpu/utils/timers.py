@@ -1,0 +1,77 @@
+"""Scoped wall-clock stats — the REGISTER_TIMER equivalent (reference:
+paddle/utils/Stat.h:63,114,230 Stat/StatSet/REGISTER_TIMER, printed per
+log_period in TrainerInternal.cpp:443).  For on-device profiling use
+jax.profiler traces; these timers cover the host-side loop (feed, dispatch,
+blocking waits)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator
+
+
+class _Stat:
+    __slots__ = ("total", "count", "max")
+
+    def __init__(self) -> None:
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, dt: float) -> None:
+        self.total += dt
+        self.count += 1
+        if dt > self.max:
+            self.max = dt
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self) -> None:
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._stats.setdefault(name, _Stat()).add(dt)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                k: {"total": s.total, "count": s.count, "avg": s.avg, "max": s.max}
+                for k, s in self._stats.items()
+            }
+
+    def print_all_status(self) -> str:
+        """globalStat.printAllStatus() equivalent."""
+        lines = [f"{'name':<24}{'count':>8}{'total_s':>12}{'avg_ms':>10}{'max_ms':>10}"]
+        for k, s in sorted(self.summary().items()):
+            lines.append(
+                f"{k:<24}{s['count']:>8}{s['total']:>12.3f}"
+                f"{s['avg'] * 1e3:>10.3f}{s['max'] * 1e3:>10.3f}"
+            )
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+global_stats = StatSet()
+
+
+def stat_timer(name: str):
+    return global_stats.timer(name)

@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU mesh so all sharding
+paths (data/model parallel) are exercised without TPU hardware — the loopback
+"fake cluster" strategy of the reference's distributed tests (reference:
+paddle/trainer/tests/test_CompareSparse.cpp spawning localhost pservers)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,90 @@
+"""Minimum end-to-end slice (SURVEY.md §7 stage 2): LeNet on MNIST via the
+v2 API — build topology, train passes, evaluator improves, checkpoint
+round-trips.  Mirrors the reference's test_TrainerOnePass.cpp (train one pass
+on mnist and check cost) but through the paddle.v2-compatible surface."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def lenet(img):
+    conv1 = paddle.layer.img_conv(
+        img, filter_size=5, num_filters=8, num_channels=1, padding=2,
+        act=paddle.activation.Relu(),
+    )
+    pool1 = paddle.layer.img_pool(conv1, pool_size=2, stride=2)
+    conv2 = paddle.layer.img_conv(
+        pool1, filter_size=5, num_filters=16, padding=2,
+        act=paddle.activation.Relu(),
+    )
+    pool2 = paddle.layer.img_pool(conv2, pool_size=2, stride=2)
+    fc1 = paddle.layer.fc(pool2, size=64, act=paddle.activation.Relu())
+    return paddle.layer.fc(fc1, size=10, act=paddle.activation.Softmax())
+
+
+@pytest.fixture(scope="module")
+def trained():
+    paddle.init(seed=0)
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(784))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(10))
+    predict = lenet(img)
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    params = paddle.parameters.create(cost, seed=0)
+    opt = paddle.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9,
+        regularization=paddle.optimizer.L2Regularization(1e-4),
+    )
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params, update_equation=opt, extra_layers=[predict]
+    )
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    train_reader = paddle.batch(paddle.dataset.mnist.train(), batch_size=128)
+    trainer.train(train_reader, num_passes=2, event_handler=handler)
+    return trainer, costs
+
+
+def test_cost_decreases(trained):
+    trainer, costs = trained
+    assert len(costs) >= 32
+    head = np.mean(costs[:4])
+    tail = np.mean(costs[-4:])
+    assert tail < head * 0.5, f"cost did not improve: {head} -> {tail}"
+
+
+def test_classification_error_drops(trained):
+    trainer, _ = trained
+    result = trainer.test(paddle.batch(paddle.dataset.mnist.test(), 128))
+    assert result.metrics["classification_error"] < 0.2
+
+
+def test_checkpoint_roundtrip(trained):
+    trainer, _ = trained
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+
+    name = trainer.parameters.names()[0]
+    before = trainer.parameters.get(name).copy()
+    trainer.parameters.set(name, np.zeros_like(before))
+    assert not np.allclose(trainer.parameters.get(name), before)
+
+    trainer.parameters.from_tar(buf)
+    after = trainer.parameters.get(name)
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_topology_serialize_stable(trained):
+    trainer, _ = trained
+    text = trainer.topology.serialize()
+    assert "conv" in text and "cross_entropy" in text
