@@ -46,6 +46,23 @@ def _param_std(param_attr: Optional[ParamAttr]):
     return param_attr.initial_std if param_attr else None
 
 
+_IMG_ATTR_KEYS = ("out_h", "out_w", "in_h", "in_w", "in_c", "channels")
+
+
+def _img_passthrough(input: LayerOutput) -> dict:
+    """Propagate image-geometry attrs through shape-preserving layers (addto,
+    batch_norm, clip, ...) so conv chains keep their spatial metadata —
+    the reference keeps this in each LayerConfig's img size fields."""
+    a = input.conf.attrs
+    out = {}
+    c = a.get("channels") or a.get("in_c")
+    h = a.get("out_h") or a.get("in_h")
+    w = a.get("out_w") or a.get("in_w")
+    if c is not None and h is not None:
+        out.update(in_c=c, in_h=h, in_w=w, channels=c, out_h=h, out_w=w)
+    return out
+
+
 def cnn_output_size(
     img_size: int, filter_size: int, padding: int, stride: int, caffe_mode: bool = True
 ) -> int:
@@ -144,6 +161,7 @@ def addto(
         inputs=tuple(i.name for i in ins),
         act=act_name(act),
         bias=bool(bias_attr),
+        attrs=_img_passthrough(ins[0]),
         drop_rate=drop,
         shard_axis=shard,
     )
@@ -178,6 +196,7 @@ def dropout(input: LayerOutput, dropout_rate: float, name: Optional[str] = None)
         size=input.size,
         inputs=(input.name,),
         bias=False,
+        attrs=_img_passthrough(input),
         drop_rate=dropout_rate,
     )
     return LayerOutput(conf, [input])

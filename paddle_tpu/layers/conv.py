@@ -109,17 +109,28 @@ def pool_apply(conf, params, inputs, ctx):
     sh, sw = a.get("stride_h", 1), a.get("stride_w", 1)
     ph, pw = a.get("pad_h", 0), a.get("pad_w", 0)
     kind = a.get("pool_type", "max")
+    # The DSL computes output sizes with v1's ceil mode (cnn_output_size);
+    # reduce_window floors, so pad the high side to make them agree.
+    out_h, out_w = a["out_h"], a["out_w"]
+    extra_h = max((out_h - 1) * sh + kh - x.shape[1] - 2 * ph, 0)
+    extra_w = max((out_w - 1) * sw + kw - x.shape[2] - 2 * pw, 0)
     window = (1, kh, kw, 1)
     strides = (1, sh, sw, 1)
-    pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    pads = ((0, 0), (ph, ph + extra_h), (pw, pw + extra_w), (0, 0))
     if kind.startswith("max"):
         out = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
     else:
         summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
-        # Average over the true window size incl. padding contribution,
-        # matching the reference's avg pooling (hl_cnn.h avgpool counts the
-        # full k*k window).
-        out = summed / float(kh * kw)
+        # Reference avg pooling divides by the window clipped to the
+        # explicitly-padded extent (CpuMatrix::avgPoolForward, Matrix.cpp:
+        # poolSize = (hend-hstart)*(wend-wstart) with hend clipped to
+        # height+padding) — so explicit padding counts, ceil-extra doesn't.
+        ones = jnp.ones((1, x.shape[1] + 2 * ph, x.shape[2] + 2 * pw, 1), x.dtype)
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add, window, strides,
+            ((0, 0), (0, extra_h), (0, extra_w), (0, 0)),
+        )
+        out = summed / counts
     return SeqTensor(out, inputs[0].lengths)
 
 

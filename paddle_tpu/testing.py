@@ -1,0 +1,38 @@
+"""Test/dev-environment helpers.
+
+The ambient environment pins jax to the single-chip `axon` TPU backend via a
+sitecustomize that registers the PJRT plugin at interpreter start, so env
+vars alone cannot switch platforms after startup — processes that need the
+virtual multi-device CPU mesh must re-exec themselves once with the hook
+disabled.  Shared by tests/conftest.py and __graft_entry__.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+REEXEC_SENTINEL = "PADDLE_TPU_CPU_MESH_REEXEC"
+
+
+def ensure_cpu_mesh(argv: Optional[List[str]] = None, device_count: int = 8) -> None:
+    """Re-exec the current process on a `device_count`-device virtual CPU
+    mesh if the axon TPU hook is active.  `argv` overrides the re-exec
+    command (default: preserve sys.argv)."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(REEXEC_SENTINEL):
+        os.environ.setdefault(
+            "XLA_FLAGS", f"--xla_force_host_platform_device_count={device_count}"
+        )
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={device_count}"
+    ).strip()
+    env[REEXEC_SENTINEL] = "1"
+    cmd = [sys.executable] + (argv if argv is not None else sys.argv)
+    os.execve(sys.executable, cmd, env)

@@ -1,13 +1,30 @@
 """Test configuration: force an 8-device virtual CPU mesh so all sharding
 paths (data/model parallel) are exercised without TPU hardware — the loopback
 "fake cluster" strategy of the reference's distributed tests (reference:
-paddle/trainer/tests/test_CompareSparse.cpp spawning localhost pservers)."""
+paddle/trainer/tests/test_CompareSparse.cpp spawning localhost pservers).
+
+The ambient sitecustomize registers the single-chip `axon` TPU backend at
+interpreter start, so switching platforms requires a re-exec (see
+paddle_tpu.testing.ensure_cpu_mesh).  The re-exec happens in pytest_configure
+— after suspending pytest's fd capture, otherwise the new process inherits
+redirected fds and all output vanishes."""
 
 import os
+import sys
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from paddle_tpu.testing import REEXEC_SENTINEL, ensure_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_enable_x64", False)
+
+def pytest_configure(config):
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get(REEXEC_SENTINEL):
+        ensure_cpu_mesh()  # just sets env defaults; no exec
+        import jax
+
+        jax.config.update("jax_enable_x64", False)
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    ensure_cpu_mesh(argv=["-m", "pytest", *config.invocation_params.args])
