@@ -21,6 +21,7 @@ from paddle_tpu import attr  # noqa: F401
 from paddle_tpu import dataset  # noqa: F401
 from paddle_tpu import event  # noqa: F401
 from paddle_tpu import layers as layer  # noqa: F401
+from paddle_tpu.layers import networks  # noqa: F401
 from paddle_tpu import optimizer  # noqa: F401
 from paddle_tpu import parallel  # noqa: F401
 from paddle_tpu import parameters  # noqa: F401

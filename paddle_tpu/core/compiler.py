@@ -86,7 +86,9 @@ class CompiledNetwork:
         for name in self.topology.order:
             conf = self.topology.layers[name]
             impl = self._impls[name]
-            if conf.type == "data":
+            if conf.type in ("data", "step_input", "memory"):
+                # data: user slots; step_input/memory: placeholders fed by an
+                # enclosing recurrent_group's scan body.
                 if name not in batch:
                     raise KeyError(f"batch is missing data slot {name!r}")
                 ctx.outputs[name] = batch[name]

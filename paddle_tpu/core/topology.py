@@ -109,12 +109,13 @@ class Topology:
         return tuple(o.conf.name for o in self.outputs)
 
     def data_layers(self) -> Dict[str, LayerConf]:
-        """Data layers in declaration order (the feeding contract)."""
-        return {
-            name: conf
-            for name, conf in self.layers.items()
-            if conf.type == "data"
-        }
+        """Data layers in DECLARATION order — the feeding contract.  Graph
+        traversal order would depend on the cost graph's shape; the reference
+        keeps declaration order in ModelConfig.input_layer_names
+        (config_parser.py), which is what readers yield tuples in."""
+        confs = [c for c in self.layers.values() if c.type == "data"]
+        confs.sort(key=lambda c: c.attrs.get("_decl_idx", 0))
+        return {c.name: c for c in confs}
 
     def data_types(self) -> List[Tuple[str, InputType]]:
         """[(name, InputType)] — same contract as v2 Topology.data_type()
@@ -128,21 +129,33 @@ class Topology:
     def get(self, name: str) -> LayerConf:
         return self.layers[name]
 
-    def serialize(self) -> str:
+    def serialize(self, indent: str = "") -> str:
         """Deterministic text form used for golden-snapshot tests (the
         protostr-equality tests of the reference,
-        python/paddle/trainer_config_helpers/tests/configs/)."""
+        python/paddle/trainer_config_helpers/tests/configs/).  Attr keys
+        starting with '_' hold non-scalar build artifacts (e.g. a group's
+        sub-topology) and are serialized specially."""
         lines = []
         for name in self.order:
             c = self.layers[name]
-            attrs = ", ".join(f"{k}={c.attrs[k]!r}" for k in sorted(c.attrs))
+            attrs = ", ".join(
+                f"{k}={c.attrs[k]!r}"
+                for k in sorted(c.attrs)
+                if not k.startswith("_")
+            )
             lines.append(
-                f"{c.type} {name} size={c.size} act={c.act} bias={c.bias}"
+                indent
+                + f"{c.type} {name} size={c.size} act={c.act} bias={c.bias}"
                 f" inputs={list(c.inputs)}"
                 + (f" drop={c.drop_rate}" if c.drop_rate else "")
                 + (f" [{attrs}]" if attrs else "")
             )
-        lines.append(f"outputs={list(self.output_names)}")
+            sub = c.attrs.get("_sub_topology")
+            if sub is not None:
+                lines.append(indent + "  {")
+                lines.append(sub.serialize(indent + "    "))
+                lines.append(indent + "  }")
+        lines.append(indent + f"outputs={list(self.output_names)}")
         return "\n".join(lines)
 
 

@@ -26,6 +26,11 @@ from paddle_tpu.layers import basic as _basic  # noqa: F401
 from paddle_tpu.layers import conv as _conv  # noqa: F401
 from paddle_tpu.layers import cost as _cost  # noqa: F401
 from paddle_tpu.layers import sequence as _sequence  # noqa: F401
+from paddle_tpu.layers.recurrent_group import (  # noqa: F401
+    StaticInput,
+    memory,
+    recurrent_group,
+)
 
 Inputish = Union[LayerOutput, Sequence[LayerOutput]]
 
@@ -78,9 +83,14 @@ def cnn_output_size(
 # ---------------------------------------------------------------------------
 
 
+_DATA_DECL_COUNTER = [0]
+
+
 def data(name: str, type: InputType, height: int = 0, width: int = 0) -> LayerOutput:
-    """Declare an input slot (reference data_layer, layers.py)."""
-    attrs = {}
+    """Declare an input slot (reference data_layer, layers.py).  Declaration
+    order defines the default reader-tuple feeding order."""
+    attrs = {"_decl_idx": _DATA_DECL_COUNTER[0]}
+    _DATA_DECL_COUNTER[0] += 1
     if height and width:
         attrs.update(in_h=height, in_w=width, in_c=max(type.dim // (height * width), 1))
     conf = LayerConf(
@@ -253,6 +263,10 @@ def img_conv(
     ph = padding_y if padding_y is not None else padding
     pw = padding
     if trans:
+        if groups != 1:
+            raise NotImplementedError(
+                "grouped transpose conv (trans=True, groups>1) is not supported"
+            )
         out_h = (in_h - 1) * sh + fh - 2 * ph
         out_w = (in_w - 1) * sw + fw - 2 * pw
     else:
@@ -908,6 +922,121 @@ def recurrent(
 
 
 recurrent_layer = recurrent
+
+
+def context_projection(
+    input: LayerOutput,
+    context_len: int,
+    context_start: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference context_projection (config_parser.py ContextProjection):
+    default start centers the window."""
+    start = context_start if context_start is not None else -(context_len // 2)
+    conf = LayerConf(
+        name=name or auto_name("context_projection"),
+        type="context_projection",
+        size=input.size * context_len,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"context_len": context_len, "context_start": start},
+    )
+    return LayerOutput(conf, [input])
+
+
+def row_conv(
+    input: LayerOutput, context_len: int, act=None, name: Optional[str] = None
+) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("row_conv"),
+        type="row_conv",
+        size=input.size,
+        inputs=(input.name,),
+        act=act_name(act),
+        bias=False,
+        attrs={"context_len": context_len},
+    )
+    return LayerOutput(conf, [input])
+
+
+row_conv_layer = row_conv
+
+
+def conv_shift(a: LayerOutput, b: LayerOutput, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("conv_shift"),
+        type="conv_shift",
+        size=a.size,
+        inputs=(a.name, b.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [a, b])
+
+
+conv_shift_layer = conv_shift
+
+
+def gru_step(
+    input: LayerOutput,
+    output_mem: LayerOutput,
+    size: Optional[int] = None,
+    act=None,
+    gate_act=None,
+    bias_attr=True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """One GRU step (reference gru_step_layer): input pre-projected to 3H,
+    output_mem = previous state (usually a memory)."""
+    size = size or output_mem.size
+    assert input.size == 3 * size
+    conf = LayerConf(
+        name=name or auto_name("gru_step"),
+        type="gru_step",
+        size=size,
+        inputs=(input.name, output_mem.name),
+        bias=bool(bias_attr),
+        attrs={
+            "active_type": act_name(act if act is not None else _act_mod.Tanh()),
+            "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+        },
+    )
+    return LayerOutput(conf, [input, output_mem])
+
+
+gru_step_layer = gru_step
+
+
+def lstm_step(
+    input: LayerOutput,
+    output_mem: LayerOutput,
+    state_mem: LayerOutput,
+    size: Optional[int] = None,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    bias_attr=True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """One LSTM step (reference lstm_step_layer): cell state is exposed as
+    `<name>@cell` for a second memory link."""
+    size = size or output_mem.size
+    assert input.size == 4 * size
+    conf = LayerConf(
+        name=name or auto_name("lstm_step"),
+        type="lstm_step",
+        size=size,
+        inputs=(input.name, output_mem.name, state_mem.name),
+        bias=bool(bias_attr),
+        attrs={
+            "active_type": act_name(act if act is not None else _act_mod.Tanh()),
+            "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+            "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
+        },
+    )
+    return LayerOutput(conf, [input, output_mem, state_mem])
+
+
+lstm_step_layer = lstm_step
 
 
 def sampling_id(input: LayerOutput, name=None) -> LayerOutput:

@@ -45,8 +45,8 @@ def conv_init(conf, in_confs, rng) -> Dict[str, Any]:
     cin, cout = a["in_c"], a["channels"]
     groups = a.get("groups", 1)
     if conf.type == "convt":
-        shape = (kh, kw, cout // groups, cin)  # transpose conv: out feature dim
-        w = init.normal(rng, shape, init.default_std(kh * kw * max(cin // groups, 1)))
+        shape = (kh, kw, cin, cout)  # HWIO, consumed by conv_transpose as-is
+        w = init.normal(rng, shape, init.default_std(kh * kw * cin))
     else:
         shape = (kh, kw, cin // groups, cout)
         w = init.conv_normal(rng, shape)
@@ -88,8 +88,7 @@ def convt_apply(conf, params, inputs, ctx):
             (a.get("pad_h", 0), a.get("pad_h", 0)),
             (a.get("pad_w", 0), a.get("pad_w", 0)),
         ],
-        dimension_numbers=("NHWC", "HWOI", "NHWC"),
-        transpose_kernel=True,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     if "b" in params:
         out = out + params["b"]

@@ -1,0 +1,208 @@
+"""Prebuilt network compositions — the ``trainer_config_helpers.networks``
+surface (reference: python/paddle/trainer_config_helpers/networks.py:
+simple_img_conv_pool, vgg_16_network, simple_lstm, lstmemory_group,
+simple_gru, bidirectional_lstm, simple_attention, sequence_conv_pool)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu import activation as A
+from paddle_tpu import pooling as P
+from paddle_tpu.core.topology import LayerOutput, auto_name
+from paddle_tpu.layers import (
+    addto,
+    concat,
+    data,
+    expand,
+    fc,
+    first_seq,
+    grumemory,
+    img_conv,
+    img_pool,
+    last_seq,
+    lstmemory,
+    pooling,
+    recurrent_group,
+    scaling,
+    seq_reshape,
+)
+from paddle_tpu.layers import StaticInput, memory
+from paddle_tpu.layers import sequence  # noqa: F401
+from paddle_tpu.core.topology import LayerConf
+
+
+def simple_img_conv_pool(
+    input: LayerOutput,
+    filter_size: int,
+    num_filters: int,
+    pool_size: int,
+    pool_stride: Optional[int] = None,
+    num_channel: Optional[int] = None,
+    act=None,
+    padding: int = 0,
+    pool_type=None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    conv = img_conv(
+        input,
+        filter_size=filter_size,
+        num_filters=num_filters,
+        num_channels=num_channel,
+        padding=padding,
+        act=act,
+        name=(name + "_conv") if name else None,
+    )
+    return img_pool(
+        conv,
+        pool_size=pool_size,
+        stride=pool_stride or pool_size,
+        pool_type=pool_type,
+        name=(name + "_pool") if name else None,
+    )
+
+
+def vgg_16_network(input_image: LayerOutput, num_channels: int, num_classes: int = 1000):
+    """reference vgg_16_network (networks.py)."""
+
+    def block(ipt, num_filter, groups, ch_in=None):
+        out = ipt
+        for i in range(groups):
+            out = img_conv(
+                out,
+                filter_size=3,
+                num_filters=num_filter,
+                num_channels=ch_in if i == 0 else None,
+                padding=1,
+                act=A.Relu(),
+            )
+        return img_pool(out, pool_size=2, stride=2)
+
+    t = block(input_image, 64, 2, num_channels)
+    t = block(t, 128, 2)
+    t = block(t, 256, 3)
+    t = block(t, 512, 3)
+    t = block(t, 512, 3)
+    t = fc(t, size=4096, act=A.Relu(), layer_attr=None)
+    t = fc(t, size=4096, act=A.Relu())
+    return fc(t, size=num_classes, act=A.Softmax())
+
+
+def simple_lstm(
+    input: LayerOutput,
+    size: int,
+    reverse: bool = False,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """fc(4*size) + fused lstmemory (reference simple_lstm networks.py)."""
+    proj = fc(
+        input,
+        size=size * 4,
+        act=A.Identity(),
+        bias_attr=False,
+        name=(name + "_transform") if name else None,
+    )
+    return lstmemory(
+        proj,
+        size=size,
+        reverse=reverse,
+        act=act,
+        gate_act=gate_act,
+        state_act=state_act,
+        name=name,
+    )
+
+
+def simple_gru(
+    input: LayerOutput,
+    size: int,
+    reverse: bool = False,
+    act=None,
+    gate_act=None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    proj = fc(
+        input,
+        size=size * 3,
+        act=A.Identity(),
+        bias_attr=False,
+        name=(name + "_transform") if name else None,
+    )
+    return grumemory(proj, size=size, reverse=reverse, act=act, gate_act=gate_act, name=name)
+
+
+def bidirectional_lstm(
+    input: LayerOutput,
+    size: int,
+    return_concat: bool = True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    fwd = simple_lstm(input, size, reverse=False, name=(name + "_fw") if name else None)
+    bwd = simple_lstm(input, size, reverse=True, name=(name + "_bw") if name else None)
+    if return_concat:
+        return concat([fwd, bwd])
+    return addto([fwd, bwd])
+
+
+def bidirectional_gru(
+    input: LayerOutput, size: int, return_concat: bool = True, name=None
+) -> LayerOutput:
+    fwd = simple_gru(input, size, reverse=False, name=(name + "_fw") if name else None)
+    bwd = simple_gru(input, size, reverse=True, name=(name + "_bw") if name else None)
+    if return_concat:
+        return concat([fwd, bwd])
+    return addto([fwd, bwd])
+
+
+def sequence_conv_pool(
+    input: LayerOutput,
+    context_len: int,
+    hidden_size: int,
+    pool_type=None,
+    act=None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Text conv (context window projection + fc) then seq pooling
+    (reference sequence_conv_pool / context_projection path)."""
+    from paddle_tpu.layers import context_projection
+
+    ctxp = context_projection(input, context_len=context_len)
+    h = fc(ctxp, size=hidden_size, act=act or A.Tanh(),
+           name=(name + "_conv") if name else None)
+    return pooling(h, pool_type or P.Max(), name=(name + "_pool") if name else None)
+
+
+def simple_attention(
+    encoded_sequence: LayerOutput,
+    encoded_proj: LayerOutput,
+    decoder_state: LayerOutput,
+    transform_bias_attr=False,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Bahdanau-style attention (reference simple_attention,
+    networks.py:1400-1464): score = fc_tanh(enc_proj + expand(dec_state)),
+    weights = sequence_softmax, context = weighted sum over time.
+
+    Used INSIDE a recurrent_group step: encoded_sequence/encoded_proj are
+    StaticInput sequences [B, S, D]; decoder_state is a memory [B, H]."""
+    expanded = expand(decoder_state, encoded_proj)
+    state_proj = fc(
+        expanded,
+        size=encoded_proj.size,
+        act=A.Identity(),
+        bias_attr=transform_bias_attr,
+        name=(name + "_state_proj") if name else None,
+    )
+    attn_hidden = addto([encoded_proj, state_proj], act=A.Tanh(), bias_attr=False)
+    scores = fc(
+        attn_hidden,
+        size=1,
+        act=A.SequenceSoftmax(),
+        bias_attr=False,
+        name=(name + "_scores") if name else None,
+    )
+    scaled = scaling(scores, encoded_sequence)
+    return pooling(scaled, P.Sum(), name=(name + "_context") if name else None)

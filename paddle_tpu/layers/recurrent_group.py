@@ -1,0 +1,313 @@
+"""recurrent_group — the TPU-native RecurrentGradientMachine (reference:
+paddle/gserver/gradientmachines/RecurrentGradientMachine.cpp:530 forward,
+python/paddle/trainer_config_helpers/layers.py recurrent_group/memory, and
+the SubModelConfig plumbing of config_parser.py:366-386).
+
+Reference semantics: a user step function composed of ordinary layers runs
+per timestep; ``memory(name=X)`` reads layer X's output from t-1; sequence
+inputs are scanned; non-sequence ("static") inputs are visible every step.
+The reference executes this by cloning frame networks per timestep and
+re-batching variable-length sequences by length (createInFrameInfo,
+.cpp:428).
+
+TPU-native lowering: the step function is traced ONCE at model-build time
+into a *sub-topology* (the SubModelConfig analogue).  At apply time the
+sub-network becomes the body of one ``lax.scan`` over the padded time axis;
+memories are scan carries with mask-carry-through for padding; the whole
+group is part of the same jitted XLA program as the rest of the model.
+No per-timestep re-batching, no frame cloning — static shapes end to end.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import LayerConf, LayerOutput, Topology, auto_name
+from paddle_tpu.layers.base import ApplyContext, register_layer
+
+
+class StaticInput:
+    """Marks an outer layer as visible-every-step instead of scanned
+    (reference StaticInput, trainer_config_helpers/layers.py)."""
+
+    def __init__(self, input: LayerOutput, is_seq: bool = False):
+        self.input = input
+        self.is_seq = is_seq
+
+
+# Build-time state for the step function trace: maps memory placeholders to
+# their link targets so the group layer can wire carries.
+class _GroupBuild:
+    def __init__(self) -> None:
+        self.memories: List[LayerConf] = []
+        # placeholder name -> outer boot LayerOutput (must join group parents)
+        self.boot_layers: Dict[str, LayerOutput] = {}
+
+
+_current_build: Optional[_GroupBuild] = None
+
+
+@contextlib.contextmanager
+def _group_build():
+    global _current_build
+    prev = _current_build
+    _current_build = _GroupBuild()
+    try:
+        yield _current_build
+    finally:
+        _current_build = prev
+
+
+def memory(
+    name: str,
+    size: int,
+    boot_layer: Optional[LayerOutput] = None,
+    boot_with_const_id: Optional[int] = None,
+) -> LayerOutput:
+    """Previous-timestep output of the in-group layer called `name`
+    (reference memory(), layers.py; RecurrentGradientMachine "memory frame"
+    links).  boot_layer provides the t=0 value (non-seq [B, size])."""
+    assert _current_build is not None, "memory() must be called inside a recurrent_group step"
+    conf = LayerConf(
+        name=auto_name(f"memory_{name}"),
+        type="memory",
+        size=size,
+        bias=False,
+        attrs={
+            "link": name,
+            "boot": boot_layer.name if boot_layer is not None else None,
+            "boot_const_id": boot_with_const_id,
+        },
+    )
+    _current_build.memories.append(conf)
+    if boot_layer is not None:
+        _current_build.boot_layers[conf.name] = boot_layer
+    return LayerOutput(conf)
+
+
+@register_layer("memory")
+def memory_apply(conf, params, inputs, ctx):  # pragma: no cover
+    raise RuntimeError("memory placeholders are fed by the recurrent_group scan")
+
+
+@register_layer("step_input")
+def step_input_apply(conf, params, inputs, ctx):  # pragma: no cover
+    raise RuntimeError("step inputs are fed by the recurrent_group scan")
+
+
+def recurrent_group(
+    step,
+    input: Union[LayerOutput, StaticInput, Sequence[Union[LayerOutput, StaticInput]]],
+    reverse: bool = False,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Run `step` over the time axis of the sequence inputs.
+
+    Returns the step's (first) output as a sequence layer.  See module
+    docstring for the lowering.
+    """
+    ins = input if isinstance(input, (list, tuple)) else [input]
+    scanned: List[LayerOutput] = []
+    statics: List[StaticInput] = []
+    for i in ins:
+        if isinstance(i, StaticInput):
+            statics.append(i)
+        else:
+            scanned.append(i)
+    assert scanned, "recurrent_group needs at least one sequence input to scan"
+
+    gname = name or auto_name("recurrent_group")
+
+    # ---- trace the step function into a sub-topology ------------------
+    step_args: List[LayerOutput] = []
+    scan_placeholders: List[LayerConf] = []
+    static_placeholders: List[LayerConf] = []
+    for k, lo in enumerate(scanned):
+        conf = LayerConf(
+            name=f"{gname}@in{k}", type="step_input", size=lo.size, bias=False
+        )
+        scan_placeholders.append(conf)
+        step_args.append(LayerOutput(conf))
+    for k, st in enumerate(statics):
+        conf = LayerConf(
+            name=f"{gname}@static{k}",
+            type="step_input",
+            size=st.input.size,
+            bias=False,
+            attrs={"static_seq": st.is_seq},
+        )
+        static_placeholders.append(conf)
+        step_args.append(LayerOutput(conf))
+
+    with _group_build() as gb:
+        out = step(*step_args)
+    step_outputs: List[LayerOutput] = out if isinstance(out, (list, tuple)) else [out]
+
+    # Memory link targets must be part of the sub-topology even when not on
+    # the path to the step output.
+    sub_topo = Topology(list(step_outputs))
+    # links may address auxiliary outputs like "<layer>@cell" (lstm_step)
+    missing_links = [
+        m
+        for m in gb.memories
+        if m.attrs["link"].split("@")[0] not in sub_topo.layers
+    ]
+    if missing_links:
+        raise ValueError(
+            f"memory links {[m.attrs['link'] for m in missing_links]} not found "
+            f"in recurrent_group {gname!r} step outputs' graph"
+        )
+
+    # Boot layers are OUTER layers: include them as group parents so their
+    # values exist in ctx.outputs at apply time.
+    outer_inputs: List[LayerOutput] = (
+        list(scanned) + [s.input for s in statics] + list(gb.boot_layers.values())
+    )
+
+    conf = LayerConf(
+        name=gname,
+        type="recurrent_group",
+        size=step_outputs[0].size,
+        inputs=tuple(o.name for o in outer_inputs),
+        bias=False,
+        attrs={
+            "_sub_topology": sub_topo,
+            "_memories": tuple(gb.memories),
+            "_scan_placeholders": tuple(c.name for c in scan_placeholders),
+            "_static_placeholders": tuple(
+                (c.name, c.attrs.get("static_seq", False))
+                for c in static_placeholders
+            ),
+            "_output": step_outputs[0].name,
+            "n_scanned": len(scanned),
+            "reverse": reverse,
+        },
+    )
+    return LayerOutput(conf, outer_inputs)
+
+
+# ---------------------------------------------------------------------------
+# layer implementation
+# ---------------------------------------------------------------------------
+
+
+def _rg_init(conf, in_confs, rng):
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    sub = CompiledNetwork(conf.attrs["_sub_topology"])
+    return sub.init_params(rng)
+
+
+def _rg_init_state(conf, in_confs):
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    sub = CompiledNetwork(conf.attrs["_sub_topology"])
+    return sub.init_state()
+
+
+@register_layer(
+    "recurrent_group", init=_rg_init, init_state=_rg_init_state, auto_activation=False
+)
+def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    a = conf.attrs
+    sub_topo: Topology = a["_sub_topology"]
+    subnet = CompiledNetwork(sub_topo)
+    memories: Sequence[LayerConf] = a["_memories"]
+    scan_names: Sequence[str] = a["_scan_placeholders"]
+    static_info = a["_static_placeholders"]
+    out_name: str = a["_output"]
+    n_scan = a["n_scanned"]
+    reverse = a["reverse"]
+
+    scanned = inputs[:n_scan]
+    statics = inputs[n_scan : n_scan + len(static_info)]  # rest are boot layers
+    lengths = scanned[0].lengths
+    assert lengths is not None, "recurrent_group inputs must be sequences"
+    t_max = scanned[0].max_len
+    b = scanned[0].batch_size
+
+    # time-major scanned inputs
+    xs = []
+    for s in scanned:
+        x = jnp.swapaxes(s.data, 0, 1)  # [T, B, D]
+        if reverse:
+            x = jnp.flip(x, axis=0)
+        xs.append(x)
+    tpos = jnp.arange(t_max, dtype=jnp.int32)[:, None]  # [T, 1]
+    if reverse:
+        valid = tpos >= (t_max - lengths[None, :])
+    else:
+        valid = tpos < lengths[None, :]
+    mask_seq = valid[..., None].astype(scanned[0].data.dtype)  # [T, B, 1]
+
+    # initial memory carries
+    init_carry = {}
+    for m in memories:
+        boot = m.attrs.get("boot")
+        boot_const = m.attrs.get("boot_const_id")
+        if boot is not None:
+            init_carry[m.name] = ctx.outputs[boot].data
+        elif boot_const is not None:
+            # id-type memory booted with a constant id (reference
+            # boot_with_const_id — used for generated-input memories)
+            init_carry[m.name] = jnp.full(
+                (b, m.size), boot_const, scanned[0].data.dtype
+            )
+        else:
+            init_carry[m.name] = jnp.zeros((b, m.size), scanned[0].data.dtype)
+
+    static_batch = {
+        pname: (st if is_seq else SeqTensor(st.data))
+        for (pname, is_seq), st in zip(static_info, statics)
+    }
+
+    step_rng = ctx.layer_rng(conf.name)
+    t_iota = jnp.arange(t_max, dtype=jnp.uint32)
+    sub_state0 = ctx.state.get(conf.name, {})
+
+    def body(carry_all, scan_in):
+        carry, sub_state = carry_all
+        xt = scan_in[:-2]
+        m_t = scan_in[-2]
+        t_idx = scan_in[-1]
+        sub_batch = dict(static_batch)
+        for pname, x in zip(scan_names, xt):
+            sub_batch[pname] = SeqTensor(x)
+        for m in memories:
+            sub_batch[m.name] = SeqTensor(carry[m.name])
+        # fold the timestep in so dropout/sampling decorrelate across steps
+        rng_t = None if step_rng is None else jax.random.fold_in(step_rng, t_idx)
+        outs, new_sub_state = subnet.apply(
+            params, sub_batch, state=sub_state, train=ctx.train, rng=rng_t
+        )
+        new_carry = {}
+        for m in memories:
+            upd = outs[m.attrs["link"]].data
+            new_carry[m.name] = jnp.where(m_t > 0, upd, carry[m.name])
+        y = outs[out_name].data
+        return (new_carry, new_sub_state), y
+
+    # Memory/step placeholders ride the compiler's data path per step.
+    (_, sub_state_out), ys = jax.lax.scan(
+        body, (init_carry, sub_state0), tuple(xs) + (mask_seq, t_iota)
+    )
+    if sub_state0:
+        ctx.new_state[conf.name] = sub_state_out
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    ys = jnp.swapaxes(ys, 0, 1)  # [B, T, D]
+    ys = ys * mask_like(ys, lengths)
+    return SeqTensor(ys, lengths)
+
+
+def mask_like(ys: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    t = jnp.arange(ys.shape[1], dtype=jnp.int32)
+    m = (t[None, :] < lengths[:, None]).astype(ys.dtype)
+    return m[..., None] if ys.ndim == 3 else m

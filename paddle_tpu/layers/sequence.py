@@ -228,6 +228,72 @@ def recurrent_apply(conf, params, inputs, ctx):
 
 
 # ---------------------------------------------------------------------------
+# gru_step / lstm_step — GruStepLayer.cpp / LstmStepLayer.cpp: one-timestep
+# cells used inside recurrent_group decoders
+# ---------------------------------------------------------------------------
+
+
+def gru_step_init(conf, in_confs, rng):
+    h = conf.size
+    r1, r2 = jax.random.split(rng)
+    p = {"w_h": init.normal(r1, (h, 2 * h)), "w_c": init.normal(r2, (h, h))}
+    if conf.bias:
+        p["b"] = init.zeros((3 * h,))
+    return p
+
+
+@register_layer("gru_step", init=gru_step_init, auto_activation=False)
+def gru_step_apply(conf, params, inputs, ctx):
+    from paddle_tpu.ops.activations import get_activation
+
+    x, h_p = inputs[0].data, inputs[1].data  # [B, 3H], [B, H]
+    h = conf.size
+    f_gate = get_activation(conf.attr("gate_act", "sigmoid"))
+    f_act = get_activation(conf.attr("active_type", "tanh"))
+    if "b" in params:
+        x = x + params["b"]
+    x_u, x_r, x_c = jnp.split(x, 3, axis=-1)
+    ur = h_p @ params["w_h"]
+    u_t = f_gate(x_u + ur[:, :h])
+    r_t = f_gate(x_r + ur[:, h:])
+    c_t = f_act(x_c + r_t * (h_p @ params["w_c"]))
+    h_t = u_t * h_p + (1.0 - u_t) * c_t
+    return SeqTensor(h_t)
+
+
+def lstm_step_init(conf, in_confs, rng):
+    h = conf.size
+    p = {"w_h": init.normal(rng, (h, 4 * h))}
+    if conf.bias:
+        p["b"] = init.zeros((4 * h,))
+    return p
+
+
+@register_layer("lstm_step", init=lstm_step_init, auto_activation=False)
+def lstm_step_apply(conf, params, inputs, ctx):
+    """inputs: (gates [B,4H], prev_h [B,H], prev_c [B,H]); output h; the cell
+    state is exposed as `<name>@cell` for memory links (the reference reaches
+    it via get_output_layer on the step's second output)."""
+    from paddle_tpu.ops.activations import get_activation
+
+    x, h_p, c_p = (t.data for t in inputs)
+    f_gate = get_activation(conf.attr("gate_act", "sigmoid"))
+    f_act = get_activation(conf.attr("active_type", "tanh"))
+    f_state = get_activation(conf.attr("state_act", "tanh"))
+    a = x + h_p @ params["w_h"]
+    if "b" in params:
+        a = a + params["b"]
+    a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)
+    i_t = f_gate(a_i)
+    f_t = f_gate(a_f)
+    c_t = f_t * c_p + i_t * f_act(a_g)
+    o_t = f_gate(a_o)
+    h_t = o_t * f_state(c_t)
+    ctx.outputs[conf.name + "@cell"] = SeqTensor(c_t)
+    return SeqTensor(h_t)
+
+
+# ---------------------------------------------------------------------------
 # sampling_id — SamplingIdLayer.cpp: sample an id from each row's distribution
 # ---------------------------------------------------------------------------
 
@@ -256,6 +322,72 @@ def eos_id_apply(conf, params, inputs, ctx):
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = ids[..., 0]
     return SeqTensor((ids == eos).astype(jnp.float32), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# context_projection — ContextProjection (paddle/function/ContextProjectionOp,
+# gserver/layers/ContextProjection.cpp): per-timestep window concat
+# ---------------------------------------------------------------------------
+
+
+@register_layer("context_projection")
+def context_projection_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    clen = conf.attrs["context_len"]
+    start = conf.attrs["context_start"]
+    data = x.masked_data()  # zeros beyond length so windows read zeros
+    b, t, d = data.shape
+    lo = max(-start, 0)
+    hi = max(start + clen - 1, 0)
+    padded = jnp.pad(data, ((0, 0), (lo, hi), (0, 0)))
+    slices = [
+        jax.lax.dynamic_slice_in_dim(padded, lo + start + k, t, axis=1)
+        for k in range(clen)
+    ]
+    return SeqTensor(jnp.concatenate(slices, axis=-1), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# row_conv — RowConvLayer.cpp: causal look-ahead convolution over time
+# ---------------------------------------------------------------------------
+
+
+def row_conv_init(conf, in_confs, rng):
+    k = conf.attrs["context_len"]
+    return {"w": init.normal(rng, (k, conf.size), 1.0 / max(k, 1))}
+
+
+@register_layer("row_conv", init=row_conv_init)
+def row_conv_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    data = x.masked_data()
+    b, t, d = data.shape
+    k = conf.attrs["context_len"]
+    padded = jnp.pad(data, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(
+        jax.lax.dynamic_slice_in_dim(padded, j, t, axis=1) * params["w"][j]
+        for j in range(k)
+    )
+    return SeqTensor(out, x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# conv_shift — ConvShiftLayer.cpp: circular convolution of each row pair
+# ---------------------------------------------------------------------------
+
+
+@register_layer("conv_shift")
+def conv_shift_apply(conf, params, inputs, ctx):
+    a, b = inputs  # a: [B, D], b: [B, K] (K odd)
+    k = b.data.shape[-1]
+    d = a.data.shape[-1]
+    half = k // 2
+    idx = (jnp.arange(d)[:, None] + jnp.arange(-half, half + 1)[None, :]) % d
+    gathered = a.data[:, idx]  # [B, D, K]
+    out = jnp.einsum("bdk,bk->bd", gathered, b.data)
+    return SeqTensor(out, a.lengths)
 
 
 # ---------------------------------------------------------------------------

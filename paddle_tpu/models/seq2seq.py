@@ -1,0 +1,215 @@
+"""Seq2seq NMT with attention — the north-star seq2seq config (BASELINE.json;
+the reference era's demo/seqToseq text_generation topology: bi-GRU encoder +
+attention GRU decoder, built here from the same recurrent_group/
+simple_attention DSL the reference uses: trainer_config_helpers
+networks.py simple_attention, layers.py recurrent_group).
+
+Training: one jitted graph, per-step softmax CE over target vocab with
+padding masked.  Generation: the decoder step sub-network is re-used as the
+body of a jitted beam/greedy scan (ops/beam.py) — beam search runs on-device,
+unlike the reference's host-side RecurrentGradientMachine beamSearch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import LayerOutput, Topology
+
+L = paddle.layer
+A = paddle.activation
+
+
+def encoder_net(
+    src_word: LayerOutput, word_dim: int, hidden_dim: int
+) -> Tuple[LayerOutput, LayerOutput]:
+    """Bi-GRU encoder; returns (encoded_seq [B,S,2H], encoded_proj)."""
+    emb = L.embedding(src_word, size=word_dim, name="src_emb")
+    fwd = paddle.networks.simple_gru(emb, size=hidden_dim, name="enc_fw")
+    bwd = paddle.networks.simple_gru(emb, size=hidden_dim, reverse=True, name="enc_bw")
+    enc = L.concat([fwd, bwd], name="enc")
+    enc_proj = L.fc(
+        enc, size=hidden_dim, act=A.Identity(), bias_attr=False, name="enc_proj"
+    )
+    return enc, enc_proj
+
+
+def decoder_step_builder(hidden_dim: int, trg_vocab: int, boot: LayerOutput):
+    """Returns the recurrent_group step fn used for BOTH training and
+    generation — identical weights, mirroring the reference's shared
+    SubModelConfig.  `boot` is an OUTER layer captured by closure (reference
+    memory boot_layer semantics)."""
+
+    def step(trg_emb_t, enc_seq, enc_p):
+        state = L.memory("dec_state", hidden_dim, boot_layer=boot)
+        context = paddle.networks.simple_attention(
+            encoded_sequence=enc_seq,
+            encoded_proj=enc_p,
+            decoder_state=state,
+            name="att",
+        )
+        inputs = L.fc(
+            [context, trg_emb_t],
+            size=hidden_dim * 3,
+            act=A.Identity(),
+            bias_attr=False,
+            name="dec_in_proj",
+        )
+        gru = L.gru_step(inputs, state, size=hidden_dim, name="dec_state")
+        out = L.fc(gru, size=trg_vocab, act=A.Softmax(), name="dec_out")
+        return out
+
+    return step
+
+
+def seq2seq_cost(
+    src_vocab: int,
+    trg_vocab: int,
+    word_dim: int = 128,
+    hidden_dim: int = 256,
+) -> Tuple[LayerOutput, LayerOutput]:
+    """Training topology.  Data slots: src_word ids, trg_word ids (bos-led),
+    trg_next ids (the shifted targets)."""
+    src = L.data("src_word", paddle.data_type.integer_value_sequence(src_vocab))
+    trg = L.data("trg_word", paddle.data_type.integer_value_sequence(trg_vocab))
+    lbl = L.data("trg_next", paddle.data_type.integer_value_sequence(trg_vocab))
+
+    enc, enc_proj = encoder_net(src, word_dim, hidden_dim)
+    boot = L.fc(
+        L.first_seq(enc, name="enc_first"),
+        size=hidden_dim,
+        act=A.Tanh(),
+        name="dec_boot",
+    )
+    trg_emb = L.embedding(trg, size=word_dim, name="trg_emb")
+
+    step = decoder_step_builder(hidden_dim, trg_vocab, boot)
+    dec = L.recurrent_group(
+        step,
+        [
+            trg_emb,
+            L.StaticInput(enc, is_seq=True),
+            L.StaticInput(enc_proj, is_seq=True),
+        ],
+        name="decoder",
+    )
+    cost = L.classification_cost(input=dec, label=lbl, name="nmt_cost")
+    return cost, dec
+
+
+class Seq2SeqGenerator:
+    """On-device generation over a trained seq2seq net (capi-style inference
+    surface; reference: paddle/gserver/.../RecurrentGradientMachine
+    generation mode + demo seqToseq gen configs)."""
+
+    def __init__(
+        self,
+        parameters: "paddle.parameters.Parameters",
+        src_vocab: int,
+        trg_vocab: int,
+        word_dim: int = 128,
+        hidden_dim: int = 256,
+        bos_id: int = 0,
+        eos_id: int = 1,
+        max_length: int = 32,
+        beam_size: int = 4,
+    ):
+        self.params = parameters
+        self.net = parameters.network
+        self.topo = self.net.topology
+        self.hidden_dim = hidden_dim
+        self.trg_vocab = trg_vocab
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.max_length = max_length
+        self.beam_size = beam_size
+
+        dec_conf = self.topo.get("decoder")
+        self._sub_topo = dec_conf.attrs["_sub_topology"]
+        self._subnet = CompiledNetwork(self._sub_topo)
+        self._scan_names = dec_conf.attrs["_scan_placeholders"]
+        self._static_info = dec_conf.attrs["_static_placeholders"]
+        self._memories = dec_conf.attrs["_memories"]
+
+    # -- encoder forward up to the decoder's static inputs ---------------
+    def _encode(self, batch):
+        outs, _ = self.net.apply(
+            self.params.params, batch, state=self.params.state, train=False
+        )
+        return outs
+
+    def _step_fn(self, statics):
+        """Build step_fn(ids, carry) for beam/greedy: embeds ids with the
+        trained trg_emb table, runs the decoder sub-network once."""
+        emb_w = self.params.params["trg_emb"]["w"]
+        sub_params = self.params.params["decoder"]
+
+        def step_fn(ids, carry):
+            sub_batch = dict(statics)
+            emb = jnp.take(emb_w, ids, axis=0)
+            sub_batch[self._scan_names[0]] = SeqTensor(emb)
+            for m in self._memories:
+                sub_batch[m.name] = SeqTensor(carry[m.name])
+            outs, _ = self._subnet.apply(sub_params, sub_batch, train=False)
+            new_carry = {m.name: outs[m.attrs["link"]].data for m in self._memories}
+            prob = outs["dec_out"].data
+            return jnp.log(jnp.maximum(prob, 1e-9)), new_carry
+
+        return step_fn
+
+    def _prepare(self, batch):
+        outs = self._encode(batch)
+        statics = {}
+        static_layers = ["enc", "enc_proj"]
+        for (pname, is_seq), lname in zip(self._static_info, static_layers):
+            val = outs[lname]
+            statics[pname] = val if is_seq else SeqTensor(val.data)
+        boot = outs["dec_boot"].data
+        carry = {m.name: boot for m in self._memories}
+        b = boot.shape[0]
+        return statics, carry, b
+
+    def generate(self, batch, beam_size: Optional[int] = None):
+        """Beam-search decode; returns (sequences [B,K,T], scores [B,K])."""
+        from paddle_tpu.ops.beam import beam_search
+
+        k = beam_size or self.beam_size
+        statics, carry, b = self._prepare(batch)
+        # static tensors must be expanded to B*K rows inside beam_search —
+        # it repeats carry but statics stay per-row: expand here.
+        statics_k = {
+            n: SeqTensor(
+                jnp.repeat(t.data, k, axis=0),
+                None if t.lengths is None else jnp.repeat(t.lengths, k, axis=0),
+            )
+            for n, t in statics.items()
+        }
+        return beam_search(
+            self._step_fn(statics_k),
+            carry,
+            batch_size=b,
+            beam_size=k,
+            vocab_size=self.trg_vocab,
+            bos_id=self.bos_id,
+            eos_id=self.eos_id,
+            max_len=self.max_length,
+        )
+
+    def generate_greedy(self, batch):
+        from paddle_tpu.ops.beam import greedy_search
+
+        statics, carry, b = self._prepare(batch)
+        return greedy_search(
+            self._step_fn(statics),
+            carry,
+            batch_size=b,
+            bos_id=self.bos_id,
+            eos_id=self.eos_id,
+            max_len=self.max_length,
+        )

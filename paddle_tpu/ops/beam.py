@@ -1,0 +1,123 @@
+"""Beam search as a jitted lax.scan — the TPU-native replacement for the
+reference's host-side beam search (reference: paddle/gserver/
+gradientmachines/RecurrentGradientMachine.cpp:1393 beamSearch, .cpp:964
+generateSequence): fixed beam width K and max length T, padded beams, eos
+handling via finished masks — no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def beam_search(
+    step_fn: Callable[[jnp.ndarray, Any], Tuple[jnp.ndarray, Any]],
+    init_carry: Any,
+    batch_size: int,
+    beam_size: int,
+    vocab_size: int,
+    bos_id: int,
+    eos_id: int,
+    max_len: int,
+):
+    """Generic beam search.
+
+    step_fn(ids[B*K] int32, carry) -> (log_probs [B*K, V], new_carry); carry
+    leaves must have leading dim B*K.  Returns (sequences [B, K, T] int32,
+    scores [B, K]) sorted best-first.  Finished beams propagate only via the
+    eos column so shorter hypotheses stay comparable (the reference's
+    eosFrameLine_ bookkeeping).
+    """
+    bk = batch_size * beam_size
+
+    def expand_first(x):
+        # [B, ...] -> [B*K, ...] by repeat
+        return jnp.repeat(x, beam_size, axis=0)
+
+    carry0 = jax.tree_util.tree_map(expand_first, init_carry)
+    ids0 = jnp.full((bk,), bos_id, jnp.int32)
+    # Only beam 0 of each batch starts alive; others -inf so the first step
+    # picks K distinct tokens rather than K copies.
+    scores0 = jnp.tile(
+        jnp.asarray([0.0] + [NEG_INF] * (beam_size - 1), jnp.float32),
+        (batch_size,),
+    )
+    finished0 = jnp.zeros((bk,), bool)
+
+    def body(state, _):
+        ids, scores, finished, carry, seqs, t = state
+        logp, new_carry = step_fn(ids, carry)  # [B*K, V]
+        # Finished beams: only the eos continuation at score-delta 0, so
+        # their total stays frozen and they remain comparable.
+        eos_row = jnp.where(
+            jnp.arange(vocab_size) == eos_id, 0.0, NEG_INF
+        ).astype(logp.dtype)
+        logp = jnp.where(finished[:, None], eos_row[None, :], logp)
+        cand = scores[:, None] + logp  # [B*K, V]
+        cand = cand.reshape(batch_size, beam_size * vocab_size)
+        top_scores, top_idx = jax.lax.top_k(cand, beam_size)  # [B, K]
+        beam_idx = top_idx // vocab_size  # which parent beam
+        tok_idx = (top_idx % vocab_size).astype(jnp.int32)  # which token
+
+        # flat parent indices into [B*K]
+        parent = (
+            beam_idx + jnp.arange(batch_size, dtype=beam_idx.dtype)[:, None] * beam_size
+        ).reshape(-1)
+        new_scores = top_scores.reshape(-1)
+        new_ids = tok_idx.reshape(-1)
+        new_finished = jnp.take(finished, parent) | (new_ids == eos_id)
+        new_carry = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, parent, axis=0), new_carry
+        )
+        new_seqs = jnp.take(seqs, parent, axis=0)  # reorder histories
+        new_seqs = new_seqs.at[:, t].set(new_ids)
+        return (new_ids, new_scores, new_finished, new_carry, new_seqs, t + 1), None
+
+    seqs0 = jnp.zeros((bk, max_len), jnp.int32)
+    state0 = (ids0, scores0, finished0, carry0, seqs0, jnp.asarray(0, jnp.int32))
+    (ids, scores, finished, carry, seqs, _), _ = jax.lax.scan(
+        body, state0, None, length=max_len
+    )
+    seqs = seqs.reshape(batch_size, beam_size, max_len)
+    scores = scores.reshape(batch_size, beam_size)
+    order = jnp.argsort(-scores, axis=1)
+    seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores
+
+
+def greedy_search(
+    step_fn: Callable[[jnp.ndarray, Any], Tuple[jnp.ndarray, Any]],
+    init_carry: Any,
+    batch_size: int,
+    bos_id: int,
+    eos_id: int,
+    max_len: int,
+):
+    """Greedy decode: argmax each step; returns ([B, T] ids, [B] lengths)."""
+
+    ids0 = jnp.full((batch_size,), bos_id, jnp.int32)
+    finished0 = jnp.zeros((batch_size,), bool)
+
+    def body(state, _):
+        ids, finished, carry = state
+        logp, new_carry = step_fn(ids, carry)
+        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, eos_id, nxt)
+        new_finished = finished | (nxt == eos_id)
+        return (nxt, new_finished, new_carry), nxt
+
+    (_, finished, _), toks = jax.lax.scan(
+        body, (ids0, finished0, init_carry), None, length=max_len
+    )
+    toks = jnp.swapaxes(toks, 0, 1)  # [B, T]
+    is_eos = toks == eos_id
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos.astype(jnp.int32), axis=1)
+    lengths = jnp.where(any_eos, first_eos, max_len).astype(jnp.int32)
+    return toks, lengths

@@ -30,34 +30,44 @@ class Parameters:
         self.params = params
         self.state = state
 
-    # -- dict-like numpy access (name = "layer.slot") -------------------
+    # -- dict-like numpy access (name = dotted path, e.g. "fc0.w0" or
+    # "decoder.hproj.w0" for nested recurrent_group params) --------------
     def names(self):
-        return [
-            f"{layer}.{slot}"
-            for layer, slots in self.params.items()
-            for slot in slots
-        ]
+        out = []
+
+        def walk(prefix, node):
+            if isinstance(node, dict):
+                for k in node:
+                    walk(f"{prefix}.{k}" if prefix else k, node[k])
+            else:
+                out.append(prefix)
+
+        walk("", self.params)
+        return out
 
     def keys(self):
         return self.names()
 
-    def _split(self, key: str) -> Tuple[str, str]:
-        layer, _, slot = key.rpartition(".")
-        return layer, slot
+    def _resolve(self, key: str):
+        parts = key.split(".")
+        node = self.params
+        for p in parts[:-1]:
+            node = node[p]
+        return node, parts[-1]
 
     def get(self, key: str) -> np.ndarray:
-        layer, slot = self._split(key)
-        return np.asarray(self.params[layer][slot])
+        node, leaf = self._resolve(key)
+        return np.asarray(node[leaf])
 
     __getitem__ = get
 
     def set(self, key: str, value: np.ndarray) -> None:
         import jax.numpy as jnp
 
-        layer, slot = self._split(key)
-        old = self.params[layer][slot]
+        node, leaf = self._resolve(key)
+        old = node[leaf]
         value = jnp.asarray(value, dtype=old.dtype).reshape(old.shape)
-        self.params[layer][slot] = value
+        node[leaf] = value
 
     __setitem__ = set
 
@@ -80,13 +90,14 @@ class Parameters:
                 tar.addfile(info, io.BytesIO(payload))
 
     def from_tar(self, f) -> None:
+        known = set(self.names())
         with tarfile.open(fileobj=f, mode="r") as tar:
             for member in tar.getmembers():
                 buf = tar.extractfile(member).read()
                 version, value_size, size = struct.unpack("<iIQ", buf[:16])
                 assert value_size == 4, "only float32 checkpoints supported"
                 arr = np.frombuffer(buf[16 : 16 + 4 * size], dtype=np.float32)
-                if member.name in set(self.names()):
+                if member.name in known:
                     self.set(member.name, arr)
 
     @staticmethod

@@ -51,6 +51,15 @@ class DataFeeder:
         return self.convert(batch_data)
 
     def convert(self, batch_data: List[Any]) -> Dict[str, SeqTensor]:
+        n_slots = max(self.index.values()) + 1 if self.index else 0
+        for sample in batch_data[:1]:
+            if not isinstance(sample, (tuple, list)) or len(sample) < n_slots:
+                raise ValueError(
+                    f"each sample must be a tuple of {n_slots} slot(s) "
+                    f"({[n for n, _ in self.data_types]}); got "
+                    f"{type(sample).__name__}. Did you forget to wrap the "
+                    f"reader with paddle.batch(reader, batch_size)?"
+                )
         out: Dict[str, SeqTensor] = {}
         for name, itype in self.data_types:
             col = [sample[self.index[name]] for sample in batch_data]

@@ -1,0 +1,179 @@
+"""recurrent_group tests — the test_RecurrentGradientMachine/
+test_RecurrentLayer equivalents (reference: paddle/gserver/tests/
+test_RecurrentLayer.cpp compares recurrent_group output against the fused
+recurrent layer with identical weights)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+from layer_grad_util import check_layer_grad, rand_batch_for
+
+L = paddle.layer
+A = paddle.activation
+
+
+@pytest.fixture(autouse=True)
+def _reset_names():
+    reset_auto_names()
+    yield
+
+
+H = 6
+
+
+def make_batch(seed=0, b=4, t=7, d=H):
+    rng = np.random.RandomState(seed)
+    lengths = np.array([7, 3, 5, 1], dtype=np.int32)[:b]
+    data = rng.randn(b, t, d).astype(np.float32)
+    mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+    data = data * mask[..., None]
+    return SeqTensor(jnp.asarray(data), jnp.asarray(lengths))
+
+
+def test_group_matches_fused_recurrent():
+    """A simple-RNN built from fc+addto inside recurrent_group must equal the
+    fused `recurrent` layer given the same weights."""
+    x = L.data("x", paddle.data_type.dense_vector_sequence(H))
+
+    def step(x_t):
+        mem = L.memory("h", H)
+        hm = L.fc(mem, H, act=A.Identity(), bias_attr=False, name="hproj")
+        h = L.addto([x_t, hm], act=A.Tanh(), bias_attr=True, name="h")
+        return h
+
+    grp = L.recurrent_group(step, x, name="grp")
+    fused = L.recurrent(x, act=A.Tanh(), name="fused")
+
+    topo = Topology([grp, fused])
+    net = CompiledNetwork(topo)
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    # tie weights: fused.w_h <- hproj.w0, fused.b <- h.b
+    params["fused"]["w_h"] = params["grp"]["hproj"]["w0"]
+    params["fused"]["b"] = params["grp"]["h"]["b"]
+
+    batch = {"x": make_batch()}
+    outs, _ = net.apply(params, batch, train=False)
+    g = np.asarray(outs["grp"].masked_data())
+    f = np.asarray(outs["fused"].masked_data())
+    np.testing.assert_allclose(g, f, rtol=1e-5, atol=1e-5)
+
+
+def test_group_reverse_matches_fused():
+    x = L.data("x", paddle.data_type.dense_vector_sequence(H))
+
+    def step(x_t):
+        mem = L.memory("h", H)
+        hm = L.fc(mem, H, act=A.Identity(), bias_attr=False, name="hproj")
+        return L.addto([x_t, hm], act=A.Tanh(), bias_attr=True, name="h")
+
+    grp = L.recurrent_group(step, x, reverse=True, name="grp")
+    fused = L.recurrent(x, act=A.Tanh(), reverse=True, name="fused")
+    topo = Topology([grp, fused])
+    net = CompiledNetwork(topo)
+    params = net.init_params(jax.random.PRNGKey(0))
+    params["fused"]["w_h"] = params["grp"]["hproj"]["w0"]
+    params["fused"]["b"] = params["grp"]["h"]["b"]
+    batch = {"x": make_batch()}
+    outs, _ = net.apply(params, batch, train=False)
+    np.testing.assert_allclose(
+        np.asarray(outs["grp"].masked_data()),
+        np.asarray(outs["fused"].masked_data()),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_group_with_boot_memory():
+    x = L.data("x", paddle.data_type.dense_vector_sequence(H))
+    boot_src = L.data("bootsrc", paddle.data_type.dense_vector(4))
+    boot = L.fc(boot_src, H, act=A.Tanh(), name="boot")
+
+    def step(x_t):
+        mem = L.memory("h", H, boot_layer=boot)
+        hm = L.fc(mem, H, act=A.Identity(), bias_attr=False, name="hproj")
+        return L.addto([x_t, hm], act=A.Tanh(), name="h")
+
+    grp = L.recurrent_group(step, x, name="grp")
+    topo = Topology([grp])
+    net = CompiledNetwork(topo)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    batch = {
+        "x": make_batch(),
+        "bootsrc": SeqTensor(jnp.asarray(rng.randn(4, 4), jnp.float32)),
+    }
+    outs, _ = net.apply(params, batch, train=False)
+    out = np.asarray(outs["grp"].data)
+    assert out.shape == (4, 7, H)
+    assert np.isfinite(out).all()
+    # boot must influence t=0 output: zero the boot input and compare
+    batch2 = dict(batch)
+    batch2["bootsrc"] = SeqTensor(jnp.zeros((4, 4), jnp.float32))
+    outs2, _ = net.apply(params, batch2, train=False)
+    assert not np.allclose(out[:, 0], np.asarray(outs2["grp"].data)[:, 0])
+
+
+def test_group_gradients():
+    x = L.data("in0", paddle.data_type.dense_vector_sequence(H))
+
+    def step(x_t):
+        mem = L.memory("h", H)
+        hm = L.fc(mem, H, act=A.Identity(), bias_attr=False, name="hproj")
+        return L.addto([x_t, hm], act=A.Tanh(), name="h")
+
+    grp = L.recurrent_group(step, x, name="grp")
+    check_layer_grad(grp, atol=8e-2, rtol=8e-2)
+
+
+def test_group_static_input_attention():
+    """Attention decoder pattern: static encoder sequence + memory decoder
+    state; checks shapes, masking and that attention weights vary by step."""
+    src = L.data("src", paddle.data_type.dense_vector_sequence(5))
+    trg = L.data("trg", paddle.data_type.dense_vector_sequence(3))
+    enc = paddle.networks.simple_gru(src, size=H, name="enc")
+    enc_proj = L.fc(enc, size=H, act=A.Identity(), bias_attr=False, name="encproj")
+
+    def step(trg_t, enc_seq, enc_p):
+        state = L.memory("dec", H)
+        context = paddle.networks.simple_attention(
+            encoded_sequence=enc_seq,
+            encoded_proj=enc_p,
+            decoder_state=state,
+            name="att",
+        )
+        return L.fc(
+            [context, trg_t, state], size=H, act=A.Tanh(), name="dec"
+        )
+
+    grp = L.recurrent_group(
+        step,
+        [trg, L.StaticInput(enc, is_seq=True), L.StaticInput(enc_proj, is_seq=True)],
+        name="decoder",
+    )
+    topo = Topology([grp])
+    net = CompiledNetwork(topo)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    src_lens = np.array([6, 2, 4, 6], np.int32)
+    trg_lens = np.array([5, 3, 1, 4], np.int32)
+    src_data = rng.randn(4, 6, 5).astype(np.float32)
+    trg_data = rng.randn(4, 5, 3).astype(np.float32)
+    batch = {
+        "src": SeqTensor(jnp.asarray(src_data), jnp.asarray(src_lens)),
+        "trg": SeqTensor(jnp.asarray(trg_data), jnp.asarray(trg_lens)),
+    }
+    outs, _ = net.apply(params, batch, train=False)
+    out = np.asarray(outs["decoder"].data)
+    assert out.shape == (4, 5, H)
+    assert np.isfinite(out).all()
+    # masking: steps beyond trg length are zero
+    assert np.allclose(out[2, 1:], 0.0)
+    assert np.allclose(out[1, 3:], 0.0)
