@@ -19,6 +19,7 @@ from __future__ import annotations
 from paddle_tpu import activation  # noqa: F401
 from paddle_tpu import attr  # noqa: F401
 from paddle_tpu import dataset  # noqa: F401
+from paddle_tpu import evaluator  # noqa: F401
 from paddle_tpu import event  # noqa: F401
 from paddle_tpu import layers as layer  # noqa: F401
 from paddle_tpu.layers import networks  # noqa: F401

@@ -25,6 +25,10 @@ from paddle_tpu.layers import base as _base  # noqa: F401
 from paddle_tpu.layers import basic as _basic  # noqa: F401
 from paddle_tpu.layers import conv as _conv  # noqa: F401
 from paddle_tpu.layers import cost as _cost  # noqa: F401
+from paddle_tpu.layers import misc as _misc  # noqa: F401
+from paddle_tpu.layers import mixed as _mixed_impl  # noqa: F401
+from paddle_tpu.layers import sampled as _sampled  # noqa: F401
+from paddle_tpu.layers import structured as _structured  # noqa: F401
 from paddle_tpu.layers import sequence as _sequence  # noqa: F401
 from paddle_tpu.layers.recurrent_group import (  # noqa: F401
     StaticInput,
@@ -1051,6 +1055,533 @@ def eos(input: LayerOutput, eos_id: int, name=None) -> LayerOutput:
 
 
 eos_layer = eos
+
+
+# ---------------------------------------------------------------------------
+# misc inventory layers (layers/misc.py impls)
+# ---------------------------------------------------------------------------
+
+
+def prelu(input: LayerOutput, partial_sum: int = 1, name=None) -> LayerOutput:
+    return _unary("prelu", input, name=name, partial_sum=partial_sum)
+
+
+prelu_layer = prelu
+
+
+def power(input: LayerOutput, weight: LayerOutput, name=None) -> LayerOutput:
+    """reference power_layer: y = input ^ weight (weight [B,1])."""
+    conf = LayerConf(
+        name=name or auto_name("power"),
+        type="power",
+        size=input.size,
+        inputs=(weight.name, input.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [weight, input])
+
+
+power_layer = power
+
+
+def data_norm(input: LayerOutput, strategy: str = "z-score", name=None) -> LayerOutput:
+    return _unary("data_norm", input, name=name, strategy=strategy)
+
+
+def block_expand(
+    input: LayerOutput,
+    block_x: int,
+    block_y: int,
+    stride_x: int = 1,
+    stride_y: int = 1,
+    padding_x: int = 0,
+    padding_y: int = 0,
+    num_channels: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference block_expand_layer (BlockExpandLayer.cpp): im2col into a
+    block sequence."""
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    conf = LayerConf(
+        name=name or auto_name("block_expand"),
+        type="block_expand",
+        size=in_c * block_x * block_y,
+        inputs=(input.name,),
+        bias=False,
+        attrs={
+            "in_h": in_h, "in_w": in_w, "in_c": in_c,
+            "block_x": block_x, "block_y": block_y,
+            "stride_x": stride_x, "stride_y": stride_y,
+            "padding_x": padding_x, "padding_y": padding_y,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+block_expand_layer = block_expand
+
+
+def rotate(input: LayerOutput, height: Optional[int] = None,
+           width: Optional[int] = None, name=None) -> LayerOutput:
+    a = _img_passthrough(input)
+    in_h = height or a.get("in_h")
+    in_w = width or a.get("in_w")
+    in_c = a.get("in_c", 1)
+    conf = LayerConf(
+        name=name or auto_name("rotate"),
+        type="rotate",
+        size=input.size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"in_h": in_h, "in_w": in_w, "in_c": in_c,
+               "out_h": in_w, "out_w": in_h, "channels": in_c},
+    )
+    return LayerOutput(conf, [input])
+
+
+rotate_layer = rotate
+
+
+def sub_seq(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput,
+            name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("sub_seq"),
+        type="sub_seq",
+        size=input.size,
+        inputs=(input.name, offsets.name, sizes.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [input, offsets, sizes])
+
+
+sub_seq_layer = sub_seq
+
+
+def linear_comb(weights: LayerOutput, vectors: LayerOutput, size: int,
+                name=None) -> LayerOutput:
+    """reference linear_comb_layer / convex_comb_layer."""
+    conf = LayerConf(
+        name=name or auto_name("linear_comb"),
+        type="linear_comb",
+        size=size,
+        inputs=(weights.name, vectors.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [weights, vectors])
+
+
+convex_comb = linear_comb
+convex_comb_layer = linear_comb
+
+
+def cos_sim_vec_mat(vec: LayerOutput, mat: LayerOutput, size: int,
+                    scale: float = 1.0, name=None) -> LayerOutput:
+    """reference cos_vm (CosSimVecMatLayer.cpp)."""
+    conf = LayerConf(
+        name=name or auto_name("cos_vm"),
+        type="cos_vm",
+        size=size,
+        inputs=(vec.name, mat.name),
+        bias=False,
+        attrs={"scale": scale},
+    )
+    return LayerOutput(conf, [vec, mat])
+
+
+def print_layer(input: LayerOutput, format: str = "{name}: {val}", name=None) -> LayerOutput:
+    return _unary("print", input, name=name, format=format)
+
+
+def scale_shift(input: LayerOutput, bias_attr: Union[bool, ParamAttr] = True,
+                name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("scale_shift"),
+        type="scale_shift",
+        size=input.size,
+        inputs=(input.name,),
+        bias=bool(bias_attr),
+    )
+    return LayerOutput(conf, [input])
+
+
+scale_shift_layer = scale_shift
+
+
+def kmax_seq_score(input: LayerOutput, beam_size: int = 1, name=None) -> LayerOutput:
+    conf = LayerConf(
+        name=name or auto_name("kmax_seq_score"),
+        type="kmax_seq_score",
+        size=beam_size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"beam_size": beam_size},
+    )
+    return LayerOutput(conf, [input])
+
+
+# ---------------------------------------------------------------------------
+# large-vocab output layers: nce / hsigmoid / selective_fc / lambda_cost
+# (reference layers.py nce_layer, hsigmoid, selective_fc_layer, lambda_cost)
+# ---------------------------------------------------------------------------
+
+
+def nce(
+    input: Inputish,
+    label: LayerOutput,
+    num_classes: Optional[int] = None,
+    num_neg_samples: int = 10,
+    noise_dist: Optional[Sequence[float]] = None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    feats = _as_list(input)
+    c = num_classes or label.size
+    conf = LayerConf(
+        name=name or auto_name("nce"),
+        type="nce",
+        size=1,
+        inputs=tuple(f.name for f in feats) + (label.name,),
+        bias=bool(bias_attr),
+        attrs={
+            "num_classes": c,
+            "num_neg_samples": num_neg_samples,
+            "num_feat_inputs": len(feats),
+            "noise_dist": tuple(noise_dist) if noise_dist is not None else None,
+        },
+    )
+    return LayerOutput(conf, feats + [label])
+
+
+nce_layer = nce
+
+
+def hsigmoid(
+    input: Inputish,
+    label: LayerOutput,
+    num_classes: Optional[int] = None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    feats = _as_list(input)
+    c = num_classes or label.size
+    conf = LayerConf(
+        name=name or auto_name("hsigmoid"),
+        type="hsigmoid",
+        size=1,
+        inputs=tuple(f.name for f in feats) + (label.name,),
+        bias=bool(bias_attr),
+        attrs={"num_classes": c},
+    )
+    return LayerOutput(conf, feats + [label])
+
+
+def selective_fc(
+    input: Inputish,
+    select: Optional[LayerOutput],
+    size: int,
+    act=None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    feats = _as_list(input)
+    parents = feats + ([select] if select is not None else [])
+    conf = LayerConf(
+        name=name or auto_name("selective_fc"),
+        type="selective_fc",
+        size=size,
+        inputs=tuple(p.name for p in parents),
+        act=act_name(act),
+        bias=bool(bias_attr),
+        attrs={"has_selection": select is not None,
+               "param_std": _param_std(param_attr)},
+    )
+    return LayerOutput(conf, parents)
+
+
+selective_fc_layer = selective_fc
+
+
+def lambda_cost(
+    input: LayerOutput,
+    score: LayerOutput,
+    NDCG_num: int = 5,
+    max_sort_size: int = -1,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference lambda_cost (LambdaCost.cpp): `input` is the model score
+    sequence, `score` the gold relevance sequence.  max_sort_size is accepted
+    for API parity; the TPU version always ranks the full (padded) list."""
+    conf = LayerConf(
+        name=name or auto_name("lambda_cost"),
+        type="lambda_cost",
+        size=1,
+        inputs=(input.name, score.name),
+        bias=False,
+        attrs={"ndcg_num": NDCG_num},
+    )
+    return LayerOutput(conf, [input, score])
+
+
+# ---------------------------------------------------------------------------
+# structured prediction: crf / crf_decoding / ctc / warp_ctc
+# (reference layers.py crf_layer, crf_decoding_layer, ctc_layer, warp_ctc_layer)
+# ---------------------------------------------------------------------------
+
+
+def crf(
+    input: LayerOutput,
+    label: LayerOutput,
+    size: Optional[int] = None,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Linear-chain CRF cost (reference crf_layer → CRFLayer.cpp)."""
+    n = size or input.size
+    conf = LayerConf(
+        name=name or auto_name("crf"),
+        type="crf",
+        size=1,
+        inputs=(input.name, label.name),
+        bias=False,
+        attrs={"num_classes": n, "param_std": _param_std(param_attr)},
+    )
+    return LayerOutput(conf, [input, label])
+
+
+crf_layer = crf
+
+
+def crf_decoding(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    label: Optional[LayerOutput] = None,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Viterbi decoding (reference crf_decoding_layer → CRFDecodingLayer.cpp);
+    with `label`, emits per-position mismatch indicators."""
+    n = size or input.size
+    parents = [input] + ([label] if label is not None else [])
+    conf = LayerConf(
+        name=name or auto_name("crf_decoding"),
+        type="crf_decoding",
+        size=n,
+        inputs=tuple(p.name for p in parents),
+        bias=False,
+        attrs={"num_classes": n, "param_std": _param_std(param_attr)},
+    )
+    return LayerOutput(conf, parents)
+
+
+crf_decoding_layer = crf_decoding
+
+
+def ctc(
+    input: LayerOutput,
+    label: LayerOutput,
+    size: Optional[int] = None,
+    blank: Optional[int] = None,
+    norm_by_times: bool = False,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """CTC cost (reference ctc_layer → CTCLayer.cpp/LinearChainCTC.cpp).
+    `size` = num_classes + 1 (incl. blank); blank defaults to size-1."""
+    n = size or input.size
+    conf = LayerConf(
+        name=name or auto_name("ctc"),
+        type="ctc",
+        size=1,
+        inputs=(input.name, label.name),
+        bias=False,
+        attrs={
+            "blank": blank if blank is not None else n - 1,
+            "norm_by_times": norm_by_times,
+            "_num_classes": n,
+        },
+    )
+    return LayerOutput(conf, [input, label])
+
+
+ctc_layer = ctc
+
+
+def warp_ctc(
+    input: LayerOutput,
+    label: LayerOutput,
+    size: Optional[int] = None,
+    blank: int = 0,
+    norm_by_times: bool = False,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference warp_ctc_layer (WarpCTCLayer.cpp): same loss, blank=0
+    convention.  On TPU both lower to the same scan DP."""
+    return ctc(input, label, size=size, blank=blank,
+               norm_by_times=norm_by_times, name=name or auto_name("warp_ctc"))
+
+
+warp_ctc_layer = warp_ctc
+
+
+# ---------------------------------------------------------------------------
+# mixed layer + projections (reference: trainer_config_helpers mixed_layer +
+# *_projection functions, config_parser.py:487-858; MixedLayer.cpp)
+# ---------------------------------------------------------------------------
+
+
+class Projection:
+    """Spec for one term of a mixed layer.  Unlike a LayerOutput this is not
+    itself a graph node — the enclosing mixed layer owns the parameters (the
+    reference's Projection objects likewise live inside MixedLayer,
+    Projection.h)."""
+
+    def __init__(self, kind: str, input: LayerOutput, **attrs):
+        self.kind = kind
+        self.input = input
+        self.attrs = attrs
+
+
+def full_matrix_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
+    return Projection("full_matrix", input, param_std=_param_std(param_attr))
+
+
+def trans_full_matrix_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
+    return Projection("trans_full_matrix", input, param_std=_param_std(param_attr))
+
+
+def table_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
+    return Projection("table", input, param_std=_param_std(param_attr))
+
+
+def identity_projection(input: LayerOutput, offset: Optional[int] = None, size: int = 0) -> Projection:
+    if offset is None:
+        return Projection("identity", input)
+    return Projection("identity_offset", input, offset=offset, size=size)
+
+
+def slice_projection(input: LayerOutput, slices: Sequence[tuple]) -> Projection:
+    return Projection("slice", input, slices=tuple(tuple(s) for s in slices))
+
+
+def scaling_projection(input: LayerOutput) -> Projection:
+    return Projection("scaling", input)
+
+
+def dotmul_projection(input: LayerOutput) -> Projection:
+    return Projection("dotmul", input)
+
+
+def conv_projection(
+    input: LayerOutput,
+    filter_size: int,
+    num_filters: int,
+    num_channels: Optional[int] = None,
+    stride: int = 1,
+    padding: int = 0,
+    groups: int = 1,
+    param_attr: Optional[ParamAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference conv_projection — here a bias-less conv layer the mixed
+    layer consumes as an identity term (same math, reuses the conv impl)."""
+    return img_conv(
+        input,
+        filter_size=filter_size,
+        num_filters=num_filters,
+        num_channels=num_channels,
+        stride=stride,
+        padding=padding,
+        groups=groups,
+        act=_act_mod.Identity(),
+        bias_attr=False,
+        param_attr=param_attr,
+        name=name or auto_name("conv_proj"),
+    )
+
+
+def conv_operator(
+    img: LayerOutput,
+    filter: LayerOutput,
+    filter_size: int,
+    num_filters: int,
+    num_channels: Optional[int] = None,
+    stride: int = 1,
+    padding: int = 0,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference conv_operator (ConvOperator.cpp): convolve the image input
+    with per-sample filters produced by another layer."""
+    img_attrs = _img_passthrough(img)
+    in_c = num_channels if num_channels is not None else img_attrs.get("out_c", 1)
+    in_h, in_w = img_attrs.get("out_h"), img_attrs.get("out_w")
+    out_h = cnn_output_size(in_h, filter_size, padding, stride)
+    out_w = cnn_output_size(in_w, filter_size, padding, stride)
+    conf = LayerConf(
+        name=name or auto_name("conv_op"),
+        type="conv_op",
+        size=num_filters * out_h * out_w,
+        inputs=(img.name, filter.name),
+        bias=False,
+        attrs={
+            "in_h": in_h, "in_w": in_w, "in_c": in_c,
+            "filter_h": filter_size, "filter_w": filter_size,
+            "channels": num_filters,
+            "stride_h": stride, "stride_w": stride,
+            "pad_h": padding, "pad_w": padding,
+            "out_h": out_h, "out_w": out_w, "out_c": num_filters,
+        },
+    )
+    return LayerOutput(conf, [img, filter])
+
+
+def mixed(
+    size: int = 0,
+    input: Union[Projection, LayerOutput, Sequence[Union[Projection, LayerOutput]], None] = None,
+    name: Optional[str] = None,
+    act=None,
+    bias_attr: Union[bool, ParamAttr, None] = False,
+    layer_attr: Optional[ExtraAttr] = None,
+) -> LayerOutput:
+    """reference mixed_layer (layers.py): sum of projections.  Plain
+    LayerOutputs enter as identity terms (the standalone forms of
+    context/conv projections and operators)."""
+    items = [input] if isinstance(input, (Projection, LayerOutput)) else list(input)
+    parents: list = []
+    specs: list = []
+    for item in items:
+        if isinstance(item, Projection):
+            lo, kind, attrs = item.input, item.kind, dict(item.attrs)
+        else:
+            lo, kind, attrs = item, "identity", {}
+        if lo.name not in [p.name for p in parents]:
+            parents.append(lo)
+        idx = [p.name for p in parents].index(lo.name)
+        specs.append({"kind": kind, "in": idx, **attrs})
+    if size == 0:
+        inferred = [
+            parents[s["in"]].size for s in specs
+            if s["kind"] in ("identity", "dotmul", "scaling")
+        ]
+        assert inferred, "mixed() needs an explicit size"
+        size = inferred[0]
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("mixed"),
+        type="mixed",
+        size=size,
+        inputs=tuple(p.name for p in parents),
+        act=act_name(act),
+        bias=bool(bias_attr),
+        drop_rate=drop,
+        shard_axis=shard,
+        attrs={"projections": tuple(specs)},
+    )
+    return LayerOutput(conf, parents)
+
+
+mixed_layer = mixed
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

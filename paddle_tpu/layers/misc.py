@@ -1,0 +1,235 @@
+"""Remaining layer inventory: prelu, power, data_norm, block_expand, rotate,
+sub_seq, linear_comb (convex_comb), cos_vm, print, scale_shift, kmax_seq.
+
+Reference: paddle/gserver/layers/{PReluLayer(ParameterReluLayer),PowerLayer,
+DataNormLayer,BlockExpandLayer,RotateLayer,SubSequenceLayer,LinearChainCombLayer
+(ConvexCombinationLayer),CosSimVecMatLayer,PrintLayer,ScaleShiftLayer,
+KmaxSeqScoreLayer}.cpp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+
+
+# ---------------------------------------------------------------------------
+# prelu — ParameterReluLayer.cpp: negative-slope parameter shared over groups
+# of `partial_sum` consecutive features
+# ---------------------------------------------------------------------------
+
+
+def prelu_init(conf, in_confs, rng):
+    partial = conf.attrs.get("partial_sum", 1)
+    return {"a": jnp.full((in_confs[0].size // partial,), 0.25)}
+
+
+@register_layer("prelu", init=prelu_init)
+def prelu_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    a = params["a"]
+    partial = conf.attrs.get("partial_sum", 1)
+    slope = jnp.repeat(a, partial)
+    return x.with_data(jnp.where(x.data > 0, x.data, slope * x.data))
+
+
+# ---------------------------------------------------------------------------
+# power — PowerLayer.cpp: y = x ^ w, w a per-sample scalar input
+# ---------------------------------------------------------------------------
+
+
+@register_layer("power")
+def power_apply(conf, params, inputs, ctx):
+    w, x = inputs  # w: [B, 1], x: [B, D]
+    return x.with_data(jnp.power(x.data, w.data))
+
+
+# ---------------------------------------------------------------------------
+# data_norm — DataNormLayer.cpp: fixed-statistics normalization.  The stats
+# are non-trainable state (set from dataset scan via set_state, like the
+# reference loads them from a pre-computed parameter).
+# ---------------------------------------------------------------------------
+
+
+def data_norm_state(conf, in_confs):
+    d = in_confs[0].size
+    return {
+        "mean": init.zeros((d,)),
+        "std": init.ones((d,)),
+        "min": init.zeros((d,)),
+        "max": init.ones((d,)),
+    }
+
+
+@register_layer("data_norm", init_state=data_norm_state)
+def data_norm_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    st = ctx.state.get(conf.name, {})
+    strategy = conf.attrs.get("strategy", "z-score")
+    if strategy == "z-score":
+        out = (x.data - st["mean"]) / jnp.maximum(st["std"], 1e-12)
+    elif strategy == "min-max":
+        rng_ = jnp.maximum(st["max"] - st["min"], 1e-12)
+        out = (x.data - st["min"]) / rng_
+    else:  # decimal-scaling
+        scale = jnp.power(
+            10.0, jnp.ceil(jnp.log10(jnp.maximum(jnp.abs(st["max"]), 1e-12)))
+        )
+        out = x.data / scale
+    return x.with_data(out)
+
+
+# ---------------------------------------------------------------------------
+# block_expand — BlockExpandLayer.cpp: im2col into a sequence of blocks
+# (OCR pipelines: image → block sequence → rnn/ctc).  Output is a sequence
+# with static length = num_blocks (every sample the same, lengths full).
+# ---------------------------------------------------------------------------
+
+
+@register_layer("block_expand")
+def block_expand_apply(conf, params, inputs, ctx):
+    from paddle_tpu.layers.conv import to_nhwc
+
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    bh, bw = a["block_y"], a["block_x"]
+    sh, sw = a.get("stride_y", 1), a.get("stride_x", 1)
+    ph, pw = a.get("padding_y", 0), a.get("padding_x", 0)
+    b_ = x.shape[0]
+    # NCHW for patch extraction to get channel-major block features
+    # (reference emits blocks as C*bh*bw rows).
+    patches = lax.conv_general_dilated_patches(
+        jnp.moveaxis(x, 3, 1),
+        filter_shape=(bh, bw),
+        window_strides=(sh, sw),
+        padding=[(ph, ph), (pw, pw)],
+    )  # [B, C*bh*bw, OH, OW]
+    c_blk = patches.shape[1]
+    seq = patches.reshape(b_, c_blk, -1).transpose(0, 2, 1)  # [B, OH*OW, F]
+    n_blocks = seq.shape[1]
+    lengths = jnp.full((b_,), n_blocks, jnp.int32)
+    return SeqTensor(seq, lengths)
+
+
+# ---------------------------------------------------------------------------
+# rotate — RotateLayer.cpp: 90° CCW rotation of each feature map
+# ---------------------------------------------------------------------------
+
+
+@register_layer("rotate")
+def rotate_apply(conf, params, inputs, ctx):
+    from paddle_tpu.layers.conv import to_nhwc
+
+    a = conf.attrs
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    out = jnp.flip(jnp.swapaxes(x, 1, 2), axis=1)  # [B, W, H, C]
+    return SeqTensor(out, inputs[0].lengths)
+
+
+# ---------------------------------------------------------------------------
+# sub_seq — SubSequenceLayer.cpp: slice [offset, offset+size) of each sequence
+# ---------------------------------------------------------------------------
+
+
+@register_layer("sub_seq")
+def sub_seq_apply(conf, params, inputs, ctx):
+    x, off_t, size_t = inputs
+    assert x.is_seq
+    off = off_t.data.astype(jnp.int32).reshape(-1)  # [B]
+    sz = size_t.data.astype(jnp.int32).reshape(-1)  # [B]
+    t_ = x.max_len
+    idx = jnp.clip(off[:, None] + jnp.arange(t_)[None, :], 0, t_ - 1)
+    data = jnp.take_along_axis(
+        x.data, idx.reshape(idx.shape + (1,) * (x.data.ndim - 2)), axis=1
+    )
+    return SeqTensor(data, jnp.minimum(sz, x.lengths - off))
+
+
+# ---------------------------------------------------------------------------
+# linear_comb / convex_comb — LinearCombinationLayer(ConvexCombinationLayer).cpp
+# y[d] = sum_m w[m] * x[m, d] with x given flat as [B, M*D]
+# ---------------------------------------------------------------------------
+
+
+@register_layer("linear_comb")
+def linear_comb_apply(conf, params, inputs, ctx):
+    w, x = inputs  # w: [B, M], x: [B, M*D]
+    b_ = w.data.shape[0]
+    m = w.data.shape[-1]
+    mat = x.data.reshape(b_, m, -1)
+    return SeqTensor(jnp.einsum("bm,bmd->bd", w.data, mat), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# cos_vm — CosSimVecMatLayer.cpp: cosine of a vector with each matrix row
+# ---------------------------------------------------------------------------
+
+
+@register_layer("cos_vm")
+def cos_vm_apply(conf, params, inputs, ctx):
+    v, m = inputs  # v: [B, D], m: [B, M*D]
+    scale = conf.attrs.get("scale", 1.0)
+    b_ = v.data.shape[0]
+    mat = m.data.reshape(b_, -1, v.data.shape[-1])  # [B, M, D]
+    num = jnp.einsum("bd,bmd->bm", v.data, mat)
+    den = jnp.linalg.norm(v.data, axis=-1, keepdims=True) * jnp.linalg.norm(
+        mat, axis=-1
+    )
+    return SeqTensor(scale * num / jnp.maximum(den, 1e-12), v.lengths)
+
+
+# ---------------------------------------------------------------------------
+# print — PrintLayer.cpp: host-side debug print, identity pass-through
+# ---------------------------------------------------------------------------
+
+
+@register_layer("print")
+def print_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    jax.debug.print(conf.attrs.get("format", "{name}: {val}"),
+                    name=conf.name, val=x.data)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# scale_shift — ScaleShiftLayer.cpp: y = scale * x + shift (learned scalars)
+# ---------------------------------------------------------------------------
+
+
+def scale_shift_init(conf, in_confs, rng):
+    p = {"scale": init.ones((1,))}
+    if conf.bias:
+        p["shift"] = init.zeros((1,))
+    return p
+
+
+@register_layer("scale_shift", init=scale_shift_init)
+def scale_shift_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    out = params["scale"][0] * x.data
+    if "shift" in params:
+        out = out + params["shift"][0]
+    return x.with_data(out)
+
+
+# ---------------------------------------------------------------------------
+# kmax_seq_score — KmaxSeqScoreLayer.cpp: indices of the top-k scores per seq
+# ---------------------------------------------------------------------------
+
+
+@register_layer("kmax_seq_score", auto_activation=False)
+def kmax_seq_score_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq
+    k = conf.attrs.get("beam_size", 1)
+    scores = x.data[..., 0] if x.data.ndim == 3 else x.data  # [B, T]
+    masked = jnp.where(x.mask(bool), scores, -jnp.inf)
+    vals, idx = lax.top_k(masked, k)
+    # slots beyond the sample's length get -1 (reference KmaxSeqScoreLayer)
+    idx = jnp.where(jnp.isfinite(vals), idx, -1)
+    return SeqTensor(idx.astype(jnp.int32))

@@ -1,0 +1,145 @@
+"""The ``mixed`` layer: a sum of projections over its inputs.
+
+Reference: paddle/gserver/layers/MixedLayer.cpp plus the Projection family
+(FullMatrixProjection.cpp, TransposedFullMatrixProjection.cpp,
+TableProjection.cpp, IdentityProjection.cpp (+offset), SliceProjection.cpp,
+ScalingProjection.cpp, DotMulProjection.cpp) and the config plane
+(config_parser.py:487-858).
+
+TPU-native design: a projection is not a runtime object — each is a small
+trace-time function contributing one term to a fused sum.  XLA fuses the
+adds into the matmuls, so an N-projection mixed layer is N MXU calls plus
+fused elementwise, with no interpreter dispatch.
+
+The conf carries ``attrs["projections"]``: a tuple of plain dicts
+``{"kind": ..., "in": input_index, ...kind-specific...}``.  Inputs that are
+ordinary layers (e.g. a conv_projection or context_projection layer output,
+or an operator output) enter as ``kind="identity"`` terms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import LayerConf
+from paddle_tpu.layers.base import ApplyContext, register_layer
+
+
+def _proj_params(kind: str, spec: Dict[str, Any], in_size: int, out_size: int,
+                 rng) -> Dict[str, Any]:
+    if kind == "full_matrix":
+        return {"w": init.normal(rng, (in_size, out_size),
+                                 spec.get("param_std"))}
+    if kind == "trans_full_matrix":
+        return {"w": init.normal(rng, (out_size, in_size),
+                                 spec.get("param_std"))}
+    if kind == "table":
+        vocab = spec["vocab"] if "vocab" in spec else in_size
+        return {"w": init.normal(rng, (vocab, out_size),
+                                 spec.get("param_std"))}
+    if kind == "scaling":
+        return {"w": init.normal(rng, (1,), 1.0)}
+    if kind == "dotmul":
+        return {"w": init.normal(rng, (out_size,),
+                                 1.0 / max(out_size, 1))}
+    return {}
+
+
+def mixed_init(conf: LayerConf, in_confs: List[LayerConf], rng) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    for j, spec in enumerate(conf.attrs["projections"]):
+        in_size = in_confs[spec["in"]].size
+        sub = _proj_params(spec["kind"], spec, in_size, conf.size,
+                          jax.random.fold_in(rng, j))
+        for k, v in sub.items():
+            params[f"p{j}_{k}"] = v
+    if conf.bias:
+        params["b"] = init.zeros((conf.size,))
+    return params
+
+
+def _apply_proj(spec: Dict[str, Any], p: Dict[str, Any], t: SeqTensor,
+                out_size: int) -> jnp.ndarray:
+    kind = spec["kind"]
+    x = t.data
+    if kind == "full_matrix":
+        if not t.is_seq and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        return jnp.matmul(x, p["w"])
+    if kind == "trans_full_matrix":
+        return jnp.matmul(x, p["w"].T)
+    if kind == "table":
+        idx = x.astype(jnp.int32)
+        if idx.ndim >= 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        return jnp.take(p["w"], idx, axis=0)
+    if kind == "identity":
+        return x
+    if kind == "identity_offset":
+        off = spec.get("offset", 0)
+        return x[..., off:off + out_size]
+    if kind == "slice":
+        return jnp.concatenate(
+            [x[..., b:e] for b, e in spec["slices"]], axis=-1
+        )
+    if kind == "scaling":
+        return p["w"][0] * x
+    if kind == "dotmul":
+        return x * p["w"]
+    raise KeyError(f"unknown projection kind {kind!r}")
+
+
+@register_layer("mixed", init=mixed_init)
+def mixed_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTensor:
+    acc = None
+    lengths = None
+    for j, spec in enumerate(conf.attrs["projections"]):
+        t = inputs[spec["in"]]
+        if t.is_seq:
+            lengths = t.lengths
+        p = {k[len(f"p{j}_"):]: v for k, v in params.items()
+             if k.startswith(f"p{j}_")}
+        y = _apply_proj(spec, p, t, conf.size)
+        acc = y if acc is None else acc + y
+    if "b" in params:
+        acc = acc + params["b"]
+    return SeqTensor(acc, lengths)
+
+
+# ---------------------------------------------------------------------------
+# conv_operator — ConvOperator.cpp: convolve input[0] (image) with input[1]
+# (per-sample filters produced by another layer); no own parameters.
+# ---------------------------------------------------------------------------
+
+
+@register_layer("conv_op")
+def conv_op_apply(conf, params, inputs, ctx):
+    from paddle_tpu.layers.conv import to_nhwc
+
+    a = conf.attrs
+    img = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    kh, kw, cin, cout = a["filter_h"], a["filter_w"], a["in_c"], a["channels"]
+    filt = inputs[1].data.reshape(-1, cout, cin, kh, kw)
+    # HWIO per sample; vmap the per-sample conv (each sample has its own
+    # filter — the reference loops samples through GemmConv).
+    filt = filt.transpose(0, 3, 4, 2, 1)
+
+    def one(x, w):
+        return jax.lax.conv_general_dilated(
+            x[None],
+            w,
+            window_strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
+            padding=[
+                (a.get("pad_h", 0), a.get("pad_h", 0)),
+                (a.get("pad_w", 0), a.get("pad_w", 0)),
+            ],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )[0]
+
+    out = jax.vmap(one)(img, filt)
+    return SeqTensor(out, inputs[0].lengths)

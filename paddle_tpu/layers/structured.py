@@ -1,0 +1,237 @@
+"""Structured-prediction layers: linear-chain CRF (loss + Viterbi decoding)
+and CTC loss.
+
+Reference: paddle/gserver/layers/{CRFLayer,CRFDecodingLayer,LinearChainCRF,
+CTCLayer,LinearChainCTC,WarpCTCLayer}.cpp.
+
+TPU-native design: the reference runs per-sequence dynamic programming on the
+CPU (LinearChainCRF.cpp walks each sequence; WarpCTC is a CUDA kernel).  Here
+each DP is a single ``lax.scan`` over the padded time axis for the whole
+batch at once — one XLA while-loop with [B, N] (or [B, S]) carries, masked
+per-sample by length, so variable-length batches cost max-length steps with
+full vectorization and autodiff provides the gradients (no hand-written
+backward DP).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+
+NEG = -1e30  # effective -inf that stays finite under arithmetic
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+#
+# Parameterization matches the reference (LinearChainCRF.h): a weight matrix
+# of shape [N+2, N] — row 0 start scores `a`, row 1 end scores `b`, rows 2..
+# the transition matrix W[from, to].
+
+
+def crf_init(conf, in_confs, rng):
+    n = conf.attrs["num_classes"]
+    return {"w": init.normal(rng, (n + 2, n), 0.1)}
+
+
+def _crf_unpack(w):
+    return w[0], w[1], w[2:]  # a[N], b[N], trans[N, N]
+
+
+def _crf_log_z(x, lengths, a, b, trans):
+    """log partition per sequence.  x: [B, T, N] emissions."""
+    b_, t_, n = x.shape
+    alpha0 = a[None, :] + x[:, 0]  # [B, N]
+
+    def step(alpha, inp):
+        xt, valid = inp  # [B, N], [B]
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + xt
+        alpha = jnp.where(valid[:, None], nxt, alpha)
+        return alpha, None
+
+    ts = jnp.arange(1, t_)
+    valid = ts[:, None] < lengths[None, :]  # [T-1, B]
+    alpha, _ = lax.scan(step, alpha0, (jnp.moveaxis(x[:, 1:], 1, 0), valid))
+    return jax.nn.logsumexp(alpha + b[None, :], axis=-1)  # [B]
+
+
+def _crf_path_score(x, labels, lengths, a, b, trans):
+    """score of the gold path per sequence.  labels: [B, T] int."""
+    b_, t_, n = x.shape
+    tpos = jnp.arange(t_)[None, :]  # [1, T]
+    mask = (tpos < lengths[:, None]).astype(x.dtype)  # [B, T]
+    emit = jnp.take_along_axis(x, labels[..., None], axis=-1)[..., 0]  # [B, T]
+    score = jnp.sum(emit * mask, axis=1)
+    score = score + a[labels[:, 0]]
+    last = jnp.take_along_axis(labels, (lengths - 1)[:, None], axis=1)[:, 0]
+    score = score + b[last]
+    trans_scores = trans[labels[:, :-1], labels[:, 1:]]  # [B, T-1]
+    score = score + jnp.sum(trans_scores * mask[:, 1:], axis=1)
+    return score
+
+
+@register_layer("crf", init=crf_init, auto_activation=False)
+def crf_apply(conf, params, inputs, ctx):
+    """-log P(label | emissions) per sequence → [B, 1]."""
+    x_t, y_t = inputs
+    assert x_t.is_seq, "crf needs sequence emissions"
+    a, b, trans = _crf_unpack(params["w"])
+    x = x_t.data
+    labels = y_t.data.astype(jnp.int32)
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    lengths = x_t.lengths
+    nll = _crf_log_z(x, lengths, a, b, trans) - _crf_path_score(
+        x, labels, lengths, a, b, trans
+    )
+    return SeqTensor(nll[:, None])
+
+
+@register_layer("crf_decoding", init=crf_init, auto_activation=False)
+def crf_decoding_apply(conf, params, inputs, ctx):
+    """Viterbi decode → [B, T] best label ids (padded with 0); when a label
+    input is present, returns [B, T] 0/1 mismatch indicators instead
+    (reference CRFDecodingLayer.cpp)."""
+    x_t = inputs[0]
+    assert x_t.is_seq
+    a, b, trans = _crf_unpack(params["w"])
+    x = x_t.data
+    lengths = x_t.lengths
+    b_, t_, n = x.shape
+
+    alpha0 = a[None, :] + x[:, 0]
+
+    def step(alpha, inp):
+        xt, valid = inp
+        cand = alpha[:, :, None] + trans[None]  # [B, from, to]
+        bp = jnp.argmax(cand, axis=1).astype(jnp.int32)  # [B, to]
+        nxt = jnp.max(cand, axis=1) + xt
+        alpha_new = jnp.where(valid[:, None], nxt, alpha)
+        return alpha_new, jnp.where(valid[:, None], bp, -1)
+
+    ts = jnp.arange(1, t_)
+    valid = ts[:, None] < lengths[None, :]
+    alpha, bps = lax.scan(step, alpha0, (jnp.moveaxis(x[:, 1:], 1, 0), valid))
+    # bps: [T-1, B, N]; backpointer for step t lives at bps[t-1].
+    y_last = jnp.argmax(alpha + b[None, :], axis=-1).astype(jnp.int32)  # [B]
+
+    # Backtrack t = T-2 .. 0.  bps[t] maps (label at t+1) -> (label at t).
+    # The carry holds the decoded label at position t+1; it is (re)seeded
+    # with y_last exactly when t+1 == len-1 (each sample's last position).
+    def back(carry, inp):
+        bp_t, t = inp
+        carry = jnp.where((t + 1) == (lengths - 1), y_last, carry)
+        y_t = jnp.take_along_axis(bp_t, carry[:, None], axis=1)[:, 0]
+        emit_valid = t <= lengths - 2
+        y_t = jnp.where(emit_valid, y_t, 0).astype(jnp.int32)
+        carry = jnp.where(emit_valid, y_t, carry)
+        return carry, y_t
+
+    rev = lambda z: jnp.flip(z, axis=0)
+    if t_ > 1:
+        _, ys = lax.scan(back, y_last, (rev(bps), rev(jnp.arange(t_ - 1))))
+        ys = jnp.moveaxis(rev(ys), 0, 1)  # [B, T-1]: labels at positions 0..T-2
+        path = jnp.concatenate(
+            [ys, jnp.zeros((b_, 1), jnp.int32)], axis=1
+        )
+    else:
+        path = jnp.zeros((b_, 1), jnp.int32)
+    path = path.at[jnp.arange(b_), lengths - 1].set(y_last)
+    tpos = jnp.arange(t_)[None, :]
+    path = jnp.where(tpos < lengths[:, None], path, 0).astype(jnp.int32)
+    if len(inputs) > 1:
+        gold = inputs[1].data.astype(jnp.int32)
+        if gold.ndim == 3:
+            gold = gold[..., 0]
+        err = (path != gold) & (tpos < lengths[:, None])
+        return SeqTensor(err.astype(jnp.float32), lengths)
+    return SeqTensor(path, lengths)
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+@register_layer("ctc", auto_activation=False)
+def ctc_apply(conf, params, inputs, ctx):
+    """CTC negative log likelihood per sequence → [B, 1].
+
+    inputs[0]: [B, T, C] pre-softmax logits (the reference applies softmax
+    inside, CTCLayer.cpp forwards through softmax); inputs[1]: label id
+    sequence with its own lengths.  Blank index is configurable
+    (``blank``); the `warp_ctc` registration fixes blank=0.
+    """
+    logits_t, labels_t = inputs
+    assert logits_t.is_seq and labels_t.is_seq
+    blank = conf.attrs.get("blank", conf.size - 1)
+    norm_by_times = conf.attrs.get("norm_by_times", False)
+
+    logp = jax.nn.log_softmax(logits_t.data, axis=-1)  # [B, T, C]
+    in_len = logits_t.lengths
+    labels = labels_t.data.astype(jnp.int32)
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    lab_len = labels_t.lengths
+
+    b_, t_, c_ = logp.shape
+    l_ = labels.shape[1]
+    s_ = 2 * l_ + 1
+
+    # Extended label sequence z': blank, z1, blank, z2, ..., blank
+    ext = jnp.full((b_, s_), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    spos = jnp.arange(s_)[None, :]
+    s_eff = 2 * lab_len + 1  # [B]
+    ext_valid = spos < s_eff[:, None]
+
+    # can_skip[s]: alpha may come from s-2 (z'_s not blank and != z'_{s-2})
+    ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s_]
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    alpha0 = jnp.full((b_, s_), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    first_lab = jnp.where(lab_len > 0, labels[:, 0], blank)
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lab_len > 0,
+                  jnp.take_along_axis(logp[:, 0], first_lab[:, None], -1)[:, 0],
+                  NEG)
+    )
+
+    def shift(a, k):
+        return jnp.pad(a, ((0, 0), (k, 0)), constant_values=NEG)[:, :s_]
+
+    def step(alpha, inp):
+        em, valid = inp  # [B, S], [B]
+        stay = alpha
+        s1 = shift(alpha, 1)
+        s2 = jnp.where(can_skip, shift(alpha, 2), NEG)
+        nxt = jnp.logaddexp(jnp.logaddexp(stay, s1), s2) + em
+        nxt = jnp.where(ext_valid, nxt, NEG)
+        return jnp.where(valid[:, None], nxt, alpha), None
+
+    ts = jnp.arange(1, t_)
+    valid = ts[:, None] < in_len[None, :]
+    # [B, T-1, S] emission log-probs of the extended labels, time-major for scan
+    ems = jnp.take_along_axis(
+        logp[:, 1:], jnp.broadcast_to(ext[:, None, :], (b_, t_ - 1, s_)), axis=-1
+    )
+    alpha, _ = lax.scan(step, alpha0, (jnp.moveaxis(ems, 1, 0), valid))
+
+    last = jnp.take_along_axis(alpha, (s_eff - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(s_eff - 2, 0)[:, None], axis=1
+    )[:, 0]
+    # empty label sequence: only the all-blank path exists (s_eff == 1)
+    last2 = jnp.where(s_eff >= 2, last2, NEG)
+    ll = jnp.logaddexp(last, last2)
+    nll = -ll
+    if norm_by_times:
+        nll = nll / in_len.astype(nll.dtype)
+    return SeqTensor(nll[:, None])

@@ -103,6 +103,20 @@ def seq2seq_cost(
     return cost, dec
 
 
+def _subgraph(topo: Topology, names) -> Topology:
+    """Rebuild a LayerOutput graph for `names` from an existing Topology and
+    return the pruned Topology over just their ancestors."""
+    cache = {}
+
+    def build(n: str) -> LayerOutput:
+        if n not in cache:
+            conf = topo.get(n)
+            cache[n] = LayerOutput(conf, [build(p) for p in conf.inputs])
+        return cache[n]
+
+    return Topology([build(n) for n in names])
+
+
 class Seq2SeqGenerator:
     """On-device generation over a trained seq2seq net (capi-style inference
     surface; reference: paddle/gserver/.../RecurrentGradientMachine
@@ -136,10 +150,15 @@ class Seq2SeqGenerator:
         self._scan_names = dec_conf.attrs["_scan_placeholders"]
         self._static_info = dec_conf.attrs["_static_placeholders"]
         self._memories = dec_conf.attrs["_memories"]
+        # Pruned encoder-only graph: generation must not pay for the training
+        # decoder scan + softmax + cost (and must not require dummy trg slots).
+        self._enc_net = CompiledNetwork(
+            _subgraph(self.topo, ["enc", "enc_proj", "dec_boot"])
+        )
 
     # -- encoder forward up to the decoder's static inputs ---------------
     def _encode(self, batch):
-        outs, _ = self.net.apply(
+        outs, _ = self._enc_net.apply(
             self.params.params, batch, state=self.params.state, train=False
         )
         return outs

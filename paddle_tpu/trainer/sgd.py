@@ -41,10 +41,14 @@ class SGD:
         is_local: bool = True,  # kept for surface compat; always "local"
         mesh=None,
         seed: int = 0,
+        evaluators: Optional[Sequence] = None,
     ):
         outputs: List[LayerOutput] = [cost] if isinstance(cost, LayerOutput) else list(cost)
         if extra_layers:
             outputs += list(extra_layers)
+        self.evaluators = list(evaluators or [])
+        for ev in self.evaluators:
+            outputs += list(ev.layers)
         self.topology = Topology(outputs)
         if parameters is not None and parameters.network.topology.order == self.topology.order:
             self.network = parameters.network
@@ -55,7 +59,7 @@ class SGD:
         assert update_equation is not None, "update_equation (an Optimizer) is required"
         self.optimizer = update_equation
         self.mesh = mesh if mesh is not None else get_default_mesh()
-        self._metrics_fn = default_metrics_fn(self.topology)
+        self._metrics_fn = self._build_metrics_fn()
         self._train_step = make_train_step(
             self.network, self.optimizer, self.mesh, self._metrics_fn
         )
@@ -63,6 +67,37 @@ class SGD:
         self._opt_state = self.optimizer.init(self.parameters.params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
+
+    # ------------------------------------------------------------------
+    def _build_metrics_fn(self):
+        default = default_metrics_fn(self.topology)
+        if not self.evaluators:
+            return default
+        from paddle_tpu.evaluator import combined_update
+
+        ev_update = combined_update(self.evaluators)
+
+        def metrics(outs):
+            m = default(outs) if default else {}
+            m.update(ev_update(outs))
+            return m
+
+        return metrics
+
+    def _split_metrics(self, metrics):
+        """(plain scalar metrics, evaluator accumulators) from a step result."""
+        scalars, accums = {}, {}
+        for k, v in metrics.items():
+            if k.startswith("ev:"):
+                accums[k] = np.asarray(v)
+            elif k != "cost":
+                scalars[k] = float(v)
+        return scalars, accums
+
+    def _finalize(self, accums):
+        from paddle_tpu.evaluator import finalize_all
+
+        return finalize_all(self.evaluators, accums) if self.evaluators else {}
 
     # ------------------------------------------------------------------
     def _make_feeder(self, feeding) -> DataFeeder:
@@ -83,6 +118,7 @@ class SGD:
         for pass_id in range(num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             pass_costs: List[float] = []
+            pass_accums: Dict[str, np.ndarray] = {}
             for batch_id, data_batch in enumerate(reader()):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 with stat_timer("feed"):
@@ -96,21 +132,21 @@ class SGD:
                 self._step_count += 1
                 cost = float(metrics["cost"])
                 pass_costs.append(cost)
-                evaluator = {
-                    k: float(v) for k, v in metrics.items() if k != "cost"
-                }
+                evaluator, accums = self._split_metrics(metrics)
+                for k, v in accums.items():
+                    pass_accums[k] = pass_accums.get(k, 0) + v
+                evaluator.update(self._finalize(accums))
                 event_handler(
                     v2_event.EndIteration(pass_id, batch_id, cost, evaluator)
                 )
             # persist latest values so checkpoints/test see them
             self.parameters.params, self.parameters.state = params, state
             self._opt_state = opt_state
-            event_handler(
-                v2_event.EndPass(
-                    pass_id,
-                    {"mean_cost": float(np.mean(pass_costs)) if pass_costs else 0.0},
-                )
-            )
+            pass_metrics = {
+                "mean_cost": float(np.mean(pass_costs)) if pass_costs else 0.0
+            }
+            pass_metrics.update(self._finalize(pass_accums))
+            event_handler(v2_event.EndPass(pass_id, pass_metrics))
         self.parameters.params, self.parameters.state = params, state
         self._opt_state = opt_state
 
@@ -119,6 +155,7 @@ class SGD:
         feeder = self._make_feeder(feeding)
         costs: List[float] = []
         sums: Dict[str, float] = {}
+        accum_sums: Dict[str, np.ndarray] = {}
         n = 0
         for data_batch in reader():
             batch = shard_batch(feeder(data_batch), self.mesh)
@@ -126,11 +163,14 @@ class SGD:
                 self.parameters.params, self.parameters.state, batch
             )
             costs.append(float(metrics["cost"]))
-            for k, v in metrics.items():
-                if k != "cost":
-                    sums[k] = sums.get(k, 0.0) + float(v)
+            scalars, accums = self._split_metrics(metrics)
+            for k, v in scalars.items():
+                sums[k] = sums.get(k, 0.0) + v
+            for k, v in accums.items():
+                accum_sums[k] = accum_sums.get(k, 0) + v
             n += 1
         avg = {k: v / max(n, 1) for k, v in sums.items()}
+        avg.update(self._finalize(accum_sums))
         return v2_event.TestResult(avg, float(np.mean(costs)) if costs else 0.0)
 
     # ------------------------------------------------------------------

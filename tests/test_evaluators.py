@@ -1,0 +1,179 @@
+"""Evaluator framework tests (reference: the evaluator checks embedded in
+paddle/gserver/tests and trainer integration in test_TrainerOnePass.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor, non_seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.evaluator import (
+    _chunk_bounds,
+    _ctc_best_path,
+    _edit_distance,
+    auc_evaluator,
+    chunk_evaluator,
+    classification_error_evaluator,
+    combined_update,
+    finalize_all,
+    pnpair_evaluator,
+    precision_recall_evaluator,
+    sum_evaluator,
+)
+
+L = paddle.layer
+
+
+@pytest.fixture(autouse=True)
+def _reset_names():
+    reset_auto_names()
+    yield
+
+
+def _run_ev(evs, outs):
+    acc = combined_update(evs)(outs)
+    return finalize_all(evs, {k: np.asarray(v) for k, v in acc.items()})
+
+
+def test_classification_error():
+    x = L.data("x", paddle.data_type.dense_vector(3))
+    y = L.data("y", paddle.data_type.integer_value(3))
+    ev = classification_error_evaluator(x, y, name="err")
+    outs = {
+        "x": non_seq(jnp.asarray([[0.9, 0.1, 0.0], [0.1, 0.8, 0.1],
+                                  [0.3, 0.3, 0.4], [1.0, 0.0, 0.0]])),
+        "y": SeqTensor(jnp.asarray([0, 1, 0, 2], jnp.int32)),
+    }
+    res = _run_ev([ev], outs)
+    assert res["err"] == 0.5
+
+
+def test_sum_evaluator():
+    x = L.data("x", paddle.data_type.dense_vector(2))
+    ev = sum_evaluator(x, name="s")
+    outs = {"x": non_seq(jnp.asarray([[1.0, 2.0], [3.0, 4.0]]))}
+    assert _run_ev([ev], outs)["s"] == 10.0
+
+
+def test_auc_perfect_separation():
+    x = L.data("x", paddle.data_type.dense_vector(2))
+    y = L.data("y", paddle.data_type.integer_value(2))
+    ev = auc_evaluator(x, y, name="auc")
+    # scores: positives all above negatives → AUC 1
+    score = np.array([[0.1, 0.9], [0.2, 0.8], [0.9, 0.1], [0.7, 0.3]], np.float32)
+    label = np.array([1, 1, 0, 0], np.int32)
+    res = _run_ev([ev], {"x": non_seq(score), "y": SeqTensor(jnp.asarray(label))})
+    np.testing.assert_allclose(res["auc"], 1.0, atol=1e-3)
+
+
+def test_auc_random_is_half():
+    x = L.data("x", paddle.data_type.dense_vector(2))
+    y = L.data("y", paddle.data_type.integer_value(2))
+    ev = auc_evaluator(x, y, name="auc")
+    rng = np.random.RandomState(0)
+    n = 4000
+    p1 = rng.rand(n).astype(np.float32)
+    score = np.stack([1 - p1, p1], axis=1)
+    label = rng.randint(0, 2, n).astype(np.int32)
+    res = _run_ev([ev], {"x": non_seq(score), "y": SeqTensor(jnp.asarray(label))})
+    assert abs(res["auc"] - 0.5) < 0.05
+
+
+def test_precision_recall():
+    x = L.data("x", paddle.data_type.dense_vector(2))
+    y = L.data("y", paddle.data_type.integer_value(2))
+    ev = precision_recall_evaluator(x, y, positive_label=1, name="pr")
+    score = np.array([[0.1, 0.9], [0.2, 0.8], [0.9, 0.1], [0.4, 0.6]], np.float32)
+    label = np.array([1, 0, 0, 1], np.int32)
+    res = _run_ev([ev], {"x": non_seq(score), "y": SeqTensor(jnp.asarray(label))})
+    # predictions: 1,1,0,1 → tp=2 fp=1 fn=0
+    np.testing.assert_allclose(res["pr.precision"], 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(res["pr.recall"], 1.0, rtol=1e-6)
+
+
+def test_pnpair():
+    s = L.data("s", paddle.data_type.dense_vector(1))
+    y = L.data("y", paddle.data_type.integer_value(3))
+    q = L.data("q", paddle.data_type.integer_value(10))
+    ev = pnpair_evaluator(s, y, q, name="pn")
+    outs = {
+        "s": non_seq(jnp.asarray([[0.9], [0.1], [0.5], [0.6]])),
+        "y": SeqTensor(jnp.asarray([1, 0, 1, 0], jnp.int32)),
+        "q": SeqTensor(jnp.asarray([0, 0, 1, 1], jnp.int32)),
+    }
+    # q0: pair (0>1): score 0.9>0.1 pos.  q1: pair (2>3): 0.5<0.6 neg.
+    res = _run_ev([ev], outs)
+    np.testing.assert_allclose(res["pn"], 1.0, rtol=1e-6)
+
+
+def test_edit_distance():
+    a = jnp.asarray([[1, 2, 3, 0], [1, 1, 0, 0]], jnp.int32)
+    alen = jnp.asarray([3, 2], jnp.int32)
+    b = jnp.asarray([[1, 3, 0], [2, 2, 2]], jnp.int32)
+    blen = jnp.asarray([2, 3], jnp.int32)
+    d = np.asarray(_edit_distance(a, alen, b, blen))
+    # "123" vs "13" → 1 deletion; "11" vs "222" → 3 (2 sub + 1 ins)
+    np.testing.assert_allclose(d, [1.0, 3.0])
+
+
+def test_ctc_best_path_collapse():
+    # argmax path: [1, 1, 0, 2, 2] (blank=0) → collapse → [1, 2]
+    logits = np.full((1, 5, 3), -5.0, np.float32)
+    for t, c in enumerate([1, 1, 0, 2, 2]):
+        logits[0, t, c] = 5.0
+    dec, dlen = _ctc_best_path(jnp.asarray(logits), jnp.asarray([5], jnp.int32), 0)
+    assert int(dlen[0]) == 2
+    np.testing.assert_array_equal(np.asarray(dec)[0, :2], [1, 2])
+
+
+def test_chunk_bounds_iob():
+    # types: B-PER I-PER O B-LOC → ids with 2 types (PER=0, LOC=1), tag_num=2
+    # B-PER=0, I-PER=1, B-LOC=2, I-LOC=3, O=4
+    ids = jnp.asarray([[0, 1, 4, 2]], jnp.int32)
+    start, end, typ = _chunk_bounds(ids, jnp.asarray([4], jnp.int32), "IOB", 2)
+    np.testing.assert_array_equal(np.asarray(start)[0], [True, False, False, True])
+    np.testing.assert_array_equal(np.asarray(end)[0], [False, True, False, True])
+
+
+def test_chunk_evaluator_f1():
+    p = L.data("p", paddle.data_type.integer_value_sequence(5))
+    g = L.data("g", paddle.data_type.integer_value_sequence(5))
+    ev = chunk_evaluator(p, g, chunk_scheme="IOB", num_chunk_types=2, name="ch")
+    gold = jnp.asarray([[0, 1, 4, 2]], jnp.int32)  # chunks: PER[0,1], LOC[3]
+    pred = jnp.asarray([[0, 1, 4, 4]], jnp.int32)  # chunks: PER[0,1]
+    lengths = jnp.asarray([4], jnp.int32)
+    outs = {"p": SeqTensor(pred, lengths), "g": SeqTensor(gold, lengths)}
+    res = _run_ev([ev], outs)
+    np.testing.assert_allclose(res["ch.precision"], 1.0)
+    np.testing.assert_allclose(res["ch.recall"], 0.5)
+
+
+def test_trainer_with_evaluator():
+    """End-to-end: evaluator flows through SGD.train events."""
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(4))
+    y = L.data("y", paddle.data_type.integer_value(2))
+    fc = L.fc(x, size=2, act=paddle.activation.Softmax())
+    cost = L.classification_cost(fc, y)
+    ev = classification_error_evaluator(fc, y, name="clserr")
+
+    trainer = paddle.trainer.SGD(
+        cost,
+        update_equation=paddle.optimizer.SGD(learning_rate=0.1),
+        evaluators=[ev],
+    )
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype(np.float32), int(i % 2)) for i in range(16)]
+
+    seen = {}
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndPass):
+            seen.update(e.evaluator)
+
+    trainer.train(paddle.batch(lambda: iter(data), 8), num_passes=1,
+                  event_handler=handler)
+    assert "clserr" in seen and 0.0 <= seen["clserr"] <= 1.0

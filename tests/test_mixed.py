@@ -1,0 +1,128 @@
+"""Mixed layer + projection family (reference: MixedLayer.cpp and the
+projection tests inside paddle/gserver/tests/test_LayerGrad.cpp testProjection
+cases)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import non_seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+from layer_grad_util import check_layer_grad
+
+L = paddle.layer
+A = paddle.activation
+
+
+@pytest.fixture(autouse=True)
+def _reset_names():
+    reset_auto_names()
+    yield
+
+
+def dense(dim=8, name="in0"):
+    return L.data(name, paddle.data_type.dense_vector(dim))
+
+
+def ids(vocab=12, name="ids0"):
+    return L.data(name, paddle.data_type.integer_value(vocab))
+
+
+def test_mixed_full_matrix_grad():
+    check_layer_grad(
+        L.mixed(size=6, input=L.full_matrix_projection(dense()), act=A.Tanh(),
+                bias_attr=True)
+    )
+
+
+def test_mixed_trans_full_matrix_grad():
+    check_layer_grad(
+        L.mixed(size=6, input=L.trans_full_matrix_projection(dense()))
+    )
+
+
+def test_mixed_multiple_projections_grad():
+    a, b = dense(8, "a"), dense(6, "b")
+    check_layer_grad(
+        L.mixed(
+            size=6,
+            input=[
+                L.full_matrix_projection(a),
+                L.identity_projection(b),
+                L.dotmul_projection(b),
+                L.scaling_projection(b),
+            ],
+            act=A.Sigmoid(),
+        )
+    )
+
+
+def test_mixed_table_projection_grad():
+    check_layer_grad(L.mixed(size=5, input=L.table_projection(ids())))
+
+
+def test_mixed_identity_offset():
+    x = dense(8)
+    out = L.mixed(size=3, input=L.identity_projection(x, offset=2, size=3))
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    import jax
+
+    params, state = net.init(jax.random.PRNGKey(0))
+    data = jnp.asarray(np.arange(32, dtype=np.float32).reshape(4, 8))
+    outs, _ = net.apply(
+        params, {"in0": non_seq(data)}, state=state
+    )
+    np.testing.assert_allclose(outs[out.name].data, data[:, 2:5])
+
+
+def test_mixed_slice_projection():
+    x = dense(8)
+    out = L.mixed(size=4, input=L.slice_projection(x, [(0, 2), (6, 8)]))
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    import jax
+
+    params, state = net.init(jax.random.PRNGKey(0))
+    data = jnp.asarray(np.arange(16, dtype=np.float32).reshape(2, 8))
+    outs, _ = net.apply(
+        params, {"in0": non_seq(data)}, state=state
+    )
+    expect = np.concatenate([data[:, 0:2], data[:, 6:8]], axis=1)
+    np.testing.assert_allclose(outs[out.name].data, expect)
+
+
+def test_mixed_matches_fc():
+    """A single full_matrix projection + bias must equal an fc layer with the
+    same weights (the reference asserts this equivalence in
+    test_NetworkCompare-style configs)."""
+    import jax
+
+    x = dense(8)
+    out = L.mixed(size=6, input=L.full_matrix_projection(x), bias_attr=True)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(3))
+
+    data = jnp.asarray(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    outs, _ = net.apply(params, {"in0": non_seq(data)}, state=state)
+    w = params[out.name]["p0_w"]
+    b = params[out.name]["b"]
+    np.testing.assert_allclose(
+        np.asarray(outs[out.name].data), np.asarray(data @ w + b), rtol=1e-5
+    )
+
+
+def test_conv_operator():
+    img = L.data("img", paddle.data_type.dense_vector(3 * 8 * 8), height=8, width=8)
+    filt = L.fc(dense(4, "z"), size=2 * 3 * 3 * 3, act=A.Identity())
+    out = L.conv_operator(img, filt, filter_size=3, num_filters=2, num_channels=3)
+    check_layer_grad(out, batch_size=2)
+
+
+def test_mixed_seq_input_grad():
+    seq = L.data("s", paddle.data_type.dense_vector_sequence(5))
+    check_layer_grad(L.mixed(size=4, input=L.full_matrix_projection(seq)))

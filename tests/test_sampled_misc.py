@@ -1,0 +1,253 @@
+"""Tests for nce/hsigmoid/selective_fc/lambda_cost and the misc layer batch
+(reference: the corresponding cases in paddle/gserver/tests/test_LayerGrad.cpp)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor, non_seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+from layer_grad_util import check_layer_grad
+
+L = paddle.layer
+A = paddle.activation
+
+
+@pytest.fixture(autouse=True)
+def _reset_names():
+    reset_auto_names()
+    yield
+
+
+def dense(dim=8, name="in0"):
+    return L.data(name, paddle.data_type.dense_vector(dim))
+
+
+def ids(vocab=10, name="lab"):
+    return L.data(name, paddle.data_type.integer_value(vocab))
+
+
+# -- nce / hsigmoid / selective_fc / lambda_cost ----------------------------
+
+
+def test_nce_grad():
+    check_layer_grad(L.nce(dense(), ids(), num_neg_samples=4))
+
+
+def test_nce_with_dist_runs():
+    x, lab = dense(6, "x"), ids(8)
+    dist = [0.3, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1, 0.1]
+    out = L.nce(x, lab, num_neg_samples=3, noise_dist=dist)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": non_seq(rng.randn(4, 6).astype(np.float32)),
+        "lab": SeqTensor(jnp.asarray(rng.randint(0, 8, 4), jnp.int32)),
+    }
+    outs, _ = net.apply(params, batch, state=state, train=True,
+                        rng=jax.random.PRNGKey(1))
+    assert np.all(np.isfinite(np.asarray(outs[out.name].data)))
+
+
+def test_hsigmoid_grad():
+    check_layer_grad(L.hsigmoid(dense(), ids(vocab=7)))
+
+
+def test_hsigmoid_probabilities_sum_to_one():
+    """Sum over classes of exp(-cost(c)) must be 1 — the binary tree defines
+    a normalized distribution (LinearChainCRF-style sanity used for
+    HierarchicalSigmoidLayer in the reference tests)."""
+    c = 6
+    x, lab = dense(5, "x"), ids(c)
+    out = L.hsigmoid(x, lab, num_classes=c)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(1))
+    feat = np.random.RandomState(0).randn(1, 5).astype(np.float32)
+    total = 0.0
+    for cls in range(c):
+        batch = {
+            "x": non_seq(feat),
+            "lab": SeqTensor(jnp.asarray([cls], jnp.int32)),
+        }
+        outs, _ = net.apply(params, batch, state=state)
+        total += math.exp(-float(outs[out.name].data[0, 0]))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_selective_fc_grad():
+    x = dense(6, "x")
+    sel = L.data("sel", paddle.data_type.sparse_binary_vector(9))
+    check_layer_grad(L.selective_fc(x, sel, size=9), check_inputs=False)
+
+
+def test_selective_fc_masks_output():
+    x = dense(4, "x")
+    sel = L.data("sel", paddle.data_type.sparse_binary_vector(5))
+    out = L.selective_fc(x, sel, size=5, act=A.Identity())
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    selv = np.zeros((2, 5), np.float32)
+    selv[0, [1, 3]] = 1
+    selv[1, [0]] = 1
+    batch = {
+        "x": non_seq(np.random.RandomState(0).randn(2, 4).astype(np.float32)),
+        "sel": non_seq(selv),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    got = np.asarray(outs[out.name].data)
+    assert got[0, 0] == 0 and got[0, 2] == 0 and got[0, 4] == 0
+    assert got[1, 1] == 0 and np.any(got[0, [1, 3]] != 0)
+
+
+def test_lambda_cost_grad():
+    s = L.data("s", paddle.data_type.dense_vector_sequence(1))
+    y = L.data("y", paddle.data_type.dense_vector_sequence(1))
+    out = L.lambda_cost(s, y)
+    rng = np.random.RandomState(0)
+    B, T = 3, 5
+    lengths = np.array([5, 3, 4], np.int32)
+    batch = {
+        "s": SeqTensor(jnp.asarray(rng.randn(B, T, 1).astype(np.float32)),
+                       jnp.asarray(lengths)),
+        "y": SeqTensor(
+            jnp.asarray(rng.randint(0, 3, (B, T, 1)).astype(np.float32)),
+            jnp.asarray(lengths)),
+    }
+    check_layer_grad(out, batch=batch)
+
+
+# -- misc batch --------------------------------------------------------------
+
+
+def test_prelu_grad():
+    check_layer_grad(L.prelu(dense()))
+
+
+def test_prelu_partial_sum_grad():
+    check_layer_grad(L.prelu(dense(8), partial_sum=4))
+
+
+def test_power():
+    w = L.data("w", paddle.data_type.dense_vector(1))
+    x = L.data("x", paddle.data_type.dense_vector(5))
+    out = L.power(x, w)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    xv = np.abs(np.random.RandomState(0).randn(3, 5)).astype(np.float32) + 0.5
+    wv = np.array([[2.0], [0.5], [1.0]], np.float32)
+    outs, _ = net.apply(params, {"w": non_seq(wv), "x": non_seq(xv)}, state=state)
+    np.testing.assert_allclose(
+        np.asarray(outs[out.name].data), xv ** wv, rtol=1e-5
+    )
+
+
+def test_data_norm():
+    x = dense(4, "x")
+    out = L.data_norm(x)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    state[out.name]["mean"] = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    state[out.name]["std"] = jnp.asarray([2.0, 2.0, 2.0, 2.0])
+    xv = np.ones((2, 4), np.float32)
+    outs, _ = net.apply(params, {"x": non_seq(xv)}, state=state)
+    np.testing.assert_allclose(
+        np.asarray(outs[out.name].data),
+        (xv - np.array([1, 2, 3, 4])) / 2.0,
+        rtol=1e-6,
+    )
+
+
+def test_block_expand():
+    img = L.data("img", paddle.data_type.dense_vector(1 * 4 * 4), height=4, width=4)
+    out = L.block_expand(img, block_x=2, block_y=2, stride_x=2, stride_y=2)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    xv = np.arange(16, dtype=np.float32).reshape(1, 16)
+    outs, _ = net.apply(params, {"img": non_seq(xv)}, state=state)
+    got = outs[out.name]
+    assert got.is_seq and got.data.shape == (1, 4, 4)
+    # first block = top-left 2x2 patch of the 4x4 image
+    np.testing.assert_allclose(np.asarray(got.data)[0, 0], [0, 1, 4, 5])
+
+
+def test_rotate():
+    img = L.data("img", paddle.data_type.dense_vector(1 * 2 * 3), height=2, width=3)
+    out = L.rotate(img)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    xv = np.arange(6, dtype=np.float32).reshape(1, 6)  # [[0 1 2],[3 4 5]]
+    outs, _ = net.apply(params, {"img": non_seq(xv)}, state=state)
+    got = np.asarray(outs[out.name].data)[0, :, :, 0]  # [3, 2] rotated CCW
+    np.testing.assert_allclose(got, [[2, 5], [1, 4], [0, 3]])
+
+
+def test_sub_seq():
+    s = L.data("s", paddle.data_type.dense_vector_sequence(2))
+    off = L.data("off", paddle.data_type.integer_value(10))
+    sz = L.data("sz", paddle.data_type.integer_value(10))
+    out = L.sub_seq(s, off, sz)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    data = np.arange(12, dtype=np.float32).reshape(1, 6, 2)
+    batch = {
+        "s": SeqTensor(jnp.asarray(data), jnp.asarray([6], jnp.int32)),
+        "off": SeqTensor(jnp.asarray([2], jnp.int32)),
+        "sz": SeqTensor(jnp.asarray([3], jnp.int32)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    got = outs[out.name]
+    assert int(got.lengths[0]) == 3
+    np.testing.assert_allclose(np.asarray(got.data)[0, :3], data[0, 2:5])
+
+
+def test_linear_comb():
+    w = L.data("w", paddle.data_type.dense_vector(3))
+    x = L.data("x", paddle.data_type.dense_vector(12))
+    out = L.linear_comb(w, x, size=4)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    wv = rng.randn(2, 3).astype(np.float32)
+    xv = rng.randn(2, 12).astype(np.float32)
+    outs, _ = net.apply(params, {"w": non_seq(wv), "x": non_seq(xv)}, state=state)
+    expect = np.einsum("bm,bmd->bd", wv, xv.reshape(2, 3, 4))
+    np.testing.assert_allclose(np.asarray(outs[out.name].data), expect, rtol=1e-5)
+
+
+def test_cos_sim_vec_mat():
+    v = L.data("v", paddle.data_type.dense_vector(4))
+    m = L.data("m", paddle.data_type.dense_vector(12))
+    out = L.cos_sim_vec_mat(v, m, size=3)
+    check_layer_grad(out)
+
+
+def test_scale_shift_grad():
+    check_layer_grad(L.scale_shift(dense()))
+
+
+def test_kmax_seq_score():
+    s = L.data("s", paddle.data_type.dense_vector_sequence(1))
+    out = L.kmax_seq_score(s, beam_size=2)
+    topo = Topology([out])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    data = np.array([[[0.1], [0.9], [0.5], [0.3]]], np.float32)
+    batch = {"s": SeqTensor(jnp.asarray(data), jnp.asarray([3], jnp.int32))}
+    outs, _ = net.apply(params, batch, state=state)
+    np.testing.assert_array_equal(np.asarray(outs[out.name].data)[0], [1, 2])

@@ -1,0 +1,219 @@
+"""CRF / CTC layer tests (reference: paddle/gserver/tests/test_CRFLayerGrad.cpp,
+test_LinearChainCRF.cpp, test_CTCLayer.cpp, test_WarpCTCLayer.cpp).
+
+Goldens: brute-force enumeration for the CRF (tiny label spaces), and
+torch.nn.functional.ctc_loss (CPU) for CTC — the same role WarpCTC plays as
+the alternative implementation in the reference's test_WarpCTCLayer.cpp.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+from layer_grad_util import check_layer_grad
+
+L = paddle.layer
+
+
+@pytest.fixture(autouse=True)
+def _reset_names():
+    reset_auto_names()
+    yield
+
+
+def _crf_net(n=3):
+    emis = L.data("emis", paddle.data_type.dense_vector_sequence(n))
+    lab = L.data("lab", paddle.data_type.integer_value_sequence(n))
+    cost = L.crf(emis, lab, size=n)
+    topo = Topology([cost])
+    return cost, topo, CompiledNetwork(topo)
+
+
+def _brute_force_nll(x, y, lengths, w):
+    """Enumerate all label paths; x: [B,T,N] np, w: [(N+2),N]."""
+    a, b, trans = w[0], w[1], w[2:]
+    out = []
+    for i in range(x.shape[0]):
+        T = int(lengths[i])
+        n = x.shape[2]
+
+        def path_score(path):
+            s = a[path[0]] + b[path[-1]] + sum(x[i, t, path[t]] for t in range(T))
+            s += sum(trans[path[t - 1], path[t]] for t in range(1, T))
+            return s
+
+        scores = [path_score(p) for p in itertools.product(range(n), repeat=T)]
+        logz = np.logaddexp.reduce(scores)
+        gold = path_score([int(v) for v in y[i, :T]])
+        out.append(logz - gold)
+    return np.array(out)
+
+
+def test_crf_matches_brute_force():
+    n = 3
+    cost, topo, net = _crf_net(n)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    B, T = 3, 4
+    x = rng.randn(B, T, n).astype(np.float32)
+    lengths = np.array([4, 2, 3], np.int32)
+    y = rng.randint(0, n, size=(B, T)).astype(np.int32)
+    batch = {
+        "emis": SeqTensor(jnp.asarray(x), jnp.asarray(lengths)),
+        "lab": SeqTensor(jnp.asarray(y), jnp.asarray(lengths)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    got = np.asarray(outs[cost.name].data)[:, 0]
+    w = np.asarray(params[cost.name]["w"])
+    expect = _brute_force_nll(x, y, lengths, w)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_grad():
+    n = 3
+    cost, _, _ = _crf_net(n)
+    check_layer_grad(cost, batch_size=3, max_len=4)
+
+
+def test_crf_decoding_matches_brute_force():
+    n = 3
+    emis = L.data("emis", paddle.data_type.dense_vector_sequence(n))
+    dec = L.crf_decoding(emis, size=n)
+    topo = Topology([dec])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(2))
+    rng = np.random.RandomState(3)
+    B, T = 4, 5
+    x = rng.randn(B, T, n).astype(np.float32)
+    lengths = np.array([5, 1, 3, 4], np.int32)
+    batch = {"emis": SeqTensor(jnp.asarray(x), jnp.asarray(lengths))}
+    outs, _ = net.apply(params, batch, state=state)
+    got = np.asarray(outs[dec.name].data)
+
+    w = np.asarray(params[dec.name]["w"])
+    a, b, trans = w[0], w[1], w[2:]
+    for i in range(B):
+        T_i = int(lengths[i])
+
+        def path_score(path):
+            s = a[path[0]] + b[path[-1]] + sum(x[i, t, path[t]] for t in range(T_i))
+            s += sum(trans[path[t - 1], path[t]] for t in range(1, T_i))
+            return s
+
+        best = max(
+            itertools.product(range(n), repeat=T_i), key=path_score
+        )
+        np.testing.assert_array_equal(got[i, :T_i], np.array(best))
+        assert not got[i, T_i:].any()
+
+
+def test_crf_decoding_with_label_mismatch_output():
+    n = 3
+    emis = L.data("emis", paddle.data_type.dense_vector_sequence(n))
+    lab = L.data("lab", paddle.data_type.integer_value_sequence(n))
+    dec = L.crf_decoding(emis, size=n, label=lab)
+    topo = Topology([dec])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(2))
+    rng = np.random.RandomState(3)
+    B, T = 2, 4
+    lengths = np.array([4, 3], np.int32)
+    batch = {
+        "emis": SeqTensor(jnp.asarray(rng.randn(B, T, n).astype(np.float32)),
+                          jnp.asarray(lengths)),
+        "lab": SeqTensor(jnp.asarray(rng.randint(0, n, (B, T)), jnp.int32),
+                         jnp.asarray(lengths)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    err = np.asarray(outs[dec.name].data)
+    assert err.shape == (B, T)
+    assert set(np.unique(err)).issubset({0.0, 1.0})
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def _ctc_batch(B, T, C, Lmax, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(B, T, C).astype(np.float32)
+    in_len = rng.randint(Lmax + 1, T + 1, size=B).astype(np.int32)
+    lab_len = rng.randint(1, Lmax + 1, size=B).astype(np.int32)
+    # labels in 1..C-1 (0 is the blank in warp_ctc convention)
+    labels = rng.randint(1, C, size=(B, Lmax)).astype(np.int32)
+    return logits, in_len, labels, lab_len
+
+
+def test_ctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    B, T, C, Lmax = 4, 8, 5, 3
+    logits, in_len, labels, lab_len = _ctc_batch(B, T, C, Lmax)
+
+    probs = L.data("probs", paddle.data_type.dense_vector_sequence(C))
+    lab = L.data("lab", paddle.data_type.integer_value_sequence(C))
+    cost = L.warp_ctc(probs, lab, size=C, blank=0)
+    topo = Topology([cost])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "probs": SeqTensor(jnp.asarray(logits), jnp.asarray(in_len)),
+        "lab": SeqTensor(jnp.asarray(labels), jnp.asarray(lab_len)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    got = np.asarray(outs[cost.name].data)[:, 0]
+
+    lp = F.log_softmax(torch.tensor(logits), dim=-1).transpose(0, 1)  # [T,B,C]
+    expect = F.ctc_loss(
+        lp,
+        torch.tensor(labels),
+        torch.tensor(in_len),
+        torch.tensor(lab_len),
+        blank=0,
+        reduction="none",
+    ).numpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_empty_label():
+    """Empty target: NLL must be -log P(all blank) exactly (regression: the
+    s_eff==1 case double-counted the final alpha)."""
+    B, T, C = 1, 2, 3
+    logits = np.zeros((B, T, C), np.float32)  # uniform: p(blank)=1/3 each step
+    probs = L.data("probs", paddle.data_type.dense_vector_sequence(C))
+    lab = L.data("lab", paddle.data_type.integer_value_sequence(C))
+    cost = L.warp_ctc(probs, lab, size=C, blank=0)
+    topo = Topology([cost])
+    net = CompiledNetwork(topo)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "probs": SeqTensor(jnp.asarray(logits), jnp.asarray([T], jnp.int32)),
+        "lab": SeqTensor(jnp.zeros((B, 2), jnp.int32), jnp.asarray([0], jnp.int32)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    np.testing.assert_allclose(
+        float(outs[cost.name].data[0, 0]), 2 * np.log(3.0), rtol=1e-5
+    )
+
+
+def test_ctc_grad():
+    B, T, C, Lmax = 3, 6, 4, 2
+    logits, in_len, labels, lab_len = _ctc_batch(B, T, C, Lmax, seed=7)
+    probs = L.data("probs", paddle.data_type.dense_vector_sequence(C))
+    lab = L.data("lab", paddle.data_type.integer_value_sequence(C))
+    cost = L.warp_ctc(probs, lab, size=C, blank=0)
+    batch = {
+        "probs": SeqTensor(jnp.asarray(logits), jnp.asarray(in_len)),
+        "lab": SeqTensor(jnp.asarray(labels), jnp.asarray(lab_len)),
+    }
+    check_layer_grad(cost, batch=batch)
