@@ -8,4 +8,32 @@ common.py) and otherwise falls back to a deterministic synthetic generator
 with the real schema — keeping every demo runnable end-to-end.
 """
 
-from paddle_tpu.dataset import mnist, uci_housing  # noqa: F401
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+)
+
+__all__ = [
+    "mnist",
+    "cifar",
+    "imdb",
+    "imikolov",
+    "movielens",
+    "conll05",
+    "uci_housing",
+    "wmt14",
+    "flowers",
+    "voc2012",
+    "sentiment",
+    "mq2007",
+]

@@ -15,3 +15,46 @@ def data_path(*parts: str) -> str:
 
 def exists(*parts: str) -> bool:
     return os.path.exists(data_path(*parts))
+
+
+def synth_two_class_docs(
+    n: int,
+    vocab: int,
+    seed: int,
+    min_len: int,
+    max_len: int,
+    signal: float = 0.8,
+    word_fmt: str = "w{}",
+):
+    """Deterministic two-class word corpus: positive docs draw from the low
+    half of the vocab, negative from the high half, with (1-signal) crossover
+    noise — separable enough for a text classifier to learn.  Shared by the
+    imdb/sentiment synthetic fallbacks."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    docs = []
+    for _ in range(n):
+        label = int(rng.randint(2))
+        lo, hi = (0, vocab // 2) if label else (vocab // 2, vocab)
+        length = int(rng.randint(min_len, max_len))
+        ids = np.where(
+            rng.rand(length) < signal,
+            rng.randint(lo, hi, size=length),
+            rng.randint(0, vocab, size=length),
+        )
+        docs.append(([word_fmt.format(int(i)) for i in ids], label))
+    return docs
+
+
+def build_word_dict(docs, cutoff: int = 0):
+    """word → id from an iterable of token lists, most frequent first
+    (deterministic tie-break on the word)."""
+    freq = {}
+    for words in docs:
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    if cutoff:
+        freq = {w: c for w, c in freq.items() if c > cutoff}
+    ordered = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
+    return {w: i for i, (w, _) in enumerate(ordered)}

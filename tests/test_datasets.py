@@ -1,0 +1,158 @@
+"""Dataset suite + @provider surface tests (reference test model:
+python/paddle/v2/dataset/tests/*, gserver/tests/test_PyDataProvider2.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu import data_provider as dp2
+from paddle_tpu.dataset import (
+    cifar,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    voc2012,
+    wmt14,
+)
+
+
+def take(reader, n):
+    return list(itertools.islice(reader(), n))
+
+
+def test_cifar_shapes():
+    for rd, classes in [(cifar.train10, 10), (cifar.test10, 10),
+                        (cifar.train100, 100), (cifar.test100, 100)]:
+        samples = take(rd(), 5)
+        assert len(samples) == 5
+        for img, label in samples:
+            assert img.shape == (3072,) and img.dtype == np.float32
+            assert 0 <= label < classes
+
+
+def test_imdb():
+    word_idx = imdb.word_dict()
+    assert "<unk>" in word_idx
+    for ids, label in take(imdb.train(word_idx), 10):
+        assert label in (0, 1)
+        assert all(0 <= i < len(word_idx) for i in ids)
+
+
+def test_imikolov_ngram_and_seq():
+    word_idx = imikolov.build_dict()
+    n = 5
+    for gram in take(imikolov.train(word_idx, n), 10):
+        assert len(gram) == n
+        assert all(0 <= i < len(word_idx) for i in gram)
+    for src, trg in take(imikolov.train(word_idx, -1, imikolov.DataType.SEQ), 5):
+        assert len(src) == len(trg)
+        assert src[0] == word_idx["<s>"] and trg[-1] == word_idx["<e>"]
+
+
+def test_wmt14():
+    dict_size = 100
+    src_d, trg_d = wmt14.get_dict(dict_size, reverse=False)
+    assert src_d["<s>"] == 0 and src_d["<e>"] == 1 and src_d["<unk>"] == 2
+    for src, trg, trg_next in take(wmt14.train(dict_size), 8):
+        assert len(trg) == len(trg_next)
+        assert trg[0] == 0 and trg_next[-1] == 1
+        assert trg[1:] == trg_next[:-1]
+
+
+def test_conll05():
+    word_d, verb_d, label_d = conll05.get_dict()
+    emb = conll05.get_embedding()
+    assert emb.shape[0] == len(word_d)
+    for sample in take(conll05.test(), 5):
+        assert len(sample) == 9
+        length = len(sample[0])
+        assert all(len(s) == length for s in sample)
+        assert sum(sample[7]) == 1  # exactly one predicate mark
+
+
+def test_movielens():
+    samples = take(movielens.train(), 10)
+    for s in samples:
+        # [uid, gender, age, job, mid, [cats], [title], score]
+        assert len(s) == 8
+        uid, gender, age, job, mid, cats, title, score = s
+        assert gender in (0, 1)
+        assert 1.0 <= score <= 5.0
+        assert isinstance(cats, list) and isinstance(title, list)
+    assert movielens.max_user_id() > 0
+    assert movielens.max_movie_id() > 0
+
+
+def test_mq2007_formats():
+    for score, feat in take(mq2007.train("pointwise"), 5):
+        assert feat.shape == (mq2007.FEATURE_DIM,)
+    for label, hi, lo in take(mq2007.train("pairwise"), 5):
+        assert hi.shape == lo.shape == (mq2007.FEATURE_DIM,)
+    for labels, feats in take(mq2007.train("listwise"), 2):
+        assert len(labels) == len(feats)
+        assert labels == sorted(labels, reverse=True)
+
+
+def test_sentiment():
+    wd = sentiment.get_word_dict()
+    train = take(sentiment.train(), 10)
+    for ids, label in train:
+        assert label in (0, 1)
+        assert all(0 <= i < len(wd) for i in ids)
+    assert len(list(sentiment.test()())) == (
+        sentiment.NUM_TOTAL_INSTANCES - sentiment.NUM_TRAINING_INSTANCES
+    )
+
+
+def test_flowers_voc():
+    img, label = next(flowers.train()())
+    assert img.shape == (flowers.DIM,) and 0 <= label < flowers.CLASSES
+    img, seg = next(voc2012.train()())
+    assert img.shape == (3, voc2012.SIZE, voc2012.SIZE)
+    assert seg.shape == (voc2012.SIZE, voc2012.SIZE)
+    assert seg.max() < voc2012.CLASSES
+
+
+def test_provider_decorator():
+    @dp2.provider(
+        input_types=[dp2.dense_vector(4), dp2.integer_value(3)],
+        should_shuffle=False,
+        cache=dp2.CacheType.CACHE_PASS_IN_MEM,
+        check=True,
+    )
+    def process(settings, filename):
+        assert settings.input_types is not None
+        rng = np.random.RandomState(0)
+        for _ in range(20):
+            yield rng.randn(4).astype(np.float32), int(rng.randint(3))
+
+    reader = process()
+    first = list(reader())
+    second = list(reader())  # served from the pass cache
+    assert len(first) == len(second) == 20
+    np.testing.assert_allclose(first[0][0], second[0][0])
+
+
+def test_provider_check_rejects_bad_dim():
+    @dp2.provider(
+        input_types=[dp2.dense_vector(4)], should_shuffle=False, check=True
+    )
+    def bad(settings, filename):
+        yield (np.zeros(3, np.float32),)
+
+    with pytest.raises(ValueError):
+        list(bad()())
+
+
+def test_provider_converter_batches():
+    conv = dp2.DataProviderConverter(
+        [dp2.dense_vector(4), dp2.integer_value(3)]
+    )
+    batch = conv([(np.zeros(4, np.float32), 1) for _ in range(6)])
+    assert batch["slot_0"].data.shape[0] == 6
