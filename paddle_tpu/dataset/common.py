@@ -47,14 +47,19 @@ def synth_two_class_docs(
     return docs
 
 
-def build_word_dict(docs, cutoff: int = 0):
-    """word → id from an iterable of token lists, most frequent first
-    (deterministic tie-break on the word)."""
-    freq = {}
-    for words in docs:
-        for w in words:
-            freq[w] = freq.get(w, 0) + 1
+def dict_from_freq(freq, cutoff: int = 0):
+    """word → id from a frequency table, most frequent first (deterministic
+    tie-break on the word)."""
     if cutoff:
         freq = {w: c for w, c in freq.items() if c > cutoff}
     ordered = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
     return {w: i for i, (w, _) in enumerate(ordered)}
+
+
+def build_word_dict(docs, cutoff: int = 0):
+    """word → id from an iterable of token lists (see dict_from_freq)."""
+    freq = {}
+    for words in docs:
+        for w in words:
+            freq[w] = freq.get(w, 0) + 1
+    return dict_from_freq(freq, cutoff)

@@ -110,26 +110,29 @@ def corpus_reader(words_path: str, props_path: str):
     """Yields (words, pred_pos, verb_lemma, iob_labels) — one sample per
     predicate column of each sentence."""
 
+    def flush(words, lemmas, columns):
+        for col_idx in range(len(columns[0]) if columns else 0):
+            tags = [row[col_idx] for row in columns]
+            labels = _bracket_to_iob(tags)
+            pred_positions = [i for i, lab in enumerate(labels) if lab == "B-V"]
+            pred_pos = pred_positions[0] if pred_positions else 0
+            yield words, pred_pos, lemmas[pred_pos], labels
+
     def reader():
         with gzip.open(words_path, "rt") as wf, gzip.open(props_path, "rt") as pf:
             words, lemmas, columns = [], [], []
             for wline, pline in zip(wf, pf):
                 wline, pline = wline.strip(), pline.strip()
                 if not wline:
-                    for col_idx in range(len(columns[0]) if columns else 0):
-                        tags = [row[col_idx] for row in columns]
-                        labels = _bracket_to_iob(tags)
-                        pred_positions = [
-                            i for i, lab in enumerate(labels) if lab == "B-V"
-                        ]
-                        pred_pos = pred_positions[0] if pred_positions else 0
-                        yield words, pred_pos, lemmas[pred_pos], labels
+                    yield from flush(words, lemmas, columns)
                     words, lemmas, columns = [], [], []
                     continue
                 words.append(wline.split()[0])
                 pfields = pline.split()
                 lemmas.append(pfields[0])
                 columns.append(pfields[1:])
+            # files without a trailing blank line still flush the last block
+            yield from flush(words, lemmas, columns)
 
     return reader
 

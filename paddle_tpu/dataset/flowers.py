@@ -19,7 +19,17 @@ _SYNTH = {"train": 612, "test": 204, "valid": 102}
 
 
 def _have_real() -> bool:
-    return os.path.exists(common.data_path("flowers", "102flowers.tgz"))
+    if not all(
+        common.exists("flowers", f)
+        for f in ("102flowers.tgz", "imagelabels.mat", "setid.mat")
+    ):
+        return False
+    try:  # real decoding needs optional deps
+        import scipy.io  # noqa: F401
+        from PIL import Image  # noqa: F401
+    except ImportError:
+        return False
+    return True
 
 
 def _synthetic(split: str):

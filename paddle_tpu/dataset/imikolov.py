@@ -70,11 +70,11 @@ def word_count(sents, word_freq=None):
 def build_dict(min_word_freq: int = 50):
     if _have_real():
         freq = word_count(_real_sentences("ptb.train.txt"))
-        freq = {w: c for w, c in freq.items() if c > min_word_freq and w != "<unk>"}
+        freq.pop("<unk>", None)
+        word_idx = common.dict_from_freq(freq, cutoff=min_word_freq)
     else:
         freq = word_count(_synth_sentences(_SYNTH_SENTS_TRAIN, seed=31))
-    ordered = sorted(freq.items(), key=lambda x: (-x[1], x[0]))
-    word_idx = {w: i for i, (w, _) in enumerate(ordered)}
+        word_idx = common.dict_from_freq(freq)
     word_idx["<unk>"] = len(word_idx)
     return word_idx
 

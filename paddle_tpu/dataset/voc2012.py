@@ -74,7 +74,9 @@ def _real_reader(split: str):
 
 def _reader(split: str):
     if _have_real():
-        real_split = {"train": "train", "val": "val", "test": "trainval"}[split]
+        # VOC's real test annotations are withheld; serve val for test()
+        # rather than trainval (which would overlap the training images).
+        real_split = {"train": "train", "val": "val", "test": "val"}[split]
         return _real_reader(real_split)
 
     def reader():

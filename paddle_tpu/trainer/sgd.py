@@ -109,13 +109,22 @@ class SGD:
         num_passes: int = 1,
         event_handler: Optional[Callable] = None,
         feeding=None,
+        save_dir: Optional[str] = None,
+        saving_period: int = 1,
+        saving_period_by_batches: Optional[int] = None,
+        start_pass: int = 0,
     ) -> None:
+        """Pass loop with the reference trainer's checkpoint cadence: every
+        `saving_period` passes (and optionally every `saving_period_by_batches`
+        batches) write pass-%05d under save_dir; `start_pass` resumes numbering
+        (reference: Trainer.cpp:454-488, flags saving_period /
+        saving_period_by_batches / start_pass)."""
         if event_handler is None:
             event_handler = lambda e: None
         feeder = self._make_feeder(feeding)
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, start_pass + num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             pass_costs: List[float] = []
             pass_accums: Dict[str, np.ndarray] = {}
@@ -139,6 +148,14 @@ class SGD:
                 event_handler(
                     v2_event.EndIteration(pass_id, batch_id, cost, evaluator)
                 )
+                if (
+                    save_dir
+                    and saving_period_by_batches
+                    and (batch_id + 1) % saving_period_by_batches == 0
+                ):
+                    self.parameters.params, self.parameters.state = params, state
+                    self._opt_state = opt_state
+                    self.save_pass(save_dir, pass_id, batch_id=batch_id + 1)
             # persist latest values so checkpoints/test see them
             self.parameters.params, self.parameters.state = params, state
             self._opt_state = opt_state
@@ -147,6 +164,8 @@ class SGD:
             }
             pass_metrics.update(self._finalize(pass_accums))
             event_handler(v2_event.EndPass(pass_id, pass_metrics))
+            if save_dir and (pass_id + 1 - start_pass) % saving_period == 0:
+                self.save_pass(save_dir, pass_id)
         self.parameters.params, self.parameters.state = params, state
         self._opt_state = opt_state
 
@@ -177,11 +196,66 @@ class SGD:
     def save_parameter_to_tar(self, f) -> None:
         self.parameters.to_tar(f)
 
-    def save_pass(self, save_dir: str, pass_id: int) -> str:
-        """Write pass-%05d/params.tar (reference pass-%05d dirs,
-        paddle/trainer/ParamUtil.cpp)."""
-        d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    def save_pass(self, save_dir: str, pass_id: int, batch_id: Optional[int] = None) -> str:
+        """Write pass-%05d/ with params.tar *and* one v1-format binary file
+        per parameter (reference pass-%05d dirs, paddle/trainer/ParamUtil.cpp;
+        batch checkpoints get a -batch-%d suffix like Trainer.cpp:454-465)."""
+        from paddle_tpu import checkpoint as ckpt
+
+        name = f"pass-{pass_id:05d}"
+        if batch_id is not None:
+            name += f"-batch-{batch_id}"
+        d = os.path.join(save_dir, name)
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, "params.tar"), "wb") as f:
             self.parameters.to_tar(f)
+        ckpt.save_parameter_dir(self.parameters, d)
         return d
+
+    def load_pass(self, save_dir: str, pass_id: int) -> None:
+        """Resume parameter values from a pass dir (reference
+        --init_model_path / --start_pass, Trainer.cpp:224-253)."""
+        from paddle_tpu import checkpoint as ckpt
+
+        ckpt.load_parameter_dir(
+            self.parameters, os.path.join(save_dir, f"pass-{pass_id:05d}")
+        )
+
+    # -- full-state checkpoints (params + layer state + optimizer state) --
+    def _full_state(self):
+        return {
+            "params": self.parameters.params,
+            "state": self.parameters.state,
+            "opt_state": self._opt_state,
+            "rng": self._rng,
+        }
+
+    def save_checkpoint(self, manager, step: Optional[int] = None, async_: bool = False) -> None:
+        """Write params + optimizer state + counters through a
+        checkpoint.CheckpointManager (the Go-pserver-style full checkpoint,
+        reference go/pserver/service.go:244-303 — sans pserver)."""
+        manager.save(
+            step if step is not None else self._step_count,
+            self._full_state(),
+            extra={"step_count": self._step_count},
+            async_=async_,
+        )
+
+    def restore_checkpoint(self, manager, step: Optional[int] = None) -> bool:
+        """Restore the latest (or given) checkpoint; returns False when the
+        directory holds none."""
+        if step is None:
+            restored = manager.restore_latest(self._full_state())
+            if restored is None:
+                return False
+            _, tree, extra = restored
+        else:
+            tree, extra = manager.restore(step, self._full_state())
+        self.parameters.params = tree["params"]
+        self.parameters.state = tree["state"]
+        self._opt_state = tree["opt_state"]
+        import jax.numpy as jnp
+
+        self._rng = jnp.asarray(tree["rng"])
+        self._step_count = int(extra.get("step_count", self._step_count))
+        return True

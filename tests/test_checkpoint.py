@@ -1,0 +1,176 @@
+"""Checkpoint/resume tests (reference model: Parameter.cpp save/load round
+trips, go/pserver checkpoint CRC, v2 trainer save cadence)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import checkpoint as ckpt
+from paddle_tpu.core.topology import reset_auto_names
+
+
+def _make_trainer(seed=0):
+    reset_auto_names()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1, act=paddle.activation.Linear())
+    cost = paddle.layer.square_error_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost, seed=seed)
+    return (
+        paddle.trainer.SGD(
+            cost=cost,
+            parameters=params,
+            update_equation=paddle.optimizer.Momentum(
+                learning_rate=0.05, momentum=0.9
+            ),
+        ),
+        cost,
+    )
+
+
+def _data_reader(n=64, seed=0):
+    w = np.array([1.0, -1.0, 2.0, 0.5], np.float32)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            xv = rng.randn(4).astype(np.float32)
+            yield xv, np.array([float(xv @ w)], np.float32)
+
+    return reader
+
+
+def test_v1_parameter_dir_roundtrip(tmp_path):
+    trainer, _ = _make_trainer()
+    d = str(tmp_path / "pdir")
+    before = {n: np.array(trainer.parameters.get(n)) for n in trainer.parameters.names()}
+    ckpt.save_parameter_dir(trainer.parameters, d)
+    # perturb, then reload
+    for n in trainer.parameters.names():
+        trainer.parameters.set(n, np.zeros_like(before[n]))
+    ckpt.load_parameter_dir(trainer.parameters, d)
+    for n, v in before.items():
+        np.testing.assert_allclose(np.array(trainer.parameters.get(n)), v)
+
+
+def test_v1_header_layout(tmp_path):
+    trainer, _ = _make_trainer()
+    d = str(tmp_path / "pdir")
+    ckpt.save_parameter_dir(trainer.parameters, d)
+    fname = sorted(os.listdir(d))[0]
+    raw = open(os.path.join(d, fname), "rb").read()
+    import struct
+
+    version, value_size, count = struct.unpack("<iIQ", raw[:16])
+    assert version == 0 and value_size == 4
+    assert len(raw) == 16 + 4 * count
+
+
+def test_manager_save_restore_and_crc(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3), "b": {"c": np.ones(4)}}
+    mgr.save(10, tree)
+    restored, extra = mgr.restore(10, tree)
+    np.testing.assert_allclose(restored["a"], tree["a"])
+    np.testing.assert_allclose(restored["b"]["c"], tree["b"]["c"])
+    # corruption is detected
+    data = os.path.join(str(tmp_path / "ck"), "ckpt-00000010", "state.npz")
+    with open(data, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xff\xff")
+    with pytest.raises(IOError):
+        mgr.restore(10, tree)
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+    tree = {"a": np.zeros(2)}
+    for s in (1, 2, 3, 4):
+        mgr.save(s, tree)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest_step() == 4
+
+
+def test_manager_async(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    tree = {"a": np.full(8, 3.0)}
+    mgr.save(5, tree, async_=True)
+    mgr.wait()
+    step, restored, _ = mgr.restore_latest(tree)
+    assert step == 5
+    np.testing.assert_allclose(restored["a"], tree["a"])
+
+
+def test_trainer_pass_saving_and_resume(tmp_path):
+    trainer, _ = _make_trainer(seed=1)
+    save_dir = str(tmp_path / "out")
+    trainer.train(
+        reader=paddle.batch(_data_reader(), 16),
+        num_passes=2,
+        save_dir=save_dir,
+        saving_period=1,
+    )
+    assert os.path.isdir(os.path.join(save_dir, "pass-00000"))
+    assert os.path.isdir(os.path.join(save_dir, "pass-00001"))
+    assert os.path.exists(os.path.join(save_dir, "pass-00001", "params.tar"))
+
+    # resume into a freshly-initialized trainer: values must match pass 1
+    trainer2, _ = _make_trainer(seed=9)
+    trainer2.load_pass(save_dir, 1)
+    for n in trainer.parameters.names():
+        np.testing.assert_allclose(
+            np.array(trainer2.parameters.get(n)),
+            np.array(trainer.parameters.get(n)),
+            rtol=1e-6,
+        )
+
+
+def test_full_checkpoint_resume_is_bitwise(tmp_path):
+    """Training from a restored full checkpoint (incl. momentum) must match
+    uninterrupted training — the reference's test_CompareTwoNets-style golden."""
+    reader = paddle.batch(_data_reader(n=96, seed=3), 16)
+
+    # run A: 4 passes straight
+    ta, _ = _make_trainer(seed=2)
+    ta.train(reader=reader, num_passes=4)
+
+    # run B: 2 passes, full checkpoint, restore into new trainer, 2 more
+    tb, _ = _make_trainer(seed=2)
+    tb.train(reader=reader, num_passes=2)
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    tb.save_checkpoint(mgr)
+    tc, _ = _make_trainer(seed=99)  # different init — must be overwritten
+    assert tc.restore_checkpoint(mgr)
+    tc.train(reader=reader, num_passes=2)
+
+    for n in ta.parameters.names():
+        np.testing.assert_allclose(
+            np.array(tc.parameters.get(n)),
+            np.array(ta.parameters.get(n)),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+
+def test_saving_period_by_batches(tmp_path):
+    trainer, _ = _make_trainer()
+    save_dir = str(tmp_path / "out")
+    trainer.train(
+        reader=paddle.batch(_data_reader(n=64), 16),
+        num_passes=1,
+        save_dir=save_dir,
+        saving_period_by_batches=2,
+    )
+    assert os.path.isdir(os.path.join(save_dir, "pass-00000-batch-2"))
+    assert os.path.isdir(os.path.join(save_dir, "pass-00000-batch-4"))
+
+
+def test_meta_json(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(7, {"a": np.zeros(3)}, extra={"pass_id": 2})
+    meta = mgr.meta(7)
+    assert meta["step"] == 7 and meta["extra"]["pass_id"] == 2
+    assert "crc32" in meta and meta["n_leaves"] == 1
