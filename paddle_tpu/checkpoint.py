@@ -81,6 +81,17 @@ def load_parameter_dir(parameters, dirname: str) -> None:
 # Plane 3: full-state checkpoints
 # ---------------------------------------------------------------------------
 
+def _crc_file(path: str, block: int = 1 << 20) -> int:
+    """Streaming CRC32 — O(1) memory for multi-GB checkpoints."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(block)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
 def _flatten(tree) -> Dict[str, np.ndarray]:
     out = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
@@ -127,8 +138,8 @@ class CheckpointManager:
         # Materialize on host *before* handing off so the training loop can
         # donate/overwrite device buffers immediately (orbax-style).
         arrays = _flatten(tree)
+        self.wait()  # serialize with any in-flight async write
         if async_:
-            self.wait()
 
             def run():
                 try:
@@ -136,7 +147,9 @@ class CheckpointManager:
                 except BaseException as exc:  # surfaced by the next wait()
                     self._pending_error = exc
 
-            t = threading.Thread(target=run, daemon=True)
+            # Non-daemon: interpreter exit joins it, so a checkpoint started
+            # at the end of a script is never silently truncated.
+            t = threading.Thread(target=run, daemon=False)
             t.start()
             self._pending = t
         else:
@@ -148,8 +161,7 @@ class CheckpointManager:
         try:
             data_path = os.path.join(tmp, "state.npz")
             np.savez(data_path, **arrays)
-            with open(data_path, "rb") as f:
-                crc = zlib.crc32(f.read())
+            crc = _crc_file(data_path)
             meta = {
                 "step": step,
                 "crc32": crc,
@@ -208,18 +220,14 @@ class CheckpointManager:
     def restore(self, step: int, template: Any):
         """Verify CRC, then rebuild the pytree into `template`'s structure.
         Returns (tree, extra)."""
-        import io
-
         d = os.path.join(self.directory, f"ckpt-{step:08d}")
         meta = self.meta(step)
         data_path = os.path.join(d, "state.npz")
-        with open(data_path, "rb") as f:
-            raw = f.read()
-        if zlib.crc32(raw) != meta["crc32"]:
+        if _crc_file(data_path) != meta["crc32"]:
             raise IOError(
                 f"checkpoint {d} corrupt: crc mismatch vs meta {meta['crc32']:#x}"
             )
-        with np.load(io.BytesIO(raw)) as z:
+        with np.load(data_path) as z:
             arrays = {k: z[k] for k in z.files}
         return _unflatten_into(template, arrays), meta.get("extra", {})
 

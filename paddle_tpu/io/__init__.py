@@ -1,0 +1,15 @@
+"""Native data-IO runtime bindings (recordio + prefetch).
+
+The C++ library lives in native/recordio.cc; `recordio` loads it via ctypes,
+building it on first use with g++, and falls back to a pure-Python
+implementation of the identical on-disk format when no toolchain exists.
+"""
+
+from paddle_tpu.io.recordio import (  # noqa: F401
+    Chunk,
+    Prefetcher,
+    Reader,
+    Writer,
+    native_available,
+    scan_chunks,
+)

@@ -1,0 +1,372 @@
+"""Elastic master — fault-tolerant task-queue data dispatch (reference:
+go/master/service.go, the Go master the v2 python API reaches through
+python/paddle/v2/master/client.py).
+
+The reference partitions recordio chunks into tasks and serves them to
+stateless trainers over RPC with etcd-snapshotted todo/pending/done/failed
+queues; a timed-out pending task is requeued, and a task failing more than
+`failure_max` times is discarded (service.go:80-459).  This implementation
+keeps the exact queue semantics but is etcd-free: queue snapshots go to a
+JSON file (atomic rename) and leadership is a filesystem lease — the TPU
+deployment model has a single coordinator host per pod slice, so file-lease
+is the idiomatic replacement for etcd election.
+
+Pieces:
+  * ``Service``    — the queue state machine (thread-safe, in-process).
+  * ``Server``     — serves a Service over ``multiprocessing.connection``
+                     (a real process/network boundary like the Go RPC server).
+  * ``Client``     — ``set_dataset / next_record / ...`` parity with
+                     python/paddle/v2/master/client.py; works against an
+                     in-process Service or a remote Server address.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import json
+import os
+import threading
+import time
+from multiprocessing.connection import Client as _ConnClient, Listener
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.io import recordio
+
+__all__ = ["Service", "Server", "Client"]
+
+
+@dataclasses.dataclass
+class Task:
+    task_id: int
+    chunks: List[recordio.Chunk]
+    epoch: int = 0  # failure count (reference service.go Task.Epoch)
+
+    def to_json(self):
+        return {
+            "task_id": self.task_id,
+            "epoch": self.epoch,
+            "chunks": [
+                {"path": c.path, "offset": c.offset, "n_records": c.n_records}
+                for c in self.chunks
+            ],
+        }
+
+    @staticmethod
+    def from_json(d):
+        return Task(
+            d["task_id"],
+            [recordio.Chunk(c["path"], c["offset"], c["n_records"]) for c in d["chunks"]],
+            d["epoch"],
+        )
+
+
+class Service:
+    """Queue state machine: todo / pending / done / failed (reference
+    go/master/service.go:80)."""
+
+    def __init__(
+        self,
+        snapshot_path: Optional[str] = None,
+        chunks_per_task: int = 8,
+        timeout_s: float = 60.0,
+        failure_max: int = 3,
+        auto_rotate: bool = True,
+    ):
+        """auto_rotate=True mirrors the reference: the moment a pass drains,
+        done tasks recycle into todo and other trainers stream straight into
+        the next pass (pass-end is a per-client observation, service.go:404).
+        auto_rotate=False holds the pass boundary until start_new_pass() —
+        the synchronized-pass mode a sync-SGD trainer wants."""
+        self._lock = threading.RLock()
+        self.chunks_per_task = chunks_per_task
+        self.timeout_s = timeout_s
+        self.failure_max = failure_max
+        self.auto_rotate = auto_rotate
+        self.snapshot_path = snapshot_path
+        self.todo: List[Task] = []
+        self.pending: Dict[int, Tuple[Task, float]] = {}  # id -> (task, deadline)
+        self.done: List[Task] = []
+        self.discarded: List[Task] = []
+        self.fail_events = 0
+        self.pass_id = 0
+        self._save_holder: Optional[Tuple[str, float]] = None
+        if snapshot_path and os.path.exists(snapshot_path):
+            self._recover()
+
+    # -- dataset ---------------------------------------------------------
+    def set_dataset(self, patterns: Sequence[str]) -> int:
+        """Partition the recordio files into tasks (reference
+        service.go:105 partition()).  Idempotent: only the first caller wins,
+        like the reference's SetDataset."""
+        with self._lock:
+            if self.todo or self.pending or self.done:
+                return self.n_tasks()
+            chunks: List[recordio.Chunk] = []
+            for pat in patterns:
+                for path in sorted(_glob.glob(pat)):
+                    chunks.extend(recordio.scan_chunks(path))
+            tasks = []
+            for i in range(0, len(chunks), self.chunks_per_task):
+                tasks.append(Task(len(tasks), chunks[i : i + self.chunks_per_task]))
+            self.todo = tasks
+            self._snapshot()
+            return len(tasks)
+
+    def n_tasks(self) -> int:
+        with self._lock:
+            return len(self.todo) + len(self.pending) + len(self.done)
+
+    # -- task lifecycle --------------------------------------------------
+    def get_task(self):
+        """Pop a todo task into pending with a lease deadline (reference
+        service.go:362 GetTask).  Returns the task dict, the string "wait"
+        when all remaining tasks are leased to other workers (mid-pass
+        starvation), or None at a pass boundary."""
+        with self._lock:
+            self._requeue_expired()
+            if not self.todo and not self.pending and self.done:
+                if not self.auto_rotate:
+                    return None  # hold the barrier until start_new_pass()
+                self._rotate_pass()
+                return None  # signal pass boundary to the observing client
+            if not self.todo:
+                return "wait" if self.pending else None
+            task = self.todo.pop(0)
+            self.pending[task.task_id] = (task, time.time() + self.timeout_s)
+            self._snapshot()
+            return {"task": task.to_json(), "epoch": task.epoch}
+
+    def _rotate_pass(self) -> None:
+        """Recycle done → todo; epochs reset so past failures don't carry."""
+        self.todo = self.done
+        for t in self.todo:
+            t.epoch = 0
+        self.done = []
+        self.pass_id += 1
+        self._snapshot()
+
+    def start_new_pass(self) -> int:
+        """Explicit pass barrier release (auto_rotate=False mode)."""
+        with self._lock:
+            if not self.todo and not self.pending and self.done:
+                self._rotate_pass()
+            return self.pass_id
+
+    def task_finished(self, task_id: int) -> bool:
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None:
+                return False
+            self.done.append(ent[0])
+            self._snapshot()
+            return True
+
+    def task_failed(self, task_id: int, epoch: int) -> bool:
+        """(reference service.go:442 TaskFailed → processFailedTask:308)"""
+        with self._lock:
+            ent = self.pending.pop(task_id, None)
+            if ent is None or ent[0].epoch != epoch:
+                return False
+            self._process_failed(ent[0])
+            self._snapshot()
+            return True
+
+    def _process_failed(self, task: Task) -> None:
+        """epoch++, discard past failure_max, else requeue (service.go:308)."""
+        self.fail_events += 1
+        task.epoch += 1
+        if task.epoch >= self.failure_max:
+            self.discarded.append(task)  # discard (service.go:336)
+        else:
+            self.todo.append(task)
+
+    def _requeue_expired(self) -> None:
+        now = time.time()
+        expired = [tid for tid, (_, dl) in self.pending.items() if dl < now]
+        for tid in expired:
+            task, _ = self.pending.pop(tid)
+            self._process_failed(task)
+
+    # -- save-model arbitration (reference service.go:461-497) -----------
+    def request_save_model(self, trainer_id: str, block_secs: float) -> bool:
+        """Exactly one trainer in each window gets True."""
+        with self._lock:
+            now = time.time()
+            if self._save_holder and self._save_holder[1] > now:
+                return self._save_holder[0] == trainer_id
+            self._save_holder = (trainer_id, now + block_secs)
+            return True
+
+    # -- snapshot / recover (reference service.go:165-273, etcd → file) --
+    def _snapshot(self) -> None:
+        if not self.snapshot_path:
+            return
+        state = {
+            "pass_id": self.pass_id,
+            "todo": [t.to_json() for t in self.todo],
+            "pending": [
+                {"task": t.to_json(), "deadline": dl}
+                for (t, dl) in self.pending.values()
+            ],
+            "done": [t.to_json() for t in self.done],
+            "discarded": [t.to_json() for t in self.discarded],
+        }
+        tmp = self.snapshot_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.snapshot_path)
+
+    def _recover(self) -> None:
+        with open(self.snapshot_path) as f:
+            state = json.load(f)
+        self.pass_id = state["pass_id"]
+        self.todo = [Task.from_json(t) for t in state["todo"]]
+        self.done = [Task.from_json(t) for t in state["done"]]
+        self.discarded = [Task.from_json(t) for t in state.get("discarded", [])]
+        # pending leases do not survive a master restart: requeue immediately
+        # (the reference instead waits for timeout; restart is the slow path)
+        for ent in state["pending"]:
+            self.todo.append(Task.from_json(ent["task"]))
+
+
+# ---------------------------------------------------------------------------
+# RPC layer
+# ---------------------------------------------------------------------------
+
+_METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
+            "request_save_model", "n_tasks", "start_new_pass")
+
+
+class Server:
+    """Serve a Service over multiprocessing.connection — the process/network
+    boundary of the Go master's net/rpc server."""
+
+    def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu"):
+        self.service = service
+        self._listener = Listener(address, authkey=authkey)
+        self.address = self._listener.address
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stop:
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn) -> None:
+        try:
+            while True:
+                method, args = conn.recv()
+                if method == "__close__":
+                    return
+                if method not in _METHODS:
+                    conn.send((False, f"no such method {method}"))
+                    continue
+                try:
+                    conn.send((True, getattr(self.service, method)(*args)))
+                except Exception as exc:  # noqa: BLE001 — RPC boundary
+                    conn.send((False, repr(exc)))
+        except EOFError:
+            pass
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._stop = True
+        self._listener.close()
+
+
+class Client:
+    """python/paddle/v2/master/client.py parity: set_dataset + next_record.
+
+    `master` is either an in-process Service or a (host, port) address of a
+    Server.  Records stream task-by-task; at a pass boundary next_record
+    returns None once (like the reference's empty-record pass signal)."""
+
+    def __init__(self, master, authkey: bytes = b"paddle-tpu", trainer_id: str = "0"):
+        if isinstance(master, Service):
+            self._service = master
+            self._conn = None
+        else:
+            self._service = None
+            self._conn = _ConnClient(tuple(master), authkey=authkey)
+            self._conn_lock = threading.Lock()
+        self.trainer_id = trainer_id
+        self._records: List[bytes] = []
+
+    def _call(self, method: str, *args):
+        if self._service is not None:
+            return getattr(self._service, method)(*args)
+        with self._conn_lock:
+            self._conn.send((method, args))
+            ok, result = self._conn.recv()
+        if not ok:
+            raise RuntimeError(f"master RPC {method} failed: {result}")
+        return result
+
+    # -- surface ---------------------------------------------------------
+    def set_dataset(self, patterns: Sequence[str]) -> int:
+        return self._call("set_dataset", list(patterns))
+
+    def request_save_model(self, block_secs: float = 60.0) -> bool:
+        return self._call("request_save_model", self.trainer_id, block_secs)
+
+    def start_new_pass(self) -> int:
+        return self._call("start_new_pass")
+
+    def next_record(self) -> Optional[bytes]:
+        """The next record of the current task, fetching a new task when the
+        current one drains; None exactly at a pass boundary."""
+        while not self._records:
+            got = self._call("get_task")
+            if got is None:
+                return None
+            if got == "wait":  # other workers hold the remaining leases
+                time.sleep(0.01)
+                continue
+            fetched: List[bytes] = []
+            try:
+                for c in got["task"]["chunks"]:
+                    with recordio.Reader(c["path"], offset=c["offset"]) as r:
+                        for _ in range(c["n_records"]):
+                            rec = r.next()
+                            if rec is None:
+                                break
+                            fetched.append(rec)
+            except IOError:
+                self._call("task_failed", got["task"]["task_id"], got["epoch"])
+                continue
+            # Ack as soon as the records are safely buffered client-side —
+            # holding the lease while the trainer consumes them would let it
+            # expire mid-consumption and re-serve (duplicate) the task.
+            self._call("task_finished", got["task"]["task_id"])
+            self._records = fetched
+        return self._records.pop(0)
+
+    def reader(self):
+        """A reader-creator over next_record for the v2 trainer: one call =
+        one pass."""
+
+        def _reader():
+            while True:
+                rec = self.next_record()
+                if rec is None:
+                    return
+                yield rec
+
+        return _reader
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.send(("__close__", ()))
+            except (BrokenPipeError, OSError):
+                pass
+            self._conn.close()
