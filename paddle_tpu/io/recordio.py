@@ -246,16 +246,25 @@ class Reader:
         self.close()
 
 
-def scan_chunks(path: str, cap: int = 1 << 20) -> List[Chunk]:
-    """Chunk index of a file — what the master partitions into tasks."""
+def scan_chunks(path: str) -> List[Chunk]:
+    """Chunk index of a file — what the master partitions into tasks.
+    Always returns every chunk (both backends)."""
     lib = _load_native()
     if lib is not None:
-        offsets = (ctypes.c_uint64 * cap)()
-        counts = (ctypes.c_uint32 * cap)()
-        n = lib.rio_scan_chunks(path.encode(), offsets, counts, cap)
-        if n < 0:
-            raise IOError(f"{path}: malformed recordio file")
-        return [Chunk(path, int(offsets[i]), int(counts[i])) for i in range(min(n, cap))]
+        # size the buffers from the file: a chunk is ≥16 bytes on disk
+        cap = max(16, os.path.getsize(path) // 16)
+        while True:
+            offsets = (ctypes.c_uint64 * cap)()
+            counts = (ctypes.c_uint32 * cap)()
+            n = lib.rio_scan_chunks(path.encode(), offsets, counts, cap)
+            if n < 0:
+                raise IOError(f"{path}: malformed recordio file")
+            if n <= cap:
+                return [
+                    Chunk(path, int(offsets[i]), int(counts[i]))
+                    for i in range(n)
+                ]
+            cap = n  # undersized (shouldn't happen) — rescan exactly
     chunks = []
     with open(path, "rb") as f:
         pos = 0
@@ -297,6 +306,7 @@ class Prefetcher:
             )
         else:
             self._q: _queue.Queue = _queue.Queue(maxsize=capacity)
+            self._stopped = False
             self._n_workers = max(1, min(n_threads, len(self._paths)))
             per = (len(self._paths) + self._n_workers - 1) // self._n_workers
             self._done = 0
@@ -312,14 +322,27 @@ class Prefetcher:
             for p in paths:
                 with Reader(p) as r:
                     for rec in r:
-                        self._q.put(rec)
+                        # bounded put that notices close(): don't block
+                        # forever (leaking the thread + fd) when the
+                        # consumer stops early
+                        while True:
+                            if self._stopped:
+                                return
+                            try:
+                                self._q.put(rec, timeout=0.1)
+                                break
+                            except _queue.Full:
+                                continue
         except BaseException as exc:  # surfaced to the consumer in next()
             self._worker_error = exc
         finally:
             with self._done_lock:
                 self._done += 1
                 if self._done == self._n_workers:
-                    self._q.put(None)
+                    try:
+                        self._q.put_nowait(None)
+                    except _queue.Full:
+                        pass  # consumer is gone; close() drains anyway
 
     def next(self) -> Optional[bytes]:
         if self._lib is not None:
@@ -351,9 +374,18 @@ class Prefetcher:
             yield r
 
     def close(self) -> None:
-        if self._lib is not None and self._h:
-            self._lib.rio_prefetcher_destroy(self._h)
-            self._h = None
+        if self._lib is not None:
+            if self._h:
+                self._lib.rio_prefetcher_destroy(self._h)
+                self._h = None
+            return
+        self._stopped = True
+        # unblock any worker waiting on a full queue
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
 
     def __enter__(self):
         return self

@@ -143,15 +143,25 @@ def embedding(
     input: LayerOutput,
     size: int,
     param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
+    drop, shard = _extra(layer_attr)
     conf = LayerConf(
         name=name or auto_name("embedding"),
         type="embedding",
         size=size,
         inputs=(input.name,),
         bias=False,
-        attrs={"param_std": _param_std(param_attr)},
+        attrs={
+            "param_std": _param_std(param_attr),
+            # sparse_update=True row-shards the table over the mesh model
+            # axis (the sparse-remote-update path of the reference,
+            # RemoteParameterUpdater.h:265 — see parallel/sharding.py)
+            "sparse_update": bool(param_attr and param_attr.sparse_update),
+        },
+        drop_rate=drop,
+        shard_axis=shard,
     )
     return LayerOutput(conf, [input])
 

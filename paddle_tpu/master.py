@@ -72,6 +72,7 @@ class Service:
         timeout_s: float = 60.0,
         failure_max: int = 3,
         auto_rotate: bool = True,
+        snapshot_min_interval_s: float = 1.0,
     ):
         """auto_rotate=True mirrors the reference: the moment a pass drains,
         done tasks recycle into todo and other trainers stream straight into
@@ -84,6 +85,8 @@ class Service:
         self.failure_max = failure_max
         self.auto_rotate = auto_rotate
         self.snapshot_path = snapshot_path
+        self.snapshot_min_interval_s = snapshot_min_interval_s
+        self._last_snapshot = 0.0
         self.todo: List[Task] = []
         self.pending: Dict[int, Tuple[Task, float]] = {}  # id -> (task, deadline)
         self.done: List[Task] = []
@@ -110,7 +113,7 @@ class Service:
             for i in range(0, len(chunks), self.chunks_per_task):
                 tasks.append(Task(len(tasks), chunks[i : i + self.chunks_per_task]))
             self.todo = tasks
-            self._snapshot()
+            self._snapshot(force=True)
             return len(tasks)
 
     def n_tasks(self) -> int:
@@ -144,7 +147,7 @@ class Service:
             t.epoch = 0
         self.done = []
         self.pass_id += 1
-        self._snapshot()
+        self._snapshot(force=True)
 
     def start_new_pass(self) -> int:
         """Explicit pass barrier release (auto_rotate=False mode)."""
@@ -199,9 +202,17 @@ class Service:
             return True
 
     # -- snapshot / recover (reference service.go:165-273, etcd → file) --
-    def _snapshot(self) -> None:
+    def _snapshot(self, force: bool = False) -> None:
+        """Debounced: per-task transitions at most one write per
+        snapshot_min_interval_s (a crash between writes just requeues the
+        few unsnapshotted leases on recover); structural changes
+        (set_dataset, pass rotation) always write."""
         if not self.snapshot_path:
             return
+        now = time.time()
+        if not force and now - self._last_snapshot < self.snapshot_min_interval_s:
+            return
+        self._last_snapshot = now
         state = {
             "pass_id": self.pass_id,
             "todo": [t.to_json() for t in self.todo],

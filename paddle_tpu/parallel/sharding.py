@@ -1,0 +1,84 @@
+"""Parameter sharding rules — the replacement for the reference's row-sharded
+sparse parameter servers and per-layer device placement.
+
+The reference shards embedding tables by row across pservers and has trainers
+prefetch only the touched rows (reference: paddle/math/SparseRowMatrix.h:204
+SparsePrefetchRowCpuMatrix, pserver/ParameterServer2.h:510 getParameterSparse,
+trainer/RemoteParameterUpdater.h:265 SparseRemoteParameterUpdater).  On TPU
+there is no parameter server: the table lives sharded over the mesh `model`
+axis and `jnp.take` under SPMD makes XLA emit the gather + collectives — the
+"prefetch" is an ICI all-gather of exactly the touched rows' partitions,
+fused into the step.
+
+Rules (derived from layer configs, applied to the params pytree):
+
+  * ``embedding`` with ``ParamAttr(sparse_update=True)`` or
+    ``shard_axis='model'``  →  table rows sharded: P('model', None)
+  * ``fc``/``selective_fc`` with ``shard_axis='model'``  →  column-parallel:
+    w P(None, 'model'), bias P('model') (replaces ParallelNeuralNetwork's
+    per-layer `device` attr, reference ParallelNeuralNetwork.h:34)
+  * everything else replicated: P()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel.mesh import MODEL_AXIS
+
+Params = Dict[str, Dict[str, Any]]
+
+
+def _spec_for(conf, param_name: str, value) -> P:
+    ndim = getattr(value, "ndim", 0)
+    shard_axis = getattr(conf, "shard_axis", None) or conf.attr("shard_axis")
+    sharded = bool(conf.attr("sparse_update")) or shard_axis == MODEL_AXIS
+    if not sharded:
+        return P()
+    if conf.type == "embedding":
+        # row-sharded vocab table
+        return P(MODEL_AXIS, *([None] * (ndim - 1))) if ndim >= 1 else P()
+    if conf.type in ("fc", "selective_fc"):
+        if param_name.startswith("w") and ndim == 2:
+            return P(None, MODEL_AXIS)  # column-parallel
+        if param_name == "b" and ndim == 1:
+            return P(MODEL_AXIS)
+    return P()
+
+
+def param_shardings(network, params: Params, mesh: Mesh) -> Params:
+    """NamedSharding pytree matching `params`."""
+    specs: Params = {}
+    for lname, pdict in params.items():
+        conf = network.topology.get(lname)
+        specs[lname] = {
+            k: NamedSharding(mesh, _spec_for(conf, k, v)) for k, v in pdict.items()
+        }
+    return specs
+
+
+def shard_params(network, params: Params, mesh: Optional[Mesh]) -> Params:
+    """Place every parameter according to the layer rules (replicated unless
+    a rule shards it).  Idempotent; call once after init or restore."""
+    if mesh is None:
+        return params
+    specs = param_shardings(network, params, mesh)
+    return {
+        lname: {k: jax.device_put(v, specs[lname][k]) for k, v in pdict.items()}
+        for lname, pdict in params.items()
+    }
+
+
+def has_model_sharding(network, params: Params, mesh: Optional[Mesh]) -> bool:
+    """True when any rule actually shards over a >1-sized model axis."""
+    if mesh is None or mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        return False
+    for lname, pdict in params.items():
+        conf = network.topology.get(lname)
+        for k, v in pdict.items():
+            if _spec_for(conf, k, v) != P():
+                return True
+    return False

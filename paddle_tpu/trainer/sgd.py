@@ -60,10 +60,25 @@ class SGD:
         self.optimizer = update_equation
         self.mesh = mesh if mesh is not None else get_default_mesh()
         self._metrics_fn = self._build_metrics_fn()
-        self._train_step = make_train_step(
-            self.network, self.optimizer, self.mesh, self._metrics_fn
+        from paddle_tpu.parallel.sharding import has_model_sharding, shard_params
+
+        self._model_sharded = has_model_sharding(
+            self.network, self.parameters.params, self.mesh
         )
-        self._eval_step = make_eval_step(self.network, self.mesh, self._metrics_fn)
+        if self._model_sharded:
+            # Row/column-shard the flagged tables over the model axis before
+            # optimizer state is created so its slots inherit the placement.
+            self.parameters.params = shard_params(
+                self.network, self.parameters.params, self.mesh
+            )
+        self._train_step = make_train_step(
+            self.network, self.optimizer, self.mesh, self._metrics_fn,
+            infer_param_shardings=self._model_sharded,
+        )
+        self._eval_step = make_eval_step(
+            self.network, self.mesh, self._metrics_fn,
+            infer_param_shardings=self._model_sharded,
+        )
         self._opt_state = self.optimizer.init(self.parameters.params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
@@ -258,4 +273,24 @@ class SGD:
 
         self._rng = jnp.asarray(tree["rng"])
         self._step_count = int(extra.get("step_count", self._step_count))
+        self._reshard_after_restore()
         return True
+
+    def _reshard_after_restore(self) -> None:
+        """Checkpoints come back as host arrays; re-apply the model-axis
+        placement so the inferred-sharding step doesn't recompile with a
+        replicated (possibly OOM-sized) table."""
+        if not self._model_sharded:
+            return
+        from paddle_tpu.parallel.sharding import shard_params
+
+        self.parameters.params = shard_params(
+            self.network, self.parameters.params, self.mesh
+        )
+        param_names = set(self.parameters.params)
+        self._opt_state = {
+            k: shard_params(self.network, v, self.mesh)
+            if isinstance(v, dict) and set(v) <= param_names
+            else v
+            for k, v in self._opt_state.items()
+        }

@@ -30,10 +30,16 @@ def make_train_step(
     extra_metrics: Optional[
         Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
     ] = None,
+    infer_param_shardings: bool = False,
 ):
     """Returns jitted
     (params, state, opt_state, batch, rng) ->
-        (params, state, opt_state, metrics)."""
+        (params, state, opt_state, metrics).
+
+    With infer_param_shardings=True the params/opt_state shardings follow the
+    argument placement (use parallel.sharding.shard_params first) so
+    model-axis-sharded tables stay sharded through the update; otherwise
+    params are pinned replicated."""
 
     def step(params, state, opt_state, batch, rng):
         def loss_fn(p):
@@ -48,7 +54,10 @@ def make_train_step(
             metrics.update(extra_metrics(outs))
         return new_params, new_state, new_opt_state, metrics
 
-    if mesh is None:
+    if mesh is None or infer_param_shardings:
+        # No mesh, or sharding flows from the arguments (batch via
+        # shard_batch, params via shard_params); XLA SPMD inserts the
+        # psum/all-gathers.
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     repl = NamedSharding(mesh, P())
@@ -67,6 +76,7 @@ def make_eval_step(
     extra_metrics: Optional[
         Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
     ] = None,
+    infer_param_shardings: bool = False,
 ):
     """(params, state, batch) -> metrics (test-time, no dropout/BN update)."""
 
@@ -77,7 +87,7 @@ def make_eval_step(
             metrics.update(extra_metrics(outs))
         return metrics
 
-    if mesh is None:
+    if mesh is None or infer_param_shardings:
         return jax.jit(step)
     repl = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, P(DATA_AXIS))

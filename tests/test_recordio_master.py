@@ -111,6 +111,7 @@ def test_prefetcher(tmp_path):
 def _make_service(tmp_path, n_files=2, n_records=200, **kw):
     for k in range(n_files):
         _write(str(tmp_path / f"d{k}.rio"), n_records, chunk=25, tag=f"{k}:")
+    kw.setdefault("snapshot_min_interval_s", 0.0)
     svc = master_mod.Service(
         snapshot_path=str(tmp_path / "snap.json"),
         chunks_per_task=2,
@@ -245,6 +246,21 @@ def test_master_concurrent_workers(tmp_path):
     svc.start_new_pass()
     assert svc.pass_id == 1
     assert drain() == expected  # pass 1 serves everything again
+
+
+def test_prefetcher_close_unblocks_workers(tmp_path, monkeypatch):
+    """Early consumer exit must not leak blocked fallback workers."""
+    import threading as _threading
+
+    monkeypatch.setattr(recordio, "_load_native", lambda: None)
+    p = str(tmp_path / "big.rio")
+    _write(p, 500)
+    before = _threading.active_count()
+    pf = recordio.Prefetcher([p], n_threads=1, capacity=4)
+    assert pf.next() is not None  # worker is now blocked on the full queue
+    pf.close()
+    time.sleep(0.3)
+    assert _threading.active_count() <= before + 1  # worker exited
 
 
 def test_numpy_payloads_end_to_end(tmp_path):
