@@ -1,0 +1,28 @@
+"""Op-level framework face — parity with the reference's embryonic
+``paddle/framework`` + ``paddle/operators`` design (reference:
+paddle/framework/operator.h, op_registry.h:338, scope.h:36, net_op.h,
+backward.cc, python/paddle/v2/framework/).
+
+The reference interprets a NetOp op-list one OperatorBase::Run at a time per
+device.  Here an op graph *lowers to a single XLA computation*: each op is a
+pure jax-traceable function; ``NetOp.lower()``/``Scope.run`` trace the whole
+list into one jitted HLO program (the OpDesc→HLO north star), and
+``Backward`` derives the gradient program with jax.vjp instead of per-op
+symbolic grad ops.
+"""
+
+from paddle_tpu.framework.scope import Scope, Variable  # noqa: F401
+from paddle_tpu.framework.op import (  # noqa: F401
+    Operator,
+    OpRegistry,
+    create_op,
+    register_op,
+)
+from paddle_tpu.framework.net import NetOp  # noqa: F401
+from paddle_tpu.framework.backward import Backward, BackwardOp  # noqa: F401
+from paddle_tpu.framework.recurrent import RecurrentOp  # noqa: F401
+from paddle_tpu.framework import ops  # noqa: F401  (registers the op set)
+from paddle_tpu.framework.gradient_checker import (  # noqa: F401
+    check_gradients,
+    numeric_gradient,
+)

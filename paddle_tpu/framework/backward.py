@@ -1,0 +1,99 @@
+"""Backward — gradient program construction (reference:
+paddle/framework/backward.cc:179 builds a reversed net of per-op grad ops
+with X→X@GRAD renaming; grad_op_builder.cc).
+
+TPU-native: the forward op/net is already one traceable function, so the
+gradient program is jax.vjp of that trace — one fused backward HLO instead
+of a reversed interpreter list.  The scope-facing contract is kept: running
+the backward op reads each external output's ``name@GRAD`` and writes each
+input's ``name@GRAD`` (the reference's naming scheme, backward.cc)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class BackwardOp:
+    """The gradient operator for a forward op/net."""
+
+    type = "backward"
+
+    def __init__(self, forward, no_grad_set: Optional[Set[str]] = None):
+        self.forward = forward
+        self.no_grad_set = set(no_grad_set or ())
+        self.fwd_inputs = forward.input_names()
+        self.fwd_outputs = forward.output_names()
+        self.grad_inputs = [n for n in self.fwd_inputs if n not in self.no_grad_set]
+
+    def input_names(self) -> List[str]:
+        return self.fwd_inputs + [grad_name(n) for n in self.fwd_outputs]
+
+    def output_names(self) -> List[str]:
+        return [grad_name(n) for n in self.grad_inputs]
+
+    def trace(self, values: Dict[str, Any]) -> Dict[str, Any]:
+        grads = _vjp_trace(
+            self.forward,
+            {n: values[n] for n in self.fwd_inputs},
+            {n: values[grad_name(n)] for n in self.fwd_outputs},
+            self.grad_inputs,
+        )
+        new_values = dict(values)
+        for n in self.grad_inputs:
+            new_values[grad_name(n)] = grads[n]
+        return new_values
+
+    def run(self, scope) -> None:
+        values = {}
+        for n in self.fwd_inputs:
+            values[n] = jnp.asarray(scope.get_var(n).get())
+        for n in self.fwd_outputs:
+            g = scope.find_var(grad_name(n))
+            if g is None or g.get() is None:
+                # default seed: ones like the forward output (callers usually
+                # seed the loss grad explicitly)
+                out_val = scope.find_var(n)
+                values[grad_name(n)] = jnp.ones_like(
+                    jnp.asarray(out_val.get())
+                )
+            else:
+                values[grad_name(n)] = jnp.asarray(g.get())
+        out = self.trace(values)
+        for n in self.grad_inputs:
+            scope.new_var(grad_name(n)).set(np.asarray(out[grad_name(n)]))
+
+
+def _vjp_trace(forward, inputs: Dict[str, Any], out_grads: Dict[str, Any],
+               wrt: List[str]) -> Dict[str, Any]:
+    in_names = forward.input_names()
+    out_names = forward.output_names()
+
+    def fwd_fn(wrt_vals):
+        values = dict(inputs)
+        values.update(zip(wrt, wrt_vals))
+        values = forward.trace(values)
+        return tuple(values[n] for n in out_names)
+
+    primals = [inputs[n] for n in wrt]
+    _, vjp_fn = jax.vjp(fwd_fn, primals)
+    cotangents = tuple(
+        out_grads[n].astype(jnp.result_type(float)) for n in out_names
+    )
+    (grads,) = vjp_fn(cotangents)
+    return dict(zip(wrt, grads))
+
+
+def Backward(forward, no_grad_set: Optional[Iterable[str]] = None) -> BackwardOp:
+    """reference backward.cc Backward(): returns the op computing
+    d(outputs)/d(inputs) with @GRAD-named scope variables."""
+    return BackwardOp(forward, set(no_grad_set or ()))

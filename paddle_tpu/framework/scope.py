@@ -1,0 +1,76 @@
+"""Scope/Variable — the hierarchical name→value store (reference:
+paddle/framework/scope.h:36, variable.h).  Values are host numpy or jax
+arrays; ops never mutate them in place — Run() writes fresh arrays, keeping
+the store compatible with functional jax execution."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Variable:
+    """A named slot.  `value` is the tensor (numpy/jax array) or None until
+    set; get_dims mirrors the reference Tensor::dims."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value: Optional[Any] = None
+
+    def set(self, value) -> "Variable":
+        self.value = value
+        return self
+
+    def get(self):
+        return self.value
+
+    def set_dims(self, dims) -> "Variable":
+        """Pre-allocate by shape (reference tensor.mutable_data pattern)."""
+        self.value = np.zeros(tuple(dims), dtype=np.float32)
+        return self
+
+    @property
+    def shape(self):
+        return None if self.value is None else tuple(np.shape(self.value))
+
+
+class Scope:
+    """Hierarchical variable scope (reference scope.h: parent chain lookup)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: Dict[str, Variable] = {}
+        self._kids: List["Scope"] = []
+
+    def new_var(self, name: str) -> Variable:
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(name)
+        self.vars[name] = v
+        return v
+
+    # reference naming
+    var = new_var
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def get_var(self, name: str) -> Variable:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not found in scope chain")
+        return v
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def local_names(self) -> List[str]:
+        return sorted(self.vars)

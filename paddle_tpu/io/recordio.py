@@ -251,8 +251,9 @@ def scan_chunks(path: str) -> List[Chunk]:
     Always returns every chunk (both backends)."""
     lib = _load_native()
     if lib is not None:
-        # size the buffers from the file: a chunk is ≥16 bytes on disk
-        cap = max(16, os.path.getsize(path) // 16)
+        # modest initial guess; rio_scan_chunks reports the true count when
+        # undersized and the loop rescans with the exact size
+        cap = 1 << 16
         while True:
             offsets = (ctypes.c_uint64 * cap)()
             counts = (ctypes.c_uint32 * cap)()
@@ -264,7 +265,7 @@ def scan_chunks(path: str) -> List[Chunk]:
                     Chunk(path, int(offsets[i]), int(counts[i]))
                     for i in range(n)
                 ]
-            cap = n  # undersized (shouldn't happen) — rescan exactly
+            cap = n  # undersized — rescan with the exact size
     chunks = []
     with open(path, "rb") as f:
         pos = 0
@@ -338,11 +339,16 @@ class Prefetcher:
         finally:
             with self._done_lock:
                 self._done += 1
-                if self._done == self._n_workers:
+                last = self._done == self._n_workers
+            if last:
+                # the sentinel must reach a live consumer even if the queue
+                # is momentarily full; only a close() may drop it
+                while not self._stopped:
                     try:
-                        self._q.put_nowait(None)
+                        self._q.put(None, timeout=0.1)
+                        break
                     except _queue.Full:
-                        pass  # consumer is gone; close() drains anyway
+                        continue
 
     def next(self) -> Optional[bytes]:
         if self._lib is not None:

@@ -87,6 +87,7 @@ class Service:
         self.snapshot_path = snapshot_path
         self.snapshot_min_interval_s = snapshot_min_interval_s
         self._last_snapshot = 0.0
+        self._flush_timer: Optional[threading.Timer] = None
         self.todo: List[Task] = []
         self.pending: Dict[int, Tuple[Task, float]] = {}  # id -> (task, deadline)
         self.done: List[Task] = []
@@ -204,15 +205,29 @@ class Service:
     # -- snapshot / recover (reference service.go:165-273, etcd → file) --
     def _snapshot(self, force: bool = False) -> None:
         """Debounced: per-task transitions at most one write per
-        snapshot_min_interval_s (a crash between writes just requeues the
-        few unsnapshotted leases on recover); structural changes
+        snapshot_min_interval_s; a skipped write is flushed by a timer so the
+        last transition of a burst always reaches disk.  Structural changes
         (set_dataset, pass rotation) always write."""
         if not self.snapshot_path:
             return
         now = time.time()
         if not force and now - self._last_snapshot < self.snapshot_min_interval_s:
+            if self._flush_timer is None:
+                t = threading.Timer(self.snapshot_min_interval_s, self._flush)
+                t.daemon = True
+                self._flush_timer = t
+                t.start()
             return
         self._last_snapshot = now
+        self._write_snapshot()
+
+    def _flush(self) -> None:
+        with self._lock:
+            self._flush_timer = None
+            self._last_snapshot = time.time()
+            self._write_snapshot()
+
+    def _write_snapshot(self) -> None:
         state = {
             "pass_id": self.pass_id,
             "todo": [t.to_json() for t in self.todo],

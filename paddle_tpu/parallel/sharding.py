@@ -34,8 +34,7 @@ Params = Dict[str, Dict[str, Any]]
 
 def _spec_for(conf, param_name: str, value) -> P:
     ndim = getattr(value, "ndim", 0)
-    shard_axis = getattr(conf, "shard_axis", None) or conf.attr("shard_axis")
-    sharded = bool(conf.attr("sparse_update")) or shard_axis == MODEL_AXIS
+    sharded = bool(conf.attr("sparse_update")) or conf.shard_axis == MODEL_AXIS
     if not sharded:
         return P()
     if conf.type == "embedding":

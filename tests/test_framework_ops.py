@@ -1,0 +1,239 @@
+"""Op-framework tests (reference models: python/paddle/v2/framework/tests/
+op_test_util.py per-op numpy compare, test_net.py, backward_test.cc,
+test_recurrent_op.py, gradient_checker.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import framework as fw
+
+
+def _scope_with(**arrays):
+    scope = fw.Scope()
+    for k, v in arrays.items():
+        scope.new_var(k).set(np.asarray(v, np.float32))
+    return scope
+
+
+# ---------------------------------------------------------------------------
+# per-op numpy parity (op_test_util.OpTestMeta style)
+# ---------------------------------------------------------------------------
+
+def test_add_two_op():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    scope = _scope_with(x=x, y=y)
+    op = fw.create_op("add", X="x", Y="y", Out="out")
+    op.infer_shape(scope)
+    assert scope.get_var("out").shape == (3, 4)
+    op.run(scope)
+    np.testing.assert_allclose(scope.get_var("out").get(), x + y, rtol=1e-6)
+
+
+def test_mul_op():
+    x = np.random.RandomState(2).randn(3, 5).astype(np.float32)
+    y = np.random.RandomState(3).randn(5, 2).astype(np.float32)
+    scope = _scope_with(x=x, y=y)
+    fw.create_op("mul", X="x", Y="y", Out="out").run(scope)
+    np.testing.assert_allclose(scope.get_var("out").get(), x @ y, rtol=1e-5)
+
+
+def test_rowwise_add_sigmoid_softmax_mean_scale():
+    x = np.random.RandomState(4).randn(4, 6).astype(np.float32)
+    b = np.random.RandomState(5).randn(6).astype(np.float32)
+    scope = _scope_with(x=x, b=b)
+    fw.create_op("rowwise_add", X="x", b="b", Out="r").run(scope)
+    np.testing.assert_allclose(scope.get_var("r").get(), x + b, rtol=1e-6)
+    fw.create_op("sigmoid", X="r", Y="s").run(scope)
+    np.testing.assert_allclose(
+        scope.get_var("s").get(), 1 / (1 + np.exp(-(x + b))), rtol=1e-5
+    )
+    fw.create_op("softmax", X="x", Y="sm").run(scope)
+    e = np.exp(x - x.max(1, keepdims=True))
+    np.testing.assert_allclose(
+        scope.get_var("sm").get(), e / e.sum(1, keepdims=True), rtol=1e-5
+    )
+    fw.create_op("mean", X="x", Out="m").run(scope)
+    np.testing.assert_allclose(scope.get_var("m").get(), x.mean(), rtol=1e-6)
+    fw.create_op("scale", X="x", Out="sc", scale=2.5).run(scope)
+    np.testing.assert_allclose(scope.get_var("sc").get(), 2.5 * x, rtol=1e-6)
+
+
+def test_cross_entropy_and_sgd():
+    probs = np.array([[0.2, 0.8], [0.9, 0.1]], np.float32)
+    labels = np.array([1, 0], np.int32)
+    scope = _scope_with(x=probs)
+    scope.new_var("lab").set(labels)
+    fw.create_op("onehot_cross_entropy", X="x", label="lab", Y="ce").run(scope)
+    np.testing.assert_allclose(
+        scope.get_var("ce").get(), -np.log([0.8, 0.9]), rtol=1e-5
+    )
+    p = np.ones((2, 2), np.float32)
+    g = np.full((2, 2), 0.5, np.float32)
+    scope2 = _scope_with(p=p, g=g)
+    fw.create_op(
+        "sgd", param="p", grad="g", param_out="p2", learning_rate=0.1
+    ).run(scope2)
+    np.testing.assert_allclose(scope2.get_var("p2").get(), p - 0.05, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# scope semantics (scope_test.cc)
+# ---------------------------------------------------------------------------
+
+def test_scope_hierarchy():
+    parent = fw.Scope()
+    parent.new_var("a").set(np.zeros(2))
+    child = parent.new_scope()
+    assert child.find_var("a") is parent.vars["a"]
+    child.new_var("a").set(np.ones(2))  # shadowing
+    np.testing.assert_allclose(child.find_var("a").get(), 1.0)
+    np.testing.assert_allclose(parent.find_var("a").get(), 0.0)
+    assert child.find_var("missing") is None
+    with pytest.raises(KeyError):
+        child.get_var("missing")
+
+
+# ---------------------------------------------------------------------------
+# NetOp: composition + single-program lowering (net_op_test.cc, fc_op.cc)
+# ---------------------------------------------------------------------------
+
+def test_net_external_io_dedup():
+    net = fw.NetOp()
+    net.add_op(fw.create_op("mul", X="x", Y="w", Out="h"))
+    net.add_op(fw.create_op("add", X="h", Y="h", Out="h2"))
+    net.complete_add_op()
+    assert net.external_inputs == ["x", "w"]  # h is internal
+    assert "h" in net.external_outputs and "h2" in net.external_outputs
+
+
+def test_fc_net_matches_numpy():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 3).astype(np.float32)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(5).astype(np.float32)
+    from paddle_tpu.framework.net import fc_net
+
+    net = fc_net("x", "w", "b", "out")
+    scope = _scope_with(x=x, w=w, b=b)
+    net.run(scope)
+    want = 1 / (1 + np.exp(-(x @ w + b)))
+    np.testing.assert_allclose(scope.get_var("out").get(), want, rtol=1e-5)
+
+
+def test_lowered_net_is_single_callable():
+    from paddle_tpu.framework.net import fc_net
+
+    net = fc_net("x", "w", None, "out")
+    fn = net.lower()
+    rng = np.random.RandomState(8)
+    x, w = rng.randn(2, 3).astype(np.float32), rng.randn(3, 4).astype(np.float32)
+    outs = fn(x, w)
+    assert len(outs) == len(net.external_outputs)
+
+
+# ---------------------------------------------------------------------------
+# Backward (backward_test.cc: grad net with @GRAD names)
+# ---------------------------------------------------------------------------
+
+def test_backward_names_and_values():
+    op = fw.create_op("mul", X="x", Y="w", Out="out")
+    bwd = fw.Backward(op)
+    assert bwd.output_names() == ["x@GRAD", "w@GRAD"]
+    rng = np.random.RandomState(9)
+    x = rng.randn(3, 4).astype(np.float32)
+    w = rng.randn(4, 2).astype(np.float32)
+    og = rng.randn(3, 2).astype(np.float32)
+    scope = _scope_with(x=x, w=w)
+    op.run(scope)
+    scope.new_var("out@GRAD").set(og)
+    bwd.run(scope)
+    np.testing.assert_allclose(scope.get_var("x@GRAD").get(), og @ w.T, rtol=1e-4)
+    np.testing.assert_allclose(scope.get_var("w@GRAD").get(), x.T @ og, rtol=1e-4)
+
+
+def test_backward_no_grad_set():
+    op = fw.create_op("mul", X="x", Y="w", Out="out")
+    bwd = fw.Backward(op, no_grad_set={"w"})
+    assert bwd.output_names() == ["x@GRAD"]
+
+
+def test_backward_of_net():
+    from paddle_tpu.framework.net import fc_net
+
+    net = fc_net("x", "w", "b", "out")
+    rng = np.random.RandomState(10)
+    inputs = {
+        "x": rng.randn(3, 4).astype(np.float32),
+        "w": rng.randn(4, 2).astype(np.float32),
+        "b": rng.randn(2).astype(np.float32),
+    }
+    fw.check_gradients(net, inputs)
+
+
+# ---------------------------------------------------------------------------
+# gradient checker on individual ops
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op_type", ["sigmoid", "softmax"])
+def test_unary_gradients(op_type):
+    op = fw.create_op(op_type, X="x", Y="y")
+    x = np.random.RandomState(11).randn(3, 5).astype(np.float32)
+    fw.check_gradients(op, {"x": x})
+
+
+def test_mul_gradients():
+    op = fw.create_op("mul", X="x", Y="y", Out="o")
+    rng = np.random.RandomState(12)
+    fw.check_gradients(
+        op,
+        {"x": rng.randn(3, 4).astype(np.float32),
+         "y": rng.randn(4, 2).astype(np.float32)},
+    )
+
+
+# ---------------------------------------------------------------------------
+# RecurrentOp (test_recurrent_op.py)
+# ---------------------------------------------------------------------------
+
+def test_recurrent_op_matches_loop():
+    """h_t = sigmoid(x_t @ W + h_{t-1} @ U) — compare against a python loop."""
+    T, B, D = 5, 2, 3
+    rng = np.random.RandomState(13)
+    x = rng.randn(T, B, D).astype(np.float32)
+    W = rng.randn(D, D).astype(np.float32)
+    U = rng.randn(D, D).astype(np.float32)
+    h0 = np.zeros((B, D), np.float32)
+
+    step = fw.NetOp()
+    step.add_op(fw.create_op("mul", X="x_t", Y="W", Out="xw"))
+    step.add_op(fw.create_op("mul", X="h_pre", Y="U", Out="hu"))
+    step.add_op(fw.create_op("add", X="xw", Y="hu", Out="pre_act"))
+    step.add_op(fw.create_op("sigmoid", X="pre_act", Y="h"))
+    step.complete_add_op()
+
+    rnn = fw.RecurrentOp(
+        step_net=step,
+        inlinks={"x": "x_t"},
+        outlinks=["h"],
+        memories=[("h_pre", "h", "h0")],
+    )
+    assert set(rnn.input_names()) == {"x", "h0", "W", "U"}
+    scope = _scope_with(x=x, W=W, U=U, h0=h0)
+    rnn.run(scope)
+    got = scope.get_var("h").get()
+    assert got.shape == (T, B, D)
+
+    h = h0
+    for t in range(T):
+        h = 1 / (1 + np.exp(-(x[t] @ W + h @ U)))
+        np.testing.assert_allclose(got[t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_registry_lists_ops():
+    types = fw.OpRegistry.op_types()
+    for t in ("add", "mul", "softmax", "sgd", "onehot_cross_entropy",
+              "fill_zeros_like", "rowwise_add", "mean", "sigmoid", "scale"):
+        assert t in types
+    with pytest.raises(KeyError):
+        fw.OpRegistry.get("nope")

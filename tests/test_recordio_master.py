@@ -191,6 +191,18 @@ def test_master_snapshot_recover(tmp_path):
     assert got2["task"]["task_id"] in ids
 
 
+def test_master_snapshot_trailing_flush(tmp_path):
+    """A debounced transition must still reach disk via the flush timer."""
+    import json
+
+    svc = _make_service(tmp_path, snapshot_min_interval_s=0.2)
+    svc.get_task()  # debounced (set_dataset just wrote)
+    time.sleep(0.5)  # timer fires
+    with open(str(tmp_path / "snap.json")) as f:
+        state = json.load(f)
+    assert len(state["pending"]) == 1
+
+
 def test_master_save_arbitration(tmp_path):
     svc = _make_service(tmp_path)
     a = master_mod.Client(svc, trainer_id="a")
