@@ -37,14 +37,28 @@ from paddle_tpu.minibatch import batch  # noqa: F401
 __version__ = "0.1.0"
 
 
-def init(use_tpu: bool = True, trainer_count: int = 1, seed: int = 0, **kwargs) -> None:
+def init(
+    use_tpu: bool = True,
+    trainer_count: int = 1,
+    seed: int = 0,
+    compute_dtype=None,
+    **kwargs,
+) -> None:
     """paddle.init equivalent (reference: paddle/utils/Util.h initMain via
     swig initPaddle).  JAX needs no global init; `use_tpu`/`trainer_count`
     are accepted for config compatibility — device selection and parallelism
-    come from the jax platform and the mesh instead."""
+    come from the jax platform and the mesh instead.
+
+    compute_dtype: 'bfloat16' enables mixed precision for networks built
+    after this call (master params stay float32; see core.compiler).
+    """
     import random
 
     import numpy as np
 
     random.seed(seed)
     np.random.seed(seed)
+    if compute_dtype is not None:
+        from paddle_tpu.core.compiler import set_default_compute_dtype
+
+        set_default_compute_dtype(compute_dtype)

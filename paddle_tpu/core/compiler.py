@@ -23,19 +23,48 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.batch import Batch, SeqTensor
 from paddle_tpu.core.topology import Topology
-from paddle_tpu.layers.base import ApplyContext, get_layer_impl
+from paddle_tpu.layers.base import ApplyContext, get_layer_impl, stable_hash
 from paddle_tpu.ops.activations import apply_activation
 
 Params = Dict[str, Dict[str, Any]]
 NetState = Dict[str, Dict[str, Any]]
 
+# Global default compute dtype for newly-built networks.  Master parameters
+# always live in float32; when this is bfloat16 the forward/backward compute
+# runs in bf16 on the MXU (mixed precision — the cast's transpose upcasts
+# gradients back to f32 for the optimizer).  Set via paddle.init or
+# settings(), queried at CompiledNetwork construction.
+_default_compute_dtype = None
+
+
+def set_default_compute_dtype(dtype) -> None:
+    global _default_compute_dtype
+    _default_compute_dtype = None if dtype is None else jnp.dtype(dtype)
+
+
+def get_default_compute_dtype():
+    return _default_compute_dtype
+
+
+def _cast_floats(tree, dtype):
+    """Cast every floating leaf of a pytree to `dtype` (ints/bools pass)."""
+    def cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
 
 class CompiledNetwork:
     """init/apply view over a Topology."""
 
-    def __init__(self, topology: Topology, dtype=jnp.float32):
+    def __init__(self, topology: Topology, dtype=jnp.float32, compute_dtype=None):
         self.topology = topology
         self.dtype = dtype
+        if compute_dtype is None:
+            compute_dtype = _default_compute_dtype or dtype
+        self.compute_dtype = jnp.dtype(compute_dtype)
         # Resolve implementations eagerly so unknown types fail at build.
         self._impls = {
             name: get_layer_impl(conf.type)
@@ -49,7 +78,7 @@ class CompiledNetwork:
             conf = self.topology.layers[name]
             impl = self._impls[name]
             in_confs = [self.topology.layers[i] for i in conf.inputs]
-            layer_rng = jax.random.fold_in(rng, hash(name) & 0x7FFFFFFF)
+            layer_rng = jax.random.fold_in(rng, stable_hash(name))
             p = impl.init(conf, in_confs, layer_rng)
             if p:
                 params[name] = p
@@ -82,7 +111,14 @@ class CompiledNetwork:
     ) -> Tuple[Dict[str, SeqTensor], NetState]:
         """Run the whole graph; returns every layer's output by name plus the
         functionally-updated state."""
-        ctx = ApplyContext(train=train, rng=rng, state=state or {}, dtype=self.dtype)
+        mixed = self.compute_dtype != jnp.dtype(jnp.float32)
+        if mixed:
+            # Mixed precision: master params stay f32; per-layer param/input
+            # casts below run the compute in compute_dtype on the MXU.
+            batch = _cast_floats(batch, self.compute_dtype)
+        ctx = ApplyContext(
+            train=train, rng=rng, state=state or {}, dtype=self.compute_dtype
+        )
         for name in self.topology.order:
             conf = self.topology.layers[name]
             impl = self._impls[name]
@@ -94,7 +130,23 @@ class CompiledNetwork:
                 ctx.outputs[name] = batch[name]
                 continue
             ins = [ctx.outputs[i] for i in conf.inputs]
-            out = impl.apply(conf, params.get(name, {}), ins, ctx)
+            p = params.get(name, {})
+            pre_keys = set(ctx.outputs) if mixed else ()
+            if mixed:
+                if impl.full_precision:
+                    ins = [_cast_floats(x, jnp.float32) for x in ins]
+                else:
+                    p = _cast_floats(p, self.compute_dtype)
+            out = impl.apply(conf, p, ins, ctx)
+            if mixed and not impl.full_precision:
+                # Enforce the compute dtype at every layer boundary —
+                # f32 constants/masks inside an impl would otherwise promote
+                # and leak float32 downstream (breaking e.g. scan carries).
+                out = _cast_floats(out, self.compute_dtype)
+                for k in set(ctx.outputs) - pre_keys:  # side outputs (@cell, …)
+                    ctx.outputs[k] = _cast_floats(
+                        ctx.outputs[k], self.compute_dtype
+                    )
             if impl.auto_activation and conf.act not in ("identity", "linear", ""):
                 if conf.act == "softmax":
                     # Stash pre-activation logits so downstream cross_entropy

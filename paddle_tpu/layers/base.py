@@ -15,6 +15,7 @@ whole network instead of per-layer ``backward`` methods.
 from __future__ import annotations
 
 import dataclasses
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -42,7 +43,14 @@ class ApplyContext:
     def layer_rng(self, name: str) -> Optional[jax.Array]:
         if self.rng is None:
             return None
-        return jax.random.fold_in(self.rng, hash(name) & 0x7FFFFFFF)
+        return jax.random.fold_in(self.rng, stable_hash(name))
+
+
+def stable_hash(name: str) -> int:
+    """Process-stable 31-bit hash (Python's str hash is salted per process,
+    which would make per-layer RNG folds — and thus parameter init —
+    nondeterministic across interpreters)."""
+    return zlib.crc32(name.encode("utf-8")) & 0x7FFFFFFF
 
 
 InitFn = Callable[[LayerConf, List[LayerConf], jax.Array], Dict[str, Any]]
@@ -66,6 +74,10 @@ class LayerImpl:
     auto_activation: bool = True
     # If True the compiler applies dropout (conf.drop_rate) after activation.
     auto_dropout: bool = True
+    # If True the compiler upcasts this layer's float inputs to float32 under
+    # mixed precision (cost / log-prob layers whose reductions lose too much
+    # in bfloat16).
+    full_precision: bool = False
 
 
 _LAYERS: Dict[str, LayerImpl] = {}
@@ -82,6 +94,7 @@ def register_layer(
     init_state: Optional[StateInitFn] = None,
     auto_activation: bool = True,
     auto_dropout: bool = True,
+    full_precision: bool = False,
 ):
     """Decorator over the apply function:
 
@@ -99,6 +112,7 @@ def register_layer(
             init_state=init_state,
             auto_activation=auto_activation,
             auto_dropout=auto_dropout,
+            full_precision=full_precision,
         )
         return apply
 

@@ -154,7 +154,10 @@ def batch_norm_apply(conf, params, inputs, ctx):
     eps = a.get("epsilon", 1e-5)
     momentum = a.get("moving_average_fraction", 0.9)
     img = a.get("in_h") is not None
-    x = inputs[0].data
+    in_dtype = inputs[0].data.dtype
+    # Stats in f32: bf16 mean/var accumulation loses too much; the moving
+    # state stays f32 across steps either way.
+    x = inputs[0].data.astype(jnp.float32)
     if img:
         x = to_nhwc(x, a["in_h"], a["in_w"], a["channels"])
         axes = (0, 1, 2)
@@ -173,8 +176,10 @@ def batch_norm_apply(conf, params, inputs, ctx):
                 "var": momentum * st["var"] + (1 - momentum) * var,
             }
     inv = lax.rsqrt(var + eps)
-    out = (x - mean) * inv * params["scale"] + params["beta"]
-    return SeqTensor(out, inputs[0].lengths)
+    out = (x - mean) * inv * params["scale"].astype(jnp.float32) + params[
+        "beta"
+    ].astype(jnp.float32)
+    return SeqTensor(out.astype(in_dtype), inputs[0].lengths)
 
 
 # ---------------------------------------------------------------------------

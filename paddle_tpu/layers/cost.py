@@ -37,7 +37,7 @@ def _label_ids(label: SeqTensor) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-@register_layer("cross_entropy", auto_activation=False)
+@register_layer("cross_entropy", auto_activation=False, full_precision=True)
 def cross_entropy_apply(conf, params, inputs, ctx):
     """-log p[label]; input is a probability distribution (softmax output),
     reference MultiClassCrossEntropy (CostLayer.cpp).  When the producing
@@ -48,7 +48,7 @@ def cross_entropy_apply(conf, params, inputs, ctx):
     ids = _label_ids(label)
     logits = ctx.outputs.get(conf.inputs[0] + "@logits")
     if logits is not None:
-        logp = jax.nn.log_softmax(logits.data, axis=-1)
+        logp = jax.nn.log_softmax(logits.data.astype(jnp.float32), axis=-1)
         cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
         return _per_sample(cost, prob)
     p = jnp.take_along_axis(prob.data, ids[..., None], axis=-1)[..., 0]
@@ -56,7 +56,7 @@ def cross_entropy_apply(conf, params, inputs, ctx):
     return _per_sample(cost, prob)
 
 
-@register_layer("softmax_with_cost", auto_activation=False)
+@register_layer("softmax_with_cost", auto_activation=False, full_precision=True)
 def softmax_with_cost_apply(conf, params, inputs, ctx):
     """Fused log-softmax cross-entropy from *logits* — numerically stable
     TPU-native fast path the DSL uses for classification_cost when the input
@@ -69,7 +69,7 @@ def softmax_with_cost_apply(conf, params, inputs, ctx):
     return _per_sample(cost, logits)
 
 
-@register_layer("soft_binary_class_cross_entropy", auto_activation=False)
+@register_layer("soft_binary_class_cross_entropy", auto_activation=False, full_precision=True)
 def soft_bce_apply(conf, params, inputs, ctx):
     """Per-dim BCE with soft targets (SoftBinaryClassCrossEntropy)."""
     prob, label = inputs[0], inputs[1]
@@ -79,7 +79,7 @@ def soft_bce_apply(conf, params, inputs, ctx):
     return _per_sample(cost, prob)
 
 
-@register_layer("multi_binary_label_cross_entropy", auto_activation=False)
+@register_layer("multi_binary_label_cross_entropy", auto_activation=False, full_precision=True)
 def multi_binary_label_ce_apply(conf, params, inputs, ctx):
     """BCE where the label is a multi-hot vector (MultiBinaryLabelCrossEntropy).
     The label slot arrives densified to multi-hot [B, D] by the feeder."""
@@ -90,7 +90,7 @@ def multi_binary_label_ce_apply(conf, params, inputs, ctx):
     return _per_sample(cost, prob)
 
 
-@register_layer("square_error", auto_activation=False)
+@register_layer("square_error", auto_activation=False, full_precision=True)
 def square_error_apply(conf, params, inputs, ctx):
     """0.5 * sum((x - y)^2) per sample (SumOfSquaresCostLayer)."""
     x, y = inputs[0], inputs[1]
@@ -99,7 +99,7 @@ def square_error_apply(conf, params, inputs, ctx):
     return _per_sample(cost, x)
 
 
-@register_layer("smooth_l1", auto_activation=False)
+@register_layer("smooth_l1", auto_activation=False, full_precision=True)
 def smooth_l1_apply(conf, params, inputs, ctx):
     """SmoothL1Cost: 0.5 d^2 if |d|<1 else |d|-0.5, summed per sample."""
     x, y = inputs[0], inputs[1]
@@ -109,7 +109,7 @@ def smooth_l1_apply(conf, params, inputs, ctx):
     return _per_sample(cost, x)
 
 
-@register_layer("huber_regression", auto_activation=False)
+@register_layer("huber_regression", auto_activation=False, full_precision=True)
 def huber_regression_apply(conf, params, inputs, ctx):
     delta = conf.attr("delta", 1.0)
     x, y = inputs[0], inputs[1]
@@ -120,7 +120,7 @@ def huber_regression_apply(conf, params, inputs, ctx):
     return _per_sample(cost, x)
 
 
-@register_layer("huber_classification", auto_activation=False)
+@register_layer("huber_classification", auto_activation=False, full_precision=True)
 def huber_classification_apply(conf, params, inputs, ctx):
     """HuberTwoClassification: labels {0,1} -> y in {-1,+1},
     cost = 0 if y*f>1, (1-y*f)^2 if -1<=y*f<=1, -4*y*f if y*f<-1."""
@@ -132,7 +132,7 @@ def huber_classification_apply(conf, params, inputs, ctx):
     return _per_sample(cost, x)
 
 
-@register_layer("rank_cost", auto_activation=False)
+@register_layer("rank_cost", auto_activation=False, full_precision=True)
 def rank_cost_apply(conf, params, inputs, ctx):
     """RankingCost: pairwise logistic loss on score difference
     (CostLayer.cpp RankingCost::forwardImp)."""
@@ -145,7 +145,7 @@ def rank_cost_apply(conf, params, inputs, ctx):
     return _per_sample(cost, left)
 
 
-@register_layer("sum_cost", auto_activation=False)
+@register_layer("sum_cost", auto_activation=False, full_precision=True)
 def sum_cost_apply(conf, params, inputs, ctx):
     """SumCostLayer: cost = sum of input row."""
     x = inputs[0]
@@ -155,7 +155,7 @@ def sum_cost_apply(conf, params, inputs, ctx):
     return _per_sample(cost, x)
 
 
-@register_layer("cross_entropy_with_selfnorm", auto_activation=False)
+@register_layer("cross_entropy_with_selfnorm", auto_activation=False, full_precision=True)
 def ce_selfnorm_apply(conf, params, inputs, ctx):
     """MultiClassCrossEntropyWithSelfNorm: CE + alpha * log(Z)^2 where Z is
     the row sum of the (softmax) output."""

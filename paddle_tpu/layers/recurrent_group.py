@@ -218,7 +218,9 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
 
     a = conf.attrs
     sub_topo: Topology = a["_sub_topology"]
-    subnet = CompiledNetwork(sub_topo)
+    # Inherit the enclosing network's compute dtype so scan carries keep a
+    # consistent dtype under mixed precision.
+    subnet = CompiledNetwork(sub_topo, compute_dtype=ctx.dtype)
     memories: Sequence[LayerConf] = a["_memories"]
     scan_names: Sequence[str] = a["_scan_placeholders"]
     static_info = a["_static_placeholders"]

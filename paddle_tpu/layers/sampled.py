@@ -44,7 +44,7 @@ def nce_init(conf, in_confs, rng):
     return p
 
 
-@register_layer("nce", init=nce_init, auto_activation=False)
+@register_layer("nce", init=nce_init, auto_activation=False, full_precision=True)
 def nce_apply(conf, params, inputs, ctx):
     """Noise-contrastive estimation cost → [B, 1].
 
@@ -105,7 +105,7 @@ def hsigmoid_init(conf, in_confs, rng):
     return p
 
 
-@register_layer("hsigmoid", init=hsigmoid_init, auto_activation=False)
+@register_layer("hsigmoid", init=hsigmoid_init, auto_activation=False, full_precision=True)
 def hsigmoid_apply(conf, params, inputs, ctx):
     """Hierarchical sigmoid cost → [B, 1] over an implicit complete binary
     tree (reference SimpleCode in paddle/math/MathFunctions-era code paths:
@@ -179,7 +179,7 @@ def selective_fc_apply(conf, params, inputs, ctx):
 # ---------------------------------------------------------------------------
 
 
-@register_layer("lambda_cost", auto_activation=False)
+@register_layer("lambda_cost", auto_activation=False, full_precision=True)
 def lambda_cost_apply(conf, params, inputs, ctx):
     """Listwise LambdaRank cost per query sequence → [B, 1].
 

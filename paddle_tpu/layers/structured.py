@@ -76,7 +76,7 @@ def _crf_path_score(x, labels, lengths, a, b, trans):
     return score
 
 
-@register_layer("crf", init=crf_init, auto_activation=False)
+@register_layer("crf", init=crf_init, auto_activation=False, full_precision=True)
 def crf_apply(conf, params, inputs, ctx):
     """-log P(label | emissions) per sequence → [B, 1]."""
     x_t, y_t = inputs
@@ -93,7 +93,7 @@ def crf_apply(conf, params, inputs, ctx):
     return SeqTensor(nll[:, None])
 
 
-@register_layer("crf_decoding", init=crf_init, auto_activation=False)
+@register_layer("crf_decoding", init=crf_init, auto_activation=False, full_precision=True)
 def crf_decoding_apply(conf, params, inputs, ctx):
     """Viterbi decode → [B, T] best label ids (padded with 0); when a label
     input is present, returns [B, T] 0/1 mismatch indicators instead
@@ -159,7 +159,7 @@ def crf_decoding_apply(conf, params, inputs, ctx):
 # ---------------------------------------------------------------------------
 
 
-@register_layer("ctc", auto_activation=False)
+@register_layer("ctc", auto_activation=False, full_precision=True)
 def ctc_apply(conf, params, inputs, ctx):
     """CTC negative log likelihood per sequence → [B, 1].
 

@@ -1,0 +1,49 @@
+"""Cross-process determinism of parameter init (VERDICT r1 weak #3: Python's
+salted str hash made the same seed give different parameters per process;
+init now folds a crc32-based stable hash, layers/base.py stable_hash)."""
+
+import json
+import os
+import subprocess
+import sys
+
+SNIPPET = """
+import json
+import jax
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+reset_auto_names()
+L = paddle.layer
+x = L.data("x", paddle.data_type.dense_vector(8))
+h = L.fc(x, size=16, act=paddle.activation.Tanh())
+out = L.fc(h, size=4, act=paddle.activation.Softmax())
+lab = L.data("lab", paddle.data_type.integer_value(4))
+cost = L.classification_cost(input=out, label=lab)
+net = CompiledNetwork(Topology([cost]))
+params, _ = net.init(jax.random.PRNGKey(42))
+leaves = jax.tree_util.tree_leaves(params)
+print(json.dumps([float(np.asarray(l).sum()) for l in leaves]))
+"""
+
+
+def _run_once():
+    env = dict(os.environ)
+    out = subprocess.run(
+        [sys.executable, "-c", SNIPPET],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_param_init_stable_across_processes():
+    a = _run_once()
+    b = _run_once()
+    assert a == b, (a, b)

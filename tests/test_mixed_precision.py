@@ -1,0 +1,143 @@
+"""Mixed-precision (bfloat16 compute, float32 masters) tests.
+
+Reference analogue: the reference is float32-only; bf16 compute is the
+TPU-native performance path (MXU native dtype).  These tests pin the mixed
+contract: master params stay f32, gradients arrive f32, losses stay finite
+and close to the f32 run, and recurrent_group scan carries keep a
+consistent dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import (
+    CompiledNetwork,
+    get_default_compute_dtype,
+    set_default_compute_dtype,
+)
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.trainer.step import make_train_step
+
+L = paddle.layer
+A = paddle.activation
+
+
+def _mlp_cost():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(16))
+    lab = L.data("lab", paddle.data_type.integer_value(4))
+    h = L.fc(x, size=32, act=A.Relu())
+    out = L.fc(h, size=4, act=A.Softmax())
+    return L.classification_cost(input=out, label=lab)
+
+
+def _batch(rng, n=8):
+    return {
+        "x": SeqTensor(jnp.asarray(rng.randn(n, 16), jnp.float32)),
+        "lab": SeqTensor(jnp.asarray(rng.randint(0, 4, n), jnp.int32)),
+    }
+
+
+def test_masters_stay_f32_grads_f32():
+    cost = _mlp_cost()
+    net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(0))
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.float32
+    batch = _batch(np.random.RandomState(0))
+    (c, _), grads = jax.value_and_grad(
+        lambda p: net.cost(p, batch, state=state, train=False), has_aux=True
+    )(params)
+    assert c.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    assert np.isfinite(float(c))
+
+
+def test_bf16_close_to_f32():
+    cost = _mlp_cost()
+    topo = Topology([cost])
+    net32 = CompiledNetwork(topo)
+    net16 = CompiledNetwork(topo, compute_dtype=jnp.bfloat16)
+    params, state = net32.init(jax.random.PRNGKey(1))
+    batch = _batch(np.random.RandomState(1))
+    c32, _ = net32.cost(params, batch, state=state, train=False)
+    c16, _ = net16.cost(params, batch, state=state, train=False)
+    # bf16 has ~3 decimal digits; costs should agree to a few percent
+    assert abs(float(c32) - float(c16)) < 0.05 * max(1.0, abs(float(c32)))
+
+
+def test_bf16_training_converges():
+    cost = _mlp_cost()
+    net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(2))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    opt_state = opt.init(params)
+    step = make_train_step(net, opt)
+    batch = _batch(np.random.RandomState(2), n=16)
+    costs = []
+    for i in range(20):
+        params, state, opt_state, m = step(
+            params, state, opt_state, batch, jax.random.PRNGKey(i)
+        )
+        costs.append(float(m["cost"]))
+    assert all(np.isfinite(costs))
+    assert costs[-1] < 0.3 * costs[0], costs
+
+
+def test_recurrent_group_bf16_carry():
+    """Scan carries must hold the compute dtype (regression: f32 masks inside
+    attention promoted the carry and broke lax.scan type agreement)."""
+    reset_auto_names()
+    vocab = 50
+    src = L.data("w", paddle.data_type.integer_value_sequence(vocab))
+    emb = L.embedding(src, size=16)
+
+    def step_fn(x):
+        prev = paddle.layer.memory("h", 16)
+        nxt = L.fc([x, prev], size=16, act=A.Tanh(), name="h")
+        return nxt
+
+    rec = paddle.layer.recurrent_group(step=step_fn, input=emb)
+    pooled = L.pooling(rec, pooling_type=paddle.pooling.Max())
+    out = L.fc(pooled, size=4, act=A.Softmax())
+    lab = L.data("lab", paddle.data_type.integer_value(4))
+    cost = L.classification_cost(input=out, label=lab)
+
+    net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    feeder = paddle.reader.DataFeeder(net.topology.data_types())
+    batch = feeder(
+        [([int(i) for i in rng.randint(0, vocab, 5)], int(rng.randint(4)))
+         for _ in range(4)]
+    )
+    c, _ = net.cost(params, batch, state=state, train=False)
+    assert np.isfinite(float(c))
+
+
+def test_default_compute_dtype_global():
+    prev = get_default_compute_dtype()
+    try:
+        set_default_compute_dtype("bfloat16")
+        cost = _mlp_cost()
+        net = CompiledNetwork(Topology([cost]))
+        assert net.compute_dtype == jnp.dtype(jnp.bfloat16)
+        set_default_compute_dtype(None)
+        net2 = CompiledNetwork(Topology([_mlp_cost()]))
+        assert net2.compute_dtype == jnp.dtype(jnp.float32)
+    finally:
+        set_default_compute_dtype(prev)
+
+
+def test_init_compute_dtype_kwarg():
+    prev = get_default_compute_dtype()
+    try:
+        paddle.init(seed=0, compute_dtype="bfloat16")
+        assert get_default_compute_dtype() == jnp.dtype(jnp.bfloat16)
+    finally:
+        set_default_compute_dtype(prev)
