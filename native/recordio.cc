@@ -107,7 +107,20 @@ struct Reader {
     uint32_t crc = get_u32(head + 4);
     uint32_t body_len = get_u32(head + 8);
     uint32_t n = get_u32(head + 12);
-    std::vector<uint8_t> body(body_len);
+    // The CRC covers the body only, so header fields are untrusted: the
+    // length table must fit inside the body, and each record must stay in
+    // bounds, or the chunk is treated as corrupt rather than read OOB.
+    if (4ull * n > body_len) {
+      corrupt = true;
+      return false;
+    }
+    std::vector<uint8_t> body;
+    try {
+      body.resize(body_len);
+    } catch (const std::bad_alloc&) {
+      corrupt = true;
+      return false;
+    }
     if (fread(body.data(), 1, body_len, f) != body_len) {
       corrupt = true;
       return false;
@@ -120,6 +133,11 @@ struct Reader {
     const uint8_t* p = body.data();
     for (uint32_t i = 0; i < n; i++) {
       uint32_t len = get_u32(p + 4ul * i);
+      if ((uint64_t)len > (uint64_t)body_len - off) {
+        corrupt = true;
+        records.clear();
+        return false;
+      }
       records.emplace_back(body.begin() + off, body.begin() + off + len);
       off += len;
     }
@@ -256,6 +274,16 @@ int64_t rio_scan_chunks(const char* path, uint64_t* offsets, uint32_t* counts,
                         int64_t cap) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
+  if (fseek(f, 0, SEEK_END) != 0) {
+    fclose(f);
+    return -1;
+  }
+  long fsize_l = ftell(f);
+  if (fsize_l < 0 || fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    return -1;
+  }
+  uint64_t fsize = (uint64_t)fsize_l;
   int64_t n = 0;
   uint8_t head[16];
   uint64_t pos = 0;
@@ -265,9 +293,17 @@ int64_t rio_scan_chunks(const char* path, uint64_t* offsets, uint32_t* counts,
       return -1;
     }
     uint32_t body_len = get_u32(head + 8);
+    uint32_t n_rec = get_u32(head + 12);
+    // Header fields are not covered by the CRC: a chunk whose claimed body
+    // overruns the file, or whose length table alone exceeds the body, marks
+    // the file malformed instead of producing a phantom chunk index.
+    if (4ull * n_rec > body_len || pos + 16 + (uint64_t)body_len > fsize) {
+      fclose(f);
+      return -1;
+    }
     if (n < cap) {
       offsets[n] = pos;
-      counts[n] = get_u32(head + 12);
+      counts[n] = n_rec;
     }
     n++;
     pos += 16 + body_len;

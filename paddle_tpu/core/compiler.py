@@ -112,10 +112,11 @@ class CompiledNetwork:
         """Run the whole graph; returns every layer's output by name plus the
         functionally-updated state."""
         mixed = self.compute_dtype != jnp.dtype(jnp.float32)
-        if mixed:
-            # Mixed precision: master params stay f32; per-layer param/input
-            # casts below run the compute in compute_dtype on the MXU.
-            batch = _cast_floats(batch, self.compute_dtype)
+        # Mixed precision: master params and the raw batch stay f32; each
+        # non-full_precision layer casts its own params/inputs to the compute
+        # dtype below.  Casting the whole batch up front would quantize float
+        # regression targets / soft labels before the full_precision cost
+        # layers ever see them.
         ctx = ApplyContext(
             train=train, rng=rng, state=state or {}, dtype=self.compute_dtype
         )
@@ -137,6 +138,7 @@ class CompiledNetwork:
                     ins = [_cast_floats(x, jnp.float32) for x in ins]
                 else:
                     p = _cast_floats(p, self.compute_dtype)
+                    ins = [_cast_floats(x, self.compute_dtype) for x in ins]
             out = impl.apply(conf, p, ins, ctx)
             if mixed and not impl.full_precision:
                 # Enforce the compute dtype at every layer boundary —

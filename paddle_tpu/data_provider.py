@@ -141,6 +141,11 @@ def provider(
     def __wrapper__(generator):
         @functools.wraps(generator)
         def factory(*files, **hook_kwargs):
+            # is_train resolves should_shuffle=None the way the reference
+            # trainer context does (shuffle for train, stable order for
+            # test/predict); it stays in hook_kwargs so init_hook sees it
+            # too, matching the reference PyDataProvider2 hook contract.
+            is_train = bool(hook_kwargs.get("is_train", True))
             settings = _Settings(**outter_kwargs)
             if types is not None:
                 settings.set_input_types(types)
@@ -172,9 +177,12 @@ def provider(
             if cache == CacheType.CACHE_PASS_IN_MEM:
                 rd = reader_dec.cache(rd)
             # init_hook may override the decorator's should_shuffle (the
-            # reference's test/predict readers do exactly this).
+            # reference's test/predict readers do exactly this); None falls
+            # back to the trainer context: shuffle only when training.
             shuffle_flag = settings.should_shuffle
-            if shuffle_flag is None or shuffle_flag:
+            if shuffle_flag is None:
+                shuffle_flag = is_train
+            if shuffle_flag:
                 rd = reader_dec.shuffle(rd, pool_size)
             return rd
 

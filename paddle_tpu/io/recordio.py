@@ -188,7 +188,9 @@ class Reader:
             if not self._h:
                 raise IOError(f"cannot open {path}")
             if offset:
-                self._lib.rio_reader_seek(self._h, offset)
+                if self._lib.rio_reader_seek(self._h, offset) != 0:
+                    self._lib.rio_reader_close(self._h)
+                    raise IOError(f"{path}: cannot seek to offset {offset}")
         else:
             self._f = open(path, "rb")
             if offset:
@@ -202,12 +204,20 @@ class Reader:
         magic, crc, body_len, n = struct.unpack("<IIII", head)
         if magic != _MAGIC:
             raise IOError(f"{self._path}: bad chunk magic {magic:#x}")
+        # Header fields are outside the CRC (it covers the body only), so a
+        # crafted n or record length must surface as a corrupt chunk, not an
+        # out-of-bounds slice or struct.error.
+        if 4 * n > body_len:
+            raise IOError(f"{self._path}: corrupt chunk")
         body = self._f.read(body_len)
         if len(body) != body_len or zlib.crc32(body) != crc:
             raise IOError(f"{self._path}: corrupt chunk")
         lens = struct.unpack(f"<{n}I", body[: 4 * n])
         off = 4 * n
         for ln in lens:
+            if ln > body_len - off:
+                self._records.clear()
+                raise IOError(f"{self._path}: corrupt chunk")
             self._records.append(body[off : off + ln])
             off += ln
         return True
@@ -267,6 +277,7 @@ def scan_chunks(path: str) -> List[Chunk]:
                 ]
             cap = n  # undersized — rescan with the exact size
     chunks = []
+    fsize = os.path.getsize(path)
     with open(path, "rb") as f:
         pos = 0
         while True:
@@ -274,7 +285,7 @@ def scan_chunks(path: str) -> List[Chunk]:
             if len(head) < 16:
                 break
             magic, _, body_len, n = struct.unpack("<IIII", head)
-            if magic != _MAGIC:
+            if magic != _MAGIC or 4 * n > body_len or pos + 16 + body_len > fsize:
                 raise IOError(f"{path}: malformed recordio file")
             chunks.append(Chunk(path, pos, n))
             pos += 16 + body_len

@@ -139,7 +139,11 @@ class Service:
             task = self.todo.pop(0)
             self.pending[task.task_id] = (task, time.time() + self.timeout_s)
             self._snapshot()
-            return {"task": task.to_json(), "epoch": task.epoch}
+            return {
+                "task": task.to_json(),
+                "epoch": task.epoch,
+                "timeout_s": self.timeout_s,
+            }
 
     def _rotate_pass(self) -> None:
         """Recycle done → todo; epochs reset so past failures don't carry."""
@@ -157,11 +161,27 @@ class Service:
                 self._rotate_pass()
             return self.pass_id
 
-    def task_finished(self, task_id: int) -> bool:
+    def renew_lease(self, task_id: int, epoch: int) -> bool:
+        """Extend a pending task's lease (consume-then-ack keeps the lease
+        open while the trainer drains records; renewal prevents a slow
+        consumer's task from expiring into the failure path).  The epoch
+        guard rejects a stale holder whose task was already re-served."""
         with self._lock:
-            ent = self.pending.pop(task_id, None)
-            if ent is None:
+            ent = self.pending.get(task_id)
+            if ent is None or ent[0].epoch != epoch:
                 return False
+            self.pending[task_id] = (ent[0], time.time() + self.timeout_s)
+            return True
+
+    def task_finished(self, task_id: int, epoch: Optional[int] = None) -> bool:
+        """epoch (when given) guards against a stale holder acking a task
+        that expired and was re-served at a higher epoch — same discipline
+        as task_failed (reference service.go:404 checks task epoch)."""
+        with self._lock:
+            ent = self.pending.get(task_id)
+            if ent is None or (epoch is not None and ent[0].epoch != epoch):
+                return False
+            del self.pending[task_id]
             self.done.append(ent[0])
             self._snapshot()
             return True
@@ -261,7 +281,7 @@ class Service:
 # ---------------------------------------------------------------------------
 
 _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
-            "request_save_model", "n_tasks", "start_new_pass")
+            "renew_lease", "request_save_model", "n_tasks", "start_new_pass")
 
 
 class Server:
@@ -326,6 +346,10 @@ class Client:
             self._conn_lock = threading.Lock()
         self.trainer_id = trainer_id
         self._records: List[bytes] = []
+        self._pending_task = None  # (task_id, epoch) awaiting ack-on-drain
+        self._last_renew = 0.0
+        self.lease_renew_secs = 10.0  # renewal throttle ceiling
+        self._renew_interval = self.lease_renew_secs
 
     def _call(self, method: str, *args):
         if self._service is not None:
@@ -350,7 +374,25 @@ class Client:
     def next_record(self) -> Optional[bytes]:
         """The next record of the current task, fetching a new task when the
         current one drains; None exactly at a pass boundary."""
+        if self._records and self._pending_task is not None:
+            # Renew the held lease while the trainer drains (throttled to a
+            # fraction of the server's lease timeout): a consumer slower than
+            # the lease timeout must not trip the failure/discard path.  A
+            # failed renewal means the task already expired and was re-served
+            # elsewhere — keep serving the buffer (at-least-once duplicates),
+            # the epoch-guarded ack below is then a harmless no-op.
+            now = time.time()
+            if now - self._last_renew >= self._renew_interval:
+                self._last_renew = now
+                self._call("renew_lease", *self._pending_task)
         while not self._records:
+            # Consume-then-ack (at-least-once, reference go/master client
+            # semantics): the previous task is finished only once every one
+            # of its records has been handed to the trainer, so a crash
+            # mid-consumption re-serves the task instead of losing it.
+            if self._pending_task is not None:
+                self._call("task_finished", *self._pending_task)
+                self._pending_task = None
             got = self._call("get_task")
             if got is None:
                 return None
@@ -369,10 +411,15 @@ class Client:
             except IOError:
                 self._call("task_failed", got["task"]["task_id"], got["epoch"])
                 continue
-            # Ack as soon as the records are safely buffered client-side —
-            # holding the lease while the trainer consumes them would let it
-            # expire mid-consumption and re-serve (duplicate) the task.
-            self._call("task_finished", got["task"]["task_id"])
+            # Lease is held until drain (renewed above while consuming); a
+            # crash mid-consumption re-serves the task (duplicates are
+            # possible, loss is not).
+            self._pending_task = (got["task"]["task_id"], got["epoch"])
+            self._last_renew = time.time()
+            # Renew well before the server-side lease expires.
+            self._renew_interval = min(
+                self.lease_renew_secs, got.get("timeout_s", 60.0) / 3.0
+            )
             self._records = fetched
         return self._records.pop(0)
 

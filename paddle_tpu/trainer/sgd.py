@@ -235,6 +235,10 @@ class SGD:
         ckpt.load_parameter_dir(
             self.parameters, os.path.join(save_dir, f"pass-{pass_id:05d}")
         )
+        # Restored values land with default placement; re-apply the model-axis
+        # sharding (no-op when not model-sharded) so the next step doesn't
+        # recompile against replicated tables.
+        self._reshard_after_restore()
 
     # -- full-state checkpoints (params + layer state + optimizer state) --
     def _full_state(self):

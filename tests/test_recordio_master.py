@@ -287,3 +287,124 @@ def test_numpy_payloads_end_to_end(tmp_path):
     got = [pickle.loads(r) for r in iter(client.next_record, None)]
     assert len(got) == 50
     np.testing.assert_allclose(got[0][0], samples[0][0])
+
+
+def _craft_bad_header(path, n_records=None, first_len=None):
+    """Write one valid chunk, then rewrite header fields the CRC does not
+    cover (crc32 spans the body only) to simulate a crafted/corrupted header."""
+    import struct
+    import zlib
+
+    recs = [b"abc", b"defg"]
+    body = b"".join([struct.pack("<I", len(r)) for r in recs] + recs)
+    n = n_records if n_records is not None else len(recs)
+    if first_len is not None:
+        body = struct.pack("<I", first_len) + body[4:]
+    head = struct.pack("<IIII", 0x7061646C, zlib.crc32(body), len(body), n)
+    with open(path, "wb") as f:
+        f.write(head + body)
+
+
+@pytest.mark.parametrize("force_py", [False, True])
+def test_crafted_header_n_records(tmp_path, monkeypatch, force_py):
+    """n_records claiming a length table bigger than the body must surface as
+    a corrupt chunk, not an out-of-bounds read (ADVICE r1, native/recordio.cc
+    load_chunk)."""
+    if force_py:
+        monkeypatch.setattr(recordio, "_load_native", lambda: None)
+    elif not recordio.native_available():
+        pytest.skip("no native toolchain")
+    p = str(tmp_path / "bad_n.rio")
+    _craft_bad_header(p, n_records=1 << 30)
+    with pytest.raises(IOError):
+        list(recordio.Reader(p))
+    with pytest.raises(IOError):
+        recordio.scan_chunks(p)
+
+
+@pytest.mark.parametrize("force_py", [False, True])
+def test_crafted_record_length(tmp_path, monkeypatch, force_py):
+    """A record length overrunning the body must be treated as corruption.
+    The length table is CRC-covered, so the CRC is recomputed to match."""
+    if force_py:
+        monkeypatch.setattr(recordio, "_load_native", lambda: None)
+    elif not recordio.native_available():
+        pytest.skip("no native toolchain")
+    p = str(tmp_path / "bad_len.rio")
+    _craft_bad_header(p, first_len=1 << 20)
+    with pytest.raises(IOError):
+        list(recordio.Reader(p))
+
+
+@pytest.mark.parametrize("force_py", [False, True])
+def test_reader_seek_bad_offset(tmp_path, monkeypatch, force_py):
+    """A failing seek (negative offset) must raise at construction on both
+    backends — not silently serve records from offset 0 (ADVICE r1)."""
+    if force_py:
+        monkeypatch.setattr(recordio, "_load_native", lambda: None)
+    elif not recordio.native_available():
+        pytest.skip("no native toolchain")
+    p = str(tmp_path / "a.rio")
+    _write(p, 10, chunk=5)
+    # (fseek beyond EOF succeeds on POSIX; the first read then reports clean
+    # EOF or corruption — both acceptable and covered elsewhere.)
+    with pytest.raises((IOError, OverflowError, ValueError)):
+        recordio.Reader(p, offset=-1)
+
+
+def test_master_client_acks_on_drain(tmp_path):
+    """Consume-then-ack: the task lease is released only after every record
+    was handed out (ADVICE r1; reference go/master client NextRecord)."""
+    p = str(tmp_path / "a.rio")
+    _write(p, 10, chunk=10)  # one chunk -> one task
+    svc = master_mod.Service(timeout_s=60, chunks_per_task=1, auto_rotate=False)
+    client = master_mod.Client(svc)
+    client.set_dataset([p])
+    first = client.next_record()
+    assert first is not None
+    # records are buffered but not fully consumed: the task must still be
+    # leased (pending), not done
+    assert len(svc.pending) == 1 and not svc.done
+    got = [first] + [client.next_record() for _ in range(9)]
+    assert all(r is not None for r in got)
+    # pass boundary drains + acks
+    assert client.next_record() is None
+    assert not svc.pending and len(svc.done) == 1
+
+
+def test_master_lease_renewal(tmp_path):
+    """A consumer slower than the lease timeout renews instead of expiring
+    into the failure/discard path."""
+    p = str(tmp_path / "a.rio")
+    _write(p, 4, chunk=4)
+    svc = master_mod.Service(timeout_s=0.2, chunks_per_task=1, auto_rotate=False)
+    client = master_mod.Client(svc)
+    client.lease_renew_secs = 0.05
+    client.set_dataset([p])
+    got = []
+    for _ in range(4):
+        got.append(client.next_record())
+        time.sleep(0.1)  # total consumption time > timeout_s
+    assert all(r is not None for r in got)
+    assert client.next_record() is None
+    assert not svc.pending and len(svc.done) == 1 and not svc.discarded
+
+def test_master_stale_ack_rejected(tmp_path):
+    """An expired holder must not ack a task re-served at a higher epoch."""
+    p = str(tmp_path / "a.rio")
+    _write(p, 8, chunk=4)
+    svc = master_mod.Service(timeout_s=0.05, chunks_per_task=1, auto_rotate=False)
+    svc.set_dataset([p])
+    t = svc.get_task()
+    tid, ep = t["task"]["task_id"], t["epoch"]
+    time.sleep(0.1)  # lease expires
+    # re-served at epoch+1 (possibly after draining the other task first)
+    while True:
+        t2 = svc.get_task()
+        assert isinstance(t2, dict), "task was not re-served"
+        if t2["task"]["task_id"] == tid:
+            break
+        svc.task_finished(t2["task"]["task_id"], t2["epoch"])
+    assert t2["epoch"] == ep + 1
+    assert not svc.task_finished(tid, ep)  # stale holder rejected
+    assert svc.task_finished(tid, t2["epoch"])  # live holder acks fine
