@@ -20,21 +20,27 @@ import jax.numpy as jnp
 class SeqTensor:
     """A (possibly sequential) batch value.
 
-    data:      [B, ...] for plain samples, or [B, T, ...] padded when seq.
-    lengths:   [B] int32 valid-timestep counts, or None for non-sequence.
-    sub_starts:[B, S] int32 start offsets of nested subsequences (padded with
-               `lengths`), or None — replaces subSequenceStartPositions
-               (reference Argument.h:88).
+    data:        [B, ...] for plain samples; [B, T, ...] padded when seq;
+                 [B, S, T, ...] doubly padded for nested sequences (a sequence
+                 of subsequences — the reference's SUB_SEQUENCE slots).
+    lengths:     [B] int32 — valid timesteps (plain seq) or valid subsequence
+                 count (nested), or None for non-sequence.
+    sub_lengths: [B, S] int32 valid-timestep counts of each subsequence, or
+                 None.  Replaces the reference's CSR
+                 subSequenceStartPositions (Argument.h:84-93) — static doubly
+                 padded shapes instead of two-level offset vectors, so nested
+                 recurrence lowers to a lax.scan over S whose body sees an
+                 ordinary [B, T, ...] sequence.
     """
 
-    def __init__(self, data, lengths=None, sub_starts=None):
+    def __init__(self, data, lengths=None, sub_lengths=None):
         self.data = data
         self.lengths = lengths
-        self.sub_starts = sub_starts
+        self.sub_lengths = sub_lengths
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
-        children = (self.data, self.lengths, self.sub_starts)
+        children = (self.data, self.lengths, self.sub_lengths)
         return children, None
 
     @classmethod
@@ -47,33 +53,52 @@ class SeqTensor:
         return self.lengths is not None
 
     @property
+    def is_nested(self) -> bool:
+        return self.sub_lengths is not None
+
+    @property
     def batch_size(self) -> int:
         return self.data.shape[0]
 
     @property
     def max_len(self) -> int:
+        """Extent of the outer padded axis: T (plain seq) or S (nested)."""
         assert self.is_seq
         return self.data.shape[1]
 
+    @property
+    def max_sub_len(self) -> int:
+        assert self.is_nested
+        return self.data.shape[2]
+
     def mask(self, dtype=jnp.float32):
-        """[B, T] 1/0 validity mask from lengths."""
+        """[B, T] (or [B, S] for nested) 1/0 validity of the outer axis."""
         assert self.is_seq
         t = jnp.arange(self.max_len, dtype=jnp.int32)
         return (t[None, :] < self.lengths[:, None]).astype(dtype)
+
+    def sub_mask(self, dtype=jnp.float32):
+        """[B, S, T] joint validity: subsequence s valid AND timestep t valid
+        within it."""
+        assert self.is_nested
+        outer = self.mask(dtype)  # [B, S]
+        t = jnp.arange(self.max_sub_len, dtype=jnp.int32)
+        inner = (t[None, None, :] < self.sub_lengths[:, :, None]).astype(dtype)
+        return outer[:, :, None] * inner
 
     def masked_data(self):
         """data with padding timesteps zeroed."""
         if not self.is_seq:
             return self.data
-        m = self.mask(self.data.dtype)
-        return self.data * m.reshape(m.shape + (1,) * (self.data.ndim - 2))
+        m = self.sub_mask(self.data.dtype) if self.is_nested else self.mask(self.data.dtype)
+        return self.data * m.reshape(m.shape + (1,) * (self.data.ndim - m.ndim))
 
     def with_data(self, data) -> "SeqTensor":
-        return SeqTensor(data, self.lengths, self.sub_starts)
+        return SeqTensor(data, self.lengths, self.sub_lengths)
 
     def __repr__(self) -> str:  # pragma: no cover
         shp = getattr(self.data, "shape", None)
-        return f"SeqTensor(shape={shp}, seq={self.is_seq})"
+        return f"SeqTensor(shape={shp}, seq={self.is_seq}, nested={self.is_nested})"
 
 
 Batch = Dict[str, SeqTensor]  # slot name -> value, the feeder's output
@@ -85,3 +110,13 @@ def non_seq(data) -> SeqTensor:
 
 def seq(data, lengths) -> SeqTensor:
     return SeqTensor(jnp.asarray(data), jnp.asarray(lengths, dtype=jnp.int32))
+
+
+def nested_seq(data, n_sub, sub_lengths) -> SeqTensor:
+    """[B, S, T, ...] doubly-padded nested sequence: n_sub[B] valid
+    subsequences, sub_lengths[B, S] valid timesteps per subsequence."""
+    return SeqTensor(
+        jnp.asarray(data),
+        jnp.asarray(n_sub, dtype=jnp.int32),
+        jnp.asarray(sub_lengths, dtype=jnp.int32),
+    )

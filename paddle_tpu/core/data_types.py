@@ -66,6 +66,18 @@ def integer_value_sub_sequence(value_range: int) -> InputType:
     return InputType(value_range, SlotKind.INDEX, SeqLevel.SUB_SEQ)
 
 
+def dense_vector_sub_sequence(dim: int) -> InputType:
+    return InputType(dim, SlotKind.DENSE, SeqLevel.SUB_SEQ)
+
+
+def sparse_binary_vector_sub_sequence(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_BINARY, SeqLevel.SUB_SEQ, max_nnz)
+
+
+def sparse_float_vector_sub_sequence(dim: int, max_nnz: int = 64) -> InputType:
+    return InputType(dim, SlotKind.SPARSE_FLOAT, SeqLevel.SUB_SEQ, max_nnz)
+
+
 def sparse_binary_vector(dim: int, max_nnz: int = 64) -> InputType:
     return InputType(dim, SlotKind.SPARSE_BINARY, SeqLevel.NONE, max_nnz)
 

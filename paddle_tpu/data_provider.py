@@ -48,6 +48,9 @@ __all__ = [
     "integer_value",
     "integer_value_sequence",
     "integer_value_sub_sequence",
+    "dense_vector_sub_sequence",
+    "sparse_binary_vector_sub_sequence",
+    "sparse_vector_sub_sequence",
 ]
 
 dense_slot = dt.dense_vector
@@ -63,6 +66,9 @@ index_slot = dt.integer_value
 integer_value = dt.integer_value
 integer_value_sequence = dt.integer_value_sequence
 integer_value_sub_sequence = dt.integer_value_sub_sequence
+dense_vector_sub_sequence = dt.dense_vector_sub_sequence
+sparse_binary_vector_sub_sequence = dt.sparse_binary_vector_sub_sequence
+sparse_vector_sub_sequence = dt.sparse_float_vector_sub_sequence
 
 
 class CacheType:

@@ -32,9 +32,34 @@ from paddle_tpu.layers import structured as _structured  # noqa: F401
 from paddle_tpu.layers import sequence as _sequence  # noqa: F401
 from paddle_tpu.layers.recurrent_group import (  # noqa: F401
     StaticInput,
+    SubsequenceInput,
     memory,
     recurrent_group,
 )
+
+
+class AggregateLevel:
+    """Which nesting level a pooling/selection layer collapses (reference
+    trainer_config_helpers/layers.py:248).  TO_NO_SEQUENCE pools each whole
+    (outer) sequence to one value; TO_SEQUENCE pools each subsequence of a
+    nested input, yielding a plain sequence."""
+
+    TO_NO_SEQUENCE = 0
+    TO_SEQUENCE = 1
+    # deprecated reference aliases
+    EACH_TIMESTEP = 0
+    EACH_SEQUENCE = 1
+
+
+class ExpandLevel:
+    """How expand_layer broadcasts (reference layers.py:1704):
+    FROM_NO_SEQUENCE expands a per-sample value across a (possibly nested)
+    pattern; FROM_SEQUENCE expands a plain sequence across a nested pattern's
+    subsequence timesteps."""
+
+    FROM_NO_SEQUENCE = 0
+    FROM_SEQUENCE = 1
+    FROM_TIMESTEP = 0
 
 Inputish = Union[LayerOutput, Sequence[LayerOutput]]
 
@@ -795,16 +820,19 @@ def sum_cost(input: LayerOutput, name=None):
 def pooling(
     input: LayerOutput,
     pooling_type=None,
+    agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
     name: Optional[str] = None,
 ) -> LayerOutput:
-    """Pool a sequence over time (reference pooling_layer → SequencePoolLayer)."""
+    """Pool a sequence over time (reference pooling_layer → SequencePoolLayer).
+    With nested input, agg_level picks whether whole outer sequences
+    (TO_NO_SEQUENCE) or individual subsequences (TO_SEQUENCE) collapse."""
     conf = LayerConf(
         name=name or auto_name("seqpool"),
         type="seqpool",
         size=input.size,
         inputs=(input.name,),
         bias=False,
-        attrs={"pool_type": pool_name(pooling_type)},
+        attrs={"pool_type": pool_name(pooling_type), "agg_level": agg_level},
     )
     return LayerOutput(conf, [input])
 
@@ -812,16 +840,31 @@ def pooling(
 pooling_layer = pooling
 
 
-def last_seq(input: LayerOutput, name: Optional[str] = None) -> LayerOutput:
-    return _unary("seqlastins", input, name=name, select_first=False)
+def last_seq(
+    input: LayerOutput,
+    agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    return _unary(
+        "seqlastins", input, name=name, select_first=False, agg_level=agg_level
+    )
 
 
-def first_seq(input: LayerOutput, name: Optional[str] = None) -> LayerOutput:
-    return _unary("seqlastins", input, name=name, select_first=True)
+def first_seq(
+    input: LayerOutput,
+    agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    return _unary(
+        "seqlastins", input, name=name, select_first=True, agg_level=agg_level
+    )
 
 
 def expand(
-    input: LayerOutput, expand_as: LayerOutput, name: Optional[str] = None
+    input: LayerOutput,
+    expand_as: LayerOutput,
+    expand_level: int = ExpandLevel.FROM_NO_SEQUENCE,
+    name: Optional[str] = None,
 ) -> LayerOutput:
     conf = LayerConf(
         name=name or auto_name("expand"),
@@ -829,6 +872,7 @@ def expand(
         size=input.size,
         inputs=(input.name, expand_as.name),
         bias=False,
+        attrs={"expand_level": expand_level},
     )
     return LayerOutput(conf, [input, expand_as])
 

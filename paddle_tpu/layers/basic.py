@@ -60,9 +60,12 @@ def fc_init(conf: LayerConf, in_confs: List[LayerConf], rng) -> Dict[str, Any]:
 def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTensor:
     acc = None
     lengths = None
+    sub_lengths = None
     for i, t in enumerate(inputs):
         x = t.data
-        if t.is_seq:
+        if t.is_nested:
+            lengths, sub_lengths = t.lengths, t.sub_lengths  # [B,S,T,D] as-is
+        elif t.is_seq:
             lengths = t.lengths
             if x.ndim > 3:
                 x = x.reshape(x.shape[0], x.shape[1], -1)
@@ -72,7 +75,7 @@ def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTen
         acc = y if acc is None else acc + y
     if "b" in params:
         acc = acc + params["b"]
-    return SeqTensor(acc, lengths)
+    return SeqTensor(acc, lengths, sub_lengths)
 
 
 # ---------------------------------------------------------------------------
@@ -93,7 +96,7 @@ def embedding_apply(conf, params, inputs, ctx):
     if idx.ndim >= 2 and idx.shape[-1] == 1:
         idx = idx[..., 0]
     out = jnp.take(params["w"], idx, axis=0)
-    return SeqTensor(out, ids.lengths)
+    return SeqTensor(out, ids.lengths, ids.sub_lengths)
 
 
 # ---------------------------------------------------------------------------
@@ -112,7 +115,7 @@ def addto_apply(conf, params, inputs, ctx):
         acc = acc + t.data
     if "b" in params:
         acc = acc + params["b"]
-    return SeqTensor(acc, inputs[0].lengths)
+    return inputs[0].with_data(acc)
 
 
 # ---------------------------------------------------------------------------
@@ -124,14 +127,16 @@ def addto_apply(conf, params, inputs, ctx):
 def concat_apply(conf, params, inputs, ctx):
     datas = []
     lengths = None
+    sub_lengths = None
     for t in inputs:
         x = t.data
         if t.is_seq:
             lengths = t.lengths
+            sub_lengths = t.sub_lengths
         elif x.ndim > 2:
             x = _flat2d(x)
         datas.append(x)
-    return SeqTensor(jnp.concatenate(datas, axis=-1), lengths)
+    return SeqTensor(jnp.concatenate(datas, axis=-1), lengths, sub_lengths)
 
 
 # ---------------------------------------------------------------------------

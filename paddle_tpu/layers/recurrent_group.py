@@ -40,6 +40,18 @@ class StaticInput:
         self.is_seq = is_seq
 
 
+class SubsequenceInput:
+    """Marks a NESTED outer layer whose subsequences are the scan unit
+    (reference SubsequenceInput, trainer_config_helpers/layers.py:3590;
+    engine: RecurrentGradientMachine.cpp:428-528 createInFrameInfo with
+    hasSubseq).  The group scans the outer S axis; each step's placeholder is
+    an ordinary [B, T, ...] sequence, so the step function can itself contain
+    sequence layers or an inner recurrent_group (hierarchical RNN)."""
+
+    def __init__(self, input: LayerOutput):
+        self.input = input
+
+
 # Build-time state for the step function trace: maps memory placeholders to
 # their link targets so the group layer can wire carries.
 class _GroupBuild:
@@ -113,12 +125,17 @@ def recurrent_group(
     """
     ins = input if isinstance(input, (list, tuple)) else [input]
     scanned: List[LayerOutput] = []
+    sub_scanned: List[bool] = []  # parallel: scan unit is a subsequence
     statics: List[StaticInput] = []
     for i in ins:
         if isinstance(i, StaticInput):
             statics.append(i)
+        elif isinstance(i, SubsequenceInput):
+            scanned.append(i.input)
+            sub_scanned.append(True)
         else:
             scanned.append(i)
+            sub_scanned.append(False)
     assert scanned, "recurrent_group needs at least one sequence input to scan"
 
     gname = name or auto_name("recurrent_group")
@@ -129,7 +146,8 @@ def recurrent_group(
     static_placeholders: List[LayerConf] = []
     for k, lo in enumerate(scanned):
         conf = LayerConf(
-            name=f"{gname}@in{k}", type="step_input", size=lo.size, bias=False
+            name=f"{gname}@in{k}", type="step_input", size=lo.size, bias=False,
+            attrs={"step_seq": sub_scanned[k]},
         )
         scan_placeholders.append(conf)
         step_args.append(LayerOutput(conf))
@@ -179,6 +197,7 @@ def recurrent_group(
             "_sub_topology": sub_topo,
             "_memories": tuple(gb.memories),
             "_scan_placeholders": tuple(c.name for c in scan_placeholders),
+            "_sub_scanned": tuple(sub_scanned),
             "_static_placeholders": tuple(
                 (c.name, c.attrs.get("static_seq", False))
                 for c in static_placeholders
@@ -228,26 +247,42 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     n_scan = a["n_scanned"]
     reverse = a["reverse"]
 
+    sub_scanned = a.get("_sub_scanned", (False,) * n_scan)
     scanned = inputs[:n_scan]
     statics = inputs[n_scan : n_scan + len(static_info)]  # rest are boot layers
     lengths = scanned[0].lengths
     assert lengths is not None, "recurrent_group inputs must be sequences"
-    t_max = scanned[0].max_len
+    t_max = scanned[0].max_len  # outer scan extent: T (plain) or S (nested)
     b = scanned[0].batch_size
 
-    # time-major scanned inputs
+    # Outer-axis-major scanned inputs, as SeqTensor pytrees so lax.scan
+    # slices data AND per-subsequence lengths together: a nested input
+    # [B, S, T, D] + sub_lengths [B, S] scans to an ordinary [B, T, D]
+    # sequence per step (the TPU-native hasSubseq path —
+    # RecurrentGradientMachine.cpp:446 re-batches frames instead).
     xs = []
-    for s in scanned:
-        x = jnp.swapaxes(s.data, 0, 1)  # [T, B, D]
-        if reverse:
-            x = jnp.flip(x, axis=0)
-        xs.append(x)
+    for s_in, is_sub in zip(scanned, sub_scanned):
+        if is_sub:
+            assert s_in.is_nested, (
+                f"{conf.name}: SubsequenceInput requires a nested slot"
+            )
+            data = jnp.swapaxes(s_in.data, 0, 1)  # [S, B, T, ...]
+            sub_len = jnp.swapaxes(s_in.sub_lengths, 0, 1)  # [S, B]
+            if reverse:
+                data = jnp.flip(data, axis=0)
+                sub_len = jnp.flip(sub_len, axis=0)
+            xs.append(SeqTensor(data, sub_len))
+        else:
+            x = jnp.swapaxes(s_in.data, 0, 1)  # [T, B, D]
+            if reverse:
+                x = jnp.flip(x, axis=0)
+            xs.append(SeqTensor(x))
     tpos = jnp.arange(t_max, dtype=jnp.int32)[:, None]  # [T, 1]
     if reverse:
         valid = tpos >= (t_max - lengths[None, :])
     else:
         valid = tpos < lengths[None, :]
-    mask_seq = valid[..., None].astype(scanned[0].data.dtype)  # [T, B, 1]
+    mask_seq = valid[..., None].astype(jnp.float32)  # [T, B, 1]
 
     # initial memory carries
     init_carry = {}
@@ -281,7 +316,7 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         t_idx = scan_in[-1]
         sub_batch = dict(static_batch)
         for pname, x in zip(scan_names, xt):
-            sub_batch[pname] = SeqTensor(x)
+            sub_batch[pname] = x  # SeqTensor: a sequence when SubsequenceInput
         for m in memories:
             sub_batch[m.name] = SeqTensor(carry[m.name])
         # fold the timestep in so dropout/sampling decorrelate across steps
@@ -292,9 +327,12 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         new_carry = {}
         for m in memories:
             upd = outs[m.attrs["link"]].data
-            new_carry[m.name] = jnp.where(m_t > 0, upd, carry[m.name])
-        y = outs[out_name].data
-        return (new_carry, new_sub_state), y
+            new_carry[m.name] = jnp.where(
+                m_t > 0, upd, carry[m.name].astype(upd.dtype)
+            )
+        # Return the whole SeqTensor so a seq-valued step output stacks its
+        # per-step lengths too (the nested-output case).
+        return (new_carry, new_sub_state), outs[out_name]
 
     # Memory/step placeholders ride the compiler's data path per step.
     (_, sub_state_out), ys = jax.lax.scan(
@@ -302,6 +340,16 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     )
     if sub_state0:
         ctx.new_state[conf.name] = sub_state_out
+    if ys.lengths is not None:
+        # step emitted sequences -> nested [B, S, T, ...] output
+        data, sub_len = ys.data, ys.lengths
+        if reverse:
+            data = jnp.flip(data, axis=0)
+            sub_len = jnp.flip(sub_len, axis=0)
+        data = jnp.swapaxes(data, 0, 1)  # [B, S, T, ...]
+        out = SeqTensor(data, lengths, jnp.swapaxes(sub_len, 0, 1))
+        return out.with_data(out.masked_data())
+    ys = ys.data
     if reverse:
         ys = jnp.flip(ys, axis=0)
     ys = jnp.swapaxes(ys, 0, 1)  # [B, T, D]

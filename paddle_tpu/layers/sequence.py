@@ -26,28 +26,52 @@ from paddle_tpu.ops import rnn as rnn_ops
 # ---------------------------------------------------------------------------
 
 
+def _masked_pool(data, mask, counts, kind):
+    """Pool `data` over its axis-1 under `mask` (same leading dims)."""
+    m = mask[..., None]
+    if kind == "max":
+        out = jnp.max(jnp.where(m > 0, data, -jnp.inf), axis=1)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # all-padding rows -> 0
+    s = jnp.sum(data * m, axis=1)
+    if kind == "sum":
+        return s
+    n = jnp.maximum(counts.astype(data.dtype), 1.0)[..., None]
+    return s / jnp.sqrt(n) if kind == "sqrt_n" else s / n
+
+
 @register_layer("seqpool")
 def seqpool_apply(conf, params, inputs, ctx):
     x = inputs[0]
     assert x.is_seq, f"{conf.name}: seqpool input must be a sequence"
     kind = conf.attr("pool_type", "max")
-    m = x.mask(x.data.dtype)[..., None]  # [B, T, 1]
-    if kind == "max":
-        data = jnp.where(m > 0, x.data, -jnp.inf)
-        out = jnp.max(data, axis=1)
-        # all-padding rows (len 0) -> 0
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
-    else:
-        s = jnp.sum(x.data * m, axis=1)
-        if kind == "sum":
-            out = s
-        else:
-            n = jnp.maximum(x.lengths.astype(x.data.dtype), 1.0)[:, None]
-            if kind == "sqrt_n":
-                out = s / jnp.sqrt(n)
-            else:  # average
-                out = s / n
-    return SeqTensor(out)
+    to_seq = conf.attr("agg_level", 0) == 1  # AggregateLevel.TO_SEQUENCE
+    if x.is_nested:
+        if to_seq:
+            # pool each subsequence -> a plain sequence of pooled vectors
+            # (reference SequencePoolLayer with trans_type="seq" reading
+            # subSequenceStartPositions)
+            b, s, t = x.data.shape[:3]
+            inner = (
+                jnp.arange(t, dtype=jnp.int32)[None, None, :]
+                < x.sub_lengths[:, :, None]
+            ).astype(x.data.dtype)
+            flat = _masked_pool(
+                x.data.reshape((b * s, t) + x.data.shape[3:]),
+                inner.reshape(b * s, t),
+                x.sub_lengths.reshape(b * s),
+                kind,
+            )
+            out = flat.reshape((b, s) + flat.shape[1:])
+            out = out * x.mask(out.dtype)[..., None]
+            return SeqTensor(out, x.lengths)
+        # pool the whole outer sequence -> one vector per sample
+        b, s, t = x.data.shape[:3]
+        data = x.data.reshape((b, s * t) + x.data.shape[3:])
+        mask = x.sub_mask(x.data.dtype).reshape(b, s * t)
+        counts = jnp.sum(x.sub_mask(jnp.int32), axis=(1, 2))
+        return SeqTensor(_masked_pool(data, mask, counts, kind))
+    assert not to_seq, f"{conf.name}: TO_SEQUENCE pooling needs nested input"
+    return SeqTensor(_masked_pool(x.data, x.mask(x.data.dtype), x.lengths, kind))
 
 
 # ---------------------------------------------------------------------------
@@ -55,18 +79,32 @@ def seqpool_apply(conf, params, inputs, ctx):
 # ---------------------------------------------------------------------------
 
 
+def _select_ins(data, lengths, first):
+    """First/last valid element along axis 1 of [N, T, D]."""
+    if first:
+        return data[:, 0]
+    idx = jnp.maximum(lengths - 1, 0)
+    return jnp.take_along_axis(data, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+
+
 @register_layer("seqlastins")
 def seqlastins_apply(conf, params, inputs, ctx):
     x = inputs[0]
     assert x.is_seq
-    if conf.attr("select_first", False):
-        out = x.data[:, 0]
-    else:
-        idx = jnp.maximum(x.lengths - 1, 0)
-        out = jnp.take_along_axis(
-            x.data, idx[:, None, None].astype(jnp.int32), axis=1
-        )[:, 0]
-    return SeqTensor(out)
+    first = conf.attr("select_first", False)
+    to_seq = conf.attr("agg_level", 0) == 1
+    if x.is_nested:
+        b, s, t = x.data.shape[:3]
+        flat = _select_ins(
+            x.data.reshape(b * s, t, -1), x.sub_lengths.reshape(b * s), first
+        ).reshape(b, s, -1)  # first/last of EACH subsequence: [B, S, D]
+        if to_seq:
+            return SeqTensor(flat * x.mask(flat.dtype)[..., None], x.lengths)
+        # first/last of the whole nested sample: pick the first/last valid
+        # subsequence's first/last element
+        return SeqTensor(_select_ins(flat, x.lengths, first))
+    assert not to_seq, f"{conf.name}: TO_SEQUENCE selection needs nested input"
+    return SeqTensor(_select_ins(x.data, x.lengths, first))
 
 
 # ---------------------------------------------------------------------------
@@ -76,12 +114,30 @@ def seqlastins_apply(conf, params, inputs, ctx):
 
 @register_layer("expand")
 def expand_apply(conf, params, inputs, ctx):
-    x, pattern = inputs  # x: [B, D] non-seq; pattern: [B, T, ...] seq
+    x, pattern = inputs
     assert pattern.is_seq
-    t = pattern.max_len
-    out = jnp.broadcast_to(
-        x.data[:, None, :], (x.data.shape[0], t, x.data.shape[-1])
+    from_seq = conf.attr("expand_level", 0) == 1  # ExpandLevel.FROM_SEQUENCE
+    assert from_seq == x.is_seq, (
+        f"{conf.name}: expand_level "
+        f"{'FROM_SEQUENCE' if from_seq else 'FROM_NO_SEQUENCE'} does not "
+        f"match a {'sequence' if x.is_seq else 'non-sequence'} input"
     )
+    b = x.data.shape[0]
+    d = x.data.shape[-1]
+    if pattern.is_nested:
+        s, t = pattern.max_len, pattern.max_sub_len
+        if from_seq:
+            # ExpandLevel.FROM_SEQUENCE: [B, S, D] seq -> nested, each
+            # subsequence repeats its element across timesteps
+            assert not x.is_nested and x.max_len == s
+            out = jnp.broadcast_to(x.data[:, :, None, :], (b, s, t, d))
+        else:
+            # FROM_NO_SEQUENCE: [B, D] -> every timestep of every subsequence
+            out = jnp.broadcast_to(x.data[:, None, None, :], (b, s, t, d))
+        return SeqTensor(out, pattern.lengths, pattern.sub_lengths)
+    assert not from_seq, f"{conf.name}: FROM_SEQUENCE needs a nested pattern"
+    t = pattern.max_len
+    out = jnp.broadcast_to(x.data[:, None, :], (b, t, d))
     return SeqTensor(out, pattern.lengths)
 
 

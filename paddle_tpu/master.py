@@ -189,10 +189,25 @@ class Service:
     def task_failed(self, task_id: int, epoch: int) -> bool:
         """(reference service.go:442 TaskFailed → processFailedTask:308)"""
         with self._lock:
-            ent = self.pending.pop(task_id, None)
+            ent = self.pending.get(task_id)
             if ent is None or ent[0].epoch != epoch:
                 return False
+            del self.pending[task_id]
             self._process_failed(ent[0])
+            self._snapshot()
+            return True
+
+    def task_returned(self, task_id: int, epoch: int) -> bool:
+        """Graceful give-back: a client closing with unconsumed records hands
+        its task back to the todo queue WITHOUT burning a failure event —
+        deliberate abandonment (early stop, capped test pass) is not a crash,
+        and must not walk the task toward the failure_max discard."""
+        with self._lock:
+            ent = self.pending.get(task_id)
+            if ent is None or ent[0].epoch != epoch:
+                return False
+            del self.pending[task_id]
+            self.todo.append(ent[0])
             self._snapshot()
             return True
 
@@ -281,7 +296,8 @@ class Service:
 # ---------------------------------------------------------------------------
 
 _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
-            "renew_lease", "request_save_model", "n_tasks", "start_new_pass")
+            "task_returned", "renew_lease", "request_save_model", "n_tasks",
+            "start_new_pass")
 
 
 class Server:
@@ -437,6 +453,19 @@ class Client:
         return _reader
 
     def close(self) -> None:
+        # Release a held lease: ack if the buffer drained, otherwise hand the
+        # task back (no failure event) so the records re-serve this pass
+        # instead of expiring into the failure/discard path.
+        if self._pending_task is not None:
+            try:
+                if self._records:
+                    self._call("task_returned", *self._pending_task)
+                else:
+                    self._call("task_finished", *self._pending_task)
+            except (RuntimeError, BrokenPipeError, OSError):
+                pass
+            self._pending_task = None
+            self._records = []
         if self._conn is not None:
             try:
                 self._conn.send(("__close__", ()))

@@ -75,7 +75,7 @@ class DataFeeder:
             return self._convert_plain(col, itype)
         if itype.seq == SeqLevel.SEQ:
             return self._convert_seq(col, itype)
-        raise NotImplementedError("sub-sequence slots land with the nested-seq engine")
+        return self._convert_sub_seq(col, itype)
 
     def _convert_plain(self, col, itype: InputType) -> SeqTensor:
         b = len(col)
@@ -121,3 +121,42 @@ class DataFeeder:
                         vals, self.dtype
                     )
         return SeqTensor(arr, lengths)
+
+    def _convert_sub_seq(self, col, itype: InputType) -> SeqTensor:
+        """Nested sequences: each sample is a list of subsequences.  Reference
+        packs these as two-level CSR (Argument.h:84-93,
+        subSequenceStartPositions); TPU-native form is a doubly padded
+        [B, S, T, ...] block plus n_sub[B] and sub_lengths[B, S] so nested
+        recurrence stays static-shape under jit."""
+        b = len(col)
+        n_sub = np.asarray([len(s) for s in col], dtype=np.int32)
+        s_max = max(_round_up(int(n_sub.max()) if b else 1, 4), 4)
+        sub_lengths = np.zeros((b, s_max), dtype=np.int32)
+        max_t = 1
+        for i, sample in enumerate(col):
+            for j, sub in enumerate(sample):
+                sub_lengths[i, j] = len(sub)
+                max_t = max(max_t, len(sub))
+        t = self._bucket_len(max_t)
+        if itype.kind == SlotKind.INDEX:
+            arr = np.zeros((b, s_max, t), dtype=np.int32)
+            for i, sample in enumerate(col):
+                for j, sub in enumerate(sample):
+                    arr[i, j, : len(sub)] = np.asarray(sub, dtype=np.int32)
+            return SeqTensor(arr, n_sub, sub_lengths)
+        arr = np.zeros((b, s_max, t, itype.dim), dtype=self.dtype)
+        for i, sample in enumerate(col):
+            for j, sub in enumerate(sample):
+                if itype.kind == SlotKind.DENSE:
+                    if len(sub):
+                        arr[i, j, : len(sub)] = np.asarray(sub, dtype=self.dtype)
+                else:
+                    for k, ids in enumerate(sub):
+                        if itype.kind == SlotKind.SPARSE_BINARY:
+                            arr[i, j, k, np.asarray(ids, dtype=np.int64)] = 1.0
+                        else:
+                            idx, vals = zip(*ids) if ids else ((), ())
+                            arr[i, j, k, np.asarray(idx, dtype=np.int64)] = (
+                                np.asarray(vals, self.dtype)
+                            )
+        return SeqTensor(arr, n_sub, sub_lengths)

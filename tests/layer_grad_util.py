@@ -39,6 +39,24 @@ def rand_batch_for(topology: Topology, batch_size: int = 4, max_len: int = 6, se
                 batch[name] = SeqTensor(
                     jnp.asarray(rng.randn(batch_size, it.dim), jnp.float32)
                 )
+        elif it.seq == SeqLevel.SUB_SEQ:
+            s_max = 3
+            n_sub = jnp.asarray(
+                rng.randint(1, s_max + 1, size=batch_size), jnp.int32
+            )
+            sub_len = jnp.asarray(
+                rng.randint(1, max_len + 1, size=(batch_size, s_max)), jnp.int32
+            )
+            if it.kind == SlotKind.INDEX:
+                data = jnp.asarray(
+                    rng.randint(0, it.dim, size=(batch_size, s_max, max_len)),
+                    jnp.int32,
+                )
+            else:
+                data = jnp.asarray(
+                    rng.randn(batch_size, s_max, max_len, it.dim), jnp.float32
+                )
+            batch[name] = SeqTensor(data, n_sub, sub_len)
         else:
             lengths = jnp.asarray(
                 rng.randint(2, max_len + 1, size=batch_size), jnp.int32
@@ -95,7 +113,7 @@ def check_layer_grad(
         def loss_from_inputs(*dense_vals):
             b2 = dict(batch)
             for n, v in zip(dense_slots, dense_vals):
-                b2[n] = SeqTensor(v, batch[n].lengths, batch[n].sub_starts)
+                b2[n] = SeqTensor(v, batch[n].lengths, batch[n].sub_lengths)
             outs, _ = net.apply(params, b2, state=state, train=False)
             o = outs[out_layer.name]
             data = o.masked_data() if o.is_seq else o.data

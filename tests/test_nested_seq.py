@@ -1,0 +1,300 @@
+"""Nested sub-sequence engine tests.
+
+Reference behavior being matched: two-level CSR sequences
+(paddle/parameter/Argument.h:84-93 subSequenceStartPositions), sequence
+layers operating at either nesting level (SequencePoolLayer.cpp with
+trans_type, SequenceLastInstanceLayer.cpp, ExpandLayer.cpp), and
+recurrent_group over subsequences (RecurrentGradientMachine.cpp:428-528
+hasSubseq / SubsequenceInput).  TPU-native form: doubly padded [B, S, T, ...]
+blocks + n_sub[B] + sub_lengths[B, S]."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layers
+from paddle_tpu.core.batch import SeqTensor, nested_seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.data_types import (
+    dense_vector_sub_sequence,
+    integer_value,
+    integer_value_sub_sequence,
+)
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.layers import (
+    AggregateLevel,
+    ExpandLevel,
+    StaticInput,
+    SubsequenceInput,
+)
+from paddle_tpu.reader.feeder import DataFeeder
+
+from tests.layer_grad_util import check_layer_grad
+
+
+# ---------------------------------------------------------------------------
+# feeder
+# ---------------------------------------------------------------------------
+
+
+def test_feeder_integer_sub_sequence():
+    feeder = DataFeeder(
+        [("words", integer_value_sub_sequence(100)), ("label", integer_value(3))],
+        seq_multiple=4,
+        min_seq_len=4,
+    )
+    batch = feeder(
+        [
+            ([[1, 2, 3], [4, 5]], 0),
+            ([[6]], 2),
+        ]
+    )
+    w = batch["words"]
+    assert w.is_nested
+    assert w.data.shape == (2, 4, 4)  # S bucketed to 4, T bucketed to 4
+    np.testing.assert_array_equal(np.asarray(w.lengths), [2, 1])
+    np.testing.assert_array_equal(
+        np.asarray(w.sub_lengths), [[3, 2, 0, 0], [1, 0, 0, 0]]
+    )
+    np.testing.assert_array_equal(np.asarray(w.data[0, 0, :3]), [1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(w.data[0, 1, :2]), [4, 5])
+    np.testing.assert_array_equal(np.asarray(w.data[1, 0, :1]), [6])
+    assert not batch["label"].is_seq
+
+
+def test_feeder_dense_sub_sequence():
+    feeder = DataFeeder(
+        [("x", dense_vector_sub_sequence(2))], seq_multiple=2, min_seq_len=2
+    )
+    batch = feeder([([[[1.0, 2.0], [3.0, 4.0]], [[5.0, 6.0]]],)])
+    x = batch["x"]
+    assert x.data.shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(np.asarray(x.data[0, 0]), [[1, 2], [3, 4]])
+    np.testing.assert_allclose(np.asarray(x.data[0, 1, 0]), [5, 6])
+    np.testing.assert_array_equal(np.asarray(x.sub_lengths[0, :2]), [2, 1])
+
+
+# ---------------------------------------------------------------------------
+# level-aware sequence layers
+# ---------------------------------------------------------------------------
+
+
+def _nested_batch():
+    # B=2, S=3, T=4, D=2; sample 0 has 2 subseqs (len 3, 2), sample 1 has 1 (len 4)
+    rng = np.random.RandomState(7)
+    data = rng.randn(2, 3, 4, 2).astype(np.float32)
+    n_sub = np.array([2, 1], np.int32)
+    sub_len = np.array([[3, 2, 0], [4, 0, 0]], np.int32)
+    return nested_seq(data, n_sub, sub_len), data, n_sub, sub_len
+
+
+def _run_layer(out_layer, batch):
+    net = CompiledNetwork(Topology([out_layer]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    return outs[out_layer.name]
+
+
+def test_seqpool_nested_to_sequence():
+    reset_auto_names()
+    x, data, n_sub, sub_len = _nested_batch()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    out = layers.pooling(
+        inp, pooling_type="sum", agg_level=AggregateLevel.TO_SEQUENCE
+    )
+    o = _run_layer(out, {"x": x})
+    assert o.is_seq and not o.is_nested
+    assert o.data.shape == (2, 3, 2)
+    np.testing.assert_allclose(
+        np.asarray(o.data[0, 0]), data[0, 0, :3].sum(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(o.data[0, 1]), data[0, 1, :2].sum(0), rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(o.data[1, 1]), [0, 0], atol=1e-6)
+
+
+def test_seqpool_nested_to_no_sequence():
+    reset_auto_names()
+    x, data, n_sub, sub_len = _nested_batch()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    out = layers.pooling(
+        inp, pooling_type="average", agg_level=AggregateLevel.TO_NO_SEQUENCE
+    )
+    o = _run_layer(out, {"x": x})
+    assert not o.is_seq
+    want0 = np.concatenate([data[0, 0, :3], data[0, 1, :2]]).mean(0)
+    want1 = data[1, 0, :4].mean(0)
+    np.testing.assert_allclose(np.asarray(o.data[0]), want0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o.data[1]), want1, rtol=1e-5)
+
+
+def test_last_first_seq_nested():
+    reset_auto_names()
+    x, data, n_sub, sub_len = _nested_batch()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    per_sub = layers.last_seq(inp, agg_level=AggregateLevel.TO_SEQUENCE)
+    o = _run_layer(per_sub, {"x": x})
+    assert o.is_seq and o.data.shape == (2, 3, 2)
+    np.testing.assert_allclose(np.asarray(o.data[0, 0]), data[0, 0, 2], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o.data[0, 1]), data[0, 1, 1], rtol=1e-5)
+
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    whole = layers.last_seq(inp)  # last timestep of the whole nested sample
+    o2 = _run_layer(whole, {"x": x})
+    assert not o2.is_seq
+    np.testing.assert_allclose(np.asarray(o2.data[0]), data[0, 1, 1], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o2.data[1]), data[1, 0, 3], rtol=1e-5)
+
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    first = layers.first_seq(inp)
+    o3 = _run_layer(first, {"x": x})
+    np.testing.assert_allclose(np.asarray(o3.data[0]), data[0, 0, 0], rtol=1e-5)
+
+
+def test_expand_to_nested():
+    reset_auto_names()
+    x, data, n_sub, sub_len = _nested_batch()
+    vec = SeqTensor(jnp.asarray(np.arange(4, dtype=np.float32).reshape(2, 2)))
+    pat = layers.data("pat", dense_vector_sub_sequence(2))
+    v = layers.data("v", paddle.data_type.dense_vector(2))
+    out = layers.expand(v, pat, expand_level=ExpandLevel.FROM_NO_SEQUENCE)
+    o = _run_layer(out, {"pat": x, "v": vec})
+    assert o.is_nested and o.data.shape == (2, 3, 4, 2)
+    np.testing.assert_allclose(np.asarray(o.data[0, 1, 1]), [0, 1], rtol=1e-5)
+
+    # FROM_SEQUENCE: a per-subsequence vector repeated across its timesteps
+    reset_auto_names()
+    pat = layers.data("pat", dense_vector_sub_sequence(2))
+    sv = layers.data("sv", paddle.data_type.dense_vector_sequence(2))
+    out2 = layers.expand(sv, pat, expand_level=ExpandLevel.FROM_SEQUENCE)
+    seq_vec = SeqTensor(
+        jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 3, 2)),
+        jnp.asarray(n_sub),
+    )
+    o2 = _run_layer(out2, {"pat": x, "sv": seq_vec})
+    assert o2.is_nested
+    np.testing.assert_allclose(np.asarray(o2.data[0, 1, 3]), [2, 3], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# gradients through nested layers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("agg", [AggregateLevel.TO_SEQUENCE, AggregateLevel.TO_NO_SEQUENCE])
+def test_nested_seqpool_grad(agg):
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(3))
+    out = layers.pooling(inp, pooling_type="sum", agg_level=agg)
+    check_layer_grad(out)
+
+
+def test_nested_group_grad():
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(3))
+
+    def step(sub):  # sub: [B, T, 3] plain sequence inside the group
+        h = layers.fc(sub, size=4, act=paddle.activation.Tanh())
+        return layers.last_seq(h)
+
+    out = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    check_layer_grad(out, atol=8e-2, rtol=8e-2)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical RNN end-to-end (sequence_nest_rnn-style)
+# ---------------------------------------------------------------------------
+
+
+def _hier_model(vocab=30, emb=8, hidden=8, n_cls=3):
+    words = layers.data("words", integer_value_sub_sequence(vocab))
+    label = layers.data("label", integer_value(n_cls))
+    embd = layers.embedding(words, size=emb)
+
+    def outer_step(sent):  # sent: [B, T, emb] — one subsequence per scan step
+        # inner recurrence over the words of this sentence
+        h = layers.recurrent(
+            layers.fc(sent, size=hidden, act=paddle.activation.Linear()),
+            act=paddle.activation.Tanh(),
+        )
+        sent_vec = layers.last_seq(h)
+        prev = layers.memory(name="sent_acc", size=hidden)
+        acc = layers.fc(
+            layers.concat([sent_vec, prev]),
+            size=hidden,
+            act=paddle.activation.Tanh(),
+            name="sent_acc",
+        )
+        return acc
+
+    doc = layers.recurrent_group(step=outer_step, input=SubsequenceInput(embd))
+    doc_vec = layers.last_seq(doc)
+    pred = layers.fc(doc_vec, size=n_cls, act=paddle.activation.Softmax())
+    cost = layers.classification_cost(input=pred, label=label)
+    return cost, pred
+
+
+def _hier_reader(n=40, vocab=30, n_cls=3, seed=3):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            n_sent = rng.randint(1, 4)
+            label = rng.randint(n_cls)
+            sents = [
+                list(rng.randint(label * 10, label * 10 + 9, size=rng.randint(2, 6)))
+                for _ in range(n_sent)
+            ]
+            yield sents, label
+
+    return reader
+
+
+def test_hierarchical_rnn_trains():
+    reset_auto_names()
+    paddle.init(use_gpu=False, trainer_count=1)
+    cost, pred = _hier_model()
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost,
+        parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-2),
+    )
+    losses = []
+    trainer.train(
+        reader=paddle.batch(_hier_reader(), batch_size=8),
+        num_passes=4,
+        event_handler=lambda e: losses.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration)
+        else None,
+    )
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.8, losses
+
+
+def test_nested_group_output_is_sequence():
+    """A step that returns a sequence produces a NESTED group output."""
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(3))
+
+    def step(sub):
+        return layers.fc(sub, size=4, act=paddle.activation.Tanh())
+
+    out = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    x, data, n_sub, sub_len = _nested_batch()
+    x = SeqTensor(
+        jnp.asarray(np.random.RandomState(0).randn(2, 3, 4, 3), jnp.float32),
+        x.lengths,
+        x.sub_lengths,
+    )
+    o = _run_layer(out, {"x": x})
+    assert o.is_nested
+    assert o.data.shape == (2, 3, 4, 4)
+    np.testing.assert_array_equal(np.asarray(o.lengths), [2, 1])
+    np.testing.assert_array_equal(np.asarray(o.sub_lengths), np.asarray(x.sub_lengths))
+    # padding subsequences are zeroed
+    np.testing.assert_allclose(np.asarray(o.data[1, 1]), 0.0, atol=1e-6)

@@ -408,3 +408,55 @@ def test_master_stale_ack_rejected(tmp_path):
     assert t2["epoch"] == ep + 1
     assert not svc.task_finished(tid, ep)  # stale holder rejected
     assert svc.task_finished(tid, t2["epoch"])  # live holder acks fine
+
+
+def test_client_close_returns_unconsumed_task(tmp_path):
+    """Graceful close with buffered records hands the task back (no failure
+    event, no progress toward failure_max discard) and a later client still
+    sees every record of the pass."""
+    svc = _make_service(tmp_path, failure_max=2)
+    client = master_mod.Client(svc)
+    first = client.next_record()
+    assert first is not None
+    assert client._pending_task is not None
+    client.close()
+    assert client._pending_task is None
+    assert svc.fail_events == 0
+    # every record (including the returned task's) is served to a new client
+    client2 = master_mod.Client(svc)
+    recs = [r for r in iter(client2.next_record, None)]
+    assert len(recs) == 400 and first in recs
+
+
+def test_client_close_acks_drained_task(tmp_path):
+    svc = _make_service(tmp_path, n_files=1, n_records=50)
+    n_task_records = 50  # 2 chunks/task x 25 records/chunk
+    client = master_mod.Client(svc)
+    # drain the first task's buffer completely, but don't fetch the next
+    for _ in range(n_task_records):
+        assert client.next_record() is not None
+    assert client._pending_task is not None and not client._records
+    client.close()
+    assert len(svc.done) == 1 and not svc.pending
+
+
+def test_task_failed_stale_epoch_keeps_lease(tmp_path):
+    """A stale holder's failure report must not evict the current holder's
+    pending entry (epoch guard checks BEFORE removal)."""
+    svc = _make_service(tmp_path, timeout_s=0.05)
+    t1 = svc.get_task()
+    tid, epoch = t1["task"]["task_id"], t1["epoch"]
+    time.sleep(0.1)  # lease expires; task re-served at epoch+1
+    t2 = None
+    while True:
+        t = svc.get_task()
+        if not isinstance(t, dict):
+            break
+        if t["task"]["task_id"] == tid:
+            t2 = t
+    assert t2 is not None and t2["epoch"] == epoch + 1
+    # stale holder reports failure with the old epoch: rejected, lease intact
+    assert not svc.task_failed(tid, epoch)
+    assert tid in svc.pending
+    # current holder can still ack
+    assert svc.task_finished(tid, t2["epoch"])
