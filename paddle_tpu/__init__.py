@@ -33,6 +33,8 @@ from paddle_tpu.core import data_types as data_type  # noqa: F401
 from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
 from paddle_tpu.core.topology import Topology  # noqa: F401
 from paddle_tpu.minibatch import batch  # noqa: F401
+from paddle_tpu import inference  # noqa: F401
+from paddle_tpu.inference import Inference, infer  # noqa: F401
 
 __version__ = "0.1.0"
 

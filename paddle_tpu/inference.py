@@ -1,0 +1,133 @@
+"""paddle.infer / Inference — the v2 inference user surface (reference:
+python/paddle/v2/inference.py:8-87; C ABI paddle/capi/gradient_machine.h:27-86).
+
+The reference builds a testing-mode GradientMachine and feeds CSR arguments;
+here the topology compiles to ONE jitted XLA forward (cached per batch
+shape — the feeder's bucketed padding keeps the shape set small) and field
+extraction unpads sequence outputs back to the reference's concatenated-rows
+convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork, get_default_compute_dtype
+from paddle_tpu.core.topology import LayerOutput, Topology
+
+__all__ = ["infer", "Inference"]
+
+
+def _extract_field(out: SeqTensor, field: str) -> np.ndarray:
+    """reference forwardTest fields: 'value' (activations / scores) and 'id'
+    (integer outputs).  Sequence outputs are unpadded to the reference's
+    concatenated-valid-rows form; nested outputs concatenate both levels."""
+    data = np.asarray(out.data)
+    if field == "id":
+        data = data.astype(np.int64)
+    if not out.is_seq:
+        return data
+    lengths = np.asarray(out.lengths)
+    rows: List[np.ndarray] = []
+    if out.is_nested:
+        sub_lengths = np.asarray(out.sub_lengths)
+        for i in range(data.shape[0]):
+            for j in range(int(lengths[i])):
+                rows.append(data[i, j, : int(sub_lengths[i, j])])
+    else:
+        for i in range(data.shape[0]):
+            rows.append(data[i, : int(lengths[i])])
+    return np.concatenate(rows, axis=0) if rows else data[:0].reshape(0, *data.shape[2:])
+
+
+class Inference:
+    """Compiled inference over one or more output layers.
+
+    ::
+
+        inferer = Inference(output_layer=prediction, parameters=parameters)
+        probs = inferer.infer(input=samples)
+    """
+
+    def __init__(
+        self,
+        output_layer: Union[LayerOutput, Sequence[LayerOutput]],
+        parameters,
+    ):
+        outs = (
+            list(output_layer)
+            if isinstance(output_layer, (list, tuple))
+            else [output_layer]
+        )
+        self.output_names = [o.name for o in outs]
+        self.topology = Topology(outs)
+        self.network = CompiledNetwork(
+            self.topology, compute_dtype=get_default_compute_dtype()
+        )
+        # Parameters may come from a larger (training) topology; apply() looks
+        # up layers by name, so the superset simply carries unused entries.
+        self._params = parameters.params
+        self._state = parameters.state
+
+        def fwd(params, state, batch):
+            all_outs, _ = self.network.apply(params, batch, state=state, train=False)
+            return {n: all_outs[n] for n in self.output_names}
+
+        self._fwd = jax.jit(fwd)
+
+    # ------------------------------------------------------------------
+    def iter_infer(
+        self,
+        input: Sequence[Any],
+        feeding=None,
+        batch_size: Optional[int] = None,
+    ):
+        from paddle_tpu.reader.feeder import DataFeeder
+
+        feeder = DataFeeder(self.topology.data_types(), feeding)
+        bs = batch_size or len(input)
+        for lo in range(0, len(input), bs):
+            batch = feeder(list(input[lo : lo + bs]))
+            yield self._fwd(self._params, self._state, batch)
+
+    def iter_infer_field(self, field, **kwargs):
+        fields = list(field) if isinstance(field, (list, tuple)) else [field]
+        for result in self.iter_infer(**kwargs):
+            yield [
+                _extract_field(result[name], f)
+                for name in self.output_names
+                for f in fields
+            ]
+
+    def infer(
+        self,
+        input: Sequence[Any],
+        field: Union[str, Sequence[str]] = "value",
+        feeding=None,
+        batch_size: Optional[int] = None,
+    ):
+        """Returns one ndarray per (output_layer × field), concatenated over
+        batches; a single array when there is exactly one."""
+        collected: Optional[List[List[np.ndarray]]] = None
+        for res in self.iter_infer_field(
+            field=field, input=input, feeding=feeding, batch_size=batch_size
+        ):
+            if collected is None:
+                collected = [[] for _ in res]
+            for i, item in enumerate(res):
+                collected[i].append(item)
+        assert collected, "empty input"
+        merged = [np.concatenate(c, axis=0) for c in collected]
+        return merged[0] if len(merged) == 1 else merged
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value",
+          batch_size: Optional[int] = None):
+    """One-shot inference (reference paddle.infer, v2/inference.py:87)."""
+    return Inference(output_layer, parameters).infer(
+        input=input, field=field, feeding=feeding, batch_size=batch_size
+    )

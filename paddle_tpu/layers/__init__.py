@@ -36,6 +36,10 @@ from paddle_tpu.layers.recurrent_group import (  # noqa: F401
     memory,
     recurrent_group,
 )
+from paddle_tpu.layers.generation import (  # noqa: F401
+    GeneratedInput,
+    beam_search,
+)
 
 
 class AggregateLevel:

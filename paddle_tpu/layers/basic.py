@@ -93,7 +93,10 @@ def embedding_init(conf, in_confs, rng):
 def embedding_apply(conf, params, inputs, ctx):
     ids = inputs[0]
     idx = ids.data.astype(jnp.int32)
-    if idx.ndim >= 2 and idx.shape[-1] == 1:
+    # Squeeze a trailing singleton FEATURE axis ([B,1] / [B,T,1] id columns)
+    # — but a nested slot's axes are all structural ([B,S,T] with T possibly
+    # padded to 1), so no squeeze there.
+    if idx.ndim >= 2 and idx.shape[-1] == 1 and not ids.is_nested:
         idx = idx[..., 0]
     out = jnp.take(params["w"], idx, axis=0)
     return SeqTensor(out, ids.lengths, ids.sub_lengths)

@@ -1,0 +1,201 @@
+"""Generation layers: GeneratedInput + beam_search as a first-class layer.
+
+Reference: trainer_config_helpers/layers.py beam_search/GeneratedInput
+(~:3590-3700) and the generation mode of RecurrentGradientMachine
+(paddle/gserver/gradientmachines/RecurrentGradientMachine.cpp:964
+generateSequence, :1393 beamSearch).  The reference re-batches beams on the
+host every step; here the whole search is ONE jitted lax.scan on device
+(ops/beam.py) embedded in the topology like any other layer, so
+``paddle.infer(output_layer=beam, field='id')`` runs generation end to end
+on the TPU.
+
+Parameter layout: the step sub-network's parameters live under this layer's
+name exactly as a ``recurrent_group`` of the same name would store them, so a
+generation topology whose beam layer shares the training group's name and
+step function loads trained weights unchanged.  The previous-token embedding
+table is this layer's ``@gen_emb`` parameter; copy the training embedding in
+with ``parameters.set("<beam_name>.@gen_emb.w", trained.get("<emb_name>.w"))``
+(the reference shares it globally by parameter name instead).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.topology import LayerConf, LayerOutput, Topology, auto_name
+from paddle_tpu.layers.base import ApplyContext, register_layer
+from paddle_tpu.layers.recurrent_group import StaticInput, _group_build
+
+
+class GeneratedInput:
+    """The decoder's own previous output, embedded and fed back each step
+    (reference GeneratedInput, layers.py:3590).  `size` is the vocabulary;
+    `embedding_size` the embedding width fed to the step function."""
+
+    def __init__(
+        self,
+        size: int,
+        embedding_size: int,
+        embedding_name: Optional[str] = None,
+    ):
+        self.size = size
+        self.embedding_size = embedding_size
+        self.embedding_name = embedding_name
+
+
+def beam_search(
+    step,
+    input: Sequence[Union[GeneratedInput, StaticInput]],
+    bos_id: int,
+    eos_id: int,
+    beam_size: int = 5,
+    max_length: int = 30,
+    num_results_per_sample: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Build a generation layer.  `step` is the same step function a training
+    ``recurrent_group`` would use; its GeneratedInput argument receives the
+    embedded previous token ([B, embedding_size]), StaticInputs behave as in
+    recurrent_group, and ``memory()`` links carry decoder state across steps.
+    The step must end in a softmax over the vocabulary.
+
+    Output: int32 ids [B, K, T] sorted best-first; beam scores are exposed as
+    the auxiliary output ``<name>@scores`` ([B, K]).
+    """
+    gens = [i for i in input if isinstance(i, GeneratedInput)]
+    statics = [i for i in input if isinstance(i, StaticInput)]
+    assert len(gens) == 1, "beam_search needs exactly one GeneratedInput"
+    gen = gens[0]
+    gname = name or auto_name("beam_search")
+
+    step_args: List[LayerOutput] = []
+    gen_conf = LayerConf(
+        name=f"{gname}@in0", type="step_input", size=gen.embedding_size, bias=False
+    )
+    static_confs: List[LayerConf] = []
+    # Reference beam_search passes inputs in user order; we keep that order
+    # for the step call while storing gen/static roles separately.
+    for i in input:
+        if isinstance(i, GeneratedInput):
+            step_args.append(LayerOutput(gen_conf))
+        else:
+            conf = LayerConf(
+                name=f"{gname}@static{len(static_confs)}",
+                type="step_input",
+                size=i.input.size,
+                bias=False,
+                attrs={"static_seq": i.is_seq},
+            )
+            static_confs.append(conf)
+            step_args.append(LayerOutput(conf))
+
+    with _group_build() as gb:
+        out = step(*step_args)
+    assert not isinstance(out, (list, tuple)), "beam step returns one layer"
+    assert out.size == gen.size, (
+        f"beam step output size {out.size} != vocabulary {gen.size}"
+    )
+    sub_topo = Topology([out])
+
+    outer_inputs = [s.input for s in statics] + list(gb.boot_layers.values())
+    conf = LayerConf(
+        name=gname,
+        type="beam_search",
+        size=max_length,
+        inputs=tuple(o.name for o in outer_inputs),
+        bias=False,
+        attrs={
+            "_sub_topology": sub_topo,
+            "_memories": tuple(gb.memories),
+            "_gen_placeholder": gen_conf.name,
+            "_static_placeholders": tuple(
+                (c.name, c.attrs.get("static_seq", False)) for c in static_confs
+            ),
+            "_output": out.name,
+            "vocab": gen.size,
+            "emb_size": gen.embedding_size,
+            "bos_id": bos_id,
+            "eos_id": eos_id,
+            "beam_size": beam_size,
+            "max_length": max_length,
+        },
+    )
+    return LayerOutput(conf, outer_inputs)
+
+
+def _bs_init(conf, in_confs, rng):
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    sub = CompiledNetwork(conf.attrs["_sub_topology"])
+    r1, r2 = jax.random.split(rng)
+    params = sub.init_params(r1)
+    params["@gen_emb"] = {
+        "w": init.normal(r2, (conf.attrs["vocab"], conf.attrs["emb_size"]))
+    }
+    return params
+
+
+@register_layer("beam_search", init=_bs_init, auto_activation=False,
+                full_precision=True)
+def beam_search_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.ops import beam as beam_ops
+
+    a = conf.attrs
+    subnet = CompiledNetwork(a["_sub_topology"])
+    memories = a["_memories"]
+    static_info = a["_static_placeholders"]
+    out_name = a["_output"]
+
+    statics = inputs[: len(static_info)]  # rest are boot layers
+    b = statics[0].batch_size if statics else inputs[0].batch_size
+
+    emb_w = params["@gen_emb"]["w"]
+    sub_params = {k: v for k, v in params.items() if k != "@gen_emb"}
+
+    init_mem = {}
+    for m in memories:
+        boot = m.attrs.get("boot")
+        if boot is not None:
+            init_mem[m.name] = ctx.outputs[boot].data
+        else:
+            init_mem[m.name] = jnp.zeros((b, m.size), emb_w.dtype)
+    # Statics ride the carry so beam_search expands them to B*K rows and the
+    # parent-gather keeps them aligned (identical across a sample's beams).
+    static_carry = {
+        pname: (st if is_seq else SeqTensor(st.data))
+        for (pname, is_seq), st in zip(static_info, statics)
+    }
+    carry0 = {"mem": init_mem, "static": static_carry}
+
+    def step_fn(ids, carry):
+        sub_batch = dict(carry["static"])
+        sub_batch[a["_gen_placeholder"]] = SeqTensor(jnp.take(emb_w, ids, axis=0))
+        for m in memories:
+            sub_batch[m.name] = SeqTensor(carry["mem"][m.name])
+        outs, _ = subnet.apply(sub_params, sub_batch, train=False)
+        new_mem = {m.name: outs[m.attrs["link"]].data for m in memories}
+        logits = outs.get(out_name + "@logits")
+        if logits is not None:  # stashed pre-softmax: stable log-softmax
+            logp = jax.nn.log_softmax(logits.data, axis=-1)
+        else:
+            logp = jnp.log(jnp.maximum(outs[out_name].data, 1e-9))
+        return logp, {"mem": new_mem, "static": carry["static"]}
+
+    seqs, scores = beam_ops.beam_search(
+        step_fn,
+        carry0,
+        batch_size=b,
+        beam_size=a["beam_size"],
+        vocab_size=a["vocab"],
+        bos_id=a["bos_id"],
+        eos_id=a["eos_id"],
+        max_len=a["max_length"],
+    )
+    ctx.outputs[conf.name + "@scores"] = SeqTensor(scores)
+    return SeqTensor(seqs)

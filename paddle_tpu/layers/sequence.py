@@ -128,9 +128,17 @@ def expand_apply(conf, params, inputs, ctx):
         s, t = pattern.max_len, pattern.max_sub_len
         if from_seq:
             # ExpandLevel.FROM_SEQUENCE: [B, S, D] seq -> nested, each
-            # subsequence repeats its element across timesteps
-            assert not x.is_nested and x.max_len == s
-            out = jnp.broadcast_to(x.data[:, :, None, :], (b, s, t, d))
+            # subsequence repeats its element across timesteps.  The feeder
+            # buckets the nested S axis and plain T axes independently, so
+            # logically aligned slots may differ in padded extent — align to
+            # the pattern's S (valid entries are bounded by both lengths).
+            assert not x.is_nested
+            xd = x.data
+            if xd.shape[1] < s:
+                xd = jnp.pad(xd, ((0, 0), (0, s - xd.shape[1]), (0, 0)))
+            elif xd.shape[1] > s:
+                xd = xd[:, :s]
+            out = jnp.broadcast_to(xd[:, :, None, :], (b, s, t, d))
         else:
             # FROM_NO_SEQUENCE: [B, D] -> every timestep of every subsequence
             out = jnp.broadcast_to(x.data[:, None, None, :], (b, s, t, d))

@@ -462,7 +462,7 @@ class Client:
                     self._call("task_returned", *self._pending_task)
                 else:
                     self._call("task_finished", *self._pending_task)
-            except (RuntimeError, BrokenPipeError, OSError):
+            except (RuntimeError, BrokenPipeError, OSError, EOFError):
                 pass
             self._pending_task = None
             self._records = []

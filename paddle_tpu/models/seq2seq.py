@@ -68,6 +68,21 @@ def decoder_step_builder(hidden_dim: int, trg_vocab: int, boot: LayerOutput):
     return step
 
 
+def _encoder_and_boot(src_vocab: int, word_dim: int, hidden_dim: int):
+    """Shared source-side block: training and generation topologies MUST
+    build these layers identically (same names, same auto-name consumption)
+    for the tar parameter round-trip to map weights."""
+    src = L.data("src_word", paddle.data_type.integer_value_sequence(src_vocab))
+    enc, enc_proj = encoder_net(src, word_dim, hidden_dim)
+    boot = L.fc(
+        L.first_seq(enc, name="enc_first"),
+        size=hidden_dim,
+        act=A.Tanh(),
+        name="dec_boot",
+    )
+    return enc, enc_proj, boot
+
+
 def seq2seq_cost(
     src_vocab: int,
     trg_vocab: int,
@@ -76,17 +91,9 @@ def seq2seq_cost(
 ) -> Tuple[LayerOutput, LayerOutput]:
     """Training topology.  Data slots: src_word ids, trg_word ids (bos-led),
     trg_next ids (the shifted targets)."""
-    src = L.data("src_word", paddle.data_type.integer_value_sequence(src_vocab))
+    enc, enc_proj, boot = _encoder_and_boot(src_vocab, word_dim, hidden_dim)
     trg = L.data("trg_word", paddle.data_type.integer_value_sequence(trg_vocab))
     lbl = L.data("trg_next", paddle.data_type.integer_value_sequence(trg_vocab))
-
-    enc, enc_proj = encoder_net(src, word_dim, hidden_dim)
-    boot = L.fc(
-        L.first_seq(enc, name="enc_first"),
-        size=hidden_dim,
-        act=A.Tanh(),
-        name="dec_boot",
-    )
     trg_emb = L.embedding(trg, size=word_dim, name="trg_emb")
 
     step = decoder_step_builder(hidden_dim, trg_vocab, boot)
@@ -101,6 +108,44 @@ def seq2seq_cost(
     )
     cost = L.classification_cost(input=dec, label=lbl, name="nmt_cost")
     return cost, dec
+
+
+def seq2seq_generation(
+    src_vocab: int,
+    trg_vocab: int,
+    word_dim: int = 128,
+    hidden_dim: int = 256,
+    bos_id: int = 0,
+    eos_id: int = 1,
+    beam_size: int = 4,
+    max_length: int = 32,
+) -> LayerOutput:
+    """Generation topology over the SAME step function and layer names as
+    :func:`seq2seq_cost`, with the target sequence replaced by a
+    GeneratedInput beam (reference demo/seqToseq gen config:
+    gen_trans_file + beam_search in seqToseq_net.py).  Because the beam
+    layer shares the training group's name ("decoder"), trained parameters
+    load via the tar round-trip; copy the target embedding with
+    ``gen_params.set("decoder.@gen_emb.w", trained.get("trg_emb.w"))``.
+
+    Build with the same auto-name state as the training topology (e.g. call
+    ``paddle_tpu.core.topology.reset_auto_names()`` before each build) so
+    the step's internal auto-named layers line up."""
+    enc, enc_proj, boot = _encoder_and_boot(src_vocab, word_dim, hidden_dim)
+    step = decoder_step_builder(hidden_dim, trg_vocab, boot)
+    return L.beam_search(
+        step,
+        input=[
+            L.GeneratedInput(trg_vocab, word_dim),
+            L.StaticInput(enc, is_seq=True),
+            L.StaticInput(enc_proj, is_seq=True),
+        ],
+        bos_id=bos_id,
+        eos_id=eos_id,
+        beam_size=beam_size,
+        max_length=max_length,
+        name="decoder",
+    )
 
 
 def _subgraph(topo: Topology, names) -> Topology:

@@ -1,0 +1,186 @@
+"""paddle.infer / Inference surface (reference python/paddle/v2/inference.py)
+and the beam_search generation layer (reference trainer_config_helpers
+layers.py beam_search/GeneratedInput; RecurrentGradientMachine.cpp:964)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layers
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.models import seq2seq as s2s
+
+
+def _train_classifier(n_cls=3, dim=4, n=120):
+    x = layers.data("x", paddle.data_type.dense_vector(dim))
+    y = layers.data("y", paddle.data_type.integer_value(n_cls))
+    hidden = layers.fc(x, size=16, act=paddle.activation.Tanh())
+    pred = layers.fc(hidden, size=n_cls, act=paddle.activation.Softmax())
+    cost = layers.classification_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    rng = np.random.RandomState(0)
+    centers = rng.randn(n_cls, dim) * 3
+
+    def reader():
+        for _ in range(n):
+            c = rng.randint(n_cls)
+            yield centers[c] + rng.randn(dim) * 0.3, c
+
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=5e-2),
+    )
+    trainer.train(reader=paddle.batch(reader, 20), num_passes=6)
+    samples = [(centers[c] + rng.randn(dim) * 0.3,) for c in [0, 1, 2, 1, 0, 2, 2]]
+    wanted = [0, 1, 2, 1, 0, 2, 2]
+    return pred, params, samples, wanted
+
+
+def test_infer_classification():
+    reset_auto_names()
+    pred, params, samples, wanted = _train_classifier()
+    probs = paddle.infer(output_layer=pred, parameters=params, input=samples)
+    assert probs.shape == (7, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
+    assert list(np.argmax(probs, axis=1)) == wanted
+
+
+def test_infer_batched_matches_single():
+    reset_auto_names()
+    pred, params, samples, _ = _train_classifier()
+    whole = paddle.infer(output_layer=pred, parameters=params, input=samples)
+    chunked = paddle.infer(
+        output_layer=pred, parameters=params, input=samples, batch_size=3
+    )
+    np.testing.assert_allclose(whole, chunked, rtol=1e-4, atol=1e-5)
+
+
+def test_infer_field_id_and_multiple_outputs():
+    reset_auto_names()
+    pred, params, samples, wanted = _train_classifier()
+    ids_layer = layers.maxid(pred)
+    # maxid has no params; reuse the trained ones for the shared prefix
+    inferer = paddle.Inference(
+        output_layer=[pred, ids_layer], parameters=params
+    )
+    probs, ids = inferer.infer(input=samples, field="value")
+    assert probs.shape == (7, 3)
+    assert list(np.asarray(ids).reshape(-1).astype(int)) == wanted
+    ids2 = paddle.infer(
+        output_layer=ids_layer, parameters=params, input=samples, field="id"
+    )
+    assert ids2.dtype == np.int64
+
+
+def test_infer_unpads_sequence_output():
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector_sequence(2))
+    proj = layers.fc(x, size=5, act=paddle.activation.Tanh())
+    params = paddle.parameters.create(proj)
+    samples = [([[0.1, 0.2], [0.3, 0.4], [0.5, 0.6]],), ([[1.0, 1.0]],)]
+    vals = paddle.infer(output_layer=proj, parameters=params, input=samples)
+    # CSR-rows convention: 3 + 1 valid timesteps concatenated
+    assert vals.shape == (4, 5)
+
+
+def test_infer_mnist_lenet():
+    """LeNet forward through paddle.infer (mnist demo shape)."""
+    reset_auto_names()
+    from paddle_tpu.models.lenet import lenet_cost
+
+    cost, pred = lenet_cost()
+    params = paddle.parameters.create(cost)
+    rng = np.random.RandomState(1)
+    samples = [(rng.rand(784).astype(np.float32),) for _ in range(5)]
+    probs = paddle.infer(output_layer=pred, parameters=params, input=samples)
+    assert probs.shape == (5, 10)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# generation through paddle.infer
+# ---------------------------------------------------------------------------
+
+
+V, E, H = 12, 6, 8
+BOS, EOS = 0, 1
+
+
+def _copy_reader(rng, n=60):
+    """Tiny copy task: target repeats the source (bos/eos framed)."""
+
+    def reader():
+        for _ in range(n):
+            seq = list(rng.randint(2, V, size=rng.randint(2, 5)))
+            yield seq, [BOS] + seq, seq + [EOS]
+
+    return reader
+
+
+def test_beam_search_layer_through_infer():
+    reset_auto_names()
+    cost, dec = s2s.seq2seq_cost(V, V, word_dim=E, hidden_dim=H)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=2e-2),
+    )
+    rng = np.random.RandomState(4)
+    trainer.train(reader=paddle.batch(_copy_reader(rng), 10), num_passes=3)
+
+    reset_auto_names()
+    beam = s2s.seq2seq_generation(
+        V, V, word_dim=E, hidden_dim=H,
+        bos_id=BOS, eos_id=EOS, beam_size=3, max_length=6,
+    )
+    gen_params = paddle.parameters.create(beam)
+    # weight transfer: shared names via tar round-trip + the gen embedding
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    gen_params.from_tar(buf)
+    gen_params.set("decoder.@gen_emb.w", params.get("trg_emb.w"))
+
+    samples = [([3, 4, 5],), ([7, 8],)]
+    ids = paddle.infer(
+        output_layer=beam, parameters=gen_params, input=samples, field="id"
+    )
+    assert ids.shape == (2, 3, 6)  # [B, beam, max_length]
+    assert ids.min() >= 0 and ids.max() < V
+    # after eos, beams emit only eos (finished-beam propagation)
+    for b in range(2):
+        for k in range(3):
+            seq = list(ids[b, k])
+            if EOS in seq:
+                at = seq.index(EOS)
+                assert all(t == EOS for t in seq[at:])
+    # scores exposed as auxiliary output, sorted best-first
+    inferer = paddle.Inference(output_layer=beam, parameters=gen_params)
+    out = next(inferer.iter_infer(input=samples))
+    scores = np.asarray(out["decoder"].data)  # ids
+    assert scores.shape == (2, 3, 6)
+
+
+def test_gen_params_align_with_training():
+    """The beam layer's sub-params must be name-compatible with the training
+    recurrent_group so the tar round-trip actually transfers weights."""
+    reset_auto_names()
+    cost, _ = s2s.seq2seq_cost(V, V, word_dim=E, hidden_dim=H)
+    train_p = paddle.parameters.create(cost)
+    reset_auto_names()
+    beam = s2s.seq2seq_generation(V, V, word_dim=E, hidden_dim=H)
+    gen_p = paddle.parameters.create(beam)
+    train_names = set(train_p.names())
+    gen_names = set(gen_p.names())
+    shared = {n for n in gen_names if not n.startswith("decoder.@gen_emb")}
+    missing = shared - train_names
+    assert not missing, f"gen-only params (name drift): {sorted(missing)}"
+    # and the transfer changes values
+    buf = io.BytesIO()
+    train_p.to_tar(buf)
+    buf.seek(0)
+    gen_p.from_tar(buf)
+    some = next(n for n in sorted(shared) if n.startswith("decoder."))
+    np.testing.assert_allclose(gen_p.get(some), train_p.get(some))
