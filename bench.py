@@ -4,6 +4,7 @@ metric (the first line is the headline ResNet-50 number the driver parses):
   1. resnet50_train_images_per_sec_per_chip  — bf16 mixed-precision training
   2. nmt_tokens_per_sec                      — seq2seq-NMT attention GRU fwd+bwd
   3. allreduce_bw_gbps                       — psum bandwidth over the mesh
+  4. transformer_base_tokens_per_sec         — Transformer-base MT train step
 
 Methodology: every step consumes a different pre-staged device batch (cycled)
 and a fresh PRNG key, and timing syncs via a host fetch of the cost scalar —
@@ -27,6 +28,9 @@ import numpy as np
 TARGET_IMG_S = 1400.0  # 0.8x per-chip A100 ResNet-50 throughput (north star)
 TARGET_NMT_TOK_S = 40000.0  # 0.8x per-chip A100 attention-RNN NMT estimate
 TARGET_ALLREDUCE_GBPS = 100.0
+# 0.8x per-chip A100 Transformer-base estimate (~55k tok/s training with
+# seq 64-128 class batches in mixed precision)
+TARGET_TRANSFORMER_TOK_S = 44000.0
 
 
 def _sync(metrics) -> float:
@@ -155,6 +159,69 @@ def bench_nmt() -> dict:
     }
 
 
+def bench_transformer() -> dict:
+    """Transformer-base MT training step (BASELINE configs #5, stretch
+    metric): fwd+bwd+momentum over padded batches, bf16 mixed precision."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu.models.transformer import transformer_cost
+    from paddle_tpu.trainer.step import make_train_step
+
+    reset_auto_names()
+    batch_size, seq_len = 64, 64
+    vocab = 32000
+
+    cost, _ = transformer_cost(vocab, vocab, 512, 8, 6, 2048)
+    net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(0))
+    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
+    opt_state = opt.init(params)
+    step = make_train_step(net, opt, mesh=None)
+
+    rng = np.random.RandomState(0)
+    lens = jnp.full((batch_size,), seq_len, jnp.int32)
+
+    def mk():
+        def ids():
+            return jax.device_put(
+                rng.randint(1, vocab, size=(batch_size, seq_len)).astype(np.int32)
+            )
+
+        return {
+            "src_word": SeqTensor(ids(), lens),
+            "trg_word": SeqTensor(ids(), lens),
+            "trg_next": SeqTensor(ids(), lens),
+        }
+
+    batches = [mk() for _ in range(4)]
+    params, state, opt_state, m = step(
+        params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
+    _sync(m)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, state, opt_state, m = step(
+            params, state, opt_state, batches[i % len(batches)], jax.random.PRNGKey(i)
+        )
+    _sync(m)
+    dt = time.perf_counter() - t0
+
+    tok_per_sec = batch_size * seq_len * iters / dt
+    return {
+        "metric": "transformer_base_tokens_per_sec",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tok_per_sec / TARGET_TRANSFORMER_TOK_S, 4),
+    }
+
+
 def bench_allreduce() -> dict:
     """Gradient-allreduce bandwidth over the mesh data axis — the path that
     replaces the reference pserver push/pull (ParameterServer2 addGradient /
@@ -207,7 +274,7 @@ def bench_allreduce() -> dict:
 
 
 def main() -> None:
-    for fn in (bench_resnet, bench_nmt, bench_allreduce):
+    for fn in (bench_resnet, bench_nmt, bench_allreduce, bench_transformer):
         try:
             print(json.dumps(fn()), flush=True)
         except Exception as e:  # keep later metrics alive if one fails

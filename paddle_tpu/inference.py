@@ -75,7 +75,14 @@ class Inference:
 
         def fwd(params, state, batch):
             all_outs, _ = self.network.apply(params, batch, state=state, train=False)
-            return {n: all_outs[n] for n in self.output_names}
+            # Keep auxiliary side outputs of the selected layers too
+            # ("<name>@scores" from beam_search, "<name>@cell" from lstm_step).
+            keep = set(self.output_names)
+            return {
+                n: v
+                for n, v in all_outs.items()
+                if n in keep or n.split("@")[0] in keep
+            }
 
         self._fwd = jax.jit(fwd)
 
@@ -88,6 +95,8 @@ class Inference:
     ):
         from paddle_tpu.reader.feeder import DataFeeder
 
+        if not len(input):
+            raise ValueError("infer() needs at least one input sample")
         feeder = DataFeeder(self.topology.data_types(), feeding)
         bs = batch_size or len(input)
         for lo in range(0, len(input), bs):

@@ -40,6 +40,7 @@ from paddle_tpu.layers.generation import (  # noqa: F401
     GeneratedInput,
     beam_search,
 )
+from paddle_tpu.layers import attention as _attention  # noqa: F401
 
 
 class AggregateLevel:
@@ -1640,6 +1641,48 @@ def mixed(
 
 
 mixed_layer = mixed
+
+
+# ---------------------------------------------------------------------------
+# attention family (Transformer building blocks — layers/attention.py)
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(
+    input: LayerOutput, epsilon: float = 1e-6, name: Optional[str] = None
+) -> LayerOutput:
+    return _unary("layer_norm", input, name=name, epsilon=epsilon)
+
+
+def multi_head_attention(
+    query: LayerOutput,
+    key_value: Optional[LayerOutput] = None,
+    size: Optional[int] = None,
+    n_heads: int = 8,
+    causal: bool = False,
+    bias_attr: bool = True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Multi-head attention; omit key_value for self-attention.  `causal`
+    masks future positions (decoder self-attention)."""
+    kv = key_value or query
+    conf = LayerConf(
+        name=name or auto_name("mha"),
+        type="multi_head_attention",
+        size=size or query.size,
+        inputs=(query.name, kv.name),
+        bias=bool(bias_attr),
+        attrs={"n_heads": n_heads, "causal": causal},
+    )
+    return LayerOutput(conf, [query, kv])
+
+
+def pos_encoding(
+    input: LayerOutput, emb_scale: float = 1.0, name: Optional[str] = None
+) -> LayerOutput:
+    """Add sinusoidal position encodings (input is scaled by emb_scale
+    first — pass sqrt(d_model) for the Transformer convention)."""
+    return _unary("pos_encoding", input, name=name, emb_scale=emb_scale)
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,132 @@
+"""Attention-family layers: multi-head attention, layer norm, positional
+encoding — the building blocks of Transformer-base MT (BASELINE.json configs
+#5; "new config" stressing the op-graph → HLO lowering, with no reference
+implementation to translate).
+
+TPU-native design notes:
+  * MHA is two einsums around a masked softmax — XLA fuses the scale/mask/
+    softmax chain between the MXU matmuls; heads live in one [B,T,H,dh]
+    layout (no per-head loop).
+  * Under bf16 mixed precision the softmax and layer-norm statistics compute
+    in float32 and cast back: both are cancellation-sensitive reductions.
+  * Padding is masked via SeqTensor lengths (keys) and an optional causal
+    mask (decoder self-attention) — static shapes, no dynamic slicing.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+
+NEG_INF = -1e9
+
+
+# ---------------------------------------------------------------------------
+# layer_norm
+# ---------------------------------------------------------------------------
+
+
+def layer_norm_init(conf, in_confs, rng):
+    d = conf.size
+    return {"gamma": init.ones((d,)), "beta": init.zeros((d,))}
+
+
+@register_layer("layer_norm", init=layer_norm_init, auto_activation=False)
+def layer_norm_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    eps = conf.attr("epsilon", 1e-6)
+    x32 = x.data.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    y = y * params["gamma"].astype(jnp.float32) + params["beta"].astype(jnp.float32)
+    return x.with_data(y.astype(x.data.dtype))
+
+
+# ---------------------------------------------------------------------------
+# multi-head attention
+# ---------------------------------------------------------------------------
+
+
+def mha_init(conf, in_confs, rng):
+    import jax
+
+    d = conf.size
+    d_in_q = in_confs[0].size
+    d_in_kv = in_confs[1].size if len(in_confs) > 1 else d_in_q
+    rq, rk, rv, ro = jax.random.split(rng, 4)
+    std = 1.0 / math.sqrt(d_in_q)
+    p = {
+        "wq": init.normal(rq, (d_in_q, d), std),
+        "wk": init.normal(rk, (d_in_kv, d), std),
+        "wv": init.normal(rv, (d_in_kv, d), std),
+        "wo": init.normal(ro, (d, d), 1.0 / math.sqrt(d)),
+    }
+    if conf.bias:
+        p["b"] = init.zeros((d,))
+    return p
+
+
+@register_layer("multi_head_attention", init=mha_init, auto_activation=False)
+def mha_apply(conf, params, inputs, ctx):
+    """inputs: (query, key_value) — pass the same layer twice for
+    self-attention.  attrs: n_heads, causal."""
+    q_in = inputs[0]
+    kv_in = inputs[1] if len(inputs) > 1 else inputs[0]
+    h = conf.attrs["n_heads"]
+    causal = conf.attr("causal", False)
+    d = conf.size
+    dh = d // h
+    assert d % h == 0, f"{conf.name}: size {d} not divisible by n_heads {h}"
+
+    q = q_in.data @ params["wq"]  # [B, Tq, D]
+    k = kv_in.data @ params["wk"]  # [B, Tk, D]
+    v = kv_in.data @ params["wv"]
+    b, tq = q.shape[0], q.shape[1]
+    tk = k.shape[1]
+    q = q.reshape(b, tq, h, dh)
+    k = k.reshape(b, tk, h, dh)
+    v = v.reshape(b, tk, h, dh)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+    scores = scores.astype(jnp.float32)
+    if kv_in.is_seq:
+        key_mask = kv_in.mask(jnp.float32)  # [B, Tk]
+        scores = scores + (1.0 - key_mask)[:, None, None, :] * NEG_INF
+    if causal:
+        cm = jnp.tril(jnp.ones((tq, tk), jnp.float32))
+        scores = scores + (1.0 - cm)[None, None, :, :] * NEG_INF
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, tq, d)
+    out = out @ params["wo"]
+    if "b" in params:
+        out = out + params["b"]
+    return SeqTensor(out, q_in.lengths, q_in.sub_lengths)
+
+
+# ---------------------------------------------------------------------------
+# sinusoidal positional encoding (parameterless)
+# ---------------------------------------------------------------------------
+
+
+@register_layer("pos_encoding", auto_activation=False)
+def pos_encoding_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    assert x.is_seq and not x.is_nested
+    b, t, d = x.data.shape
+    scale = conf.attr("emb_scale", 1.0)
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]  # [T, 1]
+    div = jnp.exp(
+        jnp.arange(0, d, 2, dtype=jnp.float32) * (-math.log(10000.0) / d)
+    )
+    pe = jnp.zeros((t, d), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(pos * div))  # ceil(d/2) even channels
+    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[: d // 2]))  # floor(d/2) odd
+    out = x.data * jnp.asarray(scale, x.data.dtype) + pe.astype(x.data.dtype)
+    return x.with_data(out)

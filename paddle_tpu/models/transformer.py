@@ -1,0 +1,98 @@
+"""Transformer-base MT — BASELINE.json configs #5 ("new config", no
+reference implementation; it stresses the graph→HLO lowering the way the
+reference's paddle/framework OpDesc path would have).
+
+Pre-LN encoder-decoder (Vaswani-style dims via `transformer_base`), built
+from the layer DSL: multi_head_attention / layer_norm / pos_encoding
+(layers/attention.py) + per-timestep fc for the FFN, residuals via addto.
+Training computes per-step softmax CE over the target vocabulary with
+padding masked (same convention as models/seq2seq.py).
+
+TPU notes: the whole model is matmuls + fused elementwise chains — XLA
+tiles every attention/FFN matmul onto the MXU; bf16 mixed precision applies
+per-layer with f32 softmax/LN statistics (see layers/attention.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import paddle_tpu as paddle
+from paddle_tpu.core.topology import LayerOutput
+
+L = paddle.layer
+A = paddle.activation
+
+
+def _ffn(x: LayerOutput, d_model: int, d_ff: int, name: str) -> LayerOutput:
+    h = L.fc(x, size=d_ff, act=A.Relu(), name=f"{name}_ff1")
+    return L.fc(h, size=d_model, act=A.Identity(), name=f"{name}_ff2")
+
+
+def _encoder_layer(x, d_model, n_heads, d_ff, name):
+    att = L.multi_head_attention(
+        L.layer_norm(x, name=f"{name}_ln1"), n_heads=n_heads, name=f"{name}_att"
+    )
+    x = L.addto([x, att], act=A.Identity(), bias_attr=False, name=f"{name}_res1")
+    ff = _ffn(L.layer_norm(x, name=f"{name}_ln2"), d_model, d_ff, name)
+    return L.addto([x, ff], act=A.Identity(), bias_attr=False, name=f"{name}_res2")
+
+
+def _decoder_layer(x, enc, d_model, n_heads, d_ff, name):
+    self_att = L.multi_head_attention(
+        L.layer_norm(x, name=f"{name}_ln1"),
+        n_heads=n_heads,
+        causal=True,
+        name=f"{name}_self",
+    )
+    x = L.addto([x, self_att], act=A.Identity(), bias_attr=False, name=f"{name}_res1")
+    cross = L.multi_head_attention(
+        L.layer_norm(x, name=f"{name}_ln2"),
+        key_value=enc,
+        n_heads=n_heads,
+        name=f"{name}_cross",
+    )
+    x = L.addto([x, cross], act=A.Identity(), bias_attr=False, name=f"{name}_res2")
+    ff = _ffn(L.layer_norm(x, name=f"{name}_ln3"), d_model, d_ff, name)
+    return L.addto([x, ff], act=A.Identity(), bias_attr=False, name=f"{name}_res3")
+
+
+def transformer_cost(
+    src_vocab: int,
+    trg_vocab: int,
+    d_model: int = 512,
+    n_heads: int = 8,
+    n_layers: int = 6,
+    d_ff: int = 2048,
+) -> Tuple[LayerOutput, LayerOutput]:
+    """Training topology.  Data slots: src_word ids, trg_word ids (bos-led
+    decoder input), trg_next ids (shifted targets) — same slot convention as
+    models/seq2seq.py so the NMT readers interchange."""
+    src = L.data("src_word", paddle.data_type.integer_value_sequence(src_vocab))
+    trg = L.data("trg_word", paddle.data_type.integer_value_sequence(trg_vocab))
+    lbl = L.data("trg_next", paddle.data_type.integer_value_sequence(trg_vocab))
+
+    scale = float(d_model) ** 0.5
+    x = L.pos_encoding(
+        L.embedding(src, size=d_model, name="src_emb"), emb_scale=scale
+    )
+    for i in range(n_layers):
+        x = _encoder_layer(x, d_model, n_heads, d_ff, f"enc{i}")
+    enc = L.layer_norm(x, name="enc_ln")
+
+    y = L.pos_encoding(
+        L.embedding(trg, size=d_model, name="trg_emb"), emb_scale=scale
+    )
+    for i in range(n_layers):
+        y = _decoder_layer(y, enc, d_model, n_heads, d_ff, f"dec{i}")
+    dec = L.layer_norm(y, name="dec_ln")
+
+    logits = L.fc(dec, size=trg_vocab, act=A.Softmax(), name="dec_out")
+    cost = L.classification_cost(input=logits, label=lbl, name="mt_cost")
+    return cost, logits
+
+
+def transformer_base(src_vocab: int, trg_vocab: int):
+    """The Transformer-base configuration (d_model 512, 8 heads, 6+6 layers,
+    FFN 2048)."""
+    return transformer_cost(src_vocab, trg_vocab, 512, 8, 6, 2048)

@@ -159,8 +159,9 @@ def test_beam_search_layer_through_infer():
     # scores exposed as auxiliary output, sorted best-first
     inferer = paddle.Inference(output_layer=beam, parameters=gen_params)
     out = next(inferer.iter_infer(input=samples))
-    scores = np.asarray(out["decoder"].data)  # ids
-    assert scores.shape == (2, 3, 6)
+    scores = np.asarray(out["decoder@scores"].data)
+    assert scores.shape == (2, 3)
+    assert (np.diff(scores, axis=1) <= 1e-5).all()  # best-first ordering
 
 
 def test_gen_params_align_with_training():
