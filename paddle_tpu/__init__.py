@@ -35,6 +35,7 @@ from paddle_tpu.core.topology import Topology  # noqa: F401
 from paddle_tpu.minibatch import batch  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu.inference import Inference, infer  # noqa: F401
+from paddle_tpu import v1_compat  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -17,6 +17,9 @@ class ParamAttr:
     name: Optional[str] = None
     initial_std: Optional[float] = None
     initial_mean: Optional[float] = None
+    # v1 uniform-init bounds (accepted for config compatibility)
+    initial_min: Optional[float] = None
+    initial_max: Optional[float] = None
     learning_rate: float = 1.0
     l2_rate: Optional[float] = None
     l1_rate: Optional[float] = None
@@ -31,6 +34,9 @@ class ExtraAttr:
     drop_rate: float = 0.0
     # Mesh-axis hint replacing the reference's per-layer `device`.
     shard_axis: Optional[str] = None
+    # v1 per-layer device id — accepted for config compatibility, ignored
+    # (placement is mesh-driven on TPU).
+    device: Optional[int] = None
 
 
 ParameterAttribute = ParamAttr

@@ -158,21 +158,27 @@ def provider(
             settings.should_shuffle = should_shuffle
             if init_hook is not None:
                 init_hook(settings, file_list=list(files), **hook_kwargs)
+            # init_hook may (re)declare input_types (the reference
+            # initializer pattern) — re-normalize so dict samples and checks
+            # use the hook's declaration.
+            eff_types, eff_names = types, names
+            if settings.input_types is not None and settings.input_types is not types:
+                eff_types, eff_names = _normalize_types(settings.input_types)
 
             def base_reader():
                 file_list = files if files else (None,)
                 for f in file_list:
                     for sample in generator(settings, f):
                         if isinstance(sample, dict):
-                            if names is None:
+                            if eff_names is None:
                                 raise ValueError(
                                     "generator yields dict samples but "
                                     "input_types was not a dict"
                                 )
-                            sample = tuple(sample[n] for n in names)
-                        if check and settings.input_types:
+                            sample = tuple(sample[n] for n in eff_names)
+                        if check and eff_types:
                             try:
-                                _check_sample(sample, settings.input_types)
+                                _check_sample(sample, eff_types)
                             except ValueError:
                                 if check_fail_continue:
                                     continue
@@ -192,8 +198,21 @@ def provider(
                 rd = reader_dec.shuffle(rd, pool_size)
             return rd
 
+        def resolve_input_types(**hook_kwargs):
+            """Run init_hook (if any) on a fresh settings object and return
+            (types, slot_names) — parse_config uses this to learn slot types
+            that the provider only declares inside its hook (reference
+            PyDataProvider2 initializer pattern)."""
+            settings = _Settings(**outter_kwargs)
+            if types is not None:
+                settings.set_input_types(types)
+            if init_hook is not None:
+                init_hook(settings, file_list=[], **hook_kwargs)
+            return _normalize_types(settings.input_types)
+
         factory.input_types = types
         factory.slot_names = names
+        factory.resolve_input_types = resolve_input_types
         factory.calc_batch_size = calc_batch_size
         return factory
 

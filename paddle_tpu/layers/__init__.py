@@ -914,6 +914,8 @@ def lstmemory(
     gate_act=None,
     state_act=None,
     bias_attr=True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """reference lstmemory (layers.py): input must be pre-projected to 4×size
@@ -922,17 +924,21 @@ def lstmemory(
     assert input.size == 4 * size, (
         f"lstmemory input size {input.size} must be 4*size ({4 * size})"
     )
+    drop, shard = _extra(layer_attr)
     conf = LayerConf(
         name=name or auto_name("lstmemory"),
         type="lstmemory",
         size=size,
         inputs=(input.name,),
         bias=bool(bias_attr),
+        drop_rate=drop,
+        shard_axis=shard,
         attrs={
             "reverse": reverse,
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
             "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
+            "param_std": _param_std(param_attr),
         },
     )
     return LayerOutput(conf, [input])
@@ -970,8 +976,11 @@ def recurrent(
     act=None,
     reverse: bool = False,
     bias_attr=True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
+    drop, shard = _extra(layer_attr)
     conf = LayerConf(
         name=name or auto_name("recurrent"),
         type="recurrent",
@@ -979,7 +988,9 @@ def recurrent(
         inputs=(input.name,),
         act=act_name(act if act is not None else _act_mod.Tanh()),
         bias=bool(bias_attr),
-        attrs={"reverse": reverse},
+        drop_rate=drop,
+        shard_axis=shard,
+        attrs={"reverse": reverse, "param_std": _param_std(param_attr)},
     )
     return LayerOutput(conf, [input])
 
@@ -1395,6 +1406,7 @@ def crf(
     label: LayerOutput,
     size: Optional[int] = None,
     param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """Linear-chain CRF cost (reference crf_layer → CRFLayer.cpp)."""
@@ -1418,6 +1430,7 @@ def crf_decoding(
     size: Optional[int] = None,
     label: Optional[LayerOutput] = None,
     param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """Viterbi decoding (reference crf_decoding_layer → CRFDecodingLayer.cpp);

@@ -61,11 +61,12 @@ def mha_init(conf, in_confs, rng):
     d_in_q = in_confs[0].size
     d_in_kv = in_confs[1].size if len(in_confs) > 1 else d_in_q
     rq, rk, rv, ro = jax.random.split(rng, 4)
-    std = 1.0 / math.sqrt(d_in_q)
+    std_q = 1.0 / math.sqrt(d_in_q)
+    std_kv = 1.0 / math.sqrt(d_in_kv)
     p = {
-        "wq": init.normal(rq, (d_in_q, d), std),
-        "wk": init.normal(rk, (d_in_kv, d), std),
-        "wv": init.normal(rv, (d_in_kv, d), std),
+        "wq": init.normal(rq, (d_in_q, d), std_q),
+        "wk": init.normal(rk, (d_in_kv, d), std_kv),
+        "wv": init.normal(rv, (d_in_kv, d), std_kv),
         "wo": init.normal(ro, (d, d), 1.0 / math.sqrt(d)),
     }
     if conf.bias:

@@ -62,6 +62,87 @@ def simple_img_conv_pool(
     )
 
 
+def img_conv_group(
+    input: LayerOutput,
+    conv_num_filter,
+    pool_size: int,
+    num_channels: Optional[int] = None,
+    conv_padding=1,
+    conv_filter_size=3,
+    conv_act=None,
+    conv_with_batchnorm=False,
+    conv_batchnorm_drop_rate=0,
+    pool_stride: int = 1,
+    pool_type=None,
+    param_attr=None,
+) -> LayerOutput:
+    """Image convolution group — [conv (+bn +dropout)]×N then one pool
+    (reference networks.py:333 img_conv_group, the VGG building block).
+    Scalar conv_* arguments broadcast across the group like the reference."""
+    from paddle_tpu.layers import batch_norm, dropout
+
+    n = len(conv_num_filter)
+
+    def bcast(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+    paddings = bcast(conv_padding)
+    fsizes = bcast(conv_filter_size)
+    acts = bcast(conv_act)
+    with_bn = bcast(conv_with_batchnorm)
+    bn_drop = bcast(conv_batchnorm_drop_rate)
+
+    tmp = input
+    for i in range(n):
+        tmp = img_conv(
+            tmp,
+            filter_size=fsizes[i],
+            num_filters=conv_num_filter[i],
+            num_channels=num_channels if i == 0 else None,
+            padding=paddings[i],
+            act=A.Identity() if with_bn[i] else acts[i],
+            param_attr=param_attr,
+        )
+        if with_bn[i]:
+            tmp = batch_norm(tmp, act=acts[i])
+            if bn_drop[i] > 0:
+                tmp = dropout(tmp, bn_drop[i])
+    return img_pool(tmp, pool_size=pool_size, stride=pool_stride, pool_type=pool_type)
+
+
+def small_vgg(input_image: LayerOutput, num_channels: int, num_classes: int):
+    """reference networks.py:435 small_vgg — 4 bn-conv groups + pool +
+    dropout + fc."""
+    from paddle_tpu.layers import dropout
+
+    def block(ipt, num_filter, times, dropouts, ch_in=None):
+        return img_conv_group(
+            ipt,
+            num_channels=ch_in,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * times,
+            conv_filter_size=3,
+            conv_act=A.Relu(),
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type=P.Max(),
+        )
+
+    tmp = block(input_image, 64, 2, [0.3, 0], num_channels)
+    tmp = block(tmp, 128, 2, [0.4, 0])
+    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    from paddle_tpu.attr import ExtraAttr
+    from paddle_tpu.layers import batch_norm
+
+    tmp = img_pool(tmp, stride=2, pool_size=2, pool_type=P.Max())
+    tmp = dropout(tmp, 0.5)
+    tmp = fc(tmp, size=512, act=A.Linear(), layer_attr=ExtraAttr(drop_rate=0.5))
+    tmp = batch_norm(tmp, act=A.Relu())
+    return fc(tmp, size=num_classes, act=A.Softmax())
+
+
 def vgg_16_network(input_image: LayerOutput, num_channels: int, num_classes: int = 1000):
     """reference vgg_16_network (networks.py)."""
 
@@ -96,13 +177,22 @@ def simple_lstm(
     gate_act=None,
     state_act=None,
     name: Optional[str] = None,
+    mat_param_attr=None,
+    bias_param_attr=None,
+    inner_param_attr=None,
+    lstm_cell_attr=None,
+    mixed_layer_attr=None,
 ) -> LayerOutput:
-    """fc(4*size) + fused lstmemory (reference simple_lstm networks.py)."""
+    """fc(4*size) + fused lstmemory (reference simple_lstm networks.py).
+    The v1 attr arguments accepted: lstm_cell_attr.drop_rate applies to the
+    cell output; parameter-attr knobs beyond initial_std are ignored."""
     proj = fc(
         input,
         size=size * 4,
         act=A.Identity(),
         bias_attr=False,
+        param_attr=mat_param_attr,
+        layer_attr=mixed_layer_attr,
         name=(name + "_transform") if name else None,
     )
     return lstmemory(
@@ -112,6 +202,7 @@ def simple_lstm(
         act=act,
         gate_act=gate_act,
         state_act=state_act,
+        layer_attr=lstm_cell_attr,
         name=name,
     )
 

@@ -198,7 +198,7 @@ def seqconcat_apply(conf, params, inputs, ctx):
 def lstmemory_init(conf, in_confs, rng):
     h = conf.size
     r1, r2 = jax.random.split(rng)
-    p = {"w_h": init.normal(r1, (h, 4 * h))}
+    p = {"w_h": init.normal(r1, (h, 4 * h), conf.attr("param_std"))}
     if conf.bias:
         # Reference packs gate bias + 3 peephole vectors into one 7H bias
         # (LstmLayer.cpp bias_ layout); we keep them named.
@@ -270,7 +270,7 @@ def gru_apply(conf, params, inputs, ctx):
 
 def recurrent_init(conf, in_confs, rng):
     h = conf.size
-    p = {"w_h": init.normal(rng, (h, h))}
+    p = {"w_h": init.normal(rng, (h, h), conf.attr("param_std"))}
     if conf.bias:
         p["b"] = init.zeros((h,))
     return p

@@ -43,14 +43,28 @@ class SGD:
         seed: int = 0,
         evaluators: Optional[Sequence] = None,
     ):
-        outputs: List[LayerOutput] = [cost] if isinstance(cost, LayerOutput) else list(cost)
-        if extra_layers:
-            outputs += list(extra_layers)
         self.evaluators = list(evaluators or [])
-        for ev in self.evaluators:
-            outputs += list(ev.layers)
-        self.topology = Topology(outputs)
-        if parameters is not None and parameters.network.topology.order == self.topology.order:
+        if isinstance(cost, Topology) and not extra_layers and not self.evaluators:
+            # e.g. a v1_compat parse_config result's topology
+            self.topology = cost
+        else:
+            if isinstance(cost, Topology):
+                outputs: List[LayerOutput] = list(cost.outputs)
+            elif isinstance(cost, LayerOutput):
+                outputs = [cost]
+            else:
+                outputs = list(cost)
+            if extra_layers:
+                outputs += list(extra_layers)
+            for ev in self.evaluators:
+                outputs += list(ev.layers)
+            self.topology = Topology(outputs)
+        # Structural comparison (serialize covers types/sizes/attrs) — name
+        # tuples alone would wrongly reuse a different network whose layers
+        # happen to share auto-names.
+        if parameters is not None and (
+            parameters.network.topology.serialize() == self.topology.serialize()
+        ):
             self.network = parameters.network
             self.parameters = parameters
         else:

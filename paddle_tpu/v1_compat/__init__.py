@@ -1,0 +1,234 @@
+"""v1 config-file compatibility — run reference-era trainer configs
+unmodified (north star: v1_api_demo configs run on TPU).
+
+``parse_config(path, config_arg_str)`` mirrors the reference entry
+(python/paddle/trainer/config_parser.py:3669 parse_config): it installs
+``paddle.trainer_config_helpers`` / ``paddle.trainer.PyDataProvider2`` import
+shims, executes the config file, and returns a :class:`ParsedConfig` holding
+the built Topology, trainer settings, and data-source declarations — instead
+of the reference's protobuf TrainerConfig.
+
+Data-layer input types: v1 ``data_layer`` declares only a size; the real slot
+types belong to the data provider (reference DataProvider2 ownership).  After
+executing the config we import the declared provider module and resolve each
+data layer's InputType from the @provider declaration, so feeding/training
+work end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import os
+import sys
+import types
+import warnings
+from typing import Dict, List, Optional
+
+from paddle_tpu.core.topology import LayerConf, Topology
+
+from paddle_tpu.v1_compat import config_helpers as _helpers
+from paddle_tpu.v1_compat.config_helpers import (  # noqa: F401
+    DataSources,
+    TrainerSettings,
+)
+
+__all__ = ["parse_config", "ParsedConfig", "make_optimizer"]
+
+
+def _install_import_shims() -> None:
+    """Make ``paddle.trainer_config_helpers`` / ``paddle.trainer.
+    PyDataProvider2`` importable (configs and providers import them by these
+    reference names).  No real paddle exists in this environment; refuse to
+    shadow one if it ever does."""
+    if "paddle" in sys.modules and not getattr(
+        sys.modules["paddle"], "__paddle_tpu_shim__", False
+    ):
+        raise RuntimeError("a real `paddle` package is importable; refusing to shim")
+    import paddle_tpu.data_provider as pdp2
+
+    paddle_mod = sys.modules.get("paddle")
+    if paddle_mod is None:
+        paddle_mod = types.ModuleType("paddle")
+        paddle_mod.__paddle_tpu_shim__ = True
+        sys.modules["paddle"] = paddle_mod
+    trainer_mod = types.ModuleType("paddle.trainer")
+    trainer_mod.PyDataProvider2 = pdp2
+    sys.modules["paddle.trainer"] = trainer_mod
+    sys.modules["paddle.trainer.PyDataProvider2"] = pdp2
+    sys.modules["paddle.trainer_config_helpers"] = _helpers
+    paddle_mod.trainer = trainer_mod
+    paddle_mod.trainer_config_helpers = _helpers
+
+
+@dataclasses.dataclass
+class ParsedConfig:
+    topology: Topology
+    settings: TrainerSettings
+    data_sources: Optional[DataSources]
+    input_layers: List[str]
+    output_layers: List[str]
+    evaluators: List = dataclasses.field(default_factory=list)
+    provider_input_types: Optional[dict] = None  # name -> InputType (if resolved)
+
+    def serialize(self) -> str:
+        return self.topology.serialize()
+
+
+def _parse_config_args(config_arg_str: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for piece in (config_arg_str or "").split(","):
+        piece = piece.strip()
+        if piece:
+            k, _, v = piece.partition("=")
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
+    """Import the declared provider module and patch data-layer InputTypes
+    from its @provider(input_types=...) declaration (by slot name when the
+    provider declared a dict, else by data-layer declaration order)."""
+    ds = parsed.data_sources
+    if ds is None or not ds.module:
+        return
+    sys.path.insert(0, config_dir)
+    try:
+        mod = importlib.import_module(ds.module)
+    except ImportError:
+        return
+    finally:
+        sys.path.pop(0)
+    obj = getattr(mod, ds.obj, None)
+    itypes = getattr(obj, "input_types", None)
+    names = getattr(obj, "slot_names", None)
+    hook_error: Optional[BaseException] = None
+    if itypes is None and hasattr(obj, "resolve_input_types"):
+        # hook-declared types (reference initializer pattern)
+        try:
+            itypes, names = obj.resolve_input_types(**(ds.args or {}))
+        except Exception as e:
+            hook_error = e
+            itypes = None
+    if itypes is None:
+        unresolved = [
+            c.name
+            for c in parsed.topology.data_layers().values()
+            if c.attrs.get("_v1_size_only")
+        ]
+        if unresolved:
+            warnings.warn(
+                f"could not resolve provider input types for data slots "
+                f"{unresolved} (provider {ds.module}.{ds.obj}"
+                + (f"; init_hook failed: {hook_error!r}" if hook_error else "")
+                + "); they keep the dense_vector placeholder — feeding will "
+                "be wrong for index/sequence slots",
+                stacklevel=2,
+            )
+        return
+    # Declaration order, NOT graph-traversal order — positional provider
+    # types pair with data layers the way readers yield tuples.
+    data_confs = list(parsed.topology.data_layers().values())
+    by_name = dict(zip(names, itypes)) if names else None
+    resolved = {}
+    for i, conf in enumerate(data_confs):
+        if by_name is not None:
+            t = by_name.get(conf.name)
+        else:
+            t = itypes[i] if i < len(itypes) else None
+        if t is not None and conf.attrs.get("_v1_size_only"):
+            # LayerConf is frozen; parse-time resolution happens before any
+            # compilation, so this is the one sanctioned mutation point.
+            object.__setattr__(conf, "input_type", t)
+            resolved[conf.name] = t
+    parsed.provider_input_types = resolved
+
+
+def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
+    """Execute a v1 trainer-config python file and return the build result
+    (reference config_parser.parse_config returns the proto; here the typed
+    Topology + settings)."""
+    _install_import_shims()
+    from paddle_tpu.core.topology import reset_auto_names
+
+    reset_auto_names()
+    config_dir = os.path.dirname(os.path.abspath(config_file)) or "."
+    state = _helpers._ParseState(_parse_config_args(config_arg_str))
+    prev_state = _helpers._state
+    _helpers._state = state
+    sys.path.insert(0, config_dir)
+    try:
+        with open(config_file) as f:
+            src = f.read()
+        ns = {
+            "__file__": os.path.abspath(config_file),
+            "__name__": "__paddle_config__",
+            # py2-era configs: reference v1 configs predate python 3
+            "xrange": range,
+            "unicode": str,
+        }
+        exec(compile(src, config_file, "exec"), ns)
+    finally:
+        sys.path.pop(0)
+        _helpers._state = prev_state
+
+    assert state.outputs, f"{config_file}: config declared no outputs()"
+    topo = Topology(list(state.outputs))
+    parsed = ParsedConfig(
+        topology=topo,
+        settings=state.settings,
+        data_sources=state.data_sources,
+        input_layers=[l.name for l in state.inputs],
+        output_layers=[l.name for l in state.outputs],
+        evaluators=list(state.evaluators),
+    )
+    _resolve_provider_types(parsed, config_dir)
+    return parsed
+
+
+def make_optimizer(settings: TrainerSettings):
+    """Map settings() onto a paddle_tpu optimizer instance (the v2
+    update_equation)."""
+    import paddle_tpu.optimizer as O
+
+    method = settings.learning_method
+    kind = getattr(method, "kind", "sgd") if method is not None else "sgd"
+    reg = settings.regularization
+    if reg is not None:
+        reg = (
+            O.L1Regularization(reg.rate)
+            if isinstance(reg, _helpers.L1Regularization)
+            else O.L2Regularization(reg.rate)
+        )
+    avg = settings.model_average
+    if avg is not None:
+        avg = O.ModelAverage(average_window=avg.average_window)
+    common = dict(
+        learning_rate=settings.learning_rate,
+        learning_rate_schedule=settings.learning_rate_schedule,
+        learning_rate_decay_a=settings.learning_rate_decay_a,
+        learning_rate_decay_b=settings.learning_rate_decay_b,
+        regularization=reg,
+        gradient_clipping_threshold=settings.gradient_clipping_threshold or 0.0,
+        model_average=avg,
+    )
+    extra = dict(getattr(method, "extra", {}))
+    cls = {
+        "sgd": O.Momentum,
+        "momentum": O.Momentum,
+        "adam": O.Adam,
+        "adamax": O.AdaMax,
+        "adagrad": O.AdaGrad,
+        "decayed_adagrad": O.DecayedAdaGrad,
+        "adadelta": O.AdaDelta,
+        "rmsprop": O.RMSProp,
+    }[kind]
+    if cls is O.Momentum and "momentum" not in extra and kind == "sgd":
+        extra["momentum"] = 0.0
+    if cls is O.Adam:
+        extra = {
+            "beta1": extra.get("beta1", 0.9),
+            "beta2": extra.get("beta2", 0.999),
+            "epsilon": extra.get("epsilon", 1e-8),
+        }
+    return cls(**extra, **common)

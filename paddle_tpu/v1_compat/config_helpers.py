@@ -1,0 +1,294 @@
+"""The ``paddle.trainer_config_helpers`` star-import surface for v1 config
+files (reference: python/paddle/trainer_config_helpers/__init__.py re-exports
+layers + activations + optimizers + poolings + networks + data_sources).
+
+v1 configs do ``from paddle.trainer_config_helpers import *`` then call
+`settings()`, `define_py_data_sources2()`, layer functions, and
+`outputs()`; ``parse_config`` (v1_compat/__init__.py) installs this module
+under that name, executes the config, and collects the declarations below.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+# Layer DSL + networks: configs use the *_layer names and the bare ones.
+from paddle_tpu.layers import *  # noqa: F401,F403
+from paddle_tpu.layers import LayerOutput, data as _data_fn
+from paddle_tpu.layers.networks import (  # noqa: F401
+    bidirectional_gru,
+    bidirectional_lstm,
+    img_conv_group,
+    sequence_conv_pool,
+    simple_attention,
+    simple_gru,
+    simple_img_conv_pool,
+    simple_lstm,
+    small_vgg,
+    vgg_16_network,
+)
+from paddle_tpu import evaluator as _ev
+from paddle_tpu import activation as _A
+from paddle_tpu import pooling as _P
+from paddle_tpu.attr import ExtraAttr, ParamAttr
+from paddle_tpu.core import data_types as _dt
+
+# -- v1 class-name aliases ---------------------------------------------------
+
+# Activations (reference activations.py: <Name>Activation classes)
+IdentityActivation = _A.Identity
+LinearActivation = _A.Linear
+SigmoidActivation = _A.Sigmoid
+SoftmaxActivation = _A.Softmax
+SequenceSoftmaxActivation = _A.SequenceSoftmax
+ReluActivation = _A.Relu
+BReluActivation = _A.BRelu
+TanhActivation = _A.Tanh
+STanhActivation = _A.STanh
+SoftReluActivation = _A.SoftRelu
+AbsActivation = _A.Abs
+SquareActivation = _A.Square
+ExpActivation = _A.Exp
+LogActivation = _A.Log
+
+# Poolings (reference poolings.py)
+MaxPooling = _P.Max
+AvgPooling = _P.Avg
+SumPooling = _P.Sum
+SquareRootNPooling = _P.SquareRootN
+
+# Attributes
+ParameterAttribute = ParamAttr
+ExtraLayerAttribute = ExtraAttr
+ExtraAttribute = ExtraAttr
+
+# conv_layer is the v1 name for img_conv
+conv_layer = img_conv  # noqa: F405
+norm_layer = img_cmrnorm = None  # placeholder: response-norm not supported
+
+
+def data_layer(
+    name: str, size: int, height: int = 0, width: int = 0, layer_attr=None
+) -> LayerOutput:
+    """v1 data_layer: declares only a size; the slot's real input type comes
+    from the data provider and is resolved by parse_config (reference
+    config_parser.py DataLayer + DataProvider ownership of types)."""
+    lo = _data_fn(name, _dt.dense_vector(size), height=height, width=width)
+    lo.conf.attrs["_v1_size_only"] = True
+    return lo
+
+
+# -- optimizers (reference trainer_config_helpers/optimizers.py) -------------
+
+
+class BaseSGDOptimizer:
+    """Carries the learning-method choice; settings() maps it (plus the
+    shared learning-rate/regularization arguments) onto paddle_tpu.optimizer
+    classes via make_optimizer."""
+
+    kind = "sgd"
+    extra: Dict[str, Any] = {}
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    kind = "momentum"
+
+    def __init__(self, momentum: float = 0.9, sparse: bool = False):
+        self.extra = {"momentum": momentum}
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    kind = "adam"
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        self.extra = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    kind = "adamax"
+
+    def __init__(self, beta1: float = 0.9, beta2: float = 0.999):
+        self.extra = {"beta1": beta1, "beta2": beta2}
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    kind = "adagrad"
+
+    def __init__(self):
+        self.extra = {}
+
+
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    kind = "decayed_adagrad"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.extra = {"rho": rho, "epsilon": epsilon}
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    kind = "adadelta"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.extra = {"rho": rho, "epsilon": epsilon}
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    kind = "rmsprop"
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        self.extra = {"rho": rho, "epsilon": epsilon}
+
+
+class BaseRegularization:
+    pass
+
+
+class L2Regularization(BaseRegularization):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+
+class L1Regularization(BaseRegularization):
+    def __init__(self, rate: float):
+        self.rate = rate
+
+
+class ModelAverage:
+    def __init__(self, average_window: float, max_average_window: Optional[int] = None):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+
+
+# -- parse-time collected state ----------------------------------------------
+
+
+@dataclasses.dataclass
+class TrainerSettings:
+    """What settings() declared (reference optimizers.py:358)."""
+
+    batch_size: int = 1
+    learning_rate: float = 1e-3
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    learning_rate_schedule: str = "constant"
+    learning_method: Optional[BaseSGDOptimizer] = None
+    regularization: Optional[BaseRegularization] = None
+    model_average: Optional[ModelAverage] = None
+    gradient_clipping_threshold: float = 0.0
+    is_async: bool = False
+
+
+@dataclasses.dataclass
+class DataSources:
+    """What define_py_data_sources2 declared (reference data_sources.py:158)."""
+
+    train_list: Optional[str] = None
+    test_list: Optional[str] = None
+    module: Optional[str] = None
+    obj: Optional[str] = None
+    test_obj: Optional[str] = None
+    args: Optional[dict] = None
+
+
+class _ParseState:
+    def __init__(self, config_args: Dict[str, str]):
+        self.config_args = config_args
+        self.settings = TrainerSettings()
+        self.data_sources: Optional[DataSources] = None
+        self.inputs: List[LayerOutput] = []
+        self.outputs: List[LayerOutput] = []
+        self.evaluators: List[Any] = []
+
+
+_state: Optional[_ParseState] = None
+
+
+def _require_state() -> _ParseState:
+    assert _state is not None, (
+        "v1 config helpers must run under paddle_tpu.v1_compat.parse_config"
+    )
+    return _state
+
+
+def get_config_arg(name: str, type_, default=None):
+    """reference config_parser.py:3581 — typed lookup into the
+    ``--config_args`` k=v list given to parse_config."""
+    st = _require_state()
+    if name not in st.config_args:
+        return default
+    v = st.config_args[name]
+    if type_ is bool:
+        return str(v).lower() in ("1", "true", "yes")
+    return type_(v)
+
+
+def settings(batch_size, **kw):
+    st = _require_state()
+    s = st.settings
+    s.batch_size = batch_size
+    for k, v in kw.items():
+        if not hasattr(s, k):
+            raise TypeError(f"settings() got unexpected argument {k!r}")
+        setattr(s, k, v)
+    # poly schedule with zero decay is the reference default; treat as constant
+    if s.learning_rate_schedule == "poly" and s.learning_rate_decay_a == 0.0:
+        s.learning_rate_schedule = "constant"
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    st = _require_state()
+    if isinstance(obj, (list, tuple)):
+        obj, test_obj = obj
+    else:
+        test_obj = obj
+    st.data_sources = DataSources(
+        train_list=train_list, test_list=test_list, module=module,
+        obj=obj, test_obj=test_obj, args=args,
+    )
+
+
+def inputs(*layers_):
+    st = _require_state()
+    flat: List[LayerOutput] = []
+    for l in layers_:
+        flat.extend(l if isinstance(l, (list, tuple)) else [l])
+    st.inputs = flat
+
+
+def outputs(*layers_):
+    st = _require_state()
+    flat: List[LayerOutput] = []
+    for l in layers_:
+        flat.extend(l if isinstance(l, (list, tuple)) else [l])
+    st.outputs.extend(flat)
+
+
+def default_device(device_id: int) -> None:
+    """v1 global device selector — a no-op on TPU (placement is mesh-driven;
+    reference config_parser default_device sets per-layer device ids)."""
+
+
+def _recording_evaluator(fn):
+    def wrapper(*args, **kw):
+        ev = fn(*args, **kw)
+        if _state is not None:
+            _state.evaluators.append(ev)
+        return ev
+
+    wrapper.__name__ = fn.__name__
+    return wrapper
+
+
+# Evaluator declarations (reference trainer_config_helpers/evaluators.py):
+# calling one inside a config registers it with the parse result.
+classification_error_evaluator = _recording_evaluator(_ev.classification_error_evaluator)
+sum_evaluator = _recording_evaluator(_ev.sum_evaluator)
+column_sum_evaluator = _recording_evaluator(_ev.column_sum_evaluator)
+auc_evaluator = _recording_evaluator(_ev.auc_evaluator)
+precision_recall_evaluator = _recording_evaluator(_ev.precision_recall_evaluator)
+pnpair_evaluator = _recording_evaluator(_ev.pnpair_evaluator)
+ctc_error_evaluator = _recording_evaluator(_ev.ctc_error_evaluator)
+chunk_evaluator = _recording_evaluator(_ev.chunk_evaluator)
+value_printer_evaluator = _recording_evaluator(_ev.value_printer_evaluator)
+maxid_printer_evaluator = _recording_evaluator(_ev.maxid_printer_evaluator)

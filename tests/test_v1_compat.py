@@ -1,0 +1,180 @@
+"""v1 config-file compatibility (north star: v1_api_demo configs run
+unmodified).  parse_config mirrors python/paddle/trainer/config_parser.py:3669;
+settings()/optimizer classes mirror trainer_config_helpers/optimizers.py."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.v1_compat import make_optimizer, parse_config
+
+REF = "/root/reference/v1_api_demo"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference demo configs not mounted"
+)
+
+HERE = os.path.dirname(__file__)
+
+
+@pytest.fixture()
+def dict_dir(tmp_path):
+    """cwd with ./data/dict.txt — quick_start configs hardcode this path."""
+    (tmp_path / "data").mkdir()
+    (tmp_path / "data" / "dict.txt").write_text(
+        "\n".join(f"w{i}\t{i}" for i in range(100))
+    )
+    old = os.getcwd()
+    os.chdir(tmp_path)
+    yield tmp_path
+    os.chdir(old)
+
+
+def test_light_mnist_builds_and_matches_golden():
+    p = parse_config(f"{REF}/mnist/light_mnist.py")
+    golden = open(os.path.join(HERE, "goldens", "v1_light_mnist.topo")).read()
+    assert p.serialize() == golden
+    # provider types resolved from mnist_provider's @provider declaration
+    t = p.provider_input_types
+    assert t["pixel"].dim == 784 and t["label"].dim == 10
+    assert p.settings.batch_size == 50
+    assert p.settings.learning_method.kind == "adam"
+
+
+def test_light_mnist_predict_mode():
+    p = parse_config(f"{REF}/mnist/light_mnist.py", "is_predict=1")
+    # predict config skips the data sources and the cost layer
+    assert p.data_sources is None
+    assert all(
+        p.topology.layers[n].type != "cross_entropy" for n in p.topology.order
+    )
+
+
+def test_vgg_16_mnist_builds():
+    p = parse_config(f"{REF}/mnist/vgg_16_mnist.py")
+    assert len(p.topology.order) > 30  # 4 conv groups with bn
+
+
+def test_quick_start_lr_golden(dict_dir):
+    p = parse_config(
+        f"{REF}/quick_start/trainer_config.lr.py", "dict_file=data/dict.txt"
+    )
+    golden = open(os.path.join(HERE, "goldens", "v1_quick_start_lr.topo")).read()
+    assert p.serialize() == golden
+    assert p.provider_input_types["word"].dim == 100  # from the dict file
+    assert p.settings.gradient_clipping_threshold == 25
+
+
+@pytest.mark.parametrize(
+    "cfg", ["lr", "emb", "cnn", "lstm", "bidi-lstm", "db-lstm", "resnet-lstm"]
+)
+def test_quick_start_configs_build(dict_dir, cfg):
+    p = parse_config(f"{REF}/quick_start/trainer_config.{cfg}.py")
+    assert len(p.topology.order) >= 4
+    assert p.output_layers
+
+
+def test_sequence_tagging_configs_build():
+    p = parse_config(f"{REF}/sequence_tagging/linear_crf.py")
+    assert any(p.topology.layers[n].type == "crf" for n in p.topology.order)
+    assert len(p.evaluators) == 2  # sum + chunk evaluators recorded
+    p2 = parse_config(f"{REF}/sequence_tagging/rnn_crf.py")
+    assert any(p2.topology.layers[n].type == "crf" for n in p2.topology.order)
+
+
+def test_traffic_prediction_config_builds():
+    p = parse_config(f"{REF}/traffic_prediction/trainer_config.py")
+    assert len(p.topology.order) > 50
+
+
+def test_make_optimizer_mapping(dict_dir):
+    p = parse_config(
+        f"{REF}/quick_start/trainer_config.lr.py", "dict_file=data/dict.txt"
+    )
+    opt = make_optimizer(p.settings)
+    import paddle_tpu.optimizer as O
+
+    assert isinstance(opt, O.Adam)
+    assert opt.learning_rate == pytest.approx(2e-3)
+    assert opt.clip == 25
+    assert isinstance(opt.regularization, O.L2Regularization)
+    assert opt.regularization.rate == pytest.approx(8e-4)
+
+
+def test_quick_start_lr_trains_end_to_end(dict_dir):
+    """The north-star slice: a reference config + its reference data provider
+    train through the v2 trainer with nothing modified."""
+    p = parse_config(
+        f"{REF}/quick_start/trainer_config.lr.py", "dict_file=data/dict.txt"
+    )
+    # synthesize a tiny dataset in the provider's expected format:
+    # "<label>\t<word> <word> ..." with words from the dict
+    rng = np.random.RandomState(0)
+    train_file = dict_dir / "train.txt"
+    lines = []
+    for _ in range(600):
+        label = rng.randint(2)
+        base = 10 if label else 60
+        words = [f"w{base + rng.randint(20)}" for _ in range(rng.randint(3, 8))]
+        lines.append(f"{label}\t{' '.join(words)}")
+    train_file.write_text("\n".join(lines))
+
+    import importlib
+    import sys
+
+    sys.path.insert(0, f"{REF}/quick_start")
+    try:
+        provider_mod = importlib.import_module(p.data_sources.module)
+    finally:
+        sys.path.pop(0)
+    word_dict = {f"w{i}": i for i in range(100)}
+    reader = getattr(provider_mod, p.data_sources.obj)(
+        str(train_file), dictionary=word_dict
+    )
+
+    params = paddle.parameters.create(p.topology)
+    trainer = paddle.trainer.SGD(
+        cost=p.topology,
+        parameters=params,
+        update_equation=make_optimizer(p.settings),
+    )
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, p.settings.batch_size),
+        num_passes=10,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+    )
+    assert np.mean(costs[-3:]) < 0.7 * np.mean(costs[:3]), costs
+
+
+def test_positional_provider_types_pair_by_declaration_order(tmp_path):
+    """Positional provider input_types must map to data layers in DECLARATION
+    order even when graph-traversal order differs (label declared first but
+    the cost graph visits pixel's subtree first)."""
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_py_data_sources2(train_list='t', test_list=None,\n"
+        "                        module='prov_mod', obj='process')\n"
+        "settings(batch_size=4, learning_rate=1e-3,\n"
+        "         learning_method=MomentumOptimizer())\n"
+        "lbl = data_layer(name='label', size=10)\n"
+        "img = data_layer(name='pixel', size=784)\n"
+        "fc1 = fc_layer(input=img, size=10, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=fc1, label=lbl))\n"
+    )
+    (tmp_path / "prov_mod.py").write_text(
+        "from paddle.trainer.PyDataProvider2 import *\n"
+        "@provider(input_types=[integer_value(10), dense_vector(784)])\n"
+        "def process(settings, f):\n"
+        "    yield 0, [0.0] * 784\n"
+    )
+    p = parse_config(str(cfg))
+    from paddle_tpu.core.data_types import SlotKind
+
+    assert p.provider_input_types["label"].kind == SlotKind.INDEX
+    assert p.provider_input_types["pixel"].kind == SlotKind.DENSE
+    assert p.provider_input_types["pixel"].dim == 784
