@@ -422,6 +422,133 @@ def maxid_printer_evaluator(input: LayerOutput, name: Optional[str] = None) -> E
 
 
 # ---------------------------------------------------------------------------
+# detection mAP (reference DetectionMAPEvaluator.cpp:306)
+# ---------------------------------------------------------------------------
+
+_MAP_BINS = 1000
+
+
+def detection_map_evaluator(
+    input: LayerOutput,  # detection_output layer: [B, K, 6]
+    label: LayerOutput,  # gt slot: [B, G, 6] (label,x1,y1,x2,y2,difficult)
+    num_classes: int,
+    overlap_threshold: float = 0.5,
+    background_id: int = 0,
+    evaluate_difficult: bool = False,
+    ap_type: str = "11point",
+    name: Optional[str] = None,
+) -> Evaluator:
+    """Streaming mAP: the in-graph update greedily matches each image's
+    detections to ground truth (sorted by score, one gt per detection,
+    IoU >= threshold) and accumulates TP/FP counts into per-class score-bin
+    histograms; finalize integrates the binned PR curve on the host
+    (11-point interpolation or trapezoid 'Integral', matching the
+    reference's two ap_type modes).  The reference buffers every
+    (score, tp/fp) pair on the host instead — binning keeps the accumulator
+    static-shape for jit, at <=1/NBINS score resolution."""
+    import jax
+
+    from paddle_tpu.ops.detection import iou_matrix
+
+    nm = name or auto_name("detection_map")
+
+    def update(outs):
+        det_t, gt_t = outs[input.name], outs[label.name]
+        det = det_t.data  # [B, K, 6]
+        gt = gt_t.data  # [B, G, 6]
+        gt_valid = gt_t.mask(jnp.float32) > 0 if gt_t.is_seq else (
+            jnp.ones(gt.shape[:2], bool)
+        )
+
+        def per_image(det_i, gt_i, valid_i):
+            g_lab = gt_i[:, 0].astype(jnp.int32)
+            g_box = gt_i[:, 1:5]
+            g_diff = gt_i[:, 5] > 0
+            counted = valid_i & (evaluate_difficult | ~g_diff)
+            n_gt = jnp.zeros((num_classes,), jnp.float32).at[g_lab].add(
+                counted.astype(jnp.float32)
+            )
+            # sort detections by score desc (detection_output emits top-k
+            # globally sorted, but per-class order must be by score)
+            order = jnp.argsort(-det_i[:, 1])
+            det_i = det_i[order]
+            d_lab = det_i[:, 0].astype(jnp.int32)
+            d_score = det_i[:, 1]
+            d_box = det_i[:, 2:6]
+            ious = iou_matrix(d_box, g_box)  # [K, G]
+
+            def body(used, k):
+                lab, score, iou_k = d_lab[k], d_score[k], ious[k]
+                # Reference calcTFPos: best-overlap gt over ALL same-class
+                # gts (visited or not); a hit on a visited gt is an FP, a
+                # hit on a skipped difficult gt is ignored and does NOT mark
+                # the gt visited.
+                cand = valid_i & (g_lab == lab)
+                masked = jnp.where(cand, iou_k, -1.0)
+                best = jnp.argmax(masked)
+                hit = masked[best] >= overlap_threshold
+                live = (lab >= 0) & (lab != background_id) & (score > 0)
+                ignore = hit & g_diff[best] & (not evaluate_difficult)
+                already = used[best]
+                tp = live & hit & ~ignore & ~already
+                fp = live & ((~hit) | (hit & ~ignore & already))
+                used = used.at[best].set(already | (hit & live & ~ignore))
+                bin_ = jnp.clip(
+                    (score * _MAP_BINS).astype(jnp.int32), 0, _MAP_BINS - 1
+                )
+                return used, (lab, bin_, tp, fp)
+
+            used0 = jnp.zeros(g_lab.shape, bool)
+            _, (labs, bins, tps, fps) = jax.lax.scan(
+                body, used0, jnp.arange(det_i.shape[0])
+            )
+            safe_lab = jnp.clip(labs, 0, num_classes - 1)
+            tp_h = jnp.zeros((num_classes, _MAP_BINS), jnp.float32).at[
+                safe_lab, bins
+            ].add(tps.astype(jnp.float32))
+            fp_h = jnp.zeros((num_classes, _MAP_BINS), jnp.float32).at[
+                safe_lab, bins
+            ].add(fps.astype(jnp.float32))
+            return n_gt, tp_h, fp_h
+
+        n_gt, tp_h, fp_h = jax.vmap(per_image)(det, gt, gt_valid)
+        return {
+            "n_gt": jnp.sum(n_gt, 0),
+            "tp": jnp.sum(tp_h, 0),
+            "fp": jnp.sum(fp_h, 0),
+        }
+
+    def finalize(acc):
+        import numpy as np
+
+        n_gt = np.asarray(acc["n_gt"])
+        tp = np.asarray(acc["tp"])[:, ::-1]  # high-score bins first
+        fp = np.asarray(acc["fp"])[:, ::-1]
+        aps = []
+        for c in range(num_classes):
+            if c == background_id or n_gt[c] <= 0:
+                continue
+            ctp, cfp = np.cumsum(tp[c]), np.cumsum(fp[c])
+            recall = ctp / n_gt[c]
+            precision = ctp / np.maximum(ctp + cfp, 1e-10)
+            if ap_type == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    mask = recall >= t
+                    ap += (precision[mask].max() if mask.any() else 0.0) / 11.0
+            else:  # Integral: sum precision deltas over recall steps
+                prev_r = 0.0
+                ap = 0.0
+                for r, p in zip(recall, precision):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+            aps.append(ap)
+        return {nm: float(np.mean(aps)) if aps else 0.0}
+
+    return Evaluator(nm, [input, label], update, finalize)
+
+
+# ---------------------------------------------------------------------------
 # combination helpers (used by the trainer)
 # ---------------------------------------------------------------------------
 

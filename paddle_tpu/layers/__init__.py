@@ -41,6 +41,7 @@ from paddle_tpu.layers.generation import (  # noqa: F401
     beam_search,
 )
 from paddle_tpu.layers import attention as _attention  # noqa: F401
+from paddle_tpu.layers import detection as _detection  # noqa: F401
 
 
 class AggregateLevel:
@@ -1659,6 +1660,136 @@ mixed_layer = mixed
 # ---------------------------------------------------------------------------
 # attention family (Transformer building blocks — layers/attention.py)
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# detection suite (SSD) — layers/detection.py
+# ---------------------------------------------------------------------------
+
+
+def priorbox(
+    input: LayerOutput,
+    image: LayerOutput,
+    aspect_ratio: Sequence[float],
+    variance: Sequence[float],
+    min_size: Sequence[float],
+    max_size: Sequence[float] = (),
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference priorbox_layer (layers.py:1049) → PriorBox.cpp.  Emits
+    [B, P, 8] (prior corners + variances); P is fixed by the input feature
+    map's geometry, so the priors fold to an XLA constant."""
+    from paddle_tpu.ops.detection import make_priors, priors_per_cell
+
+    fa = input.conf.attrs
+    h = fa.get("out_h") or fa.get("in_h")
+    w = fa.get("out_w") or fa.get("in_w")
+    assert h and w, f"priorbox input {input.name} has no image geometry attrs"
+    ia = image.conf.attrs
+    img_h = ia.get("in_h") or ia.get("out_h") or h
+    img_w = ia.get("in_w") or ia.get("out_w") or w
+    priors = make_priors(
+        int(h), int(w), list(min_size), list(max_size), list(aspect_ratio),
+        int(img_h), int(img_w),
+    )
+    k = priors_per_cell(len(min_size), len(max_size), aspect_ratio)
+    conf = LayerConf(
+        name=name or auto_name("priorbox"),
+        type="priorbox",
+        size=priors.shape[0] * 8,
+        inputs=(input.name, image.name),
+        bias=False,
+        attrs={
+            "_priors": priors,
+            "variance": tuple(variance),
+            "num_priors": int(priors.shape[0]),
+            "priors_per_cell": int(k),
+        },
+    )
+    return LayerOutput(conf, [input, image])
+
+
+priorbox_layer = priorbox
+
+
+def multibox_loss(
+    input_loc,
+    input_conf,
+    priorbox: LayerOutput,
+    label: LayerOutput,
+    num_classes: int,
+    overlap_threshold: float = 0.5,
+    neg_pos_ratio: float = 3.0,
+    neg_overlap: float = 0.5,
+    background_id: int = 0,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference multibox_loss_layer (layers.py:1095) → MultiBoxLossLayer.cpp.
+    `label` is a dense sequence slot of (label,xmin,ymin,xmax,ymax,difficult)
+    rows per image."""
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    assert len(locs) == len(confs), "loc/conf input counts must match"
+    parents = [priorbox, label] + locs + confs
+    conf = LayerConf(
+        name=name or auto_name("multibox_loss"),
+        type="multibox_loss",
+        size=1,
+        inputs=tuple(p.name for p in parents),
+        bias=False,
+        attrs={
+            "input_num": len(locs),
+            "num_classes": num_classes,
+            "overlap_threshold": overlap_threshold,
+            "neg_pos_ratio": neg_pos_ratio,
+            "neg_overlap": neg_overlap,
+            "background_id": background_id,
+        },
+    )
+    return LayerOutput(conf, parents)
+
+
+multibox_loss_layer = multibox_loss
+
+
+def detection_output(
+    input_loc,
+    input_conf,
+    priorbox: LayerOutput,
+    num_classes: int,
+    nms_threshold: float = 0.45,
+    nms_top_k: int = 400,
+    keep_top_k: int = 200,
+    confidence_threshold: float = 0.01,
+    background_id: int = 0,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """reference detection_output_layer (layers.py:1170) →
+    DetectionOutputLayer.cpp.  Emits a fixed [B, keep_top_k, 6] block."""
+    locs = _as_list(input_loc)
+    confs = _as_list(input_conf)
+    assert len(locs) == len(confs)
+    parents = [priorbox] + locs + confs
+    conf = LayerConf(
+        name=name or auto_name("detection_output"),
+        type="detection_output",
+        size=keep_top_k * 6,
+        inputs=tuple(p.name for p in parents),
+        bias=False,
+        attrs={
+            "input_num": len(locs),
+            "num_classes": num_classes,
+            "nms_threshold": nms_threshold,
+            "nms_top_k": nms_top_k,
+            "keep_top_k": keep_top_k,
+            "confidence_threshold": confidence_threshold,
+            "background_id": background_id,
+        },
+    )
+    return LayerOutput(conf, parents)
+
+
+detection_output_layer = detection_output
 
 
 def layer_norm(

@@ -1,0 +1,176 @@
+"""SSD detection layers: priorbox, multibox_loss, detection_output.
+
+Reference: paddle/gserver/layers/{PriorBox,MultiBoxLossLayer,
+DetectionOutputLayer}.cpp and the priorbox_layer/multibox_loss_layer/
+detection_output_layer DSL (trainer_config_helpers/layers.py:1049-1214).
+
+TPU-native shapes: ground truth is a padded dense sequence slot
+[B, G, 6] = (label, xmin, ymin, xmax, ymax, difficult) with per-image valid
+counts (reference packs it CSR); detections come out as a fixed
+[B, keep_top_k, 6] = (label, score, xmin, ymin, xmax, ymax) block padded
+with label -1 (reference emits a variable-row host matrix).  Priors are
+compile-time constants folded by XLA."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+from paddle_tpu.ops import detection as D
+
+
+# ---------------------------------------------------------------------------
+# priorbox
+# ---------------------------------------------------------------------------
+
+
+@register_layer("priorbox", auto_activation=False)
+def priorbox_apply(conf, params, inputs, ctx):
+    """Output [B, P, 8]: corner-form normalized prior + its 4 variances
+    (reference packs the same 2×P*4)."""
+    a = conf.attrs
+    priors = jnp.asarray(a["_priors"])  # [P, 4] precomputed at build
+    var = jnp.broadcast_to(
+        jnp.asarray(a["variance"], jnp.float32)[None, :], priors.shape
+    )
+    packed = jnp.concatenate([priors, var], axis=-1)  # [P, 8]
+    b = inputs[0].batch_size
+    return SeqTensor(jnp.broadcast_to(packed[None], (b,) + packed.shape))
+
+
+# ---------------------------------------------------------------------------
+# multibox_loss
+# ---------------------------------------------------------------------------
+
+
+def _gather_preds(inputs, n_loc, width):
+    """Reshape each prediction (NHWC conv [B,H,W,k*width] or already-flat
+    [B, H*W*k*width]) to [B, P_i, width] and concat along priors — same
+    cell-major order as make_priors."""
+    return jnp.concatenate(
+        [t.data.reshape(t.data.shape[0], -1, width) for t in inputs[:n_loc]],
+        axis=1,
+    )
+
+
+@register_layer("multibox_loss", auto_activation=False, full_precision=True)
+def multibox_loss_apply(conf, params, inputs, ctx):
+    """inputs: (priorbox, label, loc_0..loc_{n-1}, conf_0..conf_{n-1});
+    output [B] per-image loss = (smooth-L1 loc + softmax CE conf) / n_pos
+    with 2-phase matching and hard negative mining
+    (MultiBoxLossLayer::forward)."""
+    a = conf.attrs
+    n_in = a["input_num"]
+    n_cls = a["num_classes"]
+    bg = a["background_id"]
+
+    priors_t, label_t = inputs[0], inputs[1]
+    locs = _gather_preds(inputs[2 : 2 + n_in], n_in, 4)  # [B, P, 4]
+    confs = _gather_preds(inputs[2 + n_in :], n_in, n_cls)  # [B, P, C]
+    priors = priors_t.data[0, :, :4]  # [P, 4] (identical across batch)
+    variances = priors_t.data[0, 0, 4:]
+
+    gt = label_t.data  # [B, G, 6]
+    assert label_t.is_seq
+    gt_valid = label_t.mask(jnp.float32) > 0  # [B, G]
+    gt_boxes = gt[..., 1:5]
+    gt_labels = gt[..., 0].astype(jnp.int32)
+
+    def per_image(loc_p, conf_p, boxes, labels, valid):
+        matched, pos, max_iou = D.match_priors(
+            priors, boxes, valid, a["overlap_threshold"]
+        )
+        n_pos = jnp.sum(pos.astype(jnp.float32))
+        # localization loss over positives
+        target = D.encode_boxes(boxes[matched], priors, variances)
+        loc_loss = jnp.sum(
+            jnp.sum(D.smooth_l1(loc_p - target), axis=-1) * pos.astype(jnp.float32)
+        )
+        # confidence loss: positives -> matched class, negatives -> background
+        probs = jax.nn.softmax(conf_p, axis=-1)
+        logp = jnp.log(jnp.maximum(probs, 1e-12))
+        cls = jnp.where(pos, labels[matched], bg)
+        ce = -jnp.take_along_axis(logp, cls[:, None], axis=-1)[:, 0]  # [P]
+        # hard negative mining: reference ranks negatives by max
+        # NON-background confidence (getMaxConfidenceScores), keep ratio
+        neg_score = jnp.max(probs.at[:, bg].set(0.0), axis=-1)
+        neg_cand = (~pos) & (max_iou < a["neg_overlap"])
+        ranks = D.hard_negative_ranks(neg_score, neg_cand)
+        n_neg = jnp.minimum(
+            a["neg_pos_ratio"] * n_pos, jnp.sum(neg_cand.astype(jnp.float32))
+        )
+        neg_keep = ranks < n_neg
+        conf_loss = jnp.sum(ce * (pos | neg_keep).astype(jnp.float32))
+        return loc_loss + conf_loss, n_pos
+
+    raw, n_pos = jax.vmap(per_image)(locs, confs, gt_boxes, gt_labels, gt_valid)
+    # Reference normalizes by the BATCH-total match count
+    # (MultiBoxLossLayer.cpp:206,257 numMatches_), not per image.  The
+    # per-image outputs are scaled so their mean equals
+    # sum(raw)/total_matches.
+    total = jnp.maximum(jnp.sum(n_pos), 1.0)
+    loss = raw * (raw.shape[0] / total)
+    return SeqTensor(loss[:, None])
+
+
+# ---------------------------------------------------------------------------
+# detection_output
+# ---------------------------------------------------------------------------
+
+
+@register_layer("detection_output", auto_activation=False, full_precision=True)
+def detection_output_apply(conf, params, inputs, ctx):
+    """inputs: (priorbox, loc..., conf...); output [B, keep_top_k, 6] =
+    (label, score, xmin, ymin, xmax, ymax), empty slots label=-1
+    (DetectionOutputLayer::forward: decode + per-class NMS + global top-k)."""
+    a = conf.attrs
+    n_in = a["input_num"]
+    n_cls = a["num_classes"]
+    bg = a["background_id"]
+
+    priors_t = inputs[0]
+    locs = _gather_preds(inputs[1 : 1 + n_in], n_in, 4)
+    confs = _gather_preds(inputs[1 + n_in :], n_in, n_cls)
+    priors = priors_t.data[0, :, :4]
+    variances = priors_t.data[0, 0, 4:]
+
+    nms_top_k = min(a["nms_top_k"], locs.shape[1])
+    keep_top_k = a["keep_top_k"]
+
+    def per_image(loc_p, conf_p):
+        boxes = D.decode_boxes(loc_p, priors, variances)  # [P, 4]
+        probs = jax.nn.softmax(conf_p, axis=-1)  # [P, C]
+        all_scores = []
+        all_labels = []
+        all_boxes = []
+        for c in range(n_cls):
+            if c == bg:
+                continue
+            s = probs[:, c]
+            s = jnp.where(s >= a["confidence_threshold"], s, -jnp.inf)
+            idx, kept = D.nms(boxes, s, a["nms_threshold"], nms_top_k)
+            all_scores.append(kept)
+            all_labels.append(jnp.full_like(idx, c))
+            all_boxes.append(boxes[idx])
+        scores = jnp.concatenate(all_scores)
+        labels = jnp.concatenate(all_labels)
+        bxs = jnp.concatenate(all_boxes, axis=0)
+        k = min(keep_top_k, scores.shape[0])
+        top, ti = jax.lax.top_k(scores, k)
+        det = jnp.concatenate(
+            [
+                jnp.where(top > 0, labels[ti], -1).astype(jnp.float32)[:, None],
+                jnp.maximum(top, 0.0)[:, None],
+                bxs[ti] * (top > 0)[:, None],
+            ],
+            axis=-1,
+        )  # [k, 6]
+        if k < keep_top_k:
+            pad = jnp.zeros((keep_top_k - k, 6), det.dtype).at[:, 0].set(-1.0)
+            det = jnp.concatenate([det, pad], axis=0)
+        return det
+
+    return SeqTensor(jax.vmap(per_image)(locs, confs))

@@ -1,0 +1,199 @@
+"""SSD detection ops: prior boxes, IoU matching, box codec, NMS.
+
+Reference behavior: paddle/gserver/layers/PriorBox.cpp,
+MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp and DetectionUtil.cpp
+(encodeBBox/decodeBBox/matchBBox/applyNMSFast).
+
+TPU-native design: everything is static-shape.  Ground truth arrives as a
+padded [G, 4] block with a validity mask instead of the reference's
+variable-length CSR label argument; NMS runs as a fixed-length lax.scan
+(max_out iterations of select-and-suppress) instead of data-dependent list
+manipulation; matching is one [P, G] IoU matrix plus argmax/scatter instead
+of per-box loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# priors
+# ---------------------------------------------------------------------------
+
+
+def make_priors(
+    h: int,
+    w: int,
+    min_sizes: Sequence[float],
+    max_sizes: Sequence[float],
+    aspect_ratios: Sequence[float],
+    img_h: int,
+    img_w: int,
+    clip: bool = True,
+) -> np.ndarray:
+    """[P, 4] corner-form (xmin,ymin,xmax,ymax) normalized priors for an
+    h×w feature map over an img_h×img_w image; cell-major (row-major cells,
+    prior variants fastest) to match NHWC conv predictions.  Per-cell order
+    mirrors PriorBox.cpp: min box, sqrt(min*max) box, then r and 1/r boxes
+    per aspect ratio."""
+    step_x, step_y = img_w / w, img_h / h
+    variants: List[Tuple[float, float]] = []  # (bw, bh) in pixels
+    for k, s in enumerate(min_sizes):
+        variants.append((s, s))
+        if k < len(max_sizes):
+            m = math.sqrt(s * max_sizes[k])
+            variants.append((m, m))
+        for r in aspect_ratios:
+            if abs(r - 1.0) < 1e-6:
+                continue
+            sr = math.sqrt(r)
+            variants.append((s * sr, s / sr))
+            variants.append((s / sr, s * sr))
+    out = np.zeros((h, w, len(variants), 4), np.float32)
+    for i in range(h):
+        cy = (i + 0.5) * step_y
+        for j in range(w):
+            cx = (j + 0.5) * step_x
+            for k, (bw, bh) in enumerate(variants):
+                out[i, j, k] = [
+                    (cx - bw / 2) / img_w,
+                    (cy - bh / 2) / img_h,
+                    (cx + bw / 2) / img_w,
+                    (cy + bh / 2) / img_h,
+                ]
+    out = out.reshape(-1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return out
+
+
+def priors_per_cell(n_min: int, n_max: int, aspect_ratios: Sequence[float]) -> int:
+    n_ar = sum(1 for r in aspect_ratios if abs(r - 1.0) >= 1e-6)
+    return n_min * (1 + 2 * n_ar) + n_max
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+
+
+def box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0.0
+    )
+
+
+def iou_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[N, 4] × [M, 4] corner-form → [N, M] IoU."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def _center_form(b):
+    wh = b[..., 2:] - b[..., :2]
+    c = (b[..., 2:] + b[..., :2]) * 0.5
+    return c, jnp.maximum(wh, 1e-8)
+
+
+def encode_boxes(gt: jnp.ndarray, priors: jnp.ndarray, variances) -> jnp.ndarray:
+    """SSD codec (DetectionUtil.cpp encodeBBox): center/size offsets scaled
+    by the 4 variances.  gt/priors [..., 4] corner form."""
+    v = jnp.asarray(variances, jnp.float32)
+    gc, gwh = _center_form(gt)
+    pc, pwh = _center_form(priors)
+    d_c = (gc - pc) / pwh / v[:2]
+    d_wh = jnp.log(gwh / pwh) / v[2:]
+    return jnp.concatenate([d_c, d_wh], axis=-1)
+
+
+def decode_boxes(loc: jnp.ndarray, priors: jnp.ndarray, variances) -> jnp.ndarray:
+    v = jnp.asarray(variances, jnp.float32)
+    pc, pwh = _center_form(priors)
+    c = loc[..., :2] * v[:2] * pwh + pc
+    wh = jnp.exp(loc[..., 2:] * v[2:]) * pwh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# matching (MultiBoxLossLayer::forward matching phase / matchBBox)
+# ---------------------------------------------------------------------------
+
+
+def match_priors(
+    priors: jnp.ndarray,  # [P, 4]
+    gt: jnp.ndarray,  # [G, 4]
+    gt_valid: jnp.ndarray,  # [G] bool
+    overlap_threshold: float,
+):
+    """Returns (matched_gt [P] int32, pos_mask [P] bool, max_iou [P]).
+
+    Per-prior: best gt with IoU > threshold.  Bipartite pass: every valid gt
+    claims its single best prior regardless of threshold (so no gt goes
+    unmatched — DetectionUtil matchBBox does the same two phases)."""
+    iou = iou_matrix(priors, gt) * gt_valid[None, :].astype(jnp.float32)
+    max_iou = jnp.max(iou, axis=1)
+    matched = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    pos = max_iou > overlap_threshold
+    # bipartite: gt g's best prior -> forced match.  Invalid gts scatter to
+    # an out-of-bounds index that mode='drop' discards — a plain masked
+    # scatter would let an invalid gt that ties on the same prior clobber a
+    # valid gt's claim (duplicate-index write order is unspecified).
+    best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
+    g_idx = jnp.arange(gt.shape[0], dtype=jnp.int32)
+    safe = jnp.where(gt_valid, best_prior, priors.shape[0])
+    matched = matched.at[safe].set(g_idx, mode="drop")
+    pos = pos.at[safe].set(True, mode="drop")
+    return matched, pos, max_iou
+
+
+def smooth_l1(x: jnp.ndarray) -> jnp.ndarray:
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+def hard_negative_ranks(neg_score: jnp.ndarray, neg_mask: jnp.ndarray) -> jnp.ndarray:
+    """[P] rank of each negative prior by descending score (invalid -> P);
+    keep the top floor(neg_pos_ratio*npos) by comparing rank < n_neg."""
+    masked = jnp.where(neg_mask, neg_score, -jnp.inf)
+    order = jnp.argsort(-masked)  # best negatives first
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    return jnp.where(neg_mask, ranks, neg_score.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# NMS (DetectionUtil applyNMSFast) — fixed-iteration select-and-suppress
+# ---------------------------------------------------------------------------
+
+
+def nms(
+    boxes: jnp.ndarray,  # [N, 4]
+    scores: jnp.ndarray,  # [N]
+    iou_threshold: float,
+    max_out: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS: returns (idx [max_out] int32, keep_scores [max_out]);
+    unused slots have score 0 and idx 0.  A lax.scan of max_out
+    select-argmax-then-suppress steps — static shape, no host loop."""
+
+    def body(state, _):
+        live = state
+        i = jnp.argmax(live)
+        s = live[i]
+        overlapping = iou_matrix(boxes[i][None, :], boxes)[0] > iou_threshold
+        live = jnp.where(overlapping, -jnp.inf, live)
+        live = live.at[i].set(-jnp.inf)
+        return live, (i.astype(jnp.int32), s)
+
+    _, (idx, kept) = jax.lax.scan(body, scores, None, length=max_out)
+    valid = kept > -jnp.inf
+    return jnp.where(valid, idx, 0), jnp.where(valid, kept, 0.0)

@@ -69,6 +69,20 @@ class SGD:
             self.parameters = parameters
         else:
             self.network = CompiledNetwork(self.topology)
+            if parameters is not None:
+                # Same cost graph extended with evaluators/extra layers is
+                # fine (the extras are param-free); parameters built for a
+                # DIFFERENT network are not — catch it here instead of a
+                # shape/KeyError mid-step.
+                stale = [
+                    n for n in parameters.params if n not in self.topology.layers
+                ]
+                if stale:
+                    raise ValueError(
+                        f"parameters were created for a different topology: "
+                        f"param layers {stale} do not exist in this trainer's "
+                        f"network"
+                    )
             self.parameters = parameters or create_from_network(self.network, seed)
         assert update_equation is not None, "update_equation (an Optimizer) is required"
         self.optimizer = update_equation

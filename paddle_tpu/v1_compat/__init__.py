@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import importlib
+import importlib.util
 import os
 import sys
 import types
@@ -92,9 +93,21 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     ds = parsed.data_sources
     if ds is None or not ds.module:
         return
-    sys.path.insert(0, config_dir)
+    # Load by file path under a config-dir-unique module name: different
+    # demo dirs reuse the same provider module name (e.g. "dataprovider"),
+    # and importlib.import_module would hand the second config the first
+    # one's cached module — wrong input types, silently.
+    mod_path = os.path.join(config_dir, ds.module + ".py")
+    sys.path.insert(0, config_dir)  # provider's own sibling imports
     try:
-        mod = importlib.import_module(ds.module)
+        if os.path.exists(mod_path):
+            uniq = f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}_{ds.module}"
+            spec = importlib.util.spec_from_file_location(uniq, mod_path)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[uniq] = mod
+            spec.loader.exec_module(mod)
+        else:
+            mod = importlib.import_module(ds.module)
     except ImportError:
         return
     finally:

@@ -290,5 +290,6 @@ precision_recall_evaluator = _recording_evaluator(_ev.precision_recall_evaluator
 pnpair_evaluator = _recording_evaluator(_ev.pnpair_evaluator)
 ctc_error_evaluator = _recording_evaluator(_ev.ctc_error_evaluator)
 chunk_evaluator = _recording_evaluator(_ev.chunk_evaluator)
+detection_map_evaluator = _recording_evaluator(_ev.detection_map_evaluator)
 value_printer_evaluator = _recording_evaluator(_ev.value_printer_evaluator)
 maxid_printer_evaluator = _recording_evaluator(_ev.maxid_printer_evaluator)

@@ -1,0 +1,316 @@
+"""SSD detection suite: priorbox / multibox_loss / detection_output layers
+and the detection_map evaluator (reference gserver/layers/PriorBox.cpp,
+MultiBoxLossLayer.cpp, DetectionOutputLayer.cpp, DetectionUtil.cpp,
+evaluators/DetectionMAPEvaluator.cpp:306)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layers
+from paddle_tpu.core.batch import SeqTensor, seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.ops import detection as D
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+
+def test_make_priors_geometry():
+    # 2x2 feature map over a 100x100 image, one min_size, ratio-1 only
+    pri = D.make_priors(2, 2, [20.0], [], [1.0], 100, 100)
+    assert pri.shape == (4, 4)
+    # first cell center (25, 25), box 20x20 normalized
+    np.testing.assert_allclose(pri[0], [0.15, 0.15, 0.35, 0.35], atol=1e-6)
+    # last cell center (75, 75)
+    np.testing.assert_allclose(pri[3], [0.65, 0.65, 0.85, 0.85], atol=1e-6)
+
+
+def test_make_priors_variants_count():
+    pri = D.make_priors(3, 3, [20.0], [40.0], [1.0, 2.0], 90, 90)
+    # per cell: min + sqrt(min*max) + (2, 1/2) = 4
+    assert D.priors_per_cell(1, 1, [1.0, 2.0]) == 4
+    assert pri.shape == (3 * 3 * 4, 4)
+    # aspect-2 box: w = 20*sqrt(2), h = 20/sqrt(2) around center (15,15)
+    w, h = 20 * np.sqrt(2), 20 / np.sqrt(2)
+    np.testing.assert_allclose(
+        pri[2],
+        [(15 - w / 2) / 90, (15 - h / 2) / 90, (15 + w / 2) / 90, (15 + h / 2) / 90],
+        atol=1e-6,
+    )
+
+
+def test_iou_matrix():
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.0, 0.0, 0.5, 0.5]])
+    b = jnp.asarray([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.0, 1.0]])
+    got = np.asarray(D.iou_matrix(a, b))
+    np.testing.assert_allclose(got, [[1.0, 0.25], [0.25, 0.0]], atol=1e-6)
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = jnp.asarray(rng.uniform(0.1, 0.6, size=(7, 2)).repeat(2, 1))
+    priors = priors.at[:, 2:].add(0.3)
+    gt = jnp.asarray([[0.2, 0.2, 0.7, 0.8]] * 7)
+    var = (0.1, 0.1, 0.2, 0.2)
+    enc = D.encode_boxes(gt, priors, var)
+    dec = D.decode_boxes(enc, priors, var)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-5)
+
+
+def test_match_priors_bipartite():
+    priors = jnp.asarray([
+        [0.0, 0.0, 0.1, 0.1],   # far from gt, low IoU
+        [0.2, 0.2, 0.6, 0.6],   # good match for gt0
+        [0.65, 0.65, 0.95, 0.95],  # good match for gt1
+    ])
+    gt = jnp.asarray([[0.25, 0.25, 0.6, 0.6], [0.7, 0.7, 0.9, 0.9], [0.0, 0.0, 0.0, 0.0]])
+    valid = jnp.asarray([True, True, False])
+    matched, pos, _ = D.match_priors(priors, gt, valid, 0.5)
+    assert bool(pos[1]) and int(matched[1]) == 0
+    assert bool(pos[2]) and int(matched[2]) == 1
+    assert not bool(pos[0])
+    # bipartite: even with an impossible threshold every valid gt is claimed
+    matched2, pos2, _ = D.match_priors(priors, gt, valid, 0.99)
+    assert int(jnp.sum(pos2)) == 2
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.asarray([
+        [0.0, 0.0, 0.5, 0.5],
+        [0.02, 0.02, 0.52, 0.52],  # heavy overlap with #0
+        [0.6, 0.6, 0.9, 0.9],
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    idx, kept = D.nms(boxes, scores, 0.5, 3)
+    got = [(int(i), round(float(s), 3)) for i, s in zip(idx, kept) if s > 0]
+    assert got == [(0, 0.9), (2, 0.7)]
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+
+N_CLS = 3  # background + 2 object classes
+
+
+def _ssd_net(img_hw=8, cell=4):
+    """Tiny SSD: image -> conv feature map -> loc/conf heads + priorbox."""
+    k = D.priors_per_cell(1, 0, [1.0])  # 1 prior per cell
+    img = layers.data(
+        "image",
+        paddle.data_type.dense_vector(3 * img_hw * img_hw),
+        height=img_hw,
+        width=img_hw,
+    )
+    gt = layers.data("gt", paddle.data_type.dense_vector_sequence(6))
+    feat = layers.img_conv(
+        img, filter_size=3, num_filters=8, stride=img_hw // cell, padding=1,
+        act=paddle.activation.Relu(), name="feat",
+    )
+    loc = layers.img_conv(
+        feat, filter_size=3, num_filters=k * 4, padding=1,
+        act=paddle.activation.Identity(), name="loc",
+    )
+    cnf = layers.img_conv(
+        feat, filter_size=3, num_filters=k * N_CLS, padding=1,
+        act=paddle.activation.Identity(), name="cnf",
+    )
+    pb = layers.priorbox(
+        feat, img, aspect_ratio=[1.0], variance=[0.1, 0.1, 0.2, 0.2],
+        min_size=[3.0], name="pb",
+    )
+    cost = layers.multibox_loss(
+        input_loc=loc, input_conf=cnf, priorbox=pb, label=gt,
+        num_classes=N_CLS, name="mbl",
+    )
+    det = layers.detection_output(
+        input_loc=loc, input_conf=cnf, priorbox=pb, num_classes=N_CLS,
+        keep_top_k=8, nms_top_k=8, confidence_threshold=0.3, name="det",
+    )
+    return img, gt, cost, det
+
+
+def _gt_batch(boxes_per_img):
+    """list of [ (label,x1,y1,x2,y2,difficult) ] per image -> SeqTensor."""
+    b = len(boxes_per_img)
+    g = max(len(x) for x in boxes_per_img)
+    arr = np.zeros((b, g, 6), np.float32)
+    lens = np.zeros((b,), np.int32)
+    for i, rows in enumerate(boxes_per_img):
+        lens[i] = len(rows)
+        for j, r in enumerate(rows):
+            arr[i, j] = r
+    return seq(arr, lens)
+
+
+def test_multibox_loss_runs_and_matches():
+    reset_auto_names()
+    img, gt, cost, det = _ssd_net()
+    net = CompiledNetwork(Topology([cost, det]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": SeqTensor(jnp.asarray(rng.rand(2, 3 * 8 * 8), jnp.float32)),
+        "gt": _gt_batch([
+            [(1, 0.1, 0.1, 0.4, 0.4, 0)],
+            [(2, 0.5, 0.5, 0.9, 0.9, 0), (1, 0.0, 0.0, 0.3, 0.3, 0)],
+        ]),
+    }
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    loss = np.asarray(outs["mbl"].data)
+    assert loss.shape == (2, 1) and np.isfinite(loss).all() and (loss > 0).all()
+    dets = np.asarray(outs["det"].data)
+    assert dets.shape == (2, 8, 6)
+
+
+def test_ssd_trains():
+    """Loss decreases on a fixed single-box task."""
+    reset_auto_names()
+    img, gt, cost, det = _ssd_net()
+    net = CompiledNetwork(Topology([cost]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    image = jnp.asarray(rng.rand(4, 3 * 8 * 8), jnp.float32)
+    batch = {
+        "image": SeqTensor(image),
+        "gt": _gt_batch([[(1, 0.05, 0.05, 0.45, 0.45, 0)]] * 4),
+    }
+    import optax  # baked-in; fine for a test-only loop
+
+    opt = optax.adam(1e-2)
+
+    def loss_fn(p):
+        outs, _ = net.apply(p, batch, state=state, train=False)
+        return jnp.mean(outs[cost.name].data)
+
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, os):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        up, os = opt.update(g, os)
+        return optax.apply_updates(p, up), os, l
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, l = step(params, opt_state)
+        losses.append(float(l))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_detection_output_decodes_known_boxes():
+    """Bypass the network: feed loc preds that decode exactly onto a known
+    box and conf preds that put class 1 on one prior."""
+    reset_auto_names()
+    k = 1
+    h = w = 2
+    priors = D.make_priors(h, w, [40.0], [], [1.0], 100, 100)
+    var = (0.1, 0.1, 0.2, 0.2)
+    target = np.array([0.1, 0.1, 0.45, 0.45], np.float32)
+    enc = np.asarray(D.encode_boxes(jnp.asarray(target), jnp.asarray(priors[0]), var))
+    loc = np.zeros((1, h, w, 4), np.float32)
+    loc[0, 0, 0] = enc
+    cnf = np.full((1, h, w, N_CLS), -5.0, np.float32)
+    cnf[0, 0, 0, 1] = 5.0  # prior 0 -> class 1
+
+    img = layers.data(
+        "image", paddle.data_type.dense_vector(3 * 100 * 100), height=100, width=100
+    )
+    locd = layers.data("locd", paddle.data_type.dense_vector(h * w * 4))
+    locd.conf.attrs.update(out_h=h, out_w=w, channels=4)
+    cnfd = layers.data("cnfd", paddle.data_type.dense_vector(h * w * N_CLS))
+    cnfd.conf.attrs.update(out_h=h, out_w=w, channels=N_CLS)
+    feat = layers.data("feat", paddle.data_type.dense_vector(h * w))
+    feat.conf.attrs.update(out_h=h, out_w=w, channels=1)
+    pb = layers.priorbox(
+        feat, img, aspect_ratio=[1.0], variance=list(var), min_size=[40.0]
+    )
+    det = layers.detection_output(
+        input_loc=locd, input_conf=cnfd, priorbox=pb, num_classes=N_CLS,
+        keep_top_k=4, nms_top_k=4, confidence_threshold=0.5, name="det",
+    )
+    net = CompiledNetwork(Topology([det]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    outs, _ = net.apply(
+        params,
+        {
+            "image": SeqTensor(jnp.zeros((1, 3 * 100 * 100))),
+            "locd": SeqTensor(jnp.asarray(loc)),
+            "cnfd": SeqTensor(jnp.asarray(cnf)),
+            "feat": SeqTensor(jnp.zeros((1, h, w, 1))),
+        },
+        state=state,
+        train=False,
+    )
+    d = np.asarray(outs["det"].data)[0]
+    live = d[d[:, 0] >= 0]
+    assert live.shape[0] == 1
+    assert int(live[0, 0]) == 1  # class
+    assert live[0, 1] > 0.9  # confidence
+    np.testing.assert_allclose(live[0, 2:6], target, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# detection_map evaluator
+# ---------------------------------------------------------------------------
+
+
+def _map_of(dets, gts, ap_type="11point"):
+    """dets [B,K,6] (label,score,x1,y1,x2,y2); gts list-of-lists."""
+    from paddle_tpu.evaluator import detection_map_evaluator
+
+    reset_auto_names()
+    det_l = layers.data("det", paddle.data_type.dense_vector(6))
+    gt_l = layers.data("gtv", paddle.data_type.dense_vector_sequence(6))
+    ev = detection_map_evaluator(
+        det_l, gt_l, num_classes=N_CLS, ap_type=ap_type, name="map"
+    )
+    acc = ev.update({
+        "det": SeqTensor(jnp.asarray(dets, jnp.float32)),
+        "gtv": _gt_batch(gts),
+    })
+    return ev.finalize({k: np.asarray(v) for k, v in acc.items()})["map"]
+
+
+def test_detection_map_perfect():
+    dets = np.zeros((1, 2, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    dets[0, 1] = [2, 0.8, 0.5, 0.5, 0.9, 0.9]
+    gts = [[(1, 0.1, 0.1, 0.4, 0.4, 0), (2, 0.5, 0.5, 0.9, 0.9, 0)]]
+    assert _map_of(dets, gts) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_detection_map_half():
+    """Class 1: one TP at score .9 and one FP at .8 over one gt -> AP = 1.0
+    (11point: precision at the single recall point is 1.0 before the FP).
+    Class 2: pure miss -> AP 0.  mAP = 0.5."""
+    dets = np.zeros((1, 2, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]       # TP
+    dets[0, 1] = [2, 0.8, 0.0, 0.0, 0.05, 0.05]     # FP (gt 2 elsewhere)
+    gts = [[(1, 0.1, 0.1, 0.4, 0.4, 0), (2, 0.5, 0.5, 0.9, 0.9, 0)]]
+    assert _map_of(dets, gts) == pytest.approx(0.5, abs=1e-3)
+
+
+def test_detection_map_duplicate_detection_is_fp():
+    """Two detections on the same gt: second is FP (gt used once)."""
+    dets = np.zeros((1, 2, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]
+    dets[0, 1] = [1, 0.8, 0.11, 0.11, 0.41, 0.41]
+    gts = [[(1, 0.1, 0.1, 0.4, 0.4, 0)]]
+    # integral AP: recall jumps to 1 at precision 1, then FP doesn't add area
+    assert _map_of(dets, gts, ap_type="Integral") == pytest.approx(1.0, abs=1e-2)
+
+
+def test_detection_map_difficult_ignored():
+    dets = np.zeros((1, 1, 6), np.float32)
+    dets[0, 0] = [1, 0.9, 0.1, 0.1, 0.4, 0.4]  # matches a difficult gt
+    gts = [[(1, 0.1, 0.1, 0.4, 0.4, 1), (1, 0.6, 0.6, 0.9, 0.9, 0)]]
+    # difficult gt not counted; its detection neither TP nor FP; the one
+    # counted gt is missed -> AP 0
+    assert _map_of(dets, gts) == pytest.approx(0.0, abs=1e-3)
