@@ -139,7 +139,21 @@ class CompiledNetwork:
                 else:
                     p = _cast_floats(p, self.compute_dtype)
                     ins = [_cast_floats(x, self.compute_dtype) for x in ins]
-            out = impl.apply(conf, p, ins, ctx)
+            # named_scope labels this layer's ops in profiler traces; the
+            # except-note is the CustomStackTrace equivalent (reference
+            # utils/CustomStackTrace.h:51 pushes layer names so a fatal
+            # error reports which layer it happened in).
+            try:
+                with jax.named_scope(f"{conf.type}:{name}"):
+                    out = impl.apply(conf, p, ins, ctx)
+            except Exception as e:
+                shapes = [getattr(t.data, "shape", None) for t in ins]
+                e.add_note(
+                    f"while applying layer {name!r} (type={conf.type}, "
+                    f"size={conf.size}, inputs={list(conf.inputs)} with "
+                    f"shapes {shapes})"
+                )
+                raise
             if mixed and not impl.full_precision:
                 # Enforce the compute dtype at every layer boundary —
                 # f32 constants/masks inside an impl would otherwise promote

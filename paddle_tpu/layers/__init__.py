@@ -1686,8 +1686,12 @@ def priorbox(
     w = fa.get("out_w") or fa.get("in_w")
     assert h and w, f"priorbox input {input.name} has no image geometry attrs"
     ia = image.conf.attrs
-    img_h = ia.get("in_h") or ia.get("out_h") or h
-    img_w = ia.get("in_w") or ia.get("out_w") or w
+    img_h = ia.get("in_h") or ia.get("out_h")
+    img_w = ia.get("in_w") or ia.get("out_w")
+    assert img_h and img_w, (
+        f"priorbox image {image.name} has no geometry — declare the data "
+        f"layer with height=/width= (min_size is in image pixels)"
+    )
     priors = make_priors(
         int(h), int(w), list(min_size), list(max_size), list(aspect_ratio),
         int(img_h), int(img_w),

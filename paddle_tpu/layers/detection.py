@@ -27,7 +27,7 @@ from paddle_tpu.ops import detection as D
 # ---------------------------------------------------------------------------
 
 
-@register_layer("priorbox", auto_activation=False)
+@register_layer("priorbox", auto_activation=False, full_precision=True)
 def priorbox_apply(conf, params, inputs, ctx):
     """Output [B, P, 8]: corner-form normalized prior + its 4 variances
     (reference packs the same 2×P*4)."""

@@ -137,22 +137,33 @@ def match_priors(
 ):
     """Returns (matched_gt [P] int32, pos_mask [P] bool, max_iou [P]).
 
-    Per-prior: best gt with IoU > threshold.  Bipartite pass: every valid gt
-    claims its single best prior regardless of threshold (so no gt goes
-    unmatched — DetectionUtil matchBBox does the same two phases)."""
+    Per-prior: best gt with IoU > threshold.  Bipartite pass: valid gts
+    claim distinct priors by globally-best IoU regardless of threshold (so
+    no gt goes unmatched — DetectionUtil matchBBox does the same two
+    phases, excluding already-claimed priors and gts each round)."""
+    g = gt.shape[0]
     iou = iou_matrix(priors, gt) * gt_valid[None, :].astype(jnp.float32)
     max_iou = jnp.max(iou, axis=1)
     matched = jnp.argmax(iou, axis=1).astype(jnp.int32)
     pos = max_iou > overlap_threshold
-    # bipartite: gt g's best prior -> forced match.  Invalid gts scatter to
-    # an out-of-bounds index that mode='drop' discards — a plain masked
-    # scatter would let an invalid gt that ties on the same prior clobber a
-    # valid gt's claim (duplicate-index write order is unspecified).
-    best_prior = jnp.argmax(iou, axis=0).astype(jnp.int32)  # [G]
-    g_idx = jnp.arange(gt.shape[0], dtype=jnp.int32)
-    safe = jnp.where(gt_valid, best_prior, priors.shape[0])
-    matched = matched.at[safe].set(g_idx, mode="drop")
-    pos = pos.at[safe].set(True, mode="drop")
+    # Bipartite phase (reference matchBBox): iteratively claim the globally
+    # best remaining (prior, gt) pair, excluding claimed priors AND gts, so
+    # every valid gt gets its own prior even when two gts share a best
+    # prior.  G iterations of a masked global argmax — static shape.
+
+    def body(carry, _):
+        live, m, p_ = carry
+        flat = jnp.argmax(live)
+        pi, gi = flat // g, flat % g
+        ok = live.reshape(-1)[flat] > 0.0
+        m = jnp.where(ok, m.at[pi].set(gi.astype(jnp.int32)), m)
+        p_ = jnp.where(ok, p_.at[pi].set(True), p_)
+        live = jnp.where(ok, live.at[pi, :].set(-1.0).at[:, gi].set(-1.0), live)
+        return (live, m, p_), None
+
+    (_, matched, pos), _ = jax.lax.scan(
+        body, (iou, matched, pos), None, length=g
+    )
     return matched, pos, max_iou
 
 

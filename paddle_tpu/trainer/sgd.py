@@ -10,6 +10,7 @@ but there is nothing remote to talk to.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -26,6 +27,8 @@ from paddle_tpu.parallel.mesh import get_default_mesh, shard_batch
 from paddle_tpu.reader.feeder import DataFeeder
 from paddle_tpu.trainer.evaluators import default_metrics_fn
 from paddle_tpu.trainer.step import make_eval_step, make_train_step
+
+_log = logging.getLogger("paddle_tpu.trainer")
 from paddle_tpu.utils.timers import stat_timer
 
 
@@ -156,6 +159,7 @@ class SGD:
         saving_period: int = 1,
         saving_period_by_batches: Optional[int] = None,
         start_pass: int = 0,
+        show_parameter_stats_period: int = 0,
     ) -> None:
         """Pass loop with the reference trainer's checkpoint cadence: every
         `saving_period` passes (and optionally every `saving_period_by_batches`
@@ -182,6 +186,21 @@ class SGD:
                         params, state, opt_state, batch, step_rng
                     )
                 self._step_count += 1
+                if (
+                    show_parameter_stats_period
+                    and self._step_count % show_parameter_stats_period == 0
+                ):
+                    # reference TrainerInternal.cpp:83-110 per-param stats log
+                    from paddle_tpu.utils.debug import (
+                        format_parameter_stats,
+                        parameter_stats,
+                    )
+
+                    _log.info(
+                        "parameter stats @ step %d:\n%s",
+                        self._step_count,
+                        format_parameter_stats(parameter_stats(params)),
+                    )
                 cost = float(metrics["cost"])
                 pass_costs.append(cost)
                 evaluator, accums = self._split_metrics(metrics)

@@ -1,0 +1,117 @@
+"""Diagnostics: per-layer timing and parameter statistics.
+
+Reference counterparts: the per-layer REGISTER_TIMER_INFO forward/backward
+timers of NeuralNetwork.cpp:247,288 and the show_parameter_stats_period
+logging of TrainerInternal.cpp:83-110.
+
+Under XLA the jitted step is ONE fused computation, so per-layer wall time
+cannot be observed from inside it.  Two complements:
+
+  * every layer traces under ``jax.named_scope("type:name")``
+    (core/compiler.py), so ``jax.profiler.trace`` timelines attribute fused
+    ops back to layers;
+  * :func:`profile_layers` runs the graph layer-at-a-time eagerly with a
+    device sync per layer — the debug-mode equivalent of the reference's
+    per-layer timers (numbers include dispatch overhead; use for relative
+    cost, the profiler for truth).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def profile_layers(
+    network,
+    params,
+    batch,
+    state=None,
+    train: bool = False,
+    rng=None,
+    repeats: int = 3,
+) -> List[Tuple[str, str, float]]:
+    """[(layer_name, type, best_ms)] forward cost per layer, eager with a
+    sync per layer (reference FwdTimer per layer)."""
+    from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
+    from paddle_tpu.layers.base import ApplyContext
+
+    topo = network.topology
+    results: List[Tuple[str, str, float]] = []
+    outs_cache: Dict[str, object] = {}
+
+    # run once through apply() to obtain every layer's output for reuse as
+    # the timed layer's inputs (so each layer is timed in isolation)
+    outs, _ = network.apply(params, batch, state=state, train=train, rng=rng)
+
+    for name in topo.order:
+        conf = topo.layers[name]
+        impl = network._impls[name]
+        if conf.type in ("data", "step_input", "memory"):
+            continue
+        ins = [outs[i] for i in conf.inputs]
+        p = params.get(name, {})
+
+        def run_once():
+            ctx = ApplyContext(
+                train=train, rng=rng, state=state or {}, dtype=network.compute_dtype
+            )
+            ctx.outputs.update(outs)
+            out = impl.apply(conf, p, ins, ctx)
+            jax.block_until_ready(out.data)
+            return out
+
+        run_once()  # compile/warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run_once()
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        results.append((name, conf.type, best))
+    return results
+
+
+def format_layer_profile(rows: List[Tuple[str, str, float]]) -> str:
+    total = sum(r[2] for r in rows)
+    lines = [f"{'layer':<32} {'type':<20} {'ms':>9} {'%':>6}"]
+    for name, typ, ms in sorted(rows, key=lambda r: -r[2]):
+        lines.append(f"{name:<32} {typ:<20} {ms:9.3f} {100 * ms / max(total, 1e-9):6.1f}")
+    lines.append(f"{'TOTAL':<32} {'':<20} {total:9.3f}")
+    return "\n".join(lines)
+
+
+def parameter_stats(params) -> Dict[str, Dict[str, float]]:
+    """{dotted_name: {min,max,avg,abs_avg,size}} — the
+    show_parameter_stats_period payload (TrainerInternal.cpp:83-110)."""
+    out: Dict[str, Dict[str, float]] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            a = np.asarray(node, dtype=np.float64)
+            out[prefix] = {
+                "min": float(a.min()) if a.size else 0.0,
+                "max": float(a.max()) if a.size else 0.0,
+                "avg": float(a.mean()) if a.size else 0.0,
+                "abs_avg": float(np.abs(a).mean()) if a.size else 0.0,
+                "size": int(a.size),
+            }
+
+    walk("", params)
+    return out
+
+
+def format_parameter_stats(stats: Dict[str, Dict[str, float]]) -> str:
+    lines = [f"{'parameter':<40} {'size':>9} {'avg':>11} {'abs_avg':>11} {'min':>11} {'max':>11}"]
+    for name in sorted(stats):
+        s = stats[name]
+        lines.append(
+            f"{name:<40} {s['size']:>9} {s['avg']:>11.4g} {s['abs_avg']:>11.4g} "
+            f"{s['min']:>11.4g} {s['max']:>11.4g}"
+        )
+    return "\n".join(lines)

@@ -314,3 +314,19 @@ def test_detection_map_difficult_ignored():
     # difficult gt not counted; its detection neither TP nor FP; the one
     # counted gt is missed -> AP 0
     assert _map_of(dets, gts) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_match_priors_two_gts_share_best_prior():
+    """Two valid gts whose best prior coincides must still BOTH match
+    (exclusive bipartite — reference matchBBox claims distinct priors)."""
+    priors = jnp.asarray([
+        [0.0, 0.0, 0.4, 0.4],
+        [0.1, 0.1, 0.5, 0.5],
+        [0.6, 0.6, 0.9, 0.9],
+    ])
+    # both gts overlap prior 1 best, prior 0 second-best
+    gt = jnp.asarray([[0.1, 0.1, 0.5, 0.5], [0.12, 0.12, 0.48, 0.48]])
+    valid = jnp.asarray([True, True])
+    matched, pos, _ = D.match_priors(priors, gt, valid, 0.99)
+    claimed = {int(matched[i]) for i in range(3) if bool(pos[i])}
+    assert claimed == {0, 1}  # each gt holds its own prior

@@ -1,0 +1,89 @@
+"""Diagnostics: layer-name error context (CustomStackTrace.h:51 equivalent),
+per-layer profiling (NeuralNetwork.cpp:247 per-layer timers), parameter
+stats (TrainerInternal.cpp:83-110 show_parameter_stats_period)."""
+
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layers
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.utils.debug import (
+    format_layer_profile,
+    format_parameter_stats,
+    parameter_stats,
+    profile_layers,
+)
+
+
+def _net():
+    x = layers.data("x", paddle.data_type.dense_vector(4))
+    h = layers.fc(x, size=8, act=paddle.activation.Tanh(), name="hidden")
+    return x, layers.fc(h, size=3, act=paddle.activation.Softmax(), name="out")
+
+
+def test_layer_error_carries_name_and_type():
+    reset_auto_names()
+    _, out = _net()
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    bad = {"x": SeqTensor(np.zeros((2, 7), np.float32))}  # wrong width
+    with pytest.raises(Exception) as ei:
+        net.apply(params, bad, state=state)
+    notes = "\n".join(getattr(ei.value, "__notes__", []))
+    assert "hidden" in notes and "type=fc" in notes, notes
+
+
+def test_profile_layers_reports_every_layer():
+    reset_auto_names()
+    _, out = _net()
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {"x": SeqTensor(np.random.rand(4, 4).astype(np.float32))}
+    rows = profile_layers(net, params, batch, state=state)
+    names = [r[0] for r in rows]
+    assert names == ["hidden", "out"]
+    assert all(ms >= 0 for _, _, ms in rows)
+    text = format_layer_profile(rows)
+    assert "TOTAL" in text and "hidden" in text
+
+
+def test_parameter_stats_values():
+    params = {"fc": {"w": np.asarray([[1.0, -3.0], [2.0, 0.0]]), "b": np.zeros(2)}}
+    stats = parameter_stats(params)
+    assert stats["fc.w"]["min"] == -3.0 and stats["fc.w"]["max"] == 2.0
+    assert stats["fc.w"]["avg"] == pytest.approx(0.0)
+    assert stats["fc.w"]["abs_avg"] == pytest.approx(1.5)
+    assert stats["fc.b"]["size"] == 2
+    assert "fc.w" in format_parameter_stats(stats)
+
+
+def test_show_parameter_stats_period_logs(caplog):
+    reset_auto_names()
+    x, out = _net()
+    y = layers.data("y", paddle.data_type.integer_value(3))
+    cost = layers.classification_cost(input=out, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+    )
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(12):
+            yield rng.rand(4).astype(np.float32), rng.randint(3)
+
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.trainer"):
+        trainer.train(
+            reader=paddle.batch(reader, 4),
+            num_passes=1,
+            show_parameter_stats_period=2,
+        )
+    text = caplog.text
+    assert "parameter stats" in text and "hidden.w0" in text
