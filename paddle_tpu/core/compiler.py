@@ -70,11 +70,27 @@ class CompiledNetwork:
             name: get_layer_impl(conf.type)
             for name, conf in topology.layers.items()
         }
+        # Cross-layer parameter sharing by ParamAttr name (the reference's
+        # global parameter table: two layers declaring the same parameter
+        # name share storage — e.g. crf + crf_decoding sharing "crfw",
+        # tied embeddings).  First declarer in topology order owns the
+        # params; later declarers read the owner's slot.
+        self._param_owner: Dict[str, str] = {}
+        owners: Dict[str, str] = {}
+        for name in topology.order:
+            pname = topology.layers[name].attr("param_name")
+            if pname:
+                if pname in owners:
+                    self._param_owner[name] = owners[pname]
+                else:
+                    owners[pname] = name
 
     # ------------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> Params:
         params: Params = {}
         for name in self.topology.order:
+            if name in self._param_owner:
+                continue  # shares the owner's parameters
             conf = self.topology.layers[name]
             impl = self._impls[name]
             in_confs = [self.topology.layers[i] for i in conf.inputs]
@@ -131,7 +147,7 @@ class CompiledNetwork:
                 ctx.outputs[name] = batch[name]
                 continue
             ins = [ctx.outputs[i] for i in conf.inputs]
-            p = params.get(name, {})
+            p = params.get(self._param_owner.get(name, name), {})
             pre_keys = set(ctx.outputs) if mixed else ()
             if mixed:
                 if impl.full_precision:

@@ -1,0 +1,154 @@
+"""Multi-host cluster launcher — the TPU-native replacement for the
+reference's fabric/ssh job pusher (paddle/scripts/cluster_train/paddle.py)
+and its pserver/trainer process zoo.
+
+On TPU there are no parameter-server processes to start: every host runs the
+SAME SPMD program and jax.distributed forms the global mesh over ICI/DCN.
+So the launcher's job collapses to (1) computing each worker's environment
+(coordinator address, process id/count), (2) starting one python per host —
+locally via subprocess, remotely by emitting/executing ssh commands — and
+(3) `init_cluster()` inside the training script wiring jax.distributed.
+
+Usage, in the training script::
+
+    import paddle_tpu as paddle
+    paddle.launcher.init_cluster()   # no-op single-host; env-driven multi
+
+then either run it directly (single host) or::
+
+    python -m paddle_tpu.launcher --hosts h1,h2,h3,h4 \
+        --coordinator h1:8476 train.py --args...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional, Sequence
+
+ENV_COORD = "PADDLE_TPU_COORDINATOR"
+ENV_NPROC = "PADDLE_TPU_NUM_PROCESSES"
+ENV_PROC_ID = "PADDLE_TPU_PROCESS_ID"
+
+
+def init_cluster(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the cluster (jax.distributed.initialize) if multi-process env is
+    configured; returns True when a multi-host runtime was formed.  Call
+    before any other jax use.  Single-host (no env): no-op — the reference's
+    `paddle.init(trainer_count=...)` local mode."""
+    coordinator = coordinator or os.environ.get(ENV_COORD)
+    num_processes = num_processes or int(os.environ.get(ENV_NPROC, "0") or 0)
+    if not coordinator or num_processes <= 1:
+        return False
+    process_id = (
+        process_id
+        if process_id is not None
+        else int(os.environ.get(ENV_PROC_ID, "0") or 0)
+    )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def build_worker_env(
+    coordinator: str, num_processes: int, process_id: int
+) -> Dict[str, str]:
+    """Environment fragment for one worker process."""
+    return {
+        ENV_COORD: coordinator,
+        ENV_NPROC: str(num_processes),
+        ENV_PROC_ID: str(process_id),
+    }
+
+
+def build_commands(
+    hosts: Sequence[str],
+    coordinator: str,
+    script: str,
+    script_args: Sequence[str] = (),
+    python: str = sys.executable,
+    workdir: Optional[str] = None,
+) -> List[List[str]]:
+    """One command per host: local hosts (localhost/127.0.0.1) run directly,
+    remote hosts through ssh with the env inlined — the reference pushed
+    jobs with fabric the same way (cluster_train/paddle.py job_start)."""
+    cmds: List[List[str]] = []
+    for pid, host in enumerate(hosts):
+        env = build_worker_env(coordinator, len(hosts), pid)
+        assignments = [f"{k}={v}" for k, v in env.items()]
+        base = [python, script, *script_args]
+        if host in ("localhost", "127.0.0.1"):
+            cmds.append(["env", *assignments, *base])
+        else:
+            remote = " ".join(
+                ["cd", shlex.quote(workdir or "."), "&&", "env"]
+                + assignments
+                + [shlex.quote(c) for c in base]
+            )
+            cmds.append(["ssh", host, remote])
+    return cmds
+
+
+def launch(
+    hosts: Sequence[str],
+    coordinator: str,
+    script: str,
+    script_args: Sequence[str] = (),
+    workdir: Optional[str] = None,
+) -> int:
+    """Start every worker and wait; returns the first nonzero exit code."""
+    procs = [
+        subprocess.Popen(cmd)
+        for cmd in build_commands(
+            hosts, coordinator, script, script_args, workdir=workdir
+        )
+    ]
+    rc = 0
+    for p in procs:
+        r = p.wait()
+        rc = rc or r
+    return rc
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.launcher",
+        description="Launch one SPMD training process per host.",
+    )
+    ap.add_argument("--hosts", required=True, help="comma-separated host list")
+    ap.add_argument(
+        "--coordinator",
+        required=True,
+        help="host:port of process 0's jax.distributed coordinator",
+    )
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--dry-run", action="store_true", help="print commands only")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    hosts = [h.strip() for h in args.hosts.split(",") if h.strip()]
+    if args.dry_run:
+        for cmd in build_commands(
+            hosts, args.coordinator, args.script, args.script_args, workdir=args.workdir
+        ):
+            print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    return launch(
+        hosts, args.coordinator, args.script, args.script_args, workdir=args.workdir
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -86,6 +86,12 @@ def _param_std(param_attr: Optional[ParamAttr]):
     return param_attr.initial_std if param_attr else None
 
 
+def _param_name(param_attr: Optional[ParamAttr]):
+    """Shared-parameter name (reference global parameter table: layers
+    declaring the same ParamAttr name share storage)."""
+    return param_attr.name if param_attr else None
+
+
 _IMG_ATTR_KEYS = ("out_h", "out_w", "in_h", "in_w", "in_c", "channels")
 
 
@@ -160,7 +166,7 @@ def fc(
         inputs=tuple(i.name for i in ins),
         act=act_name(act if act is not None else _act_mod.Tanh()),
         bias=bool(bias_attr),
-        attrs={"param_std": _param_std(param_attr)},
+        attrs={"param_std": _param_std(param_attr), "param_name": _param_name(param_attr)},
         drop_rate=drop,
         shard_axis=shard,
     )
@@ -186,6 +192,7 @@ def embedding(
         bias=False,
         attrs={
             "param_std": _param_std(param_attr),
+            "param_name": _param_name(param_attr),
             # sparse_update=True row-shards the table over the mesh model
             # axis (the sparse-remote-update path of the reference,
             # RemoteParameterUpdater.h:265 — see parallel/sharding.py)
@@ -1418,7 +1425,11 @@ def crf(
         size=1,
         inputs=(input.name, label.name),
         bias=False,
-        attrs={"num_classes": n, "param_std": _param_std(param_attr)},
+        attrs={
+            "num_classes": n,
+            "param_std": _param_std(param_attr),
+            "param_name": _param_name(param_attr),
+        },
     )
     return LayerOutput(conf, [input, label])
 
@@ -1444,7 +1455,9 @@ def crf_decoding(
         size=n,
         inputs=tuple(p.name for p in parents),
         bias=False,
-        attrs={"num_classes": n, "param_std": _param_std(param_attr)},
+        attrs={"num_classes": n,
+            "param_std": _param_std(param_attr),
+            "param_name": _param_name(param_attr)},
     )
     return LayerOutput(conf, parents)
 

@@ -36,16 +36,18 @@ def profile_layers(
 ) -> List[Tuple[str, str, float]]:
     """[(layer_name, type, best_ms)] forward cost per layer, eager with a
     sync per layer (reference FwdTimer per layer)."""
-    from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.compiler import _cast_floats
     from paddle_tpu.layers.base import ApplyContext
 
     topo = network.topology
     results: List[Tuple[str, str, float]] = []
-    outs_cache: Dict[str, object] = {}
 
     # run once through apply() to obtain every layer's output for reuse as
     # the timed layer's inputs (so each layer is timed in isolation)
     outs, _ = network.apply(params, batch, state=state, train=train, rng=rng)
+    mixed = network.compute_dtype != jnp.dtype(jnp.float32)
 
     for name in topo.order:
         conf = topo.layers[name]
@@ -53,7 +55,15 @@ def profile_layers(
         if conf.type in ("data", "step_input", "memory"):
             continue
         ins = [outs[i] for i in conf.inputs]
-        p = params.get(name, {})
+        # same param resolution + mixed-precision casts as compiler.apply,
+        # so shared-param layers resolve and bf16 nets are timed in bf16
+        p = params.get(network._param_owner.get(name, name), {})
+        if mixed:
+            if impl.full_precision:
+                ins = [_cast_floats(x, jnp.float32) for x in ins]
+            else:
+                p = _cast_floats(p, network.compute_dtype)
+                ins = [_cast_floats(x, network.compute_dtype) for x in ins]
 
         def run_once():
             ctx = ApplyContext(

@@ -1,0 +1,36 @@
+"""Packaging for paddle_tpu (the reference ships cmake + a python sdist;
+here one setuptools config installs the pure-python package plus the native
+recordio source, which paddle_tpu.io.recordio compiles on demand with the
+host compiler)."""
+
+import os
+
+from setuptools import find_packages, setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def readme() -> str:
+    path = os.path.join(HERE, "README.md")
+    return open(path).read() if os.path.exists(path) else ""
+
+
+setup(
+    name="paddle-tpu",
+    version="0.1.0",
+    description="TPU-native deep-learning framework with the PaddlePaddle v1/v2 API surface",
+    long_description=readme(),
+    long_description_content_type="text/markdown",
+    packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
+    # native recordio source ships with the wheel; compiled lazily at first
+    # use (paddle_tpu/io/recordio.py), with a pure-python fallback
+    data_files=[("paddle_tpu_native", ["native/recordio.cc"])],
+    python_requires=">=3.11",  # BaseException.add_note in the error path
+    install_requires=[
+        "jax",
+        "numpy",
+    ],
+    extras_require={
+        "test": ["pytest", "chex"],
+    },
+)
