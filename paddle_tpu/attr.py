@@ -25,6 +25,9 @@ class ParamAttr:
     l1_rate: Optional[float] = None
     is_static: bool = False
     sparse_update: bool = False
+    # update hooks (reference ParameterUpdaterHook.cpp): a HookAttribute or
+    # list of them; only the static 'pruning' hook has behavior here
+    update_hooks: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -39,5 +42,18 @@ class ExtraAttr:
     device: Optional[int] = None
 
 
+@dataclasses.dataclass
+class HookAttribute:
+    """Parameter update hook declaration (reference HookAttr /
+    ParameterUpdaterHookConfig).  type='pruning' keeps the largest
+    (1 - sparsity_ratio) fraction of each parameter by initial magnitude and
+    zeroes the rest after every update (StaticPruningHook,
+    ParameterUpdaterHook.cpp:39)."""
+
+    type: str = "pruning"
+    sparsity_ratio: float = 0.6
+
+
+HookAttr = HookAttribute
 ParameterAttribute = ParamAttr
 ExtraLayerAttribute = ExtraAttr

@@ -89,13 +89,25 @@ class CompiledNetwork:
     def init_params(self, rng: jax.Array) -> Params:
         params: Params = {}
         for name in self.topology.order:
-            if name in self._param_owner:
-                continue  # shares the owner's parameters
             conf = self.topology.layers[name]
             impl = self._impls[name]
             in_confs = [self.topology.layers[i] for i in conf.inputs]
             layer_rng = jax.random.fold_in(rng, stable_hash(name))
             p = impl.init(conf, in_confs, layer_rng)
+            owner = self._param_owner.get(name)
+            if owner is not None:
+                # sharer: storage lives at the owner — validate the shapes
+                # agree NOW so a name collision between differently-sized
+                # layers fails at build, not deep inside a matmul
+                want = jax.tree_util.tree_map(jnp.shape, p)
+                have = jax.tree_util.tree_map(jnp.shape, params.get(owner, {}))
+                if want != have:
+                    raise ValueError(
+                        f"layer {name!r} shares parameter "
+                        f"{conf.attr('param_name')!r} with {owner!r} but "
+                        f"expects shapes {want} != owner's {have}"
+                    )
+                continue
             if p:
                 params[name] = p
         return params
@@ -114,6 +126,22 @@ class CompiledNetwork:
 
     def init(self, rng: jax.Array) -> Tuple[Params, NetState]:
         return self.init_params(rng), self.init_state()
+
+    # ------------------------------------------------------------------
+    def resolve_layer_call(self, name: str, params: Params, ins):
+        """(layer params, inputs) as the apply loop would hand them to the
+        impl: shared-parameter owner lookup + mixed-precision casts.  Used
+        by apply() and by utils.debug.profile_layers so the profiler times
+        exactly what training runs."""
+        impl = self._impls[name]
+        p = params.get(self._param_owner.get(name, name), {})
+        if self.compute_dtype != jnp.dtype(jnp.float32):
+            if impl.full_precision:
+                ins = [_cast_floats(x, jnp.float32) for x in ins]
+            else:
+                p = _cast_floats(p, self.compute_dtype)
+                ins = [_cast_floats(x, self.compute_dtype) for x in ins]
+        return p, ins
 
     # ------------------------------------------------------------------
     def apply(
@@ -147,14 +175,8 @@ class CompiledNetwork:
                 ctx.outputs[name] = batch[name]
                 continue
             ins = [ctx.outputs[i] for i in conf.inputs]
-            p = params.get(self._param_owner.get(name, name), {})
             pre_keys = set(ctx.outputs) if mixed else ()
-            if mixed:
-                if impl.full_precision:
-                    ins = [_cast_floats(x, jnp.float32) for x in ins]
-                else:
-                    p = _cast_floats(p, self.compute_dtype)
-                    ins = [_cast_floats(x, self.compute_dtype) for x in ins]
+            p, ins = self.resolve_layer_call(name, params, ins)
             # named_scope labels this layer's ops in profiler traces; the
             # except-note is the CustomStackTrace equivalent (reference
             # utils/CustomStackTrace.h:51 pushes layer names so a fatal

@@ -138,10 +138,13 @@ class Topology:
         lines = []
         for name in self.order:
             c = self.layers[name]
+            # None-valued attrs are absent options (param_std, param_name,
+            # prune_sparsity, ...) — skipping them keeps golden snapshots
+            # stable when new optional attributes are introduced.
             attrs = ", ".join(
                 f"{k}={c.attrs[k]!r}"
                 for k in sorted(c.attrs)
-                if not k.startswith("_")
+                if not k.startswith("_") and c.attrs[k] is not None
             )
             lines.append(
                 indent

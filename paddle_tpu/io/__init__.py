@@ -1,6 +1,6 @@
 """Native data-IO runtime bindings (recordio + prefetch).
 
-The C++ library lives in native/recordio.cc; `recordio` loads it via ctypes,
+The C++ library lives in paddle_tpu/native/recordio.cc; `recordio` loads it via ctypes,
 building it on first use with g++, and falls back to a pure-Python
 implementation of the identical on-disk format when no toolchain exists.
 """

@@ -9,7 +9,7 @@ paddle/gserver/dataproviders/DataProvider.h):
     body  := len_i:u32 × n | payload_i × n          (little-endian)
 
 Two interchangeable backends over the same bytes-on-disk: the C++ library
-(native/recordio.cc, built on demand with g++, threads + ring buffer) and a
+(paddle_tpu/native/recordio.cc, built on demand with g++, threads + ring buffer) and a
 pure-Python fallback.  `Prefetcher` always exists; it is native when possible.
 """
 
@@ -27,9 +27,11 @@ from typing import Iterable, List, Optional, Sequence
 
 _MAGIC = 0x7061646C
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "recordio.cc")
-_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# native source ships inside the package (paddle_tpu/native/) so installed
+# wheels can build it too
+_SRC = os.path.join(_PKG_ROOT, "native", "recordio.cc")
+_BUILD_DIR = os.path.join(_PKG_ROOT, "native", "build")
 _SO = os.path.join(_BUILD_DIR, "libpaddle_tpu_io.so")
 
 _lib = None

@@ -27,6 +27,7 @@ import os
 import shlex
 import subprocess
 import sys
+import time
 from typing import Dict, List, Optional, Sequence
 
 ENV_COORD = "PADDLE_TPU_COORDINATOR"
@@ -107,8 +108,12 @@ def launch(
     script: str,
     script_args: Sequence[str] = (),
     workdir: Optional[str] = None,
+    poll_interval: float = 0.2,
 ) -> int:
-    """Start every worker and wait; returns the first nonzero exit code."""
+    """Start every worker and wait.  First worker to exit NONZERO kills the
+    rest (a dead coordinator would otherwise hang every other process inside
+    jax.distributed.initialize — the reference fabric launcher tears the job
+    down on first failure too).  Returns the first nonzero exit code."""
     procs = [
         subprocess.Popen(cmd)
         for cmd in build_commands(
@@ -116,9 +121,26 @@ def launch(
         )
     ]
     rc = 0
-    for p in procs:
-        r = p.wait()
-        rc = rc or r
+    try:
+        while rc == 0 and any(p.poll() is None for p in procs):
+            rc = next((p.poll() for p in procs if p.poll() not in (None, 0)), 0)
+            if rc == 0:
+                time.sleep(poll_interval)
+        if rc:  # tear the job down on first failure
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+            rc = rc or (p.returncode or 0)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     return rc
 
 

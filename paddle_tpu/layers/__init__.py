@@ -42,6 +42,7 @@ from paddle_tpu.layers.generation import (  # noqa: F401
 )
 from paddle_tpu.layers import attention as _attention  # noqa: F401
 from paddle_tpu.layers import detection as _detection  # noqa: F401
+from paddle_tpu.layers import mdlstm as _mdlstm  # noqa: F401
 
 
 class AggregateLevel:
@@ -90,6 +91,20 @@ def _param_name(param_attr: Optional[ParamAttr]):
     """Shared-parameter name (reference global parameter table: layers
     declaring the same ParamAttr name share storage)."""
     return param_attr.name if param_attr else None
+
+
+def _prune_ratio(param_attr: Optional[ParamAttr]):
+    """sparsity_ratio of a 'pruning' update hook, or None (reference
+    StaticPruningHook — see attr.HookAttribute)."""
+    if param_attr is None or param_attr.update_hooks is None:
+        return None
+    hooks = param_attr.update_hooks
+    if not isinstance(hooks, (list, tuple)):
+        hooks = [hooks]
+    for h in hooks:
+        if getattr(h, "type", None) == "pruning":
+            return float(h.sparsity_ratio)
+    return None
 
 
 _IMG_ATTR_KEYS = ("out_h", "out_w", "in_h", "in_w", "in_c", "channels")
@@ -166,7 +181,11 @@ def fc(
         inputs=tuple(i.name for i in ins),
         act=act_name(act if act is not None else _act_mod.Tanh()),
         bias=bool(bias_attr),
-        attrs={"param_std": _param_std(param_attr), "param_name": _param_name(param_attr)},
+        attrs={
+            "param_std": _param_std(param_attr),
+            "param_name": _param_name(param_attr),
+            "prune_sparsity": _prune_ratio(param_attr),
+        },
         drop_rate=drop,
         shard_axis=shard,
     )
@@ -193,6 +212,7 @@ def embedding(
         attrs={
             "param_std": _param_std(param_attr),
             "param_name": _param_name(param_attr),
+            "prune_sparsity": _prune_ratio(param_attr),
             # sparse_update=True row-shards the table over the mesh model
             # axis (the sparse-remote-update path of the reference,
             # RemoteParameterUpdater.h:265 — see parallel/sharding.py)
@@ -315,10 +335,7 @@ def img_conv(
     ph = padding_y if padding_y is not None else padding
     pw = padding
     if trans:
-        if groups != 1:
-            raise NotImplementedError(
-                "grouped transpose conv (trans=True, groups>1) is not supported"
-            )
+        assert num_filters % groups == 0 and in_c % groups == 0
         out_h = (in_h - 1) * sh + fh - 2 * ph
         out_w = (in_w - 1) * sw + fw - 2 * pw
     else:
@@ -1844,6 +1861,138 @@ def pos_encoding(
     """Add sinusoidal position encodings (input is scaled by emb_scale
     first — pass sqrt(d_model) for the Transformer convention)."""
     return _unary("pos_encoding", input, name=name, emb_scale=emb_scale)
+
+
+def mdlstmemory(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    reverse_h: bool = False,
+    reverse_w: bool = False,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    bias_attr: bool = True,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """2D multi-dimensional LSTM (reference MDLstmLayer.cpp); input must be
+    an image-shaped layer pre-projected to 5*size channels (i, f_row, f_col,
+    o, g gates).  reverse_h/reverse_w flip the scan direction per axis —
+    compose four of these for the full multi-directional net."""
+    a = input.conf.attrs
+    in_c = a.get("channels") or a.get("in_c")
+    in_h = a.get("out_h") or a.get("in_h")
+    in_w = a.get("out_w") or a.get("in_w")
+    assert in_c and in_h and in_w, (
+        f"mdlstmemory input {input.name} needs image geometry attrs"
+    )
+    size = size or int(in_c) // 5
+    assert int(in_c) == 5 * size, (
+        f"mdlstmemory input channels {in_c} must be 5*size ({5 * size})"
+    )
+    conf = LayerConf(
+        name=name or auto_name("mdlstmemory"),
+        type="mdlstmemory",
+        # image-layer convention: size is the flattened extent; the hidden
+        # width rides the channels attr (like img_conv)
+        size=int(in_h) * int(in_w) * size,
+        inputs=(input.name,),
+        bias=bool(bias_attr),
+        attrs={
+            "in_h": int(in_h),
+            "in_w": int(in_w),
+            "in_c": int(in_c),
+            "out_h": int(in_h),
+            "out_w": int(in_w),
+            "channels": size,
+            "reverse_h": reverse_h,
+            "reverse_w": reverse_w,
+            "active_type": act_name(act if act is not None else _act_mod.Tanh()),
+            "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+            "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+mdlstmemory_layer = mdlstmemory
+
+
+def get_output(
+    input: LayerOutput,
+    arg_name: str,
+    size: Optional[int] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Select a named auxiliary output of a layer (reference
+    get_output_layer → GetOutputLayer.cpp), e.g. the cell state of an
+    lstm_step ('cell') or beam scores ('scores').  `size` overrides the
+    declared width for aux outputs shaped unlike the main output."""
+    if size is None:
+        if input.conf.type == "beam_search" and arg_name == "scores":
+            size = input.conf.attrs["beam_size"]
+        else:
+            size = input.size
+    conf = LayerConf(
+        name=name or auto_name("get_output"),
+        type="get_output",
+        size=size,
+        inputs=(input.name,),
+        bias=False,
+        attrs={"arg_name": arg_name},
+    )
+    return LayerOutput(conf, [input])
+
+
+get_output_layer = get_output
+
+
+def agent(input: LayerOutput, size: Optional[int] = None, name: Optional[str] = None) -> LayerOutput:
+    """Identity view of another layer (reference AgentLayer — cross-frame
+    wiring that the recurrent_group scan absorbs here)."""
+    conf = LayerConf(
+        name=name or auto_name("agent"),
+        type="agent",
+        size=size or input.size,
+        inputs=(input.name,),
+        bias=False,
+    )
+    return LayerOutput(conf, [input])
+
+
+agent_layer = agent
+
+
+def scatter_agent(input: LayerOutput, ids: LayerOutput, name: Optional[str] = None) -> LayerOutput:
+    """Select rows of `input` by the integer ids (reference
+    ScatterAgentLayer: distributes source rows to beam/frame slots)."""
+    conf = LayerConf(
+        name=name or auto_name("scatter_agent"),
+        type="scatter_agent",
+        size=input.size,
+        inputs=(input.name, ids.name),
+        bias=False,
+    )
+    return LayerOutput(conf, [input, ids])
+
+
+scatter_agent_layer = scatter_agent
+
+
+def gather_agent(input: Sequence[LayerOutput], name: Optional[str] = None) -> LayerOutput:
+    """Concatenate sequences along time (reference GatherAgentLayer:
+    collects scattered pieces back into one sequence)."""
+    ins = _as_list(input)
+    conf = LayerConf(
+        name=name or auto_name("gather_agent"),
+        type="gather_agent",
+        size=ins[0].size,
+        inputs=tuple(i.name for i in ins),
+        bias=False,
+    )
+    return LayerOutput(conf, ins)
+
+
+gather_agent_layer = gather_agent
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -45,8 +45,10 @@ def conv_init(conf, in_confs, rng) -> Dict[str, Any]:
     cin, cout = a["in_c"], a["channels"]
     groups = a.get("groups", 1)
     if conf.type == "convt":
-        shape = (kh, kw, cin, cout)  # HWIO, consumed by conv_transpose as-is
-        w = init.normal(rng, shape, init.default_std(kh * kw * cin))
+        # HWIO per group: axis 2 spans one group's input channels, axis 3
+        # all output channels (grouped column blocks)
+        shape = (kh, kw, cin // groups, cout)
+        w = init.normal(rng, shape, init.default_std(kh * kw * cin // groups))
     else:
         shape = (kh, kw, cin // groups, cout)
         w = init.conv_normal(rng, shape)
@@ -80,16 +82,35 @@ def conv_apply(conf, params, inputs, ctx):
 def convt_apply(conf, params, inputs, ctx):
     a = conf.attrs
     x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
-    out = lax.conv_transpose(
-        x,
-        params["w"],
-        strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
-        padding=[
-            (a.get("pad_h", 0), a.get("pad_h", 0)),
-            (a.get("pad_w", 0), a.get("pad_w", 0)),
-        ],
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    groups = a.get("groups", 1)
+    strides = (a.get("stride_h", 1), a.get("stride_w", 1))
+    # lax.conv_transpose explicit pads apply to the stride-dilated input
+    # before a VALID conv; the transpose of a forward conv with padding p
+    # and kernel k pads k-1-p per side (gives out = (in-1)*s + k - 2p,
+    # the size the DSL declares).
+    ph = a["filter_h"] - 1 - a.get("pad_h", 0)
+    pw = a["filter_w"] - 1 - a.get("pad_w", 0)
+    padding = [(ph, ph), (pw, pw)]
+    w = params["w"]
+    if groups == 1:
+        out = lax.conv_transpose(
+            x, w, strides=strides, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    else:
+        # Grouped transpose conv as ONE grouped dilated conv (conv_transpose
+        # itself lowers to conv_general_dilated with lhs_dilation and no
+        # kernel flip; feature_group_count gives XLA's native grouped
+        # kernel).  w is already per-group HWIO: (kh, kw, cin/g, cout).
+        out = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(1, 1),
+            padding=padding,
+            lhs_dilation=strides,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+        )
     if "b" in params:
         out = out + params["b"]
     return SeqTensor(out, inputs[0].lengths)

@@ -1,0 +1,103 @@
+"""Multi-dimensional (2D) LSTM — reference MDLstmLayer
+(paddle/gserver/layers/MDLstmLayer.cpp:180-240): an LSTM whose recurrence
+runs over BOTH image axes, with one forget gate per dimension
+(Graves' multi-dimensional RNN).
+
+TPU-native lowering: a lax.scan over rows whose body is a lax.scan over
+columns; each cell sees its left neighbor (inner carry) and top neighbor
+(outer carry, a whole row of states).  Gates come pre-projected from the
+input layer as 5*size channels (i, f_row, f_col, o, g), like lstmemory's
+4*size convention.  The reference packs one n×(3+numDims)n recurrent matrix;
+here the left/top recurrences get separate matrices (w_row, w_col) — same
+capacity, simpler layout.  Direction flags flip the scan over either axis
+(the reference's 2^numDims directions are built from multiple layers)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import register_layer
+from paddle_tpu.ops.activations import get_activation
+
+
+def mdlstm_init(conf, in_confs, rng):
+    n = conf.attrs["channels"]
+    r1, r2 = jax.random.split(rng)
+    p = {
+        "w_row": init.normal(r1, (n, 5 * n)),  # from the top neighbor
+        "w_col": init.normal(r2, (n, 5 * n)),  # from the left neighbor
+    }
+    if conf.bias:
+        p["b"] = init.zeros((5 * n,))
+    return p
+
+
+@register_layer("mdlstmemory", init=mdlstm_init, auto_activation=False)
+def mdlstm_apply(conf, params, inputs, ctx):
+    a = conf.attrs
+    n = a["channels"]  # hidden width; conf.size is the flattened extent
+    h_img, w_img, c_in = a["in_h"], a["in_w"], a["in_c"]
+    assert c_in == 5 * n, (
+        f"{conf.name}: input must be pre-projected to 5*size gates "
+        f"(got {c_in} channels for size {n})"
+    )
+    x = inputs[0].data
+    if x.ndim == 2:  # flat CHW from a non-conv producer
+        x = x.reshape(x.shape[0], c_in, h_img, w_img).transpose(0, 2, 3, 1)
+    b = x.shape[0]
+    if a.get("reverse_h"):
+        x = jnp.flip(x, axis=1)
+    if a.get("reverse_w"):
+        x = jnp.flip(x, axis=2)
+
+    f_gate = get_activation(conf.attr("gate_act", "sigmoid"))
+    f_act = get_activation(conf.attr("active_type", "tanh"))
+    f_state = get_activation(conf.attr("state_act", "tanh"))
+    w_row, w_col = params["w_row"], params["w_col"]
+    bias = params.get("b")
+
+    def cell(gates, h_left, c_left, h_top, c_top):
+        g = gates + h_left @ w_col + h_top @ w_row
+        if bias is not None:
+            g = g + bias
+        gi, gfr, gfc, go, gg = jnp.split(g, 5, axis=-1)
+        c = f_gate(gfc) * c_left + f_gate(gfr) * c_top + f_gate(gi) * f_act(gg)
+        h = f_gate(go) * f_state(c)
+        return h, c
+
+    def row_body(row_carry, x_row):
+        h_top_row, c_top_row = row_carry  # [B, W, n]
+
+        def col_body(col_carry, col_in):
+            h_left, c_left = col_carry
+            gates, h_top, c_top = col_in
+            h, c = cell(gates, h_left, c_left, h_top, c_top)
+            return (h, c), (h, c)
+
+        zeros = jnp.zeros((b, n), x_row.dtype)
+        (_, _), (h_row, c_row) = jax.lax.scan(
+            col_body,
+            (zeros, zeros),
+            (
+                jnp.swapaxes(x_row, 0, 1),  # [W, B, 5n]
+                jnp.swapaxes(h_top_row, 0, 1),
+                jnp.swapaxes(c_top_row, 0, 1),
+            ),
+        )
+        h_row = jnp.swapaxes(h_row, 0, 1)  # [B, W, n]
+        c_row = jnp.swapaxes(c_row, 0, 1)
+        return (h_row, c_row), h_row
+
+    zeros_row = jnp.zeros((b, w_img, n), x.dtype)
+    _, hs = jax.lax.scan(
+        row_body, (zeros_row, zeros_row), jnp.swapaxes(x, 0, 1)  # [H, B, W, 5n]
+    )
+    hs = jnp.swapaxes(hs, 0, 1)  # [B, H, W, n]
+    if a.get("reverse_h"):
+        hs = jnp.flip(hs, axis=1)
+    if a.get("reverse_w"):
+        hs = jnp.flip(hs, axis=2)
+    return SeqTensor(hs)

@@ -233,3 +233,57 @@ def kmax_seq_score_apply(conf, params, inputs, ctx):
     # slots beyond the sample's length get -1 (reference KmaxSeqScoreLayer)
     idx = jnp.where(jnp.isfinite(vals), idx, -1)
     return SeqTensor(idx.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# get_output — GetOutputLayer.cpp: select a named auxiliary output of a layer
+# (side outputs use the "<layer>@<arg>" convention: lstm_step's "@cell",
+# beam_search's "@scores")
+# ---------------------------------------------------------------------------
+
+
+@register_layer("get_output", auto_activation=False)
+def get_output_apply(conf, params, inputs, ctx):
+    arg = conf.attrs["arg_name"]
+    src = conf.inputs[0]
+    key = src if arg in ("", "default") else f"{src}@{arg}"
+    if key not in ctx.outputs:
+        raise KeyError(
+            f"{conf.name}: layer {src!r} has no auxiliary output {arg!r} "
+            f"(known: {[k for k in ctx.outputs if k.startswith(src)]})"
+        )
+    return ctx.outputs[key]
+
+
+# ---------------------------------------------------------------------------
+# agent family — AgentLayer.cpp / GatherAgentLayer / ScatterAgentLayer.
+# In the reference these wire values across RecurrentGradientMachine frame
+# networks; the recurrent_group scan absorbs that role, so here they keep
+# their data semantics: agent = identity view, scatter_agent = row
+# selection by ids, gather_agent = time-axis concatenation of sequences.
+# ---------------------------------------------------------------------------
+
+
+@register_layer("agent", auto_activation=False)
+def agent_apply(conf, params, inputs, ctx):
+    return inputs[0]
+
+
+@register_layer("scatter_agent", auto_activation=False)
+def scatter_agent_apply(conf, params, inputs, ctx):
+    src, ids_t = inputs
+    ids = ids_t.data.astype(jnp.int32).reshape(-1)
+    data = jnp.take(src.data, ids, axis=0)
+    lengths = None if src.lengths is None else jnp.take(src.lengths, ids, axis=0)
+    subs = None if src.sub_lengths is None else jnp.take(src.sub_lengths, ids, axis=0)
+    return SeqTensor(data, lengths, subs)
+
+
+@register_layer("gather_agent", auto_activation=False)
+def gather_agent_apply(conf, params, inputs, ctx):
+    from paddle_tpu.layers.sequence import seqconcat_apply
+
+    out = inputs[0]
+    for nxt in inputs[1:]:
+        out = seqconcat_apply(conf, params, [out, nxt], ctx)
+    return out

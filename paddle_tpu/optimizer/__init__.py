@@ -256,6 +256,20 @@ class Momentum(Optimizer):
 SGD = Momentum
 
 
+class SparseMomentum(Momentum):
+    """reference SparseMomentumParameterOptimizer
+    (paddle/parameter/FirstOrderOptimizer.h:52): momentum whose lazy
+    alpha/beta bookkeeping lets the CPU pserver touch only the rows a sparse
+    gradient hit.  The algorithm it computes is plain momentum — the
+    laziness is a host-memory optimization with no TPU analogue (the dense
+    vectorized update is the fast path here, and sparse tables shard over
+    the mesh instead: parallel/sharding.py) — so this subclass IS Momentum,
+    kept as a distinct type for v1 config compatibility."""
+
+    def __init__(self, momentum: float = 0.9, **kw):
+        super().__init__(momentum=momentum, **kw)
+
+
 class AdaGrad(Optimizer):
     """AdagradParameterOptimizer (FirstOrderOptimizer.h:44)."""
 

@@ -102,9 +102,19 @@ class SGD:
             self.parameters.params = shard_params(
                 self.network, self.parameters.params, self.mesh
             )
+        # Static pruning hooks: masks from initial magnitudes, applied to
+        # the initial values and after every update (StaticPruningHook).
+        from paddle_tpu.trainer.step import apply_prune_masks, build_prune_masks
+
+        self._prune_masks = build_prune_masks(self.network, self.parameters.params)
+        if self._prune_masks:
+            self.parameters.params = apply_prune_masks(
+                self.parameters.params, self._prune_masks
+            )
         self._train_step = make_train_step(
             self.network, self.optimizer, self.mesh, self._metrics_fn,
             infer_param_shardings=self._model_sharded,
+            prune_masks=self._prune_masks,
         )
         self._eval_step = make_eval_step(
             self.network, self.mesh, self._metrics_fn,

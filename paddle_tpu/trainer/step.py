@@ -23,6 +23,48 @@ from paddle_tpu.optimizer import Optimizer, OptState
 from paddle_tpu.parallel.mesh import DATA_AXIS
 
 
+def build_prune_masks(network: CompiledNetwork, params: Params) -> Optional[Params]:
+    """Static pruning masks (reference StaticPruningHook,
+    ParameterUpdaterHook.cpp:39): for every layer whose ParamAttr declared a
+    'pruning' hook, keep the largest (1 - sparsity_ratio) fraction of each
+    parameter by INITIAL magnitude; the train step re-applies the mask after
+    every update.  Returns None when nothing prunes."""
+    masks: Params = {}
+    for name, conf in network.topology.layers.items():
+        ratio = conf.attr("prune_sparsity")
+        if not ratio:
+            continue
+        # a layer sharing parameters by name stores them under the owner
+        name = network._param_owner.get(name, name)
+        if name not in params or name in masks:
+            continue
+
+        def mask_leaf(v, r=ratio):
+            flat = jnp.abs(v).reshape(-1)
+            k = max(int(flat.shape[0] * (1.0 - r)), 1)
+            thresh = jax.lax.top_k(flat, k)[0][-1]
+            return (jnp.abs(v) >= thresh).astype(v.dtype)
+
+        # hooks attach to the WEIGHT parameter (the reference's ParamAttr is
+        # per-parameter; bias has its own attr) — prune w* leaves only
+        masks[name] = {
+            k: (mask_leaf(v) if k.startswith("w") else jnp.ones_like(v))
+            for k, v in params[name].items()
+        }
+    return masks or None
+
+
+def apply_prune_masks(params: Params, masks: Optional[Params]) -> Params:
+    if not masks:
+        return params
+    out = dict(params)
+    for name, m in masks.items():
+        out[name] = jax.tree_util.tree_map(
+            lambda p, mk: p * mk.astype(p.dtype), params[name], m
+        )
+    return out
+
+
 def make_train_step(
     network: CompiledNetwork,
     optimizer: Optimizer,
@@ -31,6 +73,7 @@ def make_train_step(
         Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
     ] = None,
     infer_param_shardings: bool = False,
+    prune_masks: Optional[Params] = None,
 ):
     """Returns jitted
     (params, state, opt_state, batch, rng) ->
@@ -49,6 +92,7 @@ def make_train_step(
             loss_fn, has_aux=True
         )(params)
         new_params, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = apply_prune_masks(new_params, prune_masks)
         metrics = {"cost": cost}
         if extra_metrics is not None:
             metrics.update(extra_metrics(outs))

@@ -36,9 +36,6 @@ def profile_layers(
 ) -> List[Tuple[str, str, float]]:
     """[(layer_name, type, best_ms)] forward cost per layer, eager with a
     sync per layer (reference FwdTimer per layer)."""
-    import jax.numpy as jnp
-
-    from paddle_tpu.core.compiler import _cast_floats
     from paddle_tpu.layers.base import ApplyContext
 
     topo = network.topology
@@ -47,23 +44,16 @@ def profile_layers(
     # run once through apply() to obtain every layer's output for reuse as
     # the timed layer's inputs (so each layer is timed in isolation)
     outs, _ = network.apply(params, batch, state=state, train=train, rng=rng)
-    mixed = network.compute_dtype != jnp.dtype(jnp.float32)
 
     for name in topo.order:
         conf = topo.layers[name]
         impl = network._impls[name]
         if conf.type in ("data", "step_input", "memory"):
             continue
-        ins = [outs[i] for i in conf.inputs]
-        # same param resolution + mixed-precision casts as compiler.apply,
-        # so shared-param layers resolve and bf16 nets are timed in bf16
-        p = params.get(network._param_owner.get(name, name), {})
-        if mixed:
-            if impl.full_precision:
-                ins = [_cast_floats(x, jnp.float32) for x in ins]
-            else:
-                p = _cast_floats(p, network.compute_dtype)
-                ins = [_cast_floats(x, network.compute_dtype) for x in ins]
+        # identical param resolution + mixed-precision casts as training
+        p, ins = network.resolve_layer_call(
+            name, params, [outs[i] for i in conf.inputs]
+        )
 
         def run_once():
             ctx = ApplyContext(

@@ -22,9 +22,10 @@ setup(
     long_description=readme(),
     long_description_content_type="text/markdown",
     packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
-    # native recordio source ships with the wheel; compiled lazily at first
-    # use (paddle_tpu/io/recordio.py), with a pure-python fallback
-    data_files=[("paddle_tpu_native", ["native/recordio.cc"])],
+    # native recordio source ships inside the package; compiled lazily at
+    # first use (paddle_tpu/io/recordio.py), with a pure-python fallback
+    package_data={"paddle_tpu": ["native/recordio.cc"]},
+    include_package_data=True,
     python_requires=">=3.11",  # BaseException.add_note in the error path
     install_requires=[
         "jax",

@@ -1,0 +1,248 @@
+"""Remaining layer/optimizer inventory (VERDICT missing #8): grouped
+conv-transpose, mdlstmemory, get_output, agent family, SparseMomentum,
+static pruning hook."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import layers
+from paddle_tpu.core.batch import SeqTensor, seq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+from tests.layer_grad_util import check_layer_grad
+
+
+def _run(out_layer, batch, seed=0):
+    net = CompiledNetwork(Topology([out_layer]))
+    params, state = net.init(jax.random.PRNGKey(seed))
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    return outs, params
+
+
+# ---------------------------------------------------------------------------
+# grouped conv-transpose
+# ---------------------------------------------------------------------------
+
+
+def test_grouped_conv_transpose_shapes_and_grad():
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(4 * 5 * 5), height=5, width=5)
+    up = layers.img_conv(
+        x, filter_size=2, num_filters=4, stride=2, groups=2, trans=True,
+        act=paddle.activation.Identity(), name="up",
+    )
+    assert up.conf.attrs["out_h"] == 10 and up.conf.attrs["out_w"] == 10
+    outs, params = _run(up, {"x": SeqTensor(np.random.rand(2, 100).astype(np.float32))})
+    assert outs["up"].data.shape == (2, 10, 10, 4)
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(4 * 5 * 5), height=5, width=5)
+    up = layers.img_conv(
+        x, filter_size=2, num_filters=4, stride=2, groups=2, trans=True,
+        act=paddle.activation.Identity(),
+    )
+    check_layer_grad(up, batch_size=2)
+
+
+def test_grouped_conv_transpose_group_independence():
+    """Group 0's output channels must not depend on group 1's input
+    channels."""
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(2 * 4 * 4), height=4, width=4)
+    up = layers.img_conv(
+        x, filter_size=2, num_filters=2, stride=2, groups=2, trans=True,
+        act=paddle.activation.Identity(), bias_attr=False, name="up",
+    )
+    net = CompiledNetwork(Topology([up]))
+    params, state = net.init(jax.random.PRNGKey(1))
+    rng = np.random.RandomState(0)
+    base = rng.rand(1, 2, 4, 4).astype(np.float32)  # CHW flat
+    pert = base.copy()
+    pert[0, 1] += 5.0  # perturb channel 1 (group 1) only
+    o1, _ = net.apply(params, {"x": SeqTensor(base.reshape(1, -1))}, state=state)
+    o2, _ = net.apply(params, {"x": SeqTensor(pert.reshape(1, -1))}, state=state)
+    a, b = np.asarray(o1["up"].data), np.asarray(o2["up"].data)
+    np.testing.assert_allclose(a[..., 0], b[..., 0], atol=1e-6)  # group 0 ch
+    assert np.abs(a[..., 1] - b[..., 1]).max() > 1e-3  # group 1 ch changed
+
+
+# ---------------------------------------------------------------------------
+# mdlstmemory
+# ---------------------------------------------------------------------------
+
+
+def _md_net(n=3, hw=4):
+    x = layers.data(
+        "x", paddle.data_type.dense_vector(5 * n * hw * hw), height=hw, width=hw
+    )
+    return x, layers.mdlstmemory(x, size=n, name="md")
+
+
+def test_mdlstm_shape_and_grad():
+    reset_auto_names()
+    x, md = _md_net()
+    outs, _ = _run(md, {"x": SeqTensor(np.random.rand(2, 5 * 3 * 16).astype(np.float32))})
+    assert outs["md"].data.shape == (2, 4, 4, 3)
+    reset_auto_names()
+    x, md = _md_net()
+    check_layer_grad(md, batch_size=2, atol=8e-2, rtol=8e-2)
+
+
+def test_mdlstm_causality():
+    """Output at (0,0) must not depend on input at (2,2); with both
+    reverses, the dependency flips."""
+    reset_auto_names()
+    n, hw = 2, 3
+    x, md = _md_net(n, hw)
+    net = CompiledNetwork(Topology([md]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    base = rng.rand(1, 5 * n, hw, hw).astype(np.float32)
+    pert = base.copy()
+    pert[0, :, 2, 2] += 3.0
+    o1, _ = net.apply(params, {"x": SeqTensor(base.reshape(1, -1))}, state=state)
+    o2, _ = net.apply(params, {"x": SeqTensor(pert.reshape(1, -1))}, state=state)
+    a, b = np.asarray(o1["md"].data), np.asarray(o2["md"].data)
+    np.testing.assert_allclose(a[0, 0, 0], b[0, 0, 0], atol=1e-6)
+    assert np.abs(a[0, 2, 2] - b[0, 2, 2]).max() > 1e-4
+
+
+def test_mdlstm_reverse_direction():
+    reset_auto_names()
+    n, hw = 2, 3
+    x = layers.data(
+        "x", paddle.data_type.dense_vector(5 * n * hw * hw), height=hw, width=hw
+    )
+    md = layers.mdlstmemory(x, size=n, reverse_h=True, reverse_w=True, name="md")
+    net = CompiledNetwork(Topology([md]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    base = rng.rand(1, 5 * n, hw, hw).astype(np.float32)
+    pert = base.copy()
+    pert[0, :, 0, 0] += 3.0  # perturb the (0,0) corner
+    o1, _ = net.apply(params, {"x": SeqTensor(base.reshape(1, -1))}, state=state)
+    o2, _ = net.apply(params, {"x": SeqTensor(pert.reshape(1, -1))}, state=state)
+    a, b = np.asarray(o1["md"].data), np.asarray(o2["md"].data)
+    # reversed scan: (2,2) is now upstream of (0,0) -> unaffected
+    np.testing.assert_allclose(a[0, 2, 2], b[0, 2, 2], atol=1e-6)
+    assert np.abs(a[0, 0, 0] - b[0, 0, 0]).max() > 1e-4
+
+
+# ---------------------------------------------------------------------------
+# get_output / agents
+# ---------------------------------------------------------------------------
+
+
+def test_get_output_reads_aux_logits():
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(4))
+    sm = layers.fc(x, size=3, act=paddle.activation.Softmax(), name="sm")
+    logits = layers.get_output(sm, "logits")
+    cost = layers.cross_entropy_cost(
+        input=sm, label=layers.data("y", paddle.data_type.integer_value(3))
+    )
+    net = CompiledNetwork(Topology([cost, logits]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "x": SeqTensor(np.random.rand(2, 4).astype(np.float32)),
+        "y": SeqTensor(np.asarray([0, 2], np.int32)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    lg = np.asarray(outs[logits.name].data)
+    probs = np.asarray(outs["sm"].data)
+    np.testing.assert_allclose(
+        np.exp(lg) / np.exp(lg).sum(-1, keepdims=True), probs, rtol=1e-5
+    )
+
+
+def test_get_output_unknown_arg_errors():
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(4))
+    h = layers.fc(x, size=3, act=paddle.activation.Tanh(), name="h")
+    bad = layers.get_output(h, "nope")
+    net = CompiledNetwork(Topology([bad]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    with pytest.raises(KeyError):
+        net.apply(params, {"x": SeqTensor(np.zeros((1, 4), np.float32))}, state=state)
+
+
+def test_agent_and_scatter_gather():
+    reset_auto_names()
+    src = layers.data("src", paddle.data_type.dense_vector_sequence(2))
+    ids = layers.data("ids", paddle.data_type.integer_value(4))
+    ag = layers.agent(src, name="view")
+    sc = layers.scatter_agent(src, ids, name="sc")
+    ga = layers.gather_agent([src, src], name="ga")
+    net = CompiledNetwork(Topology([ag, sc, ga]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    data = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    batch = {
+        "src": seq(data, [3, 2]),
+        "ids": SeqTensor(np.asarray([1, 1, 0], np.int32)),
+    }
+    outs, _ = net.apply(params, batch, state=state)
+    np.testing.assert_allclose(np.asarray(outs["view"].data), data)
+    got = outs["sc"]
+    np.testing.assert_allclose(np.asarray(got.data), data[[1, 1, 0]])
+    np.testing.assert_array_equal(np.asarray(got.lengths), [2, 2, 3])
+    ga_out = outs["ga"]
+    np.testing.assert_array_equal(np.asarray(ga_out.lengths), [6, 4])
+    # sample 1 (len 2): gathered = its 2 rows twice back-to-back
+    np.testing.assert_allclose(np.asarray(ga_out.data[1, :4]),
+                               np.concatenate([data[1, :2], data[1, :2]]))
+
+
+# ---------------------------------------------------------------------------
+# SparseMomentum + pruning hook
+# ---------------------------------------------------------------------------
+
+
+def _toy_trainer(update_eq, param_attr=None):
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector(6))
+    y = layers.data("y", paddle.data_type.integer_value(3))
+    h = layers.fc(x, size=12, act=paddle.activation.Tanh(),
+                  param_attr=param_attr, name="h")
+    pred = layers.fc(h, size=3, act=paddle.activation.Softmax())
+    cost = layers.classification_cost(input=pred, label=y)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost=cost, parameters=params, update_equation=update_eq)
+    rng = np.random.RandomState(0)
+    centers = rng.randn(3, 6) * 2
+
+    def reader():
+        for _ in range(90):
+            c = rng.randint(3)
+            yield centers[c] + rng.randn(6) * 0.3, c
+
+    costs = []
+    trainer.train(reader=paddle.batch(reader, 15), num_passes=5,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, paddle.event.EndIteration) else None)
+    return trainer, costs
+
+
+def test_sparse_momentum_trains():
+    trainer, costs = _toy_trainer(
+        paddle.optimizer.SparseMomentum(momentum=0.9, learning_rate=0.1)
+    )
+    assert np.mean(costs[-3:]) < 0.5 * np.mean(costs[:3])
+
+
+def test_static_pruning_hook():
+    hook = paddle.attr.HookAttribute(type="pruning", sparsity_ratio=0.5)
+    trainer, costs = _toy_trainer(
+        paddle.optimizer.Adam(learning_rate=5e-2),
+        param_attr=paddle.attr.ParamAttr(update_hooks=hook),
+    )
+    w = np.asarray(trainer.parameters.params["h"]["w0"])
+    sparsity = float((w == 0).mean())
+    assert sparsity >= 0.45, sparsity  # ~half the weights pinned to zero
+    # and the model still learned
+    assert np.mean(costs[-3:]) < 0.6 * np.mean(costs[:3])
+    # bias was NOT pruned
+    b = np.asarray(trainer.parameters.params["h"]["b"])
+    assert (b != 0).mean() > 0.5

@@ -308,7 +308,7 @@ def _craft_bad_header(path, n_records=None, first_len=None):
 @pytest.mark.parametrize("force_py", [False, True])
 def test_crafted_header_n_records(tmp_path, monkeypatch, force_py):
     """n_records claiming a length table bigger than the body must surface as
-    a corrupt chunk, not an out-of-bounds read (ADVICE r1, native/recordio.cc
+    a corrupt chunk, not an out-of-bounds read (ADVICE r1, paddle_tpu/native/recordio.cc
     load_chunk)."""
     if force_py:
         monkeypatch.setattr(recordio, "_load_native", lambda: None)
