@@ -36,14 +36,19 @@ from paddle_tpu.minibatch import batch  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu.inference import Inference, infer  # noqa: F401
 from paddle_tpu import v1_compat  # noqa: F401
+from paddle_tpu import plot  # noqa: F401
+from paddle_tpu import image  # noqa: F401
+from paddle_tpu import launcher  # noqa: F401
+from paddle_tpu.utils import flags  # noqa: F401
+from paddle_tpu.utils import profiler  # noqa: F401
 
 __version__ = "0.1.0"
 
 
 def init(
-    use_tpu: bool = True,
-    trainer_count: int = 1,
-    seed: int = 0,
+    use_tpu=None,
+    trainer_count=None,
+    seed=None,
     compute_dtype=None,
     **kwargs,
 ) -> None:
@@ -54,14 +59,45 @@ def init(
 
     compute_dtype: 'bfloat16' enables mixed precision for networks built
     after this call (master params stay float32; see core.compiler).
+
+    Remaining keyword arguments set flags from the global flags plane
+    (utils/flags.py — the gflags surface, e.g. check_nans=True,
+    log_period=50); unknown names are accepted-and-ignored like the
+    reference's tolerant command-line init.
     """
     import random
 
     import numpy as np
 
-    random.seed(seed)
-    np.random.seed(seed)
+    from paddle_tpu.utils import flags as _flags
+
+    # Only arguments the caller actually passed enter the explicit layer —
+    # otherwise init()'s python defaults would mask PADDLE_TPU_* env
+    # overrides (the documented defaults < env < explicit precedence).
+    explicit = {
+        k: v
+        for k, v in dict(
+            use_tpu=use_tpu, trainer_count=trainer_count, seed=seed
+        ).items()
+        if v is not None
+    }
+    if "use_tpu" in explicit:
+        explicit["use_tpu"] = bool(explicit["use_tpu"])
+    _flags.set_flags(**explicit)
+    seed_val = _flags.get_flag("seed")
+    random.seed(seed_val)
+    np.random.seed(seed_val)
+    for k, v in kwargs.items():
+        try:
+            _flags.set_flag(k, v)
+        except KeyError:
+            pass  # v1 configs pass gpu-era flags; accept silently
     if compute_dtype is not None:
+        _flags.set_flag("compute_dtype", str(compute_dtype))
         from paddle_tpu.core.compiler import set_default_compute_dtype
 
         set_default_compute_dtype(compute_dtype)
+    if _flags.get_flag("check_nans"):
+        from paddle_tpu.utils.profiler import enable_nan_checks
+
+        enable_nan_checks(True)

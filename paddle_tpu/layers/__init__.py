@@ -93,6 +93,17 @@ def _param_name(param_attr: Optional[ParamAttr]):
     return param_attr.name if param_attr else None
 
 
+def _param_attrs(param_attr: Optional[ParamAttr]) -> dict:
+    """The generic per-parameter attr bundle every param_attr-taking layer
+    stores: init std, shared-parameter name, pruning hook ratio.  Assembled
+    in one place so hooks/sharing work uniformly across layer types."""
+    return {
+        "param_std": _param_std(param_attr),
+        "param_name": _param_name(param_attr),
+        "prune_sparsity": _prune_ratio(param_attr),
+    }
+
+
 def _prune_ratio(param_attr: Optional[ParamAttr]):
     """sparsity_ratio of a 'pruning' update hook, or None (reference
     StaticPruningHook — see attr.HookAttribute)."""
@@ -181,11 +192,7 @@ def fc(
         inputs=tuple(i.name for i in ins),
         act=act_name(act if act is not None else _act_mod.Tanh()),
         bias=bool(bias_attr),
-        attrs={
-            "param_std": _param_std(param_attr),
-            "param_name": _param_name(param_attr),
-            "prune_sparsity": _prune_ratio(param_attr),
-        },
+        attrs=_param_attrs(param_attr),
         drop_rate=drop,
         shard_axis=shard,
     )
@@ -210,9 +217,7 @@ def embedding(
         inputs=(input.name,),
         bias=False,
         attrs={
-            "param_std": _param_std(param_attr),
-            "param_name": _param_name(param_attr),
-            "prune_sparsity": _prune_ratio(param_attr),
+            **_param_attrs(param_attr),
             # sparse_update=True row-shards the table over the mesh model
             # axis (the sparse-remote-update path of the reference,
             # RemoteParameterUpdater.h:265 — see parallel/sharding.py)
@@ -335,7 +340,11 @@ def img_conv(
     ph = padding_y if padding_y is not None else padding
     pw = padding
     if trans:
-        assert num_filters % groups == 0 and in_c % groups == 0
+        if num_filters % groups or in_c % groups:
+            raise ValueError(
+                f"transpose conv groups={groups} must divide both in_c "
+                f"({in_c}) and num_filters ({num_filters})"
+            )
         out_h = (in_h - 1) * sh + fh - 2 * ph
         out_w = (in_w - 1) * sw + fw - 2 * pw
     else:
@@ -360,6 +369,7 @@ def img_conv(
             "pad_h": ph,
             "pad_w": pw,
             "groups": groups,
+            **_param_attrs(param_attr),
             "channels": num_filters,
             "out_h": out_h,
             "out_w": out_w,
@@ -963,7 +973,7 @@ def lstmemory(
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
             "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
-            "param_std": _param_std(param_attr),
+            **_param_attrs(param_attr),
         },
     )
     return LayerOutput(conf, [input])
@@ -1015,7 +1025,7 @@ def recurrent(
         bias=bool(bias_attr),
         drop_rate=drop,
         shard_axis=shard,
-        attrs={"reverse": reverse, "param_std": _param_std(param_attr)},
+        attrs={"reverse": reverse, **_param_attrs(param_attr)},
     )
     return LayerOutput(conf, [input])
 
@@ -1390,8 +1400,7 @@ def selective_fc(
         inputs=tuple(p.name for p in parents),
         act=act_name(act),
         bias=bool(bias_attr),
-        attrs={"has_selection": select is not None,
-               "param_std": _param_std(param_attr)},
+        attrs={"has_selection": select is not None, **_param_attrs(param_attr)},
     )
     return LayerOutput(conf, parents)
 
@@ -1442,11 +1451,7 @@ def crf(
         size=1,
         inputs=(input.name, label.name),
         bias=False,
-        attrs={
-            "num_classes": n,
-            "param_std": _param_std(param_attr),
-            "param_name": _param_name(param_attr),
-        },
+        attrs={"num_classes": n, **_param_attrs(param_attr)},
     )
     return LayerOutput(conf, [input, label])
 
@@ -1472,9 +1477,7 @@ def crf_decoding(
         size=n,
         inputs=tuple(p.name for p in parents),
         bias=False,
-        attrs={"num_classes": n,
-            "param_std": _param_std(param_attr),
-            "param_name": _param_name(param_attr)},
+        attrs={"num_classes": n, **_param_attrs(param_attr)},
     )
     return LayerOutput(conf, parents)
 

@@ -44,9 +44,9 @@ def mdlstm_apply(conf, params, inputs, ctx):
         f"{conf.name}: input must be pre-projected to 5*size gates "
         f"(got {c_in} channels for size {n})"
     )
-    x = inputs[0].data
-    if x.ndim == 2:  # flat CHW from a non-conv producer
-        x = x.reshape(x.shape[0], c_in, h_img, w_img).transpose(0, 2, 3, 1)
+    from paddle_tpu.layers.conv import to_nhwc
+
+    x = to_nhwc(inputs[0].data, h_img, w_img, c_in)
     b = x.shape[0]
     if a.get("reverse_h"):
         x = jnp.flip(x, axis=1)

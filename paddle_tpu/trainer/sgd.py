@@ -178,6 +178,12 @@ class SGD:
         saving_period_by_batches / start_pass)."""
         if event_handler is None:
             event_handler = lambda e: None
+        if not show_parameter_stats_period:
+            from paddle_tpu.utils import flags as _flags
+
+            show_parameter_stats_period = _flags.get_flag(
+                "show_parameter_stats_period"
+            )
         feeder = self._make_feeder(feeding)
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state

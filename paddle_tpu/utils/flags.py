@@ -1,0 +1,75 @@
+"""Global flags plane — the gflags equivalent (reference:
+paddle/utils/Flags.{h,cpp} DEFINE_bool/int32/string and the
+``paddle.init(use_gpu=..., trainer_count=...)`` surface that forwarded
+them).
+
+Typed registry with three override layers, strongest last:
+defaults < environment (``PADDLE_TPU_<NAME>``) < explicit ``set_flag`` /
+``paddle.init(**kwargs)``.  Unknown names raise — the reference gflags
+aborts on unknown flags the same way."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_DEFS: Dict[str, tuple] = {}  # name -> (type, default, help)
+_VALUES: Dict[str, Any] = {}
+
+_ENV_PREFIX = "PADDLE_TPU_"
+
+
+def define_flag(name: str, default, help_: str = "") -> None:
+    _DEFS[name] = (type(default), default, help_)
+
+
+def _coerce(name: str, value):
+    t = _DEFS[name][0]
+    if t is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes")
+    return t(value)
+
+
+def get_flag(name: str):
+    if name not in _DEFS:
+        raise KeyError(f"unknown flag {name!r}; defined: {sorted(_DEFS)}")
+    if name in _VALUES:
+        return _VALUES[name]
+    env = os.environ.get(_ENV_PREFIX + name.upper())
+    if env is not None:
+        return _coerce(name, env)
+    return _DEFS[name][1]
+
+
+def set_flag(name: str, value) -> None:
+    if name not in _DEFS:
+        raise KeyError(f"unknown flag {name!r}; defined: {sorted(_DEFS)}")
+    _VALUES[name] = _coerce(name, value)
+
+
+def set_flags(**kwargs) -> None:
+    for k, v in kwargs.items():
+        set_flag(k, v)
+
+
+def all_flags() -> Dict[str, Any]:
+    return {name: get_flag(name) for name in _DEFS}
+
+
+def reset_flags() -> None:
+    _VALUES.clear()
+
+
+# -- the reference flag set that still means something on TPU ---------------
+# (Flags.cpp: use_gpu/trainer_count/log_period/show_parameter_stats_period/
+#  seed/beam_size...; pserver networking flags are obsolete — the mesh
+#  replaces them.)
+define_flag("use_tpu", True, "accepted for surface compat; platform comes from jax")
+define_flag("trainer_count", 1, "local data-parallel width hint")
+define_flag("seed", 0, "global RNG seed")
+define_flag("log_period", 100, "log training stats every N batches")
+define_flag("show_parameter_stats_period", 0, "log per-parameter stats every N batches (0=off)")
+define_flag("beam_size", 5, "default generation beam width")
+define_flag("check_nans", False, "enable jax nan-debugging (FP trap equivalent)")
+define_flag("compute_dtype", "", "bfloat16 enables mixed precision")
+define_flag("profile_dir", "", "write jax profiler traces here when set")

@@ -1,0 +1,49 @@
+"""Device profiling + numeric traps.
+
+* :func:`profile` wraps ``jax.profiler.trace``: every layer already runs
+  under ``jax.named_scope("type:name")`` (core/compiler.py), so the
+  resulting TensorBoard/Perfetto timeline attributes fused XLA ops back to
+  layers — the device-side half of the reference's per-layer
+  REGISTER_TIMER_INFO (NeuralNetwork.cpp:247,288).  Host-side timers live
+  in utils/timers.py, eager per-layer timing in utils/debug.py.
+
+* :func:`enable_nan_checks` is the FP-trap equivalent (the reference
+  installs SIGFPE handlers / CHECKs on nan paths): jax re-runs any
+  computation that produced a nan un-jitted and raises with the exact
+  primitive — combined with the compiler's layer-context notes the error
+  names the offending layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(logdir: str) -> Iterator[None]:
+    """::
+
+        with paddle.utils.profiler.profile("/tmp/trace"):
+            trainer.train(...)
+
+    then `tensorboard --logdir /tmp/trace` (or open the .trace in Perfetto).
+    """
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def start(logdir: str) -> None:
+    jax.profiler.start_trace(logdir)
+
+
+def stop() -> None:
+    jax.profiler.stop_trace()
+
+
+def enable_nan_checks(enable: bool = True) -> None:
+    """Trap nans/infs produced by any jitted computation (debug-mode only:
+    forces re-execution without jit on failure)."""
+    jax.config.update("jax_debug_nans", enable)

@@ -1,0 +1,115 @@
+"""Observability plane: flags (gflags equivalent), plot, image utils,
+profiler wiring, nan trap."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    flags.reset_flags()
+
+
+def test_flags_layers_of_override(monkeypatch):
+    assert flags.get_flag("log_period") == 100  # default
+    monkeypatch.setenv("PADDLE_TPU_LOG_PERIOD", "7")
+    assert flags.get_flag("log_period") == 7  # env override
+    flags.set_flag("log_period", 3)
+    assert flags.get_flag("log_period") == 3  # explicit wins
+    with pytest.raises(KeyError):
+        flags.get_flag("no_such_flag")
+    with pytest.raises(KeyError):
+        flags.set_flag("no_such_flag", 1)
+
+
+def test_flags_bool_coercion(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NANS", "true")
+    assert flags.get_flag("check_nans") is True
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NANS", "0")
+    assert flags.get_flag("check_nans") is False
+
+
+def test_init_sets_flags_and_ignores_gpu_era_names():
+    paddle.init(trainer_count=4, log_period=9, use_gpu=False, gpu_id=2)
+    assert flags.get_flag("trainer_count") == 4
+    assert flags.get_flag("log_period") == 9
+
+
+def test_ploter_records_and_renders(tmp_path):
+    p = paddle.plot.Ploter("train", "test")
+    for i in range(5):
+        p.append("train", i, 1.0 / (i + 1))
+    p.append("test", 4, 0.3)
+    assert p.data("train").step == [0, 1, 2, 3, 4]
+    out = tmp_path / "curve.png"
+    p.plot(str(out))
+    # rendered when matplotlib exists; silent otherwise — both acceptable
+    if p._plt is not None:
+        assert out.exists() and out.stat().st_size > 0
+    p.reset()
+    assert p.data("train").step == []
+
+
+def test_image_transforms():
+    from paddle_tpu import image as I
+
+    rng = np.random.RandomState(0)
+    im = (rng.rand(40, 60, 3) * 255).astype(np.uint8)
+    r = I.resize_short(im, 20)
+    assert r.shape == (20, 30, 3)  # short edge 20, aspect kept
+    c = I.center_crop(r, 16)
+    assert c.shape == (16, 16, 3)
+    rc = I.random_crop(r, 16, rng=np.random.RandomState(1))
+    assert rc.shape == (16, 16, 3)
+    f = I.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, 0], c[:, -1])
+    chw = I.to_chw(c)
+    assert chw.shape == (3, 16, 16)
+    t = I.simple_transform(im, 24, 16, is_train=False, mean=np.zeros(3))
+    assert t.shape == (3, 16, 16) and t.dtype == np.float32
+    t2 = I.simple_transform(
+        im, 24, 16, is_train=True, rng=np.random.RandomState(2)
+    )
+    assert t2.shape == (3, 16, 16)
+
+
+def test_image_resize_values():
+    from paddle_tpu import image as I
+
+    # constant image stays constant under bilinear resize
+    im = np.full((10, 10, 3), 7, np.uint8)
+    assert (I.resize_short(im, 5) == 7).all()
+
+
+def test_profiler_trace_writes(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils import profiler
+
+    with profiler.profile(str(tmp_path)):
+        jax.jit(lambda x: x * 2)(jnp.ones((8, 8))).block_until_ready()
+    files = [
+        os.path.join(r, f) for r, _, fs in os.walk(tmp_path) for f in fs
+    ]
+    assert files, "profiler trace produced no files"
+
+
+def test_nan_trap():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils import profiler
+
+    profiler.enable_nan_checks(True)
+    try:
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.zeros(3) - 1.0).block_until_ready()
+    finally:
+        profiler.enable_nan_checks(False)
