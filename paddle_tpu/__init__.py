@@ -94,9 +94,11 @@ def init(
             pass  # v1 configs pass gpu-era flags; accept silently
     if compute_dtype is not None:
         _flags.set_flag("compute_dtype", str(compute_dtype))
+    dtype_flag = _flags.get_flag("compute_dtype")
+    if dtype_flag:
         from paddle_tpu.core.compiler import set_default_compute_dtype
 
-        set_default_compute_dtype(compute_dtype)
+        set_default_compute_dtype(dtype_flag)
     if _flags.get_flag("check_nans"):
         from paddle_tpu.utils.profiler import enable_nan_checks
 

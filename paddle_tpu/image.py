@@ -94,6 +94,8 @@ def to_chw(im: np.ndarray, order: Sequence[int] = (2, 0, 1)) -> np.ndarray:
 
 def center_crop(im: np.ndarray, size: int, is_color: bool = True) -> np.ndarray:
     h, w = im.shape[:2]
+    if size > h or size > w:
+        raise ValueError(f"crop size {size} exceeds image {h}x{w}")
     h_start = (h - size) // 2
     w_start = (w - size) // 2
     return im[h_start : h_start + size, w_start : w_start + size]

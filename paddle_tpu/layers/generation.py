@@ -53,7 +53,7 @@ def beam_search(
     input: Sequence[Union[GeneratedInput, StaticInput]],
     bos_id: int,
     eos_id: int,
-    beam_size: int = 5,
+    beam_size: Optional[int] = None,
     max_length: int = 30,
     num_results_per_sample: Optional[int] = None,
     name: Optional[str] = None,
@@ -67,6 +67,10 @@ def beam_search(
     Output: int32 ids [B, K, T] sorted best-first; beam scores are exposed as
     the auxiliary output ``<name>@scores`` ([B, K]).
     """
+    if beam_size is None:
+        from paddle_tpu.utils.flags import get_flag
+
+        beam_size = get_flag("beam_size")
     gens = [i for i in input if isinstance(i, GeneratedInput)]
     statics = [i for i in input if isinstance(i, StaticInput)]
     assert len(gens) == 1, "beam_search needs exactly one GeneratedInput"

@@ -1,0 +1,271 @@
+"""Master high availability: leader election + hot standby failover.
+
+Reference: the Go master wins leadership through an etcd campaign, keeps it
+with a lease, snapshots its queues into etcd, and a standby that wins the
+next campaign recovers from the snapshot while clients re-resolve the
+master address from etcd (go/master/etcd_client.go).
+
+etcd-free equivalent over shared storage (a TPU pod's coordinator hosts
+share a filesystem): leadership is a LEASE FILE renewed by mtime heartbeat,
+takeover is an atomic rename of a claim file, the queue snapshot is the
+Service's existing JSON file, and the leader publishes its RPC address in
+an endpoint file clients poll — the same four etcd roles (campaign, lease,
+state, discovery), one directory.
+
+    ha = HAMaster(dir, patterns)      # every candidate host runs this
+    ha.start()                        # blocks until leader OR standby-watch
+    ...
+    client = HAClient(dir)            # discovers + follows the leader
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from paddle_tpu.master import Client, Server, Service
+
+__all__ = ["LeaseFile", "HAMaster", "HAClient", "discover_endpoint"]
+
+
+class LeaseFile:
+    """Heartbeat-lease leader election in a directory.
+
+    The leader owns ``leader.lease`` and renews its mtime; a candidate may
+    claim leadership only when the lease is missing or stale (now - mtime >
+    lease_timeout).  Claims go through an exclusively-created claim file +
+    atomic rename so two candidates racing for a stale lease cannot both
+    win (the one whose rename lands second just overwrites with its own
+    identity and the loser detects the foreign owner on verify)."""
+
+    def __init__(self, dir_: str, owner_id: str, lease_timeout: float = 5.0):
+        self.dir = dir_
+        self.owner_id = owner_id
+        self.lease_timeout = lease_timeout
+        self.path = os.path.join(dir_, "leader.lease")
+        os.makedirs(dir_, exist_ok=True)
+
+    # -- inspection ------------------------------------------------------
+    def current_owner(self) -> Optional[str]:
+        try:
+            with open(self.path) as f:
+                return json.load(f)["owner"]
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def is_stale(self) -> bool:
+        try:
+            return time.time() - os.path.getmtime(self.path) > self.lease_timeout
+        except OSError:
+            return True  # missing == stale
+
+    def held_by_me(self) -> bool:
+        return self.current_owner() == self.owner_id and not self.is_stale()
+
+    # -- campaign --------------------------------------------------------
+    def try_acquire(self) -> bool:
+        if not self.is_stale():
+            return self.current_owner() == self.owner_id
+        claim = os.path.join(self.dir, f".claim-{self.owner_id}")
+        with open(claim, "w") as f:
+            json.dump({"owner": self.owner_id, "t": time.time()}, f)
+        os.replace(claim, self.path)
+        # verify after the dust settles: a racing rename may have landed on
+        # top of ours (last-writer-wins is exactly one winner)
+        time.sleep(0.01)
+        return self.current_owner() == self.owner_id
+
+    def renew(self) -> bool:
+        if self.current_owner() != self.owner_id:
+            return False  # usurped (we were stale and someone claimed)
+        os.utime(self.path, None)
+        return True
+
+    def release(self) -> None:
+        if self.current_owner() == self.owner_id:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+def _endpoint_path(dir_: str) -> str:
+    return os.path.join(dir_, "endpoint.json")
+
+
+def discover_endpoint(dir_: str) -> Optional[tuple]:
+    """(host, port) of the current leader, or None (reference: clients
+    watch the etcd master-addr key, etcd_client.go GetKey)."""
+    try:
+        with open(_endpoint_path(dir_)) as f:
+            d = json.load(f)
+        return (d["host"], d["port"])
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+class HAMaster:
+    """One master candidate.  start() campaigns; the winner serves the
+    task queues (recovering them from the shared snapshot), losers keep
+    watching and take over when the lease goes stale."""
+
+    def __init__(
+        self,
+        dir_: str,
+        patterns: Sequence[str],
+        owner_id: Optional[str] = None,
+        lease_timeout: float = 5.0,
+        renew_interval: Optional[float] = None,
+        address=("127.0.0.1", 0),
+        **service_kw,
+    ):
+        self.dir = dir_
+        self.patterns = list(patterns)
+        self.owner_id = owner_id or f"{os.uname().nodename}:{os.getpid()}"
+        self.lease = LeaseFile(dir_, self.owner_id, lease_timeout)
+        self.renew_interval = renew_interval or lease_timeout / 3.0
+        self._address = address
+        self._service_kw = dict(service_kw)
+        self._service_kw.setdefault(
+            "snapshot_path", os.path.join(dir_, "master_state.json")
+        )
+        self.service: Optional[Service] = None
+        self.server: Optional[Server] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.is_leader = threading.Event()
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def wait_leader(self, timeout: Optional[float] = None) -> bool:
+        return self.is_leader.wait(timeout)
+
+    def _become_leader(self) -> None:
+        # Recover the queues from the shared snapshot (a fresh cluster has
+        # none; set_dataset is idempotent against recovered state).
+        self.service = Service(**self._service_kw)
+        self.service.set_dataset(self.patterns)
+        self.server = Server(self.service, address=self._address)
+        host, port = self.server.address
+        tmp = _endpoint_path(self.dir) + f".{self.owner_id}"
+        with open(tmp, "w") as f:
+            json.dump({"host": host, "port": port, "owner": self.owner_id}, f)
+        os.replace(tmp, _endpoint_path(self.dir))
+        self.is_leader.set()
+
+    def _step_down(self) -> None:
+        self.is_leader.clear()
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        self.service = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.is_leader.is_set():
+                if not self.lease.renew():
+                    self._step_down()  # usurped after a stall
+                self._stop.wait(self.renew_interval)
+            else:
+                if self.lease.try_acquire():
+                    self._become_leader()
+                else:
+                    self._stop.wait(self.renew_interval)
+        if self.is_leader.is_set():
+            self._step_down()
+            self.lease.release()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # test hook: simulate a crashed leader (no release, no renewals)
+    def freeze(self) -> None:
+        self._stop.set()
+        self.is_leader.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+
+
+class HAClient:
+    """Client that discovers the leader from the endpoint file and
+    re-resolves + reconnects when the master fails over (the reference
+    client watches etcd and reconnects, client.go)."""
+
+    def __init__(self, dir_: str, timeout: float = 30.0, **client_kw):
+        self.dir = dir_
+        self.timeout = timeout
+        self._client_kw = client_kw
+        self._client: Optional[Client] = None
+        self._endpoint = None
+
+    def _connect(self) -> Client:
+        deadline = time.time() + self.timeout
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            ep = discover_endpoint(self.dir)
+            if ep is not None:
+                try:
+                    c = Client(ep, **self._client_kw)
+                    self._endpoint = ep
+                    return c
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+            time.sleep(0.1)
+        raise TimeoutError(f"no master leader in {self.dir}: {last_err}")
+
+    def _call(self, method, *args):
+        deadline = time.time() + self.timeout
+        while True:
+            if self._client is None:
+                self._client = self._connect()
+            try:
+                return getattr(self._client, method)(*args)
+            except (ConnectionError, EOFError, OSError, RuntimeError):
+                # leader died mid-call: drop the connection, re-discover
+                try:
+                    self._client.close()
+                except Exception:
+                    pass
+                self._client = None
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    # -- surface (the Client subset trainers use) ------------------------
+    def set_dataset(self, patterns):
+        return self._call("set_dataset", patterns)
+
+    def next_record(self):
+        return self._call("next_record")
+
+    def start_new_pass(self):
+        return self._call("start_new_pass")
+
+    def request_save_model(self, block_secs: float = 60.0):
+        return self._call("request_save_model", block_secs)
+
+    def reader(self):
+        def _reader():
+            while True:
+                rec = self.next_record()
+                if rec is None:
+                    return
+                yield rec
+
+        return _reader
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None

@@ -43,12 +43,17 @@ class Ploter:
         self._plt = None
         if not self._disabled:
             try:
+                import sys
+
                 import matplotlib
 
-                if not os.environ.get("DISPLAY"):
-                    # headless: pick Agg only if no backend is in use yet —
-                    # never hijack an interactive/notebook backend
-                    matplotlib.use("Agg", force=False)
+                if (
+                    not os.environ.get("DISPLAY")
+                    and "matplotlib.pyplot" not in sys.modules
+                ):
+                    # headless AND nothing rendered yet: choose Agg; never
+                    # switch a backend a notebook/session already activated
+                    matplotlib.use("Agg")
                 import matplotlib.pyplot as plt
 
                 self._plt = plt

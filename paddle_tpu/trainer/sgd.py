@@ -169,7 +169,7 @@ class SGD:
         saving_period: int = 1,
         saving_period_by_batches: Optional[int] = None,
         start_pass: int = 0,
-        show_parameter_stats_period: int = 0,
+        show_parameter_stats_period: Optional[int] = None,
     ) -> None:
         """Pass loop with the reference trainer's checkpoint cadence: every
         `saving_period` passes (and optionally every `saving_period_by_batches`
@@ -178,12 +178,13 @@ class SGD:
         saving_period_by_batches / start_pass)."""
         if event_handler is None:
             event_handler = lambda e: None
-        if not show_parameter_stats_period:
-            from paddle_tpu.utils import flags as _flags
+        from paddle_tpu.utils import flags as _flags
 
+        if show_parameter_stats_period is None:  # explicit 0 still disables
             show_parameter_stats_period = _flags.get_flag(
                 "show_parameter_stats_period"
             )
+        log_period = _flags.get_flag("log_period")
         feeder = self._make_feeder(feeding)
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state
@@ -202,6 +203,11 @@ class SGD:
                         params, state, opt_state, batch, step_rng
                     )
                 self._step_count += 1
+                if log_period and self._step_count % log_period == 0:
+                    _log.info(
+                        "pass %d batch %d cost %.6f",
+                        pass_id, batch_id, float(metrics["cost"]),
+                    )
                 if (
                     show_parameter_stats_period
                     and self._step_count % show_parameter_stats_period == 0

@@ -65,7 +65,7 @@ def reset_flags() -> None:
 #  seed/beam_size...; pserver networking flags are obsolete — the mesh
 #  replaces them.)
 define_flag("use_tpu", True, "accepted for surface compat; platform comes from jax")
-define_flag("trainer_count", 1, "local data-parallel width hint")
+define_flag("trainer_count", 1, "accepted for surface compat; parallelism comes from the mesh")
 define_flag("seed", 0, "global RNG seed")
 define_flag("log_period", 100, "log training stats every N batches")
 define_flag("show_parameter_stats_period", 0, "log per-parameter stats every N batches (0=off)")

@@ -23,14 +23,23 @@ import jax
 
 
 @contextlib.contextmanager
-def profile(logdir: str) -> Iterator[None]:
+def profile(logdir: Optional[str] = None) -> Iterator[None]:
     """::
 
         with paddle.utils.profiler.profile("/tmp/trace"):
             trainer.train(...)
 
     then `tensorboard --logdir /tmp/trace` (or open the .trace in Perfetto).
-    """
+    With no argument, the `profile_dir` flag (PADDLE_TPU_PROFILE_DIR) names
+    the directory."""
+    if logdir is None:
+        from paddle_tpu.utils.flags import get_flag
+
+        logdir = get_flag("profile_dir")
+        if not logdir:
+            raise ValueError(
+                "no logdir given and the profile_dir flag is unset"
+            )
     with jax.profiler.trace(logdir):
         yield
 
