@@ -92,13 +92,20 @@ def init(
             _flags.set_flag(k, v)
         except KeyError:
             pass  # v1 configs pass gpu-era flags; accept silently
-    if compute_dtype is not None:
-        _flags.set_flag("compute_dtype", str(compute_dtype))
-    dtype_flag = _flags.get_flag("compute_dtype")
-    if dtype_flag:
+    # compute_dtype comes from THIS call's argument, else the flag plane
+    # (env PADDLE_TPU_COMPUTE_DTYPE or an explicit flags.set_flag).  init
+    # never WRITES the flag: the argument is per-call configuration, so a
+    # later bare init() (or set_default_compute_dtype(None)) is not
+    # silently overridden by an earlier call's choice.
+    dtype_src = (
+        compute_dtype
+        if compute_dtype is not None
+        else _flags.get_flag("compute_dtype")
+    )
+    if dtype_src:
         from paddle_tpu.core.compiler import set_default_compute_dtype
 
-        set_default_compute_dtype(dtype_flag)
+        set_default_compute_dtype(dtype_src)
     if _flags.get_flag("check_nans"):
         from paddle_tpu.utils.profiler import enable_nan_checks
 

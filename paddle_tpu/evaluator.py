@@ -421,6 +421,76 @@ def maxid_printer_evaluator(input: LayerOutput, name: Optional[str] = None) -> E
     return Evaluator(nm, [input], update, lambda a: {})
 
 
+def seq_text_printer_evaluator(
+    input: LayerOutput,
+    id_to_word=None,
+    result_file: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Evaluator:
+    """Print id sequences as text (reference seqtext_printer_evaluator,
+    trainer_config_helpers/evaluators.py: dict_file + result_file).
+    `id_to_word` maps id→token (dict/list/callable); None prints raw ids.
+    The print runs host-side via io_callback so it works under jit."""
+    nm = name or auto_name("seq_text_printer")
+
+    def to_text(ids, lengths):
+        import numpy as np
+
+        lines = []
+        ids = np.asarray(ids)
+        lengths = None if lengths is None else np.asarray(lengths)
+        for i in range(ids.shape[0]):
+            row = ids[i][: int(lengths[i])] if lengths is not None else ids[i]
+            if id_to_word is None:
+                toks = [str(int(t)) for t in row.reshape(-1)]
+            elif callable(id_to_word):
+                toks = [str(id_to_word(int(t))) for t in row.reshape(-1)]
+            else:
+                toks = [str(id_to_word[int(t)]) for t in row.reshape(-1)]
+            lines.append(" ".join(toks))
+        text = "\n".join(lines)
+        if result_file:
+            with open(result_file, "a") as f:
+                f.write(text + "\n")
+        else:
+            print(f"{nm}:\n{text}")
+
+    def update(outs):
+        t = outs[input.name]
+        if t.is_seq:
+            jax.experimental.io_callback(
+                to_text, None, t.data, t.lengths, ordered=True
+            )
+        else:
+            jax.experimental.io_callback(to_text, None, t.data, None, ordered=True)
+        return {}
+
+    return Evaluator(nm, [input], update, lambda a: {})
+
+
+def gradient_printer_evaluator(
+    input: LayerOutput, name: Optional[str] = None
+) -> Evaluator:
+    """reference gradient_printer_evaluator prints a layer's output
+    gradient mid-backward.  Backward here is one jax.grad over the whole
+    step, so the per-layer output gradient is not materialized in the
+    evaluator's (forward) view — the equivalent diagnostic is
+    utils.debug.gradient_stats, which computes per-parameter gradient norms
+    with a dedicated jax.grad.  This evaluator prints the layer's forward
+    VALUE norm so v1 configs still run, and points at gradient_stats."""
+    nm = name or auto_name("gradient_printer")
+
+    def update(outs):
+        v = outs[input.name].data
+        jax.debug.print(
+            nm + " forward-norm {n} (use utils.debug.gradient_stats for "
+            "gradient norms)", n=jnp.linalg.norm(v.astype(jnp.float32)),
+        )
+        return {}
+
+    return Evaluator(nm, [input], update, lambda a: {})
+
+
 # ---------------------------------------------------------------------------
 # detection mAP (reference DetectionMAPEvaluator.cpp:306)
 # ---------------------------------------------------------------------------

@@ -1842,10 +1842,13 @@ def multi_head_attention(
     n_heads: int = 8,
     causal: bool = False,
     bias_attr: bool = True,
+    seq_parallel_axis: Optional[str] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """Multi-head attention; omit key_value for self-attention.  `causal`
-    masks future positions (decoder self-attention)."""
+    masks future positions (decoder self-attention).  `seq_parallel_axis`
+    names a mesh axis to shard the sequence over — self-attention then runs
+    as exact ring attention (long-context path, parallel/ring_attention)."""
     kv = key_value or query
     conf = LayerConf(
         name=name or auto_name("mha"),
@@ -1853,7 +1856,11 @@ def multi_head_attention(
         size=size or query.size,
         inputs=(query.name, kv.name),
         bias=bool(bias_attr),
-        attrs={"n_heads": n_heads, "causal": causal},
+        attrs={
+            "n_heads": n_heads,
+            "causal": causal,
+            "seq_parallel_axis": seq_parallel_axis,
+        },
     )
     return LayerOutput(conf, [query, kv])
 

@@ -95,6 +95,38 @@ def mha_apply(conf, params, inputs, ctx):
     k = k.reshape(b, tk, h, dh)
     v = v.reshape(b, tk, h, dh)
 
+    sp_axis = conf.attr("seq_parallel_axis")
+    if sp_axis is not None and tq == tk:
+        # context parallelism: shard T over the mesh axis and run exact
+        # ring attention (parallel/ring_attention.py) instead of the dense
+        # [T, T] score matrix — the long-context path.
+        from paddle_tpu.parallel.mesh import get_default_mesh
+        from paddle_tpu.parallel.ring_attention import (
+            sequence_parallel_attention,
+        )
+
+        mesh = get_default_mesh()
+        if mesh is None or tq % mesh.shape[sp_axis] != 0:
+            import warnings
+
+            warnings.warn(
+                f"{conf.name}: seq_parallel_axis={sp_axis!r} requested but "
+                + ("no default mesh is set" if mesh is None else
+                   f"T={tq} is not divisible by the {mesh.shape[sp_axis]}-way "
+                   f"ring") + "; falling back to dense O(T^2) attention",
+                stacklevel=2,
+            )
+        else:
+            out = sequence_parallel_attention(
+                q, k, v, mesh, sp_axis,
+                lengths=kv_in.lengths if kv_in.is_seq else None,
+                causal=causal,
+            ).reshape(b, tq, d)
+            out = out @ params["wo"]
+            if "b" in params:
+                out = out + params["b"]
+            return SeqTensor(out, q_in.lengths, q_in.sub_lengths)
+
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
     scores = scores.astype(jnp.float32)
     if kv_in.is_seq:

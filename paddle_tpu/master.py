@@ -33,7 +33,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.io import recordio
 
-__all__ = ["Service", "Server", "Client"]
+__all__ = ["Service", "Server", "Client", "MasterRPCError"]
+
+
+class MasterRPCError(RuntimeError):
+    """The master executed the call and reported an application error —
+    distinct from transport failures so HA clients do not reconnect-retry
+    deterministic errors."""
 
 
 @dataclasses.dataclass
@@ -238,6 +244,16 @@ class Service:
             return True
 
     # -- snapshot / recover (reference service.go:165-273, etcd → file) --
+    def fence(self) -> None:
+        """Stop this (deposed) Service from ever writing the shared snapshot
+        again and cancel any pending debounced flush — a new leader owns the
+        file now (the etcd design gets this for free from leases on keys)."""
+        with self._lock:
+            self.snapshot_path = None
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+
     def _snapshot(self, force: bool = False) -> None:
         """Debounced: per-task transitions at most one write per
         snapshot_min_interval_s; a skipped write is flushed by a timer so the
@@ -259,6 +275,8 @@ class Service:
     def _flush(self) -> None:
         with self._lock:
             self._flush_timer = None
+            if not self.snapshot_path:
+                return  # fenced between schedule and fire
             self._last_snapshot = time.time()
             self._write_snapshot()
 
@@ -291,6 +309,20 @@ class Service:
             self.todo.append(Task.from_json(ent["task"]))
 
 
+def reader_over(next_record_fn):
+    """Reader-creator over a next_record callable: one call = one pass
+    (shared by Client and master_ha.HAClient)."""
+
+    def _reader():
+        while True:
+            rec = next_record_fn()
+            if rec is None:
+                return
+            yield rec
+
+    return _reader
+
+
 # ---------------------------------------------------------------------------
 # RPC layer
 # ---------------------------------------------------------------------------
@@ -309,6 +341,8 @@ class Server:
         self._listener = Listener(address, authkey=authkey)
         self.address = self._listener.address
         self._stop = False
+        self._conns: List = []
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -318,6 +352,8 @@ class Server:
                 conn = self._listener.accept()
             except OSError:
                 return
+            with self._conns_lock:
+                self._conns.append(conn)
             threading.Thread(
                 target=self._handle, args=(conn,), daemon=True
             ).start()
@@ -335,14 +371,32 @@ class Server:
                     conn.send((True, getattr(self.service, method)(*args)))
                 except Exception as exc:  # noqa: BLE001 — RPC boundary
                     conn.send((False, repr(exc)))
-        except EOFError:
+        except (EOFError, OSError, TypeError, AttributeError):
+            # TypeError/AttributeError: Server.close() closed this conn while
+            # recv() was blocked (multiprocessing nulls the handle mid-read)
             pass
         finally:
-            conn.close()
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
 
     def close(self) -> None:
+        """Stop accepting AND drop live per-connection handler threads — a
+        deposed HA leader must not keep serving stale state to connected
+        clients."""
         self._stop = True
         self._listener.close()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 class Client:
@@ -374,7 +428,7 @@ class Client:
             self._conn.send((method, args))
             ok, result = self._conn.recv()
         if not ok:
-            raise RuntimeError(f"master RPC {method} failed: {result}")
+            raise MasterRPCError(f"master RPC {method} failed: {result}")
         return result
 
     # -- surface ---------------------------------------------------------
@@ -442,15 +496,7 @@ class Client:
     def reader(self):
         """A reader-creator over next_record for the v2 trainer: one call =
         one pass."""
-
-        def _reader():
-            while True:
-                rec = self.next_record()
-                if rec is None:
-                    return
-                yield rec
-
-        return _reader
+        return reader_over(self.next_record)
 
     def close(self) -> None:
         # Release a held lease: ack if the buffer drained, otherwise hand the

@@ -21,14 +21,17 @@ state, discovery), one directory.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from typing import List, Optional, Sequence
 
-from paddle_tpu.master import Client, Server, Service
+from paddle_tpu.master import Client, MasterRPCError, Server, Service
 
 __all__ = ["LeaseFile", "HAMaster", "HAClient", "discover_endpoint"]
+
+_log = logging.getLogger("paddle_tpu.master_ha")
 
 
 class LeaseFile:
@@ -72,6 +75,17 @@ class LeaseFile:
         claim = os.path.join(self.dir, f".claim-{self.owner_id}")
         with open(claim, "w") as f:
             json.dump({"owner": self.owner_id, "t": time.time()}, f)
+        # Re-check right before the rename: a stalled-but-alive leader may
+        # have renewed since our staleness read (shrinks the clobber window
+        # to the check->rename gap; the remaining dual-leader window is
+        # bounded by the deposed side's next renew(), which detects the
+        # foreign owner and steps down — snapshot writes are fenced).
+        if not self.is_stale():
+            try:
+                os.remove(claim)
+            except OSError:
+                pass
+            return False
         os.replace(claim, self.path)
         # verify after the dust settles: a racing rename may have landed on
         # top of ours (last-writer-wins is exactly one winner)
@@ -162,8 +176,10 @@ class HAMaster:
     def _step_down(self) -> None:
         self.is_leader.clear()
         if self.server is not None:
-            self.server.close()
+            self.server.close()  # stops accepting AND drops live conns
             self.server = None
+        if self.service is not None:
+            self.service.fence()  # never write the shared snapshot again
         self.service = None
 
     def _run(self) -> None:
@@ -174,7 +190,18 @@ class HAMaster:
                 self._stop.wait(self.renew_interval)
             else:
                 if self.lease.try_acquire():
-                    self._become_leader()
+                    try:
+                        self._become_leader()
+                    except Exception:
+                        # corrupt snapshot / bind failure: surface it, give
+                        # the lease back, keep campaigning after a backoff
+                        _log.exception(
+                            "master %s failed to assume leadership",
+                            self.owner_id,
+                        )
+                        self._step_down()
+                        self.lease.release()
+                        self._stop.wait(self.lease.lease_timeout)
                 else:
                     self._stop.wait(self.renew_interval)
         if self.is_leader.is_set():
@@ -231,7 +258,9 @@ class HAClient:
                 self._client = self._connect()
             try:
                 return getattr(self._client, method)(*args)
-            except (ConnectionError, EOFError, OSError, RuntimeError):
+            except MasterRPCError:
+                raise  # the master executed the call: a real app error
+            except (ConnectionError, EOFError, OSError):
                 # leader died mid-call: drop the connection, re-discover
                 try:
                     self._client.close()
@@ -256,14 +285,9 @@ class HAClient:
         return self._call("request_save_model", block_secs)
 
     def reader(self):
-        def _reader():
-            while True:
-                rec = self.next_record()
-                if rec is None:
-                    return
-                yield rec
+        from paddle_tpu.master import reader_over
 
-        return _reader
+        return reader_over(self.next_record)
 
     def close(self) -> None:
         if self._client is not None:

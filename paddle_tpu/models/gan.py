@@ -1,0 +1,123 @@
+"""GAN demo — v1_api_demo/gan parity, TPU-first.
+
+The reference trains two separate proto-configured networks (gan_conf.py
+generator/discriminator sub-configs sharing parameter names) with handwritten
+alternating v1 trainer calls.  Here the generator and discriminator are two
+CompiledNetworks and each alternating phase is ONE jitted step: the
+discriminator step differentiates only d_params (generator frozen via
+closure), the generator step differentiates only g_params through the
+discriminator — the freeze/unfreeze bookkeeping of the reference
+(gan_trainer.py prepare_generator_data_batch / is_generator_training) becomes
+plain functional argument structure."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+
+L = paddle.layer
+A = paddle.activation
+
+
+def generator_net(noise_dim: int, data_dim: int, hidden: int = 64):
+    z = L.data("z", paddle.data_type.dense_vector(noise_dim))
+    h = L.fc(z, size=hidden, act=A.Relu(), name="g_h1")
+    h = L.fc(h, size=hidden, act=A.Relu(), name="g_h2")
+    return L.fc(h, size=data_dim, act=A.Identity(), name="g_out")
+
+
+def discriminator_net(data_dim: int, hidden: int = 64):
+    x = L.data("x", paddle.data_type.dense_vector(data_dim))
+    h = L.fc(x, size=hidden, act=A.Relu(), name="d_h1")
+    h = L.fc(h, size=hidden, act=A.Relu(), name="d_h2")
+    return L.fc(h, size=1, act=A.Sigmoid(), name="d_out")
+
+
+class GANTrainer:
+    """Alternating GAN training: d_step maximizes log D(x) + log(1-D(G(z))),
+    g_step maximizes log D(G(z)) (the non-saturating generator loss)."""
+
+    def __init__(
+        self,
+        noise_dim: int,
+        data_dim: int,
+        hidden: int = 64,
+        g_lr: float = 1e-3,
+        d_lr: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.noise_dim = noise_dim
+        reset_auto_names()
+        g_out = generator_net(noise_dim, data_dim, hidden)
+        self.g_net = CompiledNetwork(Topology([g_out]))
+        self.g_out = g_out.name
+        d_out = discriminator_net(data_dim, hidden)
+        self.d_net = CompiledNetwork(Topology([d_out]))
+        self.d_out = d_out.name
+
+        k = jax.random.PRNGKey(seed)
+        kg, kd = jax.random.split(k)
+        self.g_params, _ = self.g_net.init(kg)
+        self.d_params, _ = self.d_net.init(kd)
+        self.g_opt = paddle.optimizer.Adam(learning_rate=g_lr, beta1=0.5)
+        self.d_opt = paddle.optimizer.Adam(learning_rate=d_lr, beta1=0.5)
+        self.g_opt_state = self.g_opt.init(self.g_params)
+        self.d_opt_state = self.d_opt.init(self.d_params)
+
+        def d_prob(d_params, x):
+            outs, _ = self.d_net.apply(d_params, {"x": SeqTensor(x)}, train=True)
+            return jnp.clip(outs[self.d_out].data[:, 0], 1e-6, 1 - 1e-6)
+
+        def generate(g_params, z):
+            outs, _ = self.g_net.apply(g_params, {"z": SeqTensor(z)}, train=True)
+            return outs[self.g_out].data
+
+        @jax.jit
+        def d_step(d_params, d_opt_state, g_params, real, z):
+            def loss(dp):
+                fake = generate(g_params, z)  # generator frozen
+                p_real = d_prob(dp, real)
+                p_fake = d_prob(dp, fake)
+                return -jnp.mean(jnp.log(p_real) + jnp.log(1.0 - p_fake))
+
+            l, grads = jax.value_and_grad(loss)(d_params)
+            d_params, d_opt_state = self.d_opt.update(grads, d_opt_state, d_params)
+            return d_params, d_opt_state, l
+
+        @jax.jit
+        def g_step(g_params, g_opt_state, d_params, z):
+            def loss(gp):
+                fake = generate(gp, z)
+                return -jnp.mean(jnp.log(d_prob(d_params, fake)))  # D frozen
+
+            l, grads = jax.value_and_grad(loss)(g_params)
+            g_params, g_opt_state = self.g_opt.update(grads, g_opt_state, g_params)
+            return g_params, g_opt_state, l
+
+        self._d_step, self._g_step = d_step, g_step
+        self._generate = jax.jit(generate)
+
+    # ------------------------------------------------------------------
+    def train_batch(self, real: np.ndarray, rng: np.random.RandomState):
+        b = real.shape[0]
+        z = jnp.asarray(rng.randn(b, self.noise_dim), jnp.float32)
+        self.d_params, self.d_opt_state, d_loss = self._d_step(
+            self.d_params, self.d_opt_state, self.g_params, jnp.asarray(real), z
+        )
+        z2 = jnp.asarray(rng.randn(b, self.noise_dim), jnp.float32)
+        self.g_params, self.g_opt_state, g_loss = self._g_step(
+            self.g_params, self.g_opt_state, self.d_params, z2
+        )
+        return float(d_loss), float(g_loss)
+
+    def sample(self, n: int, rng: np.random.RandomState) -> np.ndarray:
+        z = jnp.asarray(rng.randn(n, self.noise_dim), jnp.float32)
+        return np.asarray(self._generate(self.g_params, z))

@@ -29,20 +29,22 @@ def _ffn(x: LayerOutput, d_model: int, d_ff: int, name: str) -> LayerOutput:
     return L.fc(h, size=d_model, act=A.Identity(), name=f"{name}_ff2")
 
 
-def _encoder_layer(x, d_model, n_heads, d_ff, name):
+def _encoder_layer(x, d_model, n_heads, d_ff, name, sp_axis=None):
     att = L.multi_head_attention(
-        L.layer_norm(x, name=f"{name}_ln1"), n_heads=n_heads, name=f"{name}_att"
+        L.layer_norm(x, name=f"{name}_ln1"), n_heads=n_heads,
+        seq_parallel_axis=sp_axis, name=f"{name}_att"
     )
     x = L.addto([x, att], act=A.Identity(), bias_attr=False, name=f"{name}_res1")
     ff = _ffn(L.layer_norm(x, name=f"{name}_ln2"), d_model, d_ff, name)
     return L.addto([x, ff], act=A.Identity(), bias_attr=False, name=f"{name}_res2")
 
 
-def _decoder_layer(x, enc, d_model, n_heads, d_ff, name):
+def _decoder_layer(x, enc, d_model, n_heads, d_ff, name, sp_axis=None):
     self_att = L.multi_head_attention(
         L.layer_norm(x, name=f"{name}_ln1"),
         n_heads=n_heads,
         causal=True,
+        seq_parallel_axis=sp_axis,
         name=f"{name}_self",
     )
     x = L.addto([x, self_att], act=A.Identity(), bias_attr=False, name=f"{name}_res1")
@@ -64,6 +66,7 @@ def transformer_cost(
     n_heads: int = 8,
     n_layers: int = 6,
     d_ff: int = 2048,
+    seq_parallel_axis=None,
 ) -> Tuple[LayerOutput, LayerOutput]:
     """Training topology.  Data slots: src_word ids, trg_word ids (bos-led
     decoder input), trg_next ids (shifted targets) — same slot convention as
@@ -77,14 +80,14 @@ def transformer_cost(
         L.embedding(src, size=d_model, name="src_emb"), emb_scale=scale
     )
     for i in range(n_layers):
-        x = _encoder_layer(x, d_model, n_heads, d_ff, f"enc{i}")
+        x = _encoder_layer(x, d_model, n_heads, d_ff, f"enc{i}", seq_parallel_axis)
     enc = L.layer_norm(x, name="enc_ln")
 
     y = L.pos_encoding(
         L.embedding(trg, size=d_model, name="trg_emb"), emb_scale=scale
     )
     for i in range(n_layers):
-        y = _decoder_layer(y, enc, d_model, n_heads, d_ff, f"dec{i}")
+        y = _decoder_layer(y, enc, d_model, n_heads, d_ff, f"dec{i}", seq_parallel_axis)
     dec = L.layer_norm(y, name="dec_ln")
 
     logits = L.fc(dec, size=trg_vocab, act=A.Softmax(), name="dec_out")

@@ -115,3 +115,28 @@ def format_parameter_stats(stats: Dict[str, Dict[str, float]]) -> str:
             f"{s['min']:>11.4g} {s['max']:>11.4g}"
         )
     return "\n".join(lines)
+
+
+def gradient_stats(network, params, batch, state=None, rng=None):
+    """{layer.param: l2_norm} of d(mean cost)/d(param) — the functional
+    replacement for the reference's gradient_printer_evaluator (backward here
+    is one jax.grad over the whole network, so per-parameter norms are the
+    observable quantity)."""
+    import jax.numpy as jnp
+
+    def loss(p):
+        c, _ = network.cost(p, batch, state=state, rng=rng, train=True)
+        return c
+
+    grads = jax.grad(loss)(params)
+    out: Dict[str, float] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else k, v)
+        else:
+            out[prefix] = float(jnp.linalg.norm(node.astype(jnp.float32)))
+
+    walk("", grads)
+    return out
