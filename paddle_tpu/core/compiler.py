@@ -62,6 +62,10 @@ class CompiledNetwork:
     def __init__(self, topology: Topology, dtype=jnp.float32, compute_dtype=None):
         self.topology = topology
         self.dtype = dtype
+        # Mesh handed to mesh-aware layers via ApplyContext; the trainer
+        # sets this so ring attention traces against ITS mesh instead of a
+        # process-global (two trainers with different meshes stay isolated).
+        self.mesh = None
         if compute_dtype is None:
             compute_dtype = _default_compute_dtype or dtype
         self.compute_dtype = jnp.dtype(compute_dtype)
@@ -161,8 +165,14 @@ class CompiledNetwork:
         # dtype below.  Casting the whole batch up front would quantize float
         # regression targets / soft labels before the full_precision cost
         # layers ever see them.
+        from paddle_tpu.parallel.mesh import get_default_mesh
+
         ctx = ApplyContext(
-            train=train, rng=rng, state=state or {}, dtype=self.compute_dtype
+            train=train,
+            rng=rng,
+            state=state or {},
+            dtype=self.compute_dtype,
+            mesh=self.mesh if self.mesh is not None else get_default_mesh(),
         )
         for name in self.topology.order:
             conf = self.topology.layers[name]

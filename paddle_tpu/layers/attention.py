@@ -96,24 +96,38 @@ def mha_apply(conf, params, inputs, ctx):
     v = v.reshape(b, tk, h, dh)
 
     sp_axis = conf.attr("seq_parallel_axis")
+    out = None
     if sp_axis is not None and tq == tk:
         # context parallelism: shard T over the mesh axis and run exact
         # ring attention (parallel/ring_attention.py) instead of the dense
-        # [T, T] score matrix — the long-context path.
-        from paddle_tpu.parallel.mesh import get_default_mesh
+        # [T, T] score matrix — the long-context path.  The mesh comes from
+        # the owning network (trainer-scoped), falling back to the process
+        # default (compiler.py ApplyContext).
         from paddle_tpu.parallel.ring_attention import (
             sequence_parallel_attention,
         )
 
-        mesh = get_default_mesh()
-        if mesh is None or tq % mesh.shape[sp_axis] != 0:
+        mesh = ctx.mesh
+        usable = (
+            mesh is not None
+            and sp_axis in mesh.shape
+            and tq % mesh.shape[sp_axis] == 0
+        )
+        if not usable:
             import warnings
 
+            if mesh is None:
+                why = "no mesh is available"
+            elif sp_axis not in mesh.shape:
+                why = f"the mesh has no {sp_axis!r} axis"
+            else:
+                why = (
+                    f"T={tq} is not divisible by the "
+                    f"{mesh.shape[sp_axis]}-way ring"
+                )
             warnings.warn(
                 f"{conf.name}: seq_parallel_axis={sp_axis!r} requested but "
-                + ("no default mesh is set" if mesh is None else
-                   f"T={tq} is not divisible by the {mesh.shape[sp_axis]}-way "
-                   f"ring") + "; falling back to dense O(T^2) attention",
+                f"{why}; falling back to dense O(T^2) attention",
                 stacklevel=2,
             )
         else:
@@ -122,21 +136,19 @@ def mha_apply(conf, params, inputs, ctx):
                 lengths=kv_in.lengths if kv_in.is_seq else None,
                 causal=causal,
             ).reshape(b, tq, d)
-            out = out @ params["wo"]
-            if "b" in params:
-                out = out + params["b"]
-            return SeqTensor(out, q_in.lengths, q_in.sub_lengths)
 
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
-    scores = scores.astype(jnp.float32)
-    if kv_in.is_seq:
-        key_mask = kv_in.mask(jnp.float32)  # [B, Tk]
-        scores = scores + (1.0 - key_mask)[:, None, None, :] * NEG_INF
-    if causal:
-        cm = jnp.tril(jnp.ones((tq, tk), jnp.float32))
-        scores = scores + (1.0 - cm)[None, None, :, :] * NEG_INF
-    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, tq, d)
+    if out is None:  # dense path
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
+        scores = scores.astype(jnp.float32)
+        if kv_in.is_seq:
+            key_mask = kv_in.mask(jnp.float32)  # [B, Tk]
+            scores = scores + (1.0 - key_mask)[:, None, None, :] * NEG_INF
+        if causal:
+            cm = jnp.tril(jnp.ones((tq, tk), jnp.float32))
+            scores = scores + (1.0 - cm)[None, None, :, :] * NEG_INF
+        w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(b, tq, d)
+
     out = out @ params["wo"]
     if "b" in params:
         out = out + params["b"]

@@ -39,6 +39,9 @@ class ApplyContext:
     new_state: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
     # Default parameter dtype for compute (bfloat16-friendly).
     dtype: Any = jnp.float32
+    # Device mesh mesh-aware layers (ring attention) trace against: the
+    # owning trainer's mesh, falling back to the process default.
+    mesh: Any = None
 
     def layer_rng(self, name: str) -> Optional[jax.Array]:
         if self.rng is None:

@@ -354,13 +354,19 @@ class Server:
                 return
             with self._conns_lock:
                 self._conns.append(conn)
+            if self._stop:  # closed while accepting: don't serve it
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
             threading.Thread(
                 target=self._handle, args=(conn,), daemon=True
             ).start()
 
     def _handle(self, conn) -> None:
         try:
-            while True:
+            while not self._stop:  # deposed leader: stop serving stale state
                 method, args = conn.recv()
                 if method == "__close__":
                     return

@@ -23,7 +23,7 @@ from paddle_tpu.core.compiler import CompiledNetwork
 from paddle_tpu.core.topology import LayerOutput, Topology
 from paddle_tpu.optimizer import Optimizer
 from paddle_tpu.parameters import Parameters, create_from_network
-from paddle_tpu.parallel.mesh import get_default_mesh, set_default_mesh, shard_batch
+from paddle_tpu.parallel.mesh import get_default_mesh, shard_batch
 from paddle_tpu.reader.feeder import DataFeeder
 from paddle_tpu.trainer.evaluators import default_metrics_fn
 from paddle_tpu.trainer.step import make_eval_step, make_train_step
@@ -93,10 +93,10 @@ class SGD:
         self._metrics_fn = self._build_metrics_fn()
         from paddle_tpu.parallel.sharding import has_model_sharding, shard_params
 
-        if self.mesh is not None and get_default_mesh() is None:
-            # publish the trainer's mesh so mesh-aware layers (ring
-            # attention's seq_parallel_axis) see it during tracing
-            set_default_mesh(self.mesh)
+        # mesh-aware layers (ring attention) trace against the trainer's
+        # mesh, scoped to THIS network — no process-global publishing, so
+        # two trainers with different meshes stay isolated
+        self.network.mesh = self.mesh
         self._model_sharded = has_model_sharding(
             self.network, self.parameters.params, self.mesh
         )
