@@ -132,6 +132,21 @@ class CompiledNetwork:
         return self.init_params(rng), self.init_state()
 
     # ------------------------------------------------------------------
+    def make_context(self, *, train: bool, rng=None, state=None) -> ApplyContext:
+        """ApplyContext exactly as apply() would build it (mesh fallback
+        included) — shared with utils.debug so diagnostics trace the same
+        computation as training."""
+        from paddle_tpu.parallel.mesh import get_default_mesh
+
+        return ApplyContext(
+            train=train,
+            rng=rng,
+            state=state or {},
+            dtype=self.compute_dtype,
+            mesh=self.mesh if self.mesh is not None else get_default_mesh(),
+        )
+
+    # ------------------------------------------------------------------
     def resolve_layer_call(self, name: str, params: Params, ins):
         """(layer params, inputs) as the apply loop would hand them to the
         impl: shared-parameter owner lookup + mixed-precision casts.  Used
@@ -165,15 +180,7 @@ class CompiledNetwork:
         # dtype below.  Casting the whole batch up front would quantize float
         # regression targets / soft labels before the full_precision cost
         # layers ever see them.
-        from paddle_tpu.parallel.mesh import get_default_mesh
-
-        ctx = ApplyContext(
-            train=train,
-            rng=rng,
-            state=state or {},
-            dtype=self.compute_dtype,
-            mesh=self.mesh if self.mesh is not None else get_default_mesh(),
-        )
+        ctx = self.make_context(train=train, rng=rng, state=state)
         for name in self.topology.order:
             conf = self.topology.layers[name]
             impl = self._impls[name]

@@ -68,6 +68,9 @@ class Inference:
         self.network = CompiledNetwork(
             self.topology, compute_dtype=get_default_compute_dtype()
         )
+        # inherit the training network's mesh so mesh-aware layers (ring
+        # attention) keep their parallelism at inference time
+        self.network.mesh = getattr(parameters.network, "mesh", None)
         # Parameters may come from a larger (training) topology; apply() looks
         # up layers by name, so the superset simply carries unused entries.
         self._params = parameters.params

@@ -137,6 +137,25 @@ def mha_apply(conf, params, inputs, ctx):
                 causal=causal,
             ).reshape(b, tq, d)
 
+    if out is None and tq == tk:
+        # Fused flash-attention Pallas kernel (ops/pallas_attention.py):
+        # streams k/v blocks through VMEM with an online softmax — no
+        # [T, T] score matrix in HBM.  TPU backend only; dense fallback
+        # keeps CPU tests and odd shapes exact.
+        from paddle_tpu.ops import pallas_attention as fa
+        from paddle_tpu.utils.flags import get_flag
+
+        if (
+            get_flag("use_pallas_attention")
+            and jax.default_backend() == "tpu"
+            and fa.supported(tq, dh)
+        ):
+            out = fa.flash_attention_diff(
+                q, k, v,
+                kv_in.lengths if kv_in.is_seq else None,
+                causal, 128, 128, False,
+            ).reshape(b, tq, d)
+
     if out is None:  # dense path
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(dh)
         scores = scores.astype(jnp.float32)

@@ -1,0 +1,334 @@
+"""Fused (flash) attention as a Pallas TPU kernel.
+
+The dense MHA path materializes the [T, T] score matrix in HBM; this kernel
+streams key/value blocks through VMEM with an online softmax, so attention
+memory is O(T·dh) and the scores never leave the core — the standard
+flash-attention recipe, written for the MXU:
+
+  * grid = (B, H, T/bq); each program owns one [bq, dh] query block,
+  * the k-loop walks [bk, dh] key/value blocks with jnp.dot at
+    preferred_element_type=f32 (MXU-native bf16 in, f32 accumulate),
+  * causal masking + key-padding fold into the streaming max/normalizer.
+
+Used by multi_head_attention for self-attention on the TPU backend when the
+`use_pallas_attention` flag is on (opt-in: the win is MEMORY — no [T, T]
+scores in HBM, enabling context lengths the dense path cannot hold; for
+short sequences XLA's fused dense attention is faster because the kernel
+pays full-precision MXU passes).  `interpret=True` runs the same kernel on
+CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def flash_attention(
+    q: jnp.ndarray,  # [B, T, H, dh]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    lengths: Optional[jnp.ndarray] = None,  # [B] valid key counts
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """[B, T, H, dh] -> [B, T, H, dh]; exact softmax attention (one kernel
+    shared with the differentiable path; the LSE residual is simply
+    dropped here)."""
+    out, _ = _flash_fwd(q, k, v, lengths, causal, block_q, block_k, interpret)
+    return out
+
+
+def supported(t: int, dh: int) -> bool:
+    """Shapes the kernel handles well: T a multiple of a block, lane-friendly
+    head dim."""
+    return t % min(128, t) == 0 and dh % 8 == 0 and t >= 128
+
+
+# ---------------------------------------------------------------------------
+# backward kernels — the standard two-pass flash backward:
+#   forward additionally emits LSE (log-sum-exp per query row) so p = exp(s -
+#   lse) is recomputable blockwise; delta = rowsum(do * o) folds the softmax
+#   jacobian.  dq loops k-blocks per q-block; dk/dv loop q-blocks per k-block.
+# ---------------------------------------------------------------------------
+
+
+def _fa_fwd_kernel(
+    len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, t, causal, scale, bq
+):
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32) * scale
+    dh = q.shape[-1]
+    q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
+    valid_len = len_ref[pl.program_id(0)]
+    nk = t // bk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+        mask = k_pos[None, :] < valid_len
+        if causal:
+            mask &= k_pos[None, :] <= q_pos[:, None]
+        s = jnp.where(mask, s, NEG_INF)
+        blk_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        acc = acc * corr[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        l = l * corr + jnp.sum(p, axis=-1)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse block spans the FULL T row (rank-1 bq blocks are not tileable);
+    # consecutive qi iterations revisit it, each writing its own slice
+    lse_ref[pl.ds(qi * bq, bq), :] = (m + jnp.log(l_safe))[:, None]
+
+
+def _fa_bwd_dq_kernel(
+    len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, bk, t, causal, scale, bq
+):
+    qi = pl.program_id(2)
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[pl.ds(qi * bq, bq), 0]
+    delta = delta_ref[pl.ds(qi * bq, bq), 0]
+    q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
+    valid_len = len_ref[pl.program_id(0)]
+    nk = t // bk
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale
+        k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
+        mask = k_pos[None, :] < valid_len
+        if causal:
+            mask &= k_pos[None, :] <= q_pos[:, None]
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, nk, body, dq0)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(
+    len_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, bq_loop, t, causal, scale, bk
+):
+    ki = pl.program_id(2)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    k_pos = ki * bk + jax.lax.iota(jnp.int32, bk)
+    valid_len = len_ref[pl.program_id(0)]
+    nq = t // bq_loop
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(j * bq_loop, bq_loop), :].astype(jnp.float32)
+        do = do_ref[pl.ds(j * bq_loop, bq_loop), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * bq_loop, bq_loop), 0]
+        delta = delta_ref[pl.ds(j * bq_loop, bq_loop), 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * scale  # [bq, bk]
+        q_pos = j * bq_loop + jax.lax.iota(jnp.int32, bq_loop)
+        mask = k_pos[None, :] < valid_len
+        if causal:
+            mask &= k_pos[None, :] <= q_pos[:, None]
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # p^T @ do: [bk, dh]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # [bq, bk]
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # ds^T @ q: [bk, dh]
+        return dk, dv
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
+    dk_ref[...] = (dk * 1.0).astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+)
+def flash_attention_diff(q, k, v, lengths, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, lengths, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, lengths, causal, block_q, block_k, interpret):
+    b, t, h, dh = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    if t % bq or t % bk:
+        raise ValueError(
+            f"T={t} must be divisible by block sizes ({bq}, {bk}) — rows "
+            f"beyond the last full block would be silently dropped"
+        )
+    scale = 1.0 / math.sqrt(dh)
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    kernel = functools.partial(
+        _fa_fwd_kernel, bk=bk, t=t, causal=causal, scale=scale, bq=bq
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, t // bq),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, dh), lambda bi, hi, qi, _: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bq, dh), lambda bi, hi, qi, _: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, 1), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+        ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, dh), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(lengths, qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, lengths, out, lse)
+
+
+def _flash_fwd_vjp(q, k, v, lengths, causal, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, lengths, causal, block_q, block_k, interpret)
+    return out, res
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, lengths, out_bhtd, lse = res
+    b, t, h, dh = q.shape
+    bq = min(block_q, t)
+    bk = min(block_k, t)
+    scale = 1.0 / math.sqrt(dh)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    do = jnp.swapaxes(g, 1, 2)  # [B, H, T, dh]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1
+    )[..., None]  # [B, H, T, 1] (rank-2 tileable blocks)
+
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, bk=bk, t=t, causal=causal, scale=scale, bq=bq
+    )
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, t // bq),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, dh), lambda bi, hi, qi, _: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, bq, dh), lambda bi, hi, qi, _: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, 1), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, t, 1), lambda bi, hi, qi, _: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, bq, dh), lambda bi, hi, qi, _: (bi, hi, qi, 0)
+        ),
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, dh), q.dtype),
+        interpret=interpret,
+    )(lengths, qt, kt, vt, do, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _fa_bwd_dkv_kernel, bq_loop=bq, t=t, causal=causal, scale=scale, bk=bk
+    )
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, t // bk),
+        in_specs=[
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, ki, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, bk, dh), lambda bi, hi, ki, _: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, bk, dh), lambda bi, hi, ki, _: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, t, dh), lambda bi, hi, ki, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, t, 1), lambda bi, hi, ki, _: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, t, 1), lambda bi, hi, ki, _: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bk, dh), lambda bi, hi, ki, _: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, bk, dh), lambda bi, hi, ki, _: (bi, hi, ki, 0)),
+        ],
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=dkv_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, t, dh), k.dtype),
+            jax.ShapeDtypeStruct((b, h, t, dh), v.dtype),
+        ],
+        interpret=interpret,
+    )(lengths, qt, kt, vt, do, lse, delta)
+
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+        None,
+    )
+
+
+flash_attention_diff.defvjp(_flash_fwd_vjp, _flash_bwd)

@@ -67,6 +67,9 @@ class SGD:
         # happen to share auto-names.
         if parameters is not None and (
             parameters.network.topology.serialize() == self.topology.serialize()
+            # a shared network must not have its mesh clobbered: reuse only
+            # when the meshes agree (another trainer may be using it)
+            and (mesh is None or parameters.network.mesh in (None, mesh))
         ):
             self.network = parameters.network
             self.parameters = parameters

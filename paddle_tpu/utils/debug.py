@@ -36,8 +36,6 @@ def profile_layers(
 ) -> List[Tuple[str, str, float]]:
     """[(layer_name, type, best_ms)] forward cost per layer, eager with a
     sync per layer (reference FwdTimer per layer)."""
-    from paddle_tpu.layers.base import ApplyContext
-
     topo = network.topology
     results: List[Tuple[str, str, float]] = []
 
@@ -56,9 +54,7 @@ def profile_layers(
         )
 
         def run_once():
-            ctx = ApplyContext(
-                train=train, rng=rng, state=state or {}, dtype=network.compute_dtype
-            )
+            ctx = network.make_context(train=train, rng=rng, state=state)
             ctx.outputs.update(outs)
             out = impl.apply(conf, p, ins, ctx)
             jax.block_until_ready(out.data)

@@ -73,3 +73,8 @@ define_flag("beam_size", 5, "default generation beam width")
 define_flag("check_nans", False, "enable jax nan-debugging (FP trap equivalent)")
 define_flag("compute_dtype", "", "bfloat16 enables mixed precision")
 define_flag("profile_dir", "", "write jax profiler traces here when set")
+define_flag("use_pallas_attention", False,
+            "fused flash-attention Pallas kernel for TPU self-attention: "
+            "O(T*dh) attention memory instead of the [T,T] score matrix — "
+            "enable for context lengths whose dense scores blow HBM; at "
+            "short T XLA's fused dense path is faster")
