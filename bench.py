@@ -167,25 +167,8 @@ def bench_resnet_pipeline() -> dict:
     host feed and device compute.  This is the number that regresses when
     the recordio/prefetch/transfer path does (the all-device-resident bench
     above cannot)."""
-    import os
-    import tempfile
-
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as paddle
-    from paddle_tpu.core.batch import SeqTensor
-    from paddle_tpu.core.compiler import CompiledNetwork
-    from paddle_tpu.core.topology import Topology, reset_auto_names
-    from paddle_tpu.io import recordio
-    from paddle_tpu.models.resnet import resnet_cost
-    from paddle_tpu.trainer.step import make_train_step
-
-    reset_auto_names()
-    batch_size, img_size, n_rec = 128, 224, 512
-    rng = np.random.RandomState(0)
-
     import shutil
+    import tempfile
 
     tmp = tempfile.mkdtemp()
     try:

@@ -43,6 +43,7 @@ from paddle_tpu.layers.generation import (  # noqa: F401
 from paddle_tpu.layers import attention as _attention  # noqa: F401
 from paddle_tpu.layers import detection as _detection  # noqa: F401
 from paddle_tpu.layers import mdlstm as _mdlstm  # noqa: F401
+from paddle_tpu.layers import layer_math  # noqa: F401  (also patches LayerOutput operators)
 
 
 class AggregateLevel:
@@ -328,6 +329,7 @@ def img_conv(
     filter_size_y: Optional[int] = None,
     stride_y: Optional[int] = None,
     padding_y: Optional[int] = None,
+    shared_biases: bool = True,  # v1 per-channel bias sharing: always true here
     name: Optional[str] = None,
     layer_attr: Optional[ExtraAttr] = None,
 ) -> LayerOutput:
@@ -441,6 +443,10 @@ def batch_norm(
     epsilon: float = 1e-5,
     moving_average_fraction: float = 0.9,
     use_global_stats: Optional[bool] = None,
+    bias_attr=True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+    batch_norm_type: Optional[str] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     a = input.conf.attrs
@@ -850,6 +856,17 @@ def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput, name=No
 
 def sum_cost(input: LayerOutput, name=None):
     return _unary("sum_cost", input, size=1, name=name)
+
+
+# v1 cost-layer aliases without the _cost suffix (reference layers.py __all__)
+cross_entropy = cross_entropy_cost
+cross_entropy_with_selfnorm = cross_entropy_with_selfnorm_cost
+multi_binary_label_cross_entropy = multi_binary_label_cross_entropy_cost
+soft_binary_class_cross_entropy = soft_binary_class_cross_entropy_cost
+square_error = square_error_cost
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+smooth_l1 = smooth_l1_cost
 
 
 # ---------------------------------------------------------------------------
@@ -1575,8 +1592,10 @@ def scaling_projection(input: LayerOutput) -> Projection:
     return Projection("scaling", input)
 
 
-def dotmul_projection(input: LayerOutput) -> Projection:
-    return Projection("dotmul", input)
+def dotmul_projection(
+    input: LayerOutput, param_attr: Optional[ParamAttr] = None
+) -> Projection:
+    return Projection("dotmul", input, param_std=_param_std(param_attr))
 
 
 def conv_projection(
@@ -1652,7 +1671,19 @@ def mixed(
 ) -> LayerOutput:
     """reference mixed_layer (layers.py): sum of projections.  Plain
     LayerOutputs enter as identity terms (the standalone forms of
-    context/conv projections and operators)."""
+    context/conv projections and operators).
+
+    With no input, returns the v1 CONTEXT-MANAGER builder::
+
+        with mixed_layer() as m:
+            m += full_matrix_projection(x)
+        # m is the finished LayerOutput after the block
+    """
+    if input is None:
+        return _MixedBuilder(
+            size=size, name=name, act=act, bias_attr=bias_attr,
+            layer_attr=layer_attr,
+        )
     items = [input] if isinstance(input, (Projection, LayerOutput)) else list(input)
     parents: list = []
     specs: list = []
@@ -1685,6 +1716,34 @@ def mixed(
         attrs={"projections": tuple(specs)},
     )
     return LayerOutput(conf, parents)
+
+
+class _MixedBuilder(LayerOutput):
+    """`with mixed_layer() as m: m += projection` support (reference
+    layers.py MixedLayerType).  The object IS the resulting LayerOutput —
+    its conf materializes when the with-block exits."""
+
+    def __init__(self, **kw):
+        self._kw = kw
+        self._terms: list = []
+        self.conf = None  # filled on __exit__
+        self.parents = ()
+
+    def __enter__(self):
+        return self
+
+    def __iadd__(self, term):
+        self._terms.append(term)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        assert self._terms, "mixed_layer() block added no projections"
+        built = mixed(input=self._terms, **self._kw)
+        self.conf = built.conf
+        self.parents = built.parents
+        return False
 
 
 mixed_layer = mixed

@@ -101,7 +101,10 @@ def _fa_fwd_kernel(
     m0 = jnp.full((bq,), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, q.shape[-1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    # causal: key blocks past this query block's diagonal are fully masked —
+    # skip them (standard flash practice, ~2x on long causal sequences)
+    upper = ((qi + 1) * bq + bk - 1) // bk if causal else nk
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     # lse block spans the FULL T row (rank-1 bq blocks are not tileable);
@@ -147,7 +150,8 @@ def _fa_bwd_dq_kernel(
         )
 
     dq0 = jnp.zeros_like(q)
-    dq = jax.lax.fori_loop(0, nk, body, dq0)
+    upper = ((qi + 1) * bq + bk - 1) // bk if causal else nk
+    dq = jax.lax.fori_loop(0, upper, body, dq0)
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
@@ -198,8 +202,11 @@ def _fa_bwd_dkv_kernel(
 
     dk0 = jnp.zeros_like(k)
     dv0 = jnp.zeros_like(v)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[...] = (dk * 1.0).astype(dk_ref.dtype)
+    # causal: query blocks strictly before this key block's diagonal see
+    # none of these keys — start at the diagonal
+    lower = (ki * bk) // bq_loop if causal else 0
+    dk, dv = jax.lax.fori_loop(lower, nq, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 

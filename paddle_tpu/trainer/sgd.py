@@ -98,8 +98,13 @@ class SGD:
 
         # mesh-aware layers (ring attention) trace against the trainer's
         # mesh, scoped to THIS network — no process-global publishing, so
-        # two trainers with different meshes stay isolated
-        self.network.mesh = self.mesh
+        # two trainers with different meshes stay isolated.  A meshless
+        # trainer reusing a meshed network ADOPTS that mesh rather than
+        # clobbering it with None.
+        if self.mesh is not None:
+            self.network.mesh = self.mesh
+        elif self.network.mesh is not None:
+            self.mesh = self.network.mesh
         self._model_sharded = has_model_sharding(
             self.network, self.parameters.params, self.mesh
         )

@@ -15,6 +15,7 @@ from typing import Any, Dict, List, Optional
 
 # Layer DSL + networks: configs use the *_layer names and the bare ones.
 from paddle_tpu.layers import *  # noqa: F401,F403
+from paddle_tpu.layers import layer_math  # noqa: F401
 from paddle_tpu.layers import LayerOutput, data as _data_fn
 from paddle_tpu.layers.networks import (  # noqa: F401
     bidirectional_gru,

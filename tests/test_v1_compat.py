@@ -178,3 +178,76 @@ def test_positional_provider_types_pair_by_declaration_order(tmp_path):
     assert p.provider_input_types["label"].kind == SlotKind.INDEX
     assert p.provider_input_types["pixel"].kind == SlotKind.DENSE
     assert p.provider_input_types["pixel"].dim == 784
+
+
+@pytest.mark.parametrize("mode", ["generator_training", "discriminator_training", "generator"])
+def test_gan_configs_build(mode):
+    p = parse_config(
+        f"{REF}/gan/gan_conf.py",
+        f"noise_dim=10,sample_dim=2,hidden_dim=16,mode={mode}",
+    )
+    assert len(p.topology.order) >= 4
+    p2 = parse_config(
+        f"{REF}/gan/gan_conf_image.py",
+        f"noise_dim=100,sample_dim=28,c_dim=1,dataname=mnist_data,mode={mode}",
+    )
+    assert len(p2.topology.order) >= 6
+
+
+def test_vae_config_builds_and_trains():
+    """vae_conf.py exercises mixed_layer context blocks, layer_math, and
+    LayerOutput arithmetic; the parsed topology must actually train."""
+    p = parse_config(f"{REF}/vae/vae_conf.py")
+    assert len(p.topology.order) > 15
+    gen = parse_config(f"{REF}/vae/vae_conf.py", "is_generating=1")
+    assert len(gen.topology.order) == 3
+
+    params = paddle.parameters.create(p.topology)
+    trainer = paddle.trainer.SGD(
+        cost=p.topology, parameters=params,
+        update_equation=make_optimizer(p.settings),
+    )
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(64):
+            yield (rng.rand(784).astype(np.float32),)
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, p.settings.batch_size), num_passes=3,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+    )
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-2:]) < np.mean(costs[:2])
+
+
+def test_layer_math_and_mixed_context():
+    from paddle_tpu.layers import layer_math
+    import jax
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu import layers as L
+
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(3))
+    y = L.data("y", paddle.data_type.dense_vector(3))
+    expr = layer_math.exp(x) * 0.5 + y - 1.0
+    with L.mixed() as m:
+        m += L.dotmul_projection(x)
+    net = CompiledNetwork(Topology([expr, m]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    xv = np.asarray([[0.0, 1.0, 2.0]], np.float32)
+    yv = np.asarray([[10.0, 10.0, 10.0]], np.float32)
+    outs, _ = net.apply(
+        params, {"x": SeqTensor(xv), "y": SeqTensor(yv)}, state=state
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs[expr.name].data),
+        np.exp(xv) * 0.5 + yv - 1.0,
+        rtol=1e-5,
+    )
+    w = np.asarray(params[m.name]["p0_w"])
+    np.testing.assert_allclose(np.asarray(outs[m.name].data), xv * w, rtol=1e-5)
