@@ -47,6 +47,21 @@ class LayerConf:
         return self.attrs.get(key, default)
 
 
+_layer_sink = None  # optional observer of every LayerOutput creation
+
+
+def set_layer_sink(fn):
+    """Install/remove (None) a callback invoked with each new LayerOutput —
+    used by v1_compat.parse_config to resolve name-based Outputs()
+    declarations without global config state.  Returns the PREVIOUS sink so
+    nested installations restore rather than clear (parse_config can
+    re-enter via configs that parse other configs)."""
+    global _layer_sink
+    prev = _layer_sink
+    _layer_sink = fn
+    return prev
+
+
 class LayerOutput:
     """Functional DSL handle returned by every layer function — mirrors
     trainer_config_helpers.layers.LayerOutput (reference:
@@ -57,6 +72,8 @@ class LayerOutput:
     def __init__(self, conf: LayerConf, parents: Sequence["LayerOutput"] = ()):
         self.conf = conf
         self.parents: Tuple[LayerOutput, ...] = tuple(parents)
+        if _layer_sink is not None:
+            _layer_sink(self)
 
     @property
     def name(self) -> str:

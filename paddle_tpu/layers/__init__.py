@@ -454,6 +454,7 @@ def batch_norm(
     if img:
         in_c, in_h, in_w = _img_attrs(input, num_channels)
         attrs = {
+            **_param_attrs(param_attr),
             "channels": in_c,
             "in_h": in_h,
             "in_w": in_w,

@@ -161,7 +161,14 @@ def pool_apply(conf, params, inputs, ctx):
 
 def bn_init(conf, in_confs, rng):
     c = conf.attrs["channels"]
-    return {"scale": init.ones((c,)), "beta": init.zeros((c,))}
+    std = conf.attr("param_std")
+    # v1 ParamAttr(initial_std=...) on batch_norm randomizes gamma (the
+    # DCGAN-style init); default stays the standard ones
+    scale = init.normal(rng, (c,), std) if std else init.ones((c,))
+    p = {"scale": scale}
+    if conf.bias:
+        p["beta"] = init.zeros((c,))
+    return p
 
 
 def bn_init_state(conf, in_confs):
@@ -197,9 +204,9 @@ def batch_norm_apply(conf, params, inputs, ctx):
                 "var": momentum * st["var"] + (1 - momentum) * var,
             }
     inv = lax.rsqrt(var + eps)
-    out = (x - mean) * inv * params["scale"].astype(jnp.float32) + params[
-        "beta"
-    ].astype(jnp.float32)
+    out = (x - mean) * inv * params["scale"].astype(jnp.float32)
+    if "beta" in params:  # bias_attr=False BN has no shift
+        out = out + params["beta"].astype(jnp.float32)
     return SeqTensor(out.astype(in_dtype), inputs[0].lengths)
 
 

@@ -166,9 +166,14 @@ def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
 
     reset_auto_names()
     config_dir = os.path.dirname(os.path.abspath(config_file)) or "."
+    from paddle_tpu.core.topology import set_layer_sink
+
     state = _helpers._ParseState(_parse_config_args(config_arg_str))
     prev_state = _helpers._state
     _helpers._state = state
+    prev_sink = set_layer_sink(
+        lambda lo: state.all_layers.__setitem__(lo.conf.name, lo)
+    )
     sys.path.insert(0, config_dir)
     try:
         with open(config_file) as f:
@@ -184,14 +189,28 @@ def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
     finally:
         sys.path.pop(0)
         _helpers._state = prev_state
+        set_layer_sink(prev_sink)
 
+    if state.pending_output_names:  # capital-O Outputs(name, ...) form
+        missing = [n for n in state.pending_output_names if n not in state.all_layers]
+        if missing:
+            raise KeyError(
+                f"{config_file}: Outputs() names {missing} were never built"
+            )
+        state.outputs.extend(
+            state.all_layers[n] for n in state.pending_output_names
+        )
     assert state.outputs, f"{config_file}: config declared no outputs()"
     topo = Topology(list(state.outputs))
     parsed = ParsedConfig(
         topology=topo,
         settings=state.settings,
         data_sources=state.data_sources,
-        input_layers=[l.name for l in state.inputs],
+        input_layers=(
+            [l.name for l in state.inputs]
+            if state.inputs
+            else list(state.input_names)  # capital-I Inputs(name, ...) form
+        ),
         output_layers=[l.name for l in state.outputs],
         evaluators=list(state.evaluators),
     )

@@ -200,6 +200,9 @@ class _ParseState:
         self.inputs: List[LayerOutput] = []
         self.outputs: List[LayerOutput] = []
         self.evaluators: List[Any] = []
+        self.input_names: List[str] = []
+        self.pending_output_names: List[str] = []
+        self.all_layers: Dict[str, LayerOutput] = {}
 
 
 _state: Optional[_ParseState] = None
@@ -237,6 +240,41 @@ def settings(batch_size, **kw):
         s.learning_rate_schedule = "constant"
 
 
+_METHOD_BY_NAME = {
+    "momentum": lambda: MomentumOptimizer(),
+    "sgd": lambda: MomentumOptimizer(momentum=0.0),
+    "adam": lambda: AdamOptimizer(),
+    "adamax": lambda: AdamaxOptimizer(),
+    "adagrad": lambda: AdaGradOptimizer(),
+    "decayed_adagrad": lambda: DecayedAdaGradOptimizer(),
+    "adadelta": lambda: AdaDeltaOptimizer(),
+    "rmsprop": lambda: RMSPropOptimizer(),
+}
+
+
+def Settings(batch_size=1, learning_rate=1e-3, algorithm="sgd", **kw):
+    """The older capital-S config_parser.Settings() face (model_zoo-era
+    configs): maps onto settings(); string learning_method names resolve to
+    the optimizer classes; unrecognized knobs are ignored like the
+    reference's tolerant kwargs handling."""
+    st = _require_state()
+    st.settings.batch_size = batch_size
+    st.settings.learning_rate = learning_rate
+    for k, v in kw.items():
+        if k == "learning_method" and isinstance(v, str):
+            if v not in _METHOD_BY_NAME:
+                raise ValueError(
+                    f"unknown learning_method {v!r}; supported: "
+                    f"{sorted(_METHOD_BY_NAME)}"
+                )
+            existing = st.settings.learning_method
+            if existing is not None and existing.kind == v:
+                continue  # keep e.g. default_momentum()'s configured instance
+            v = _METHOD_BY_NAME[v]()
+        if hasattr(st.settings, k):
+            setattr(st.settings, k, v)
+
+
 def define_py_data_sources2(train_list, test_list, module, obj, args=None):
     st = _require_state()
     if isinstance(obj, (list, tuple)):
@@ -247,6 +285,21 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
         train_list=train_list, test_list=test_list, module=module,
         obj=obj, test_obj=test_obj, args=args,
     )
+
+
+def Inputs(*names):
+    """Capital-I config_parser form: declares input LAYER NAMES (strings).
+    Feeding order already follows data-layer declaration order here; the
+    names are recorded for the parse result."""
+    st = _require_state()
+    st.input_names = list(names)
+
+
+def Outputs(*names):
+    """Capital-O form: output layer NAMES (strings) — parse_config resolves
+    them against every layer built during the exec (LayerOutput sink)."""
+    st = _require_state()
+    st.pending_output_names = list(names)
 
 
 def inputs(*layers_):
@@ -268,6 +321,31 @@ def outputs(*layers_):
 def default_device(device_id: int) -> None:
     """v1 global device selector — a no-op on TPU (placement is mesh-driven;
     reference config_parser default_device sets per-layer device ids)."""
+
+
+def default_momentum(momentum: float) -> None:
+    """v1 global default — folded into settings().learning_method here;
+    recorded so make_optimizer can apply it when settings() didn't name a
+    momentum."""
+    st = _require_state()
+    if st.settings.learning_method is None:
+        st.settings.learning_method = MomentumOptimizer(momentum=momentum)
+
+
+def default_decay_rate(rate: float) -> None:
+    """v1 global weight-decay default -> settings().regularization."""
+    st = _require_state()
+    if st.settings.regularization is None:
+        st.settings.regularization = L2Regularization(rate)
+
+
+def default_initial_std(std: float) -> None:
+    """Accepted for config compatibility (per-layer ParamAttr initial_std is
+    the supported path)."""
+
+
+def default_initial_mean(mean: float) -> None:
+    """Accepted for config compatibility."""
 
 
 def _recording_evaluator(fn):

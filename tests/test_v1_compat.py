@@ -251,3 +251,19 @@ def test_layer_math_and_mixed_context():
     )
     w = np.asarray(params[m.name]["p0_w"])
     np.testing.assert_allclose(np.asarray(outs[m.name].data), xv * w, rtol=1e-5)
+
+
+@pytest.mark.parametrize("layer_num,n_layers", [(50, 123), (101, 242), (152, 361)])
+def test_model_zoo_resnet_configs_build(layer_num, n_layers):
+    """model_zoo/resnet/resnet.py (capital Settings/Inputs/Outputs config_parser
+    face, default_momentum/decay_rate globals) builds at all bottleneck
+    depths."""
+    p = parse_config(
+        f"{REF}/model_zoo/resnet/resnet.py", f"layer_num={layer_num},is_test=1"
+    )
+    assert len(p.topology.order) == n_layers
+    assert p.output_layers  # resolved from Outputs(name, ...) strings
+    assert p.settings.learning_method.kind == "momentum"
+    import paddle_tpu.optimizer as O
+
+    assert isinstance(make_optimizer(p.settings).regularization, O.L2Regularization)
