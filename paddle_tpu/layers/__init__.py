@@ -1889,6 +1889,41 @@ def detection_output(
 detection_output_layer = detection_output
 
 
+def img_cmrnorm(
+    input: LayerOutput,
+    size: int,
+    scale: float = 0.0128,
+    power: float = 0.75,
+    num_channels: Optional[int] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Cross-map response normalization (reference img_cmrnorm_layer,
+    layers.py:2706 — AlexNet LRN across `size` feature maps)."""
+    in_c, in_h, in_w = _img_attrs(input, num_channels)
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("crmnorm"),  # sic: the reference prefix
+        type="norm",
+        size=in_h * in_w * in_c,
+        inputs=(input.name,),
+        bias=False,
+        drop_rate=drop,
+        shard_axis=shard,
+        attrs={
+            "norm_size": size,
+            "scale": scale,
+            "power": power,
+            "in_c": in_c, "in_h": in_h, "in_w": in_w,
+            "channels": in_c, "out_h": in_h, "out_w": in_w,
+        },
+    )
+    return LayerOutput(conf, [input])
+
+
+img_cmrnorm_layer = img_cmrnorm
+
+
 def layer_norm(
     input: LayerOutput, epsilon: float = 1e-6, name: Optional[str] = None
 ) -> LayerOutput:

@@ -210,6 +210,32 @@ def batch_norm_apply(conf, params, inputs, ctx):
     return SeqTensor(out.astype(in_dtype), inputs[0].lengths)
 
 
+@register_layer("norm")
+def cmrnorm_apply(conf, params, inputs, ctx):
+    """Cross-map response normalization (reference NormLayer "norm" /
+    CMRProjectionNormLayer -> function/CrossMapNormalOp.cpp):
+    out = x * (1 + scale * sum_{window over channels} x^2)^(-power),
+    the AlexNet LRN.  Channel window sum = pad + stacked slices (static
+    size; XLA fuses the whole chain)."""
+    a = conf.attrs
+    size = a["norm_size"]
+    scale = a.get("scale", 0.0128)
+    power = a.get("power", 0.75)
+    x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
+    x32 = x.astype(jnp.float32)
+    sq = x32 * x32
+    # reference window start = -((size-1)/2): for even sizes the window
+    # extends one further to the RIGHT (CrossMapNormalOp.cpp)
+    half = (size - 1) // 2
+    padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    window = sum(
+        jax.lax.dynamic_slice_in_dim(padded, k, x.shape[-1], axis=3)
+        for k in range(size)
+    )
+    denom = (1.0 + scale * window) ** (-power)
+    return SeqTensor((x32 * denom).astype(x.dtype), inputs[0].lengths)
+
+
 # ---------------------------------------------------------------------------
 # maxout — MaxOutLayer.cpp: max over groups of channels
 # ---------------------------------------------------------------------------

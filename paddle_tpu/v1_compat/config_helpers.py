@@ -66,7 +66,6 @@ ExtraAttribute = ExtraAttr
 
 # conv_layer is the v1 name for img_conv
 conv_layer = img_conv  # noqa: F405
-norm_layer = img_cmrnorm = None  # placeholder: response-norm not supported
 
 
 def data_layer(

@@ -246,3 +246,32 @@ def test_static_pruning_hook():
     # bias was NOT pruned
     b = np.asarray(trainer.parameters.params["h"]["b"])
     assert (b != 0).mean() > 0.5
+
+
+def test_img_cmrnorm_matches_reference_formula():
+    """out = x * (1 + scale * window_sum(x^2))^(-power), window across
+    channels centered per CrossMapNormalOp.cpp."""
+    reset_auto_names()
+    c, hw, size, scale, power = 6, 3, 4, 0.01, 0.75  # EVEN size: window
+    # start -((size-1)//2), extends one further right (CrossMapNormalOp)
+    x_l = layers.data("x", paddle.data_type.dense_vector(c * hw * hw),
+                      height=hw, width=hw)
+    out = layers.img_cmrnorm(x_l, size=size, scale=scale, power=power, name="n")
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    chw = rng.randn(2, c, hw, hw).astype(np.float32)
+    outs, _ = net.apply(params, {"x": SeqTensor(chw.reshape(2, -1))}, state=state)
+    got = np.asarray(outs["n"].data)  # NHWC
+    x = chw.transpose(0, 2, 3, 1)
+    want = np.zeros_like(x)
+    half = (size - 1) // 2
+    for ch in range(c):
+        lo, hi = max(0, ch - half), min(c, ch + size - half)
+        denom = 1.0 + scale * (x[..., lo:hi] ** 2).sum(-1)
+        want[..., ch] = x[..., ch] * denom ** (-power)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    reset_auto_names()
+    x_l = layers.data("x", paddle.data_type.dense_vector(c * hw * hw),
+                      height=hw, width=hw)
+    check_layer_grad(layers.img_cmrnorm(x_l, size=3), batch_size=2)
