@@ -5,7 +5,8 @@ metric (the first line is the headline ResNet-50 number the driver parses):
   2. nmt_tokens_per_sec                      — seq2seq-NMT attention GRU fwd+bwd
   3. allreduce_bw_gbps                       — psum bandwidth over the mesh
   4. transformer_base_tokens_per_sec         — Transformer-base MT train step
-  5. resnet50_pipeline_images_per_sec        — ResNet-50 through the real data plane
+  5. lstm_textcls_ms_per_batch               — 2xLSTM text cls (benchmark/paddle/rnn)
+  6. resnet50_pipeline_images_per_sec        — ResNet-50 through the real data plane
 
 Methodology: every step consumes a different pre-staged device batch (cycled)
 and a fresh PRNG key, and timing syncs via a host fetch of the cost scalar —
@@ -338,6 +339,81 @@ def bench_transformer() -> dict:
     }
 
 
+def bench_lstm_textcls() -> dict:
+    """LSTM text classification (reference benchmark/paddle/rnn/rnn.py:
+    embedding 128 -> 2x simple_lstm(512) -> last_seq -> fc softmax, IMDB
+    class shapes: vocab 30k, seq 100, batch 128).  Reference K40m:
+    261 ms/batch (benchmark/README.md:121-127, hidden 512 / bs 128);
+    vs_baseline = reference_ms / our_ms."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu.layers import networks
+    from paddle_tpu.trainer.step import make_train_step
+
+    reset_auto_names()
+    L = paddle.layer
+    batch_size, seq_len, vocab, hidden = 128, 100, 30000, 512
+    ref_ms = 261.0
+
+    net = L.data("data", paddle.data_type.integer_value_sequence(vocab))
+    net = L.embedding(net, size=128)
+    for _ in range(2):
+        net = networks.simple_lstm(net, size=hidden)
+    net = L.last_seq(input=net)
+    net = L.fc(net, size=2, act=paddle.activation.Softmax())
+    lab = L.data("label", paddle.data_type.integer_value(2))
+    cost = L.classification_cost(input=net, label=lab)
+
+    cnet = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
+    params, state = cnet.init(jax.random.PRNGKey(0))
+    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+    opt_state = opt.init(params)
+    step = make_train_step(cnet, opt, mesh=None)
+
+    rng = np.random.RandomState(0)
+    lens = jnp.full((batch_size,), seq_len, jnp.int32)
+    batches = [
+        {
+            "data": SeqTensor(
+                jax.device_put(
+                    rng.randint(0, vocab, size=(batch_size, seq_len)).astype(
+                        np.int32
+                    )
+                ),
+                lens,
+            ),
+            "label": SeqTensor(
+                jax.device_put(rng.randint(0, 2, size=batch_size).astype(np.int32))
+            ),
+        }
+        for _ in range(4)
+    ]
+    params, state, opt_state, m = step(
+        params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
+    _sync(m)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, state, opt_state, m = step(
+            params, state, opt_state, batches[i % len(batches)], jax.random.PRNGKey(i)
+        )
+    _sync(m)
+    ms_per_batch = (time.perf_counter() - t0) / iters * 1000.0
+    return {
+        "metric": "lstm_textcls_ms_per_batch",
+        "value": round(ms_per_batch, 2),
+        "unit": "ms/batch",
+        "vs_baseline": round(ref_ms / ms_per_batch, 4),
+    }
+
+
 def bench_allreduce() -> dict:
     """Gradient-allreduce bandwidth over the mesh data axis — the path that
     replaces the reference pserver push/pull (ParameterServer2 addGradient /
@@ -391,7 +467,7 @@ def bench_allreduce() -> dict:
 
 def main() -> None:
     for fn in (bench_resnet, bench_nmt, bench_allreduce, bench_transformer,
-               bench_resnet_pipeline):
+               bench_lstm_textcls, bench_resnet_pipeline):
         try:
             print(json.dumps(fn()), flush=True)
         except Exception as e:  # keep later metrics alive if one fails

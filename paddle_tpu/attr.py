@@ -35,6 +35,9 @@ class ExtraAttr:
     """Extra layer attributes (reference ExtraLayerAttribute, attrs.py:390)."""
 
     drop_rate: float = 0.0
+    # Clip the gradient flowing back into this layer's output to
+    # [-t, t] (reference error_clipping_threshold, Layer.cpp backwardActivation)
+    error_clipping_threshold: float = 0.0
     # Mesh-axis hint replacing the reference's per-layer `device`.
     shard_axis: Optional[str] = None
     # v1 per-layer device id — accepted for config compatibility, ignored

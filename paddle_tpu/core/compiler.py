@@ -16,6 +16,7 @@ returns updated state functionally.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -44,6 +45,25 @@ def set_default_compute_dtype(dtype) -> None:
 
 def get_default_compute_dtype():
     return _default_compute_dtype
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _error_clip(x, t):
+    """Identity forward; the backward clips the layer-output cotangent to
+    [-t, t] (reference ExtraLayerAttribute.error_clipping_threshold,
+    Layer.cpp backwardActivation)."""
+    return x
+
+
+def _error_clip_fwd(x, t):
+    return x, None
+
+
+def _error_clip_bwd(t, _res, g):
+    return (jnp.clip(g, -t, t),)
+
+
+_error_clip.defvjp(_error_clip_fwd, _error_clip_bwd)
 
 
 def _cast_floats(tree, dtype):
@@ -78,16 +98,33 @@ class CompiledNetwork:
         # global parameter table: two layers declaring the same parameter
         # name share storage — e.g. crf + crf_decoding sharing "crfw",
         # tied embeddings).  First declarer in topology order owns the
-        # params; later declarers read the owner's slot.
+        # params; later declarers read the owner's slot.  Two granularities:
+        #   attr("param_name")  — the whole layer param dict (legacy layers
+        #                         with one logical parameter);
+        #   attr("param_names") — {param_key: global_name} per-key sharing
+        #                         (fc per-input weights, mixed projections,
+        #                         named bias attrs) — including intra-layer
+        #                         duplicates like fc param_attr=[p, p].
         self._param_owner: Dict[str, str] = {}
+        self._shared_keys: Dict[str, Dict[str, tuple]] = {}
         owners: Dict[str, str] = {}
+        key_owners: Dict[str, tuple] = {}
         for name in topology.order:
-            pname = topology.layers[name].attr("param_name")
-            if pname:
+            conf = topology.layers[name]
+            pmap = conf.attr("param_names") or {}
+            pname = conf.attr("param_name")
+            if pname and not pmap:
                 if pname in owners:
                     self._param_owner[name] = owners[pname]
                 else:
                     owners[pname] = name
+            for key, gname in pmap.items():
+                if not gname:
+                    continue
+                if gname in key_owners:
+                    self._shared_keys.setdefault(name, {})[key] = key_owners[gname]
+                else:
+                    key_owners[gname] = (name, key)
 
     # ------------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> Params:
@@ -112,6 +149,15 @@ class CompiledNetwork:
                         f"expects shapes {want} != owner's {have}"
                     )
                 continue
+            for key, (ol, ok) in self._shared_keys.get(name, {}).items():
+                owner_val = p[ok] if ol == name else params[ol][ok]
+                if jnp.shape(p[key]) != jnp.shape(owner_val):
+                    raise ValueError(
+                        f"layer {name!r} parameter {key!r} shares storage "
+                        f"with {ol!r}.{ok!r} but expects shape "
+                        f"{jnp.shape(p[key])} != owner's {jnp.shape(owner_val)}"
+                    )
+                del p[key]
             if p:
                 params[name] = p
         return params
@@ -154,6 +200,11 @@ class CompiledNetwork:
         exactly what training runs."""
         impl = self._impls[name]
         p = params.get(self._param_owner.get(name, name), {})
+        shared = self._shared_keys.get(name)
+        if shared:
+            p = dict(p)
+            for key, (ol, ok) in shared.items():
+                p[key] = p[ok] if ol == name else params[ol][ok]
         if self.compute_dtype != jnp.dtype(jnp.float32):
             if impl.full_precision:
                 ins = [_cast_floats(x, jnp.float32) for x in ins]
@@ -234,6 +285,9 @@ class CompiledNetwork:
                     out = out.with_data(
                         jnp.where(m, out.data / keep, jnp.zeros_like(out.data))
                     )
+            eclip = conf.attr("error_clip", 0.0)
+            if eclip and train:
+                out = out.with_data(_error_clip(out.data, eclip))
             ctx.outputs[name] = out
         new_state = dict(ctx.state)
         new_state.update(ctx.new_state)

@@ -84,6 +84,15 @@ def _extra(layer_attr: Optional[ExtraAttr]):
     return drop, shard
 
 
+def _set_error_clip(conf: LayerConf, layer_attr: Optional[ExtraAttr]) -> None:
+    """Record ExtraAttr.error_clipping_threshold on the conf; the compiler
+    clips the cotangent flowing into this layer's output to [-t, t]
+    (reference Layer.cpp backwardActivation error clipping)."""
+    t = getattr(layer_attr, "error_clipping_threshold", 0.0) if layer_attr else 0.0
+    if t:
+        conf.attrs["error_clip"] = float(t)
+
+
 def _param_std(param_attr: Optional[ParamAttr]):
     return param_attr.initial_std if param_attr else None
 
@@ -180,12 +189,40 @@ def fc(
     size: int,
     act=None,
     bias_attr: Union[bool, ParamAttr] = True,
-    param_attr: Optional[ParamAttr] = None,
+    param_attr: Union[ParamAttr, Sequence[ParamAttr], None] = None,
     layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     ins = _as_list(input)
     drop, shard = _extra(layer_attr)
+    if isinstance(param_attr, (list, tuple)):
+        # per-input weight attrs (reference fc_layer param_attr list): each
+        # input i gets weight w{i}; named attrs share storage by name —
+        # including the same name twice within one layer (shared_fc.py)
+        assert len(param_attr) == len(ins), (
+            f"fc param_attr list length {len(param_attr)} != inputs {len(ins)}"
+        )
+        attrs = {
+            "param_stds": tuple(_param_std(pa) for pa in param_attr),
+            "prune_sparsity": _prune_ratio(param_attr[0]),
+        }
+        pnames = {
+            f"w{i}": _param_name(pa)
+            for i, pa in enumerate(param_attr)
+            if _param_name(pa)
+        }
+    else:
+        attrs = _param_attrs(param_attr)
+        shared_name = attrs.pop("param_name", None)
+        pnames = (
+            {f"w{i}": shared_name for i in range(len(ins))}
+            if shared_name
+            else {}
+        )
+    if isinstance(bias_attr, ParamAttr) and bias_attr.name:
+        pnames["b"] = bias_attr.name
+    if pnames:
+        attrs["param_names"] = pnames
     conf = LayerConf(
         name=name or auto_name("fc_layer"),
         type="fc",
@@ -193,10 +230,11 @@ def fc(
         inputs=tuple(i.name for i in ins),
         act=act_name(act if act is not None else _act_mod.Tanh()),
         bias=bool(bias_attr),
-        attrs=_param_attrs(param_attr),
+        attrs=attrs,
         drop_rate=drop,
         shard_axis=shard,
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, ins)
 
 
@@ -227,6 +265,7 @@ def embedding(
         drop_rate=drop,
         shard_axis=shard,
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 
@@ -253,14 +292,26 @@ def addto(
         drop_rate=drop,
         shard_axis=shard,
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, ins)
 
 
 addto_layer = addto
 
 
-def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None) -> LayerOutput:
+def concat(input: Sequence[LayerOutput], name: Optional[str] = None, act=None,
+           bias_attr=False, layer_attr=None) -> LayerOutput:
     ins = _as_list(input)
+    if any(isinstance(i, Projection) for i in ins):
+        # reference concat2 (ConcatenateLayer2.cpp): concat of PROJECTIONS —
+        # each projection becomes a single-term mixed layer, then an
+        # ordinary feature concat
+        ins = [
+            mixed(input=[i], name=auto_name((name or "concat") + "_proj"))
+            if isinstance(i, Projection)
+            else i
+            for i in ins
+        ]
     conf = LayerConf(
         name=name or auto_name("concat"),
         type="concat",
@@ -335,6 +386,13 @@ def img_conv(
 ) -> LayerOutput:
     """reference img_conv_layer (layers.py) → ExpandConvLayer/CudnnConvLayer."""
     in_c, in_h, in_w = _img_attrs(input, num_channels)
+    # reference accepts (x, y) tuples for filter_size/stride/padding
+    if isinstance(filter_size, (list, tuple)):
+        filter_size, filter_size_y = filter_size
+    if isinstance(stride, (list, tuple)):
+        stride, stride_y = stride
+    if isinstance(padding, (list, tuple)):
+        padding, padding_y = padding
     fh = filter_size_y or filter_size
     fw = filter_size
     sh = stride_y or stride
@@ -379,6 +437,7 @@ def img_conv(
         drop_rate=drop,
         shard_axis=shard,
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 
@@ -608,6 +667,62 @@ def img_pad(
 pad_layer = img_pad
 
 
+def crop(
+    input: Inputish,
+    offset: Optional[Sequence[int]] = None,
+    axis: int = 2,
+    shape: Optional[Sequence[int]] = None,
+    name: Optional[str] = None,
+    layer_attr=None,
+) -> LayerOutput:
+    """reference crop_layer (layers.py:6044) → CropLayer.cpp: crop the image
+    input to `shape` — or to a second reference input's geometry — starting
+    at `axis` (1=C,H,W; 2=H,W; 3=W), at the given offsets (default 0)."""
+    ins = _as_list(input)
+    x = ins[0]
+    in_c, in_h, in_w = _img_attrs(x, None)
+    if len(ins) == 2:
+        rc, rh, rw = _img_attrs(ins[1], None)
+        target = (rc, rh, rw)
+    else:
+        assert shape is not None, "crop_layer needs a reference input or shape"
+        s = list(shape)
+        # shape names the cropped trailing dims starting at `axis` (NCHW)
+        tail = {1: 3, 2: 2, 3: 1}[axis]
+        assert len(s) >= tail, f"crop shape {shape} too short for axis {axis}"
+        s = s[-tail:]
+        target = (in_c, in_h, in_w)
+        target = tuple(
+            s[i - (3 - tail)] if i >= 3 - tail else target[i] for i in range(3)
+        )
+    out_c = target[0] if axis <= 1 else in_c
+    out_h = target[1] if axis <= 2 else in_h
+    out_w = target[2]
+    # offset entries align to the cropped axes starting at `axis` (reference
+    # crop_layer: axis=2, offset=[h, w]) — pad MISSING LEADING axes with 0
+    offs = list(offset) if offset is not None else []
+    offs = [0] * (3 - len(offs)) + offs
+    conf = LayerConf(
+        name=name or auto_name("crop"),
+        type="crop",
+        size=out_c * out_h * out_w,
+        inputs=tuple(i.name for i in ins),
+        bias=False,
+        attrs={
+            "in_c": in_c, "in_h": in_h, "in_w": in_w,
+            "out_c": out_c, "out_h": out_h, "out_w": out_w,
+            "offset_c": offs[0] if axis <= 1 else 0,
+            "offset_h": offs[1] if axis <= 2 else 0,
+            "offset_w": offs[2],
+            "channels": out_c,
+        },
+    )
+    return LayerOutput(conf, ins)
+
+
+crop_layer = crop
+
+
 # ---------------------------------------------------------------------------
 # simple math layers
 # ---------------------------------------------------------------------------
@@ -647,8 +762,17 @@ scaling_layer = scaling
 
 
 def interpolation(
-    weight: LayerOutput, input1: LayerOutput, input2: LayerOutput, name=None
+    weight: LayerOutput = None,
+    input1: LayerOutput = None,
+    input2: LayerOutput = None,
+    input: Optional[Sequence[LayerOutput]] = None,
+    name=None,
+    layer_attr=None,
 ) -> LayerOutput:
+    """y = w*x1 + (1-w)*x2.  Accepts either the positional (weight, x1, x2)
+    form or the reference interpolation_layer(input=[x1, x2], weight=w)."""
+    if input is not None:
+        input1, input2 = input
     conf = LayerConf(
         name=name or auto_name("interpolation"),
         type="interpolation",
@@ -690,11 +814,35 @@ def maxid(input, name=None):
 maxid_layer = maxid
 
 
-def trans(input, height: int, name=None):
+def trans(input, height: Optional[int] = None, name=None, layer_attr=None):
+    """height=None: whole-minibatch transpose (reference trans_layer →
+    TransLayer.cpp); height=H: per-sample [H, W] feature-block transpose
+    (the rotate/trans feature-map variant)."""
     return _unary("trans", input, name=name, height=height)
 
 
 trans_layer = trans
+
+
+def repeat(input, num_repeats: int, as_row_vector: bool = True, act=None,
+           name=None, layer_attr=None):
+    """reference repeat_layer (layers.py:1778): tile the feature vector
+    num_repeats times (row-vector order) or repeat each element
+    (column-vector order)."""
+    ins = _as_list(input)
+    conf = LayerConf(
+        name=name or auto_name("repeat"),
+        type="repeat",
+        size=ins[0].size * num_repeats,
+        inputs=(ins[0].name,),
+        act=act_name(act),
+        bias=False,
+        attrs={"num_repeats": num_repeats, "as_row_vector": as_row_vector},
+    )
+    return LayerOutput(conf, ins)
+
+
+repeat_layer = repeat
 
 
 def resize(input, size: int, name=None):
@@ -731,6 +879,39 @@ def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0, name=Non
     return LayerOutput(conf, [a, b])
 
 
+def gated_unit(
+    input: LayerOutput,
+    size: int,
+    act=None,
+    name: Optional[str] = None,
+    gate_attr=None,
+    gate_param_attr: Optional[ParamAttr] = None,
+    gate_bias_attr=True,
+    inproj_attr=None,
+    inproj_param_attr: Optional[ParamAttr] = None,
+    inproj_bias_attr=True,
+    layer_attr=None,
+) -> LayerOutput:
+    """reference gated_unit_layer (layers.py): GLU — proj(input) ⊙
+    σ(gate(input)) (Dauphin et al.; the conv_seq_to_seq building block)."""
+    proj = fc(
+        input, size=size,
+        act=act if act is not None else _act_mod.Identity(),
+        bias_attr=inproj_bias_attr,
+        param_attr=inproj_param_attr, layer_attr=inproj_attr,
+        name=(name + "_input_proj") if name else None,
+    )
+    gate = fc(
+        input, size=size, act=_act_mod.Sigmoid(), bias_attr=gate_bias_attr,
+        param_attr=gate_param_attr, layer_attr=gate_attr,
+        name=(name + "_gate") if name else None,
+    )
+    return dotmul_operator(a=proj, b=gate, name=name)
+
+
+gated_unit_layer = gated_unit
+
+
 def out_prod(input1: LayerOutput, input2: LayerOutput, name=None) -> LayerOutput:
     conf = LayerConf(
         name=name or auto_name("out_prod"),
@@ -745,19 +926,38 @@ def out_prod(input1: LayerOutput, input2: LayerOutput, name=None) -> LayerOutput
 out_prod_layer = out_prod
 
 
-def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0, name=None) -> LayerOutput:
+def cos_sim(a: LayerOutput, b: LayerOutput, scale: float = 1.0, size: int = 1,
+            name=None, layer_attr=None) -> LayerOutput:
+    """size>1: b holds `size` concatenated vectors of a's width; one cosine
+    per vector (reference cos_sim size param → CosSimLayer N similarities)."""
+    if size > 1:
+        assert b.size == a.size * size, (
+            f"cos_sim size={size}: b.size {b.size} != a.size*{size}"
+        )
     conf = LayerConf(
         name=name or auto_name("cos_sim"),
         type="cos",
-        size=1,
+        size=size,
         inputs=(a.name, b.name),
         bias=False,
-        attrs={"scale": scale},
+        attrs={"scale": scale, "cos_n": size},
     )
     return LayerOutput(conf, [a, b])
 
 
-def tensor(
+def tensor(*args, **kwargs):
+    """reference tensor_layer(a=..., b=..., size=...): bilinear
+    y_k = a W_k b^T.  Accepts the (input1, input2, ...) positional form
+    too."""
+    if "a" in kwargs:
+        kwargs["input1"] = kwargs.pop("a")
+    if "b" in kwargs:
+        kwargs["input2"] = kwargs.pop("b")
+    kwargs.pop("layer_attr", None)
+    return _tensor_impl(*args, **kwargs)
+
+
+def _tensor_impl(
     input1: LayerOutput,
     input2: LayerOutput,
     size: int,
@@ -796,10 +996,25 @@ def _cost2(type_: str, input: LayerOutput, label: LayerOutput, name=None, **attr
     return LayerOutput(conf, [input, label])
 
 
-def classification_cost(input: LayerOutput, label: LayerOutput, name=None) -> LayerOutput:
+def _weighted(cost: LayerOutput, weight, name=None) -> LayerOutput:
+    """Per-sample weighted cost (reference CostLayer weight input): the [B,1]
+    weight slot scales the [B,1] per-sample cost — exactly the scaling layer."""
+    if weight is None:
+        return cost
+    return scaling(weight, cost, name=name)
+
+
+def classification_cost(
+    input: LayerOutput, label: LayerOutput, weight=None, name=None, evaluator=None,
+    layer_attr=None,
+) -> LayerOutput:
     """reference classification_cost: softmax output + cross-entropy (the
     compiler fuses into log-softmax CE when the input's act is softmax)."""
-    return _cost2("cross_entropy", input, label, name=name)
+    inner = _cost2(
+        "cross_entropy", input, label,
+        name=(name + "_unweighted") if (name and weight is not None) else name,
+    )
+    return _weighted(inner, weight, name=name)
 
 
 def cross_entropy_cost(input, label, name=None):
@@ -824,8 +1039,12 @@ def soft_binary_class_cross_entropy_cost(input, label, name=None):
     return _cost2("soft_binary_class_cross_entropy", input, label, name=name)
 
 
-def square_error_cost(input, label, name=None):
-    return _cost2("square_error", input, label, name=name)
+def square_error_cost(input, label, weight=None, name=None, layer_attr=None):
+    inner = _cost2(
+        "square_error", input, label,
+        name=(name + "_unweighted") if (name and weight is not None) else name,
+    )
+    return _weighted(inner, weight, name=name)
 
 
 mse_cost = square_error_cost
@@ -842,6 +1061,10 @@ def huber_regression_cost(input, label, delta=1.0, name=None):
 
 def huber_classification_cost(input, label, name=None):
     return _cost2("huber_classification", input, label, name=name)
+
+
+# reference-era name: huber_cost was the binary-classification huber loss
+huber_cost = huber_classification_cost
 
 
 def rank_cost(left: LayerOutput, right: LayerOutput, label: LayerOutput, name=None):
@@ -879,18 +1102,32 @@ def pooling(
     input: LayerOutput,
     pooling_type=None,
     agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
+    stride: int = -1,
+    bias_attr=False,
     name: Optional[str] = None,
+    layer_attr=None,
 ) -> LayerOutput:
     """Pool a sequence over time (reference pooling_layer → SequencePoolLayer).
     With nested input, agg_level picks whether whole outer sequences
-    (TO_NO_SEQUENCE) or individual subsequences (TO_SEQUENCE) collapse."""
+    (TO_NO_SEQUENCE) or individual subsequences (TO_SEQUENCE) collapse.
+    stride>0 pools fixed windows of `stride` steps, emitting a shorter
+    sequence."""
+    if stride > 0:
+        assert agg_level == AggregateLevel.TO_NO_SEQUENCE
     conf = LayerConf(
         name=name or auto_name("seqpool"),
         type="seqpool",
         size=input.size,
         inputs=(input.name,),
         bias=False,
-        attrs={"pool_type": pool_name(pooling_type), "agg_level": agg_level},
+        attrs={
+            "pool_type": pool_name(pooling_type),
+            "agg_level": agg_level,
+            "stride": stride,
+            "output_max_index": bool(
+                getattr(pooling_type, "output_max_index", False)
+            ),
+        },
     )
     return LayerOutput(conf, [input])
 
@@ -901,20 +1138,26 @@ pooling_layer = pooling
 def last_seq(
     input: LayerOutput,
     agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
+    stride: int = -1,
     name: Optional[str] = None,
+    layer_attr=None,
 ) -> LayerOutput:
     return _unary(
-        "seqlastins", input, name=name, select_first=False, agg_level=agg_level
+        "seqlastins", input, name=name, select_first=False,
+        agg_level=agg_level, stride=stride,
     )
 
 
 def first_seq(
     input: LayerOutput,
     agg_level: int = AggregateLevel.TO_NO_SEQUENCE,
+    stride: int = -1,
     name: Optional[str] = None,
+    layer_attr=None,
 ) -> LayerOutput:
     return _unary(
-        "seqlastins", input, name=name, select_first=True, agg_level=agg_level
+        "seqlastins", input, name=name, select_first=True,
+        agg_level=agg_level, stride=stride,
     )
 
 
@@ -994,6 +1237,7 @@ def lstmemory(
             **_param_attrs(param_attr),
         },
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 
@@ -1004,23 +1248,30 @@ def grumemory(
     act=None,
     gate_act=None,
     bias_attr=True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """reference grumemory: input pre-projected to 3×size."""
     size = size or input.size // 3
     assert input.size == 3 * size
+    drop, shard = _extra(layer_attr)
     conf = LayerConf(
         name=name or auto_name("gru"),
         type="gru",
         size=size,
         inputs=(input.name,),
         bias=bool(bias_attr),
+        drop_rate=drop,
+        shard_axis=shard,
         attrs={
             "reverse": reverse,
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+            **_param_attrs(param_attr),
         },
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 
@@ -1045,6 +1296,7 @@ def recurrent(
         shard_axis=shard,
         attrs={"reverse": reverse, **_param_attrs(param_attr)},
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 
@@ -1103,6 +1355,19 @@ def conv_shift(a: LayerOutput, b: LayerOutput, name=None) -> LayerOutput:
 conv_shift_layer = conv_shift
 
 
+def _step_param_names(param_attr, bias_attr, weight_keys) -> dict:
+    """param_names map for step cells: the single reference param name ties
+    every recurrent weight key; a named bias attr ties the bias."""
+    pnames = {}
+    pn = _param_name(param_attr)
+    if pn:
+        for k in weight_keys:
+            pnames[k] = f"{pn}#{k}"
+    if isinstance(bias_attr, ParamAttr) and bias_attr.name:
+        pnames["b"] = bias_attr.name
+    return pnames
+
+
 def gru_step(
     input: LayerOutput,
     output_mem: LayerOutput,
@@ -1110,12 +1375,15 @@ def gru_step(
     act=None,
     gate_act=None,
     bias_attr=True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr=None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """One GRU step (reference gru_step_layer): input pre-projected to 3H,
     output_mem = previous state (usually a memory)."""
     size = size or output_mem.size
     assert input.size == 3 * size
+    pnames = _step_param_names(param_attr, bias_attr, ("w_h", "w_c"))
     conf = LayerConf(
         name=name or auto_name("gru_step"),
         type="gru_step",
@@ -1125,6 +1393,8 @@ def gru_step(
         attrs={
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
+            "param_std": _param_std(param_attr),
+            **({"param_names": pnames} if pnames else {}),
         },
     )
     return LayerOutput(conf, [input, output_mem])
@@ -1142,12 +1412,18 @@ def lstm_step(
     gate_act=None,
     state_act=None,
     bias_attr=True,
+    recurrent_weight: bool = True,
+    layer_attr=None,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """One LSTM step (reference lstm_step_layer): cell state is exposed as
-    `<name>@cell` for a second memory link."""
+    `<name>@cell` for a second memory link.  recurrent_weight=False matches
+    the reference exactly (no W_h inside the step — lstmemory_unit feeds the
+    recurrence through a mixed projection instead); True keeps the fused
+    convenience form."""
     size = size or output_mem.size
     assert input.size == 4 * size
+    pnames = _step_param_names(None, bias_attr, ())
     conf = LayerConf(
         name=name or auto_name("lstm_step"),
         type="lstm_step",
@@ -1158,6 +1434,8 @@ def lstm_step(
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
             "state_act": act_name(state_act if state_act is not None else _act_mod.Tanh()),
+            "recurrent_weight": recurrent_weight,
+            **({"param_names": pnames} if pnames else {}),
         },
     )
     return LayerOutput(conf, [input, output_mem, state_mem])
@@ -1280,9 +1558,18 @@ def sub_seq(input: LayerOutput, offsets: LayerOutput, sizes: LayerOutput,
 sub_seq_layer = sub_seq
 
 
-def linear_comb(weights: LayerOutput, vectors: LayerOutput, size: int,
-                name=None) -> LayerOutput:
-    """reference linear_comb_layer / convex_comb_layer."""
+def linear_comb(weights: LayerOutput, vectors: LayerOutput,
+                size: Optional[int] = None, name=None,
+                layer_attr=None) -> LayerOutput:
+    """reference linear_comb_layer / convex_comb_layer: vectors holds W
+    groups of `size` features; weights [B, W] combines them.  size defaults
+    to vectors.size // weights.size (the reference's implicit sizing)."""
+    if size is None:
+        assert vectors.size % weights.size == 0, (
+            f"linear_comb: vectors.size {vectors.size} not a multiple of "
+            f"weights.size {weights.size}"
+        )
+        size = vectors.size // weights.size
     conf = LayerConf(
         name=name or auto_name("linear_comb"),
         type="linear_comb",
@@ -1295,6 +1582,7 @@ def linear_comb(weights: LayerOutput, vectors: LayerOutput, size: int,
 
 convex_comb = linear_comb
 convex_comb_layer = linear_comb
+linear_comb_layer = linear_comb
 
 
 def cos_sim_vec_mat(vec: LayerOutput, mat: LayerOutput, size: int,
@@ -1356,12 +1644,16 @@ def nce(
     noise_dist: Optional[Sequence[float]] = None,
     bias_attr: Union[bool, ParamAttr] = True,
     param_attr: Optional[ParamAttr] = None,
+    weight: Optional[LayerOutput] = None,
     name: Optional[str] = None,
+    layer_attr=None,
 ) -> LayerOutput:
     feats = _as_list(input)
     c = num_classes or label.size
     conf = LayerConf(
-        name=name or auto_name("nce"),
+        name=(
+            (name + "_unweighted") if (name and weight is not None) else name
+        ) or auto_name("nce"),
         type="nce",
         size=1,
         inputs=tuple(f.name for f in feats) + (label.name,),
@@ -1373,7 +1665,7 @@ def nce(
             "noise_dist": tuple(noise_dist) if noise_dist is not None else None,
         },
     )
-    return LayerOutput(conf, feats + [label])
+    return _weighted(LayerOutput(conf, feats + [label]), weight, name=name)
 
 
 nce_layer = nce
@@ -1567,16 +1859,31 @@ class Projection:
         self.attrs = attrs
 
 
-def full_matrix_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
-    return Projection("full_matrix", input, param_std=_param_std(param_attr))
+def full_matrix_projection(
+    input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
+) -> Projection:
+    return Projection(
+        "full_matrix", input, size=size,
+        param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+    )
 
 
-def trans_full_matrix_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
-    return Projection("trans_full_matrix", input, param_std=_param_std(param_attr))
+def trans_full_matrix_projection(
+    input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
+) -> Projection:
+    return Projection(
+        "trans_full_matrix", input, size=size,
+        param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+    )
 
 
-def table_projection(input: LayerOutput, param_attr: Optional[ParamAttr] = None) -> Projection:
-    return Projection("table", input, param_std=_param_std(param_attr))
+def table_projection(
+    input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
+) -> Projection:
+    return Projection(
+        "table", input, size=size,
+        param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+    )
 
 
 def identity_projection(input: LayerOutput, offset: Optional[int] = None, size: int = 0) -> Projection:
@@ -1596,7 +1903,10 @@ def scaling_projection(input: LayerOutput) -> Projection:
 def dotmul_projection(
     input: LayerOutput, param_attr: Optional[ParamAttr] = None
 ) -> Projection:
-    return Projection("dotmul", input, param_std=_param_std(param_attr))
+    return Projection(
+        "dotmul", input,
+        param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+    )
 
 
 def conv_projection(
@@ -1607,6 +1917,7 @@ def conv_projection(
     stride: int = 1,
     padding: int = 0,
     groups: int = 1,
+    trans: bool = False,
     param_attr: Optional[ParamAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
@@ -1620,6 +1931,7 @@ def conv_projection(
         stride=stride,
         padding=padding,
         groups=groups,
+        trans=trans,
         act=_act_mod.Identity(),
         bias_attr=False,
         param_attr=param_attr,
@@ -1635,15 +1947,26 @@ def conv_operator(
     num_channels: Optional[int] = None,
     stride: int = 1,
     padding: int = 0,
+    filter_size_y: Optional[int] = None,
+    stride_y: Optional[int] = None,
+    padding_y: Optional[int] = None,
+    trans: bool = False,
     name: Optional[str] = None,
 ) -> LayerOutput:
     """reference conv_operator (ConvOperator.cpp): convolve the image input
-    with per-sample filters produced by another layer."""
-    img_attrs = _img_passthrough(img)
-    in_c = num_channels if num_channels is not None else img_attrs.get("out_c", 1)
-    in_h, in_w = img_attrs.get("out_h"), img_attrs.get("out_w")
-    out_h = cnn_output_size(in_h, filter_size, padding, stride)
-    out_w = cnn_output_size(in_w, filter_size, padding, stride)
+    with per-sample filters produced by another layer.  trans=True runs the
+    transposed (fractionally-strided) form."""
+    in_c, in_h, in_w = _img_attrs(img, num_channels)
+    fh, fw = filter_size_y or filter_size, filter_size
+    sh, sw = stride_y or stride, stride
+    ph = padding_y if padding_y is not None else padding
+    pw = padding
+    if trans:
+        out_h = (in_h - 1) * sh + fh - 2 * ph
+        out_w = (in_w - 1) * sw + fw - 2 * pw
+    else:
+        out_h = cnn_output_size(in_h, fh, ph, sh)
+        out_w = cnn_output_size(in_w, fw, pw, sw)
     conf = LayerConf(
         name=name or auto_name("conv_op"),
         type="conv_op",
@@ -1652,10 +1975,11 @@ def conv_operator(
         bias=False,
         attrs={
             "in_h": in_h, "in_w": in_w, "in_c": in_c,
-            "filter_h": filter_size, "filter_w": filter_size,
+            "filter_h": fh, "filter_w": fw,
             "channels": num_filters,
-            "stride_h": stride, "stride_w": stride,
-            "pad_h": padding, "pad_w": padding,
+            "stride_h": sh, "stride_w": sw,
+            "pad_h": ph, "pad_w": pw,
+            "trans": trans,
             "out_h": out_h, "out_w": out_w, "out_c": num_filters,
         },
     )
@@ -1701,9 +2025,16 @@ def mixed(
         inferred = [
             parents[s["in"]].size for s in specs
             if s["kind"] in ("identity", "dotmul", "scaling")
-        ]
+        ] + [s["size"] for s in specs if s.get("size")]
         assert inferred, "mixed() needs an explicit size"
         size = inferred[0]
+    pnames = {
+        f"p{j}_w": s["param_name"]
+        for j, s in enumerate(specs)
+        if s.get("param_name")
+    }
+    if isinstance(bias_attr, ParamAttr) and bias_attr.name:
+        pnames["b"] = bias_attr.name
     drop, shard = _extra(layer_attr)
     conf = LayerConf(
         name=name or auto_name("mixed"),
@@ -1714,8 +2045,12 @@ def mixed(
         bias=bool(bias_attr),
         drop_rate=drop,
         shard_axis=shard,
-        attrs={"projections": tuple(specs)},
+        attrs={
+            "projections": tuple(specs),
+            **({"param_names": pnames} if pnames else {}),
+        },
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, parents)
 
 
@@ -1918,6 +2253,7 @@ def img_cmrnorm(
             "channels": in_c, "out_h": in_h, "out_w": in_w,
         },
     )
+    _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])
 
 

@@ -46,8 +46,9 @@ def data_apply(conf, params, inputs, ctx):  # pragma: no cover - handled by comp
 
 def fc_init(conf: LayerConf, in_confs: List[LayerConf], rng) -> Dict[str, Any]:
     params: Dict[str, Any] = {}
+    stds = conf.attr("param_stds")
     for i, ic in enumerate(in_confs):
-        std = conf.attr("param_std")
+        std = stds[i] if stds is not None else conf.attr("param_std")
         params[f"w{i}"] = init.normal(
             jax.random.fold_in(rng, i), (ic.size, conf.size), std
         )
@@ -231,9 +232,31 @@ def multiplex_apply(conf, params, inputs, ctx):
 def trans_apply(conf, params, inputs, ctx):
     x = inputs[0]
     h = conf.attr("height")
+    if h is None:
+        # whole-minibatch transpose (reference TransLayer.cpp: y = x^T over
+        # the [batch, size] matrix; the batch axis becomes the feature axis)
+        return SeqTensor(jnp.swapaxes(x.data.reshape(x.data.shape[0], -1), 0, 1))
     b = x.data.shape[0]
     m = x.data.reshape(b, h, -1)
     return SeqTensor(jnp.swapaxes(m, 1, 2).reshape(b, -1), x.lengths)
+
+
+# ---------------------------------------------------------------------------
+# repeat — FeatureMapExpandLayer-era repeat_layer: tile the feature vector
+# ---------------------------------------------------------------------------
+
+
+@register_layer("repeat")
+def repeat_apply(conf, params, inputs, ctx):
+    x = inputs[0]
+    n = conf.attr("num_repeats")
+    if conf.attr("as_row_vector", True):
+        # [x1..xd, x1..xd, ...]
+        out = jnp.concatenate([x.data] * n, axis=-1)
+    else:
+        # [x1,x1,..., xd,xd,...]
+        out = jnp.repeat(x.data, n, axis=-1)
+    return x.with_data(out)
 
 
 # ---------------------------------------------------------------------------
@@ -302,6 +325,16 @@ def out_prod_apply(conf, params, inputs, ctx):
 def cos_apply(conf, params, inputs, ctx):
     a, b = inputs
     scale = conf.attr("scale", 1.0)
+    n = conf.attr("cos_n", 1)
+    if n > 1:
+        # reference cos_sim size=N: b holds N vectors of a's width; one
+        # cosine per vector (CosSimLayer over the reshaped [B, N, M])
+        bm = b.data.reshape(b.data.shape[0], n, -1)
+        num = jnp.sum(a.data[:, None, :] * bm, axis=-1)
+        den = jnp.linalg.norm(a.data, axis=-1, keepdims=True) * jnp.linalg.norm(
+            bm, axis=-1
+        )
+        return SeqTensor(scale * num / jnp.maximum(den, 1e-12), a.lengths)
     num = jnp.sum(a.data * b.data, axis=-1, keepdims=True)
     den = jnp.linalg.norm(a.data, axis=-1, keepdims=True) * jnp.linalg.norm(
         b.data, axis=-1, keepdims=True

@@ -129,15 +129,29 @@ def conv_op_apply(conf, params, inputs, ctx):
     # filter — the reference loops samples through GemmConv).
     filt = filt.transpose(0, 3, 4, 2, 1)
 
+    sh, sw = a.get("stride_h", 1), a.get("stride_w", 1)
+    ph, pw = a.get("pad_h", 0), a.get("pad_w", 0)
+
     def one(x, w):
+        if a.get("trans", False):
+            # transposed conv: lhs-dilate by the stride, pad k-1-p (same
+            # formulation as the convt layer — conv.py convt_apply)
+            return jax.lax.conv_general_dilated(
+                x[None],
+                w,
+                window_strides=(1, 1),
+                padding=[
+                    (a["filter_h"] - 1 - ph, a["filter_h"] - 1 - ph),
+                    (a["filter_w"] - 1 - pw, a["filter_w"] - 1 - pw),
+                ],
+                lhs_dilation=(sh, sw),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )[0]
         return jax.lax.conv_general_dilated(
             x[None],
             w,
-            window_strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
-            padding=[
-                (a.get("pad_h", 0), a.get("pad_h", 0)),
-                (a.get("pad_w", 0), a.get("pad_w", 0)),
-            ],
+            window_strides=(sh, sw),
+            padding=[(ph, ph), (pw, pw)],
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )[0]
 

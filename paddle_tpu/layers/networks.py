@@ -17,10 +17,12 @@ from paddle_tpu.layers import (
     expand,
     fc,
     first_seq,
+    gru_step,
     grumemory,
     img_conv,
     img_pool,
     last_seq,
+    lstm_step,
     lstmemory,
     pooling,
     recurrent_group,
@@ -207,45 +209,319 @@ def simple_lstm(
     )
 
 
+def _group_share_tag(param_attr, *bias_attrs) -> Optional[str]:
+    """Cross-group parameter sharing tag (shared_gru/shared_lstm configs):
+    non-None when the recurrent param AND every in-group bias are either
+    named or absent, so two groups built with the same names can share one
+    sub-param subtree (the reference shares individual parameters through
+    its global table; here the group layer's whole param dict is the unit
+    of sharing, which is exact when nothing inside is unnamed).  The tag
+    also names the in-group unit layers so the two subtrees are
+    structurally identical."""
+    from paddle_tpu.attr import ParamAttr
+
+    if param_attr is None or not param_attr.name:
+        return None
+    parts = [param_attr.name]
+    for b in bias_attrs:
+        if b is False:
+            parts.append("-")
+        elif isinstance(b, ParamAttr) and b.name:
+            parts.append(b.name)
+        else:
+            return None  # an unnamed default bias — sharing would overreach
+    return "rg:" + "|".join(parts)
+
+
+def gru_unit(
+    input: LayerOutput,
+    memory_boot: Optional[LayerOutput] = None,
+    size: Optional[int] = None,
+    name: Optional[str] = None,
+    gru_bias_attr=None,
+    gru_param_attr=None,
+    act=None,
+    gate_act=None,
+    gru_layer_attr=None,
+    naive: bool = False,
+) -> LayerOutput:
+    """One GRU step over a 3H-projected input with its own output memory
+    (reference gru_unit, networks.py:840) — recurrent_group building block."""
+    size = size or input.size // 3
+    name = name or auto_name("gru_unit")
+    out_mem = memory(name=name, size=size, boot_layer=memory_boot)
+    return gru_step(
+        input=input,
+        output_mem=out_mem,
+        size=size,
+        bias_attr=gru_bias_attr if gru_bias_attr is not None else True,
+        param_attr=gru_param_attr,
+        act=act,
+        gate_act=gate_act,
+        name=name,
+    )
+
+
+def gru_group(
+    input: LayerOutput,
+    memory_boot: Optional[LayerOutput] = None,
+    size: Optional[int] = None,
+    name: Optional[str] = None,
+    reverse: bool = False,
+    gru_bias_attr=None,
+    gru_param_attr=None,
+    act=None,
+    gate_act=None,
+    gru_layer_attr=None,
+) -> LayerOutput:
+    """GRU as a recurrent_group of gru_step (reference gru_group,
+    networks.py:902): same math as grumemory, composable step."""
+    size = size or input.size // 3
+    name = name or auto_name("gru_group")
+    tag = _group_share_tag(gru_param_attr, gru_bias_attr)
+    unit_name = f"{tag}_unit" if tag else f"{name}_unit"
+
+    def step(x):
+        return gru_unit(
+            input=x, memory_boot=memory_boot, size=size,
+            name=unit_name, gru_bias_attr=gru_bias_attr,
+            gru_param_attr=gru_param_attr, act=act, gate_act=gate_act,
+        )
+
+    group = recurrent_group(step=step, input=input, reverse=reverse, name=name)
+    if tag:
+        group.conf.attrs["param_name"] = tag
+    return group
+
+
+def lstmemory_unit(
+    input: LayerOutput,
+    out_memory: Optional[LayerOutput] = None,
+    name: Optional[str] = None,
+    size: Optional[int] = None,
+    param_attr=None,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    input_proj_bias_attr=None,
+    input_proj_layer_attr=None,
+    lstm_bias_attr=None,
+    lstm_layer_attr=None,
+) -> LayerOutput:
+    """One LSTM step (reference lstmemory_unit, networks.py:633): the
+    recurrence runs through a mixed projection of the output memory (the
+    step itself carries no W_h), cell state rides the `@cell` aux output."""
+    from paddle_tpu.layers import full_matrix_projection, identity_projection, mixed
+
+    size = size or input.size // 4
+    name = name or auto_name("lstm_unit")
+    if out_memory is None:
+        out_mem = memory(name=name, size=size)
+    else:
+        out_mem = out_memory
+    state_mem = memory(name=f"{name}@cell", size=size)
+    m = mixed(
+        size=size * 4,
+        input=[
+            identity_projection(input=input),
+            full_matrix_projection(input=out_mem, param_attr=param_attr),
+        ],
+        bias_attr=(
+            input_proj_bias_attr if input_proj_bias_attr is not None else False
+        ),
+        layer_attr=input_proj_layer_attr,
+        act=A.Identity(),
+        name=f"{name}_input_recurrent",
+    )
+    return lstm_step(
+        input=m,
+        output_mem=out_mem,
+        state_mem=state_mem,
+        size=size,
+        bias_attr=lstm_bias_attr if lstm_bias_attr is not None else True,
+        recurrent_weight=False,
+        act=act,
+        gate_act=gate_act,
+        state_act=state_act,
+        name=name,
+    )
+
+
+def lstmemory_group(
+    input: LayerOutput,
+    size: Optional[int] = None,
+    name: Optional[str] = None,
+    out_memory: Optional[LayerOutput] = None,
+    reverse: bool = False,
+    param_attr=None,
+    act=None,
+    gate_act=None,
+    state_act=None,
+    input_proj_bias_attr=None,
+    input_proj_layer_attr=None,
+    lstm_bias_attr=None,
+    lstm_layer_attr=None,
+) -> LayerOutput:
+    """LSTM as a recurrent_group of lstmemory_unit (reference
+    lstmemory_group, networks.py:744)."""
+    size = size or input.size // 4
+    name = name or auto_name("lstm_group")
+    tag = _group_share_tag(
+        param_attr, lstm_bias_attr,
+        input_proj_bias_attr if input_proj_bias_attr is not None else False,
+    )
+    unit_name = f"{tag}_unit" if tag else f"{name}_unit"
+
+    def step(x):
+        return lstmemory_unit(
+            input=x, out_memory=out_memory, name=unit_name, size=size,
+            param_attr=param_attr, act=act, gate_act=gate_act,
+            state_act=state_act,
+            input_proj_bias_attr=input_proj_bias_attr,
+            input_proj_layer_attr=input_proj_layer_attr,
+            lstm_bias_attr=lstm_bias_attr, lstm_layer_attr=lstm_layer_attr,
+        )
+
+    group = recurrent_group(step=step, input=input, reverse=reverse, name=name)
+    if tag:
+        group.conf.attrs["param_name"] = tag
+    return group
+
+
 def simple_gru(
     input: LayerOutput,
     size: int,
+    name: Optional[str] = None,
     reverse: bool = False,
+    mixed_param_attr=None,
+    mixed_bias_param_attr=None,
+    mixed_layer_attr=None,
+    gru_bias_attr=None,
+    gru_param_attr=None,
     act=None,
     gate_act=None,
-    name: Optional[str] = None,
+    gru_layer_attr=None,
+    naive: bool = False,
 ) -> LayerOutput:
+    """reference simple_gru (networks.py:975): W·x_t projection + gru_group."""
     proj = fc(
         input,
         size=size * 3,
         act=A.Identity(),
-        bias_attr=False,
+        bias_attr=(
+            mixed_bias_param_attr if mixed_bias_param_attr is not None else False
+        ),
+        param_attr=mixed_param_attr,
+        layer_attr=mixed_layer_attr,
         name=(name + "_transform") if name else None,
     )
-    return grumemory(proj, size=size, reverse=reverse, act=act, gate_act=gate_act, name=name)
+    return gru_group(
+        proj, size=size, name=name, reverse=reverse,
+        gru_bias_attr=gru_bias_attr, gru_param_attr=gru_param_attr,
+        act=act, gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+    )
+
+
+def simple_gru2(
+    input: LayerOutput,
+    size: int,
+    name: Optional[str] = None,
+    reverse: bool = False,
+    mixed_param_attr=None,
+    mixed_bias_attr=None,
+    gru_param_attr=None,
+    gru_bias_attr=None,
+    act=None,
+    gate_act=None,
+    mixed_layer_attr=None,
+    gru_cell_attr=None,
+) -> LayerOutput:
+    """reference simple_gru2 (networks.py:1061): same math through the FUSED
+    grumemory layer (one lax.scan) — the faster form."""
+    proj = fc(
+        input,
+        size=size * 3,
+        act=A.Identity(),
+        bias_attr=mixed_bias_attr if mixed_bias_attr is not None else False,
+        param_attr=mixed_param_attr,
+        layer_attr=mixed_layer_attr,
+        name=(name + "_transform") if name else None,
+    )
+    return grumemory(
+        proj, size=size, reverse=reverse, act=act, gate_act=gate_act,
+        param_attr=gru_param_attr, bias_attr=(
+            gru_bias_attr if gru_bias_attr is not None else True
+        ),
+        layer_attr=gru_cell_attr, name=name,
+    )
 
 
 def bidirectional_lstm(
     input: LayerOutput,
     size: int,
-    return_concat: bool = True,
     name: Optional[str] = None,
+    return_seq: bool = False,
+    return_concat: Optional[bool] = None,
+    **kwargs,
 ) -> LayerOutput:
-    fwd = simple_lstm(input, size, reverse=False, name=(name + "_fw") if name else None)
-    bwd = simple_lstm(input, size, reverse=True, name=(name + "_bw") if name else None)
-    if return_concat:
-        return concat([fwd, bwd])
-    return addto([fwd, bwd])
+    """reference bidirectional_lstm (networks.py): fwd + reversed LSTM;
+    return_seq=True concats the two output sequences [B,T,2H], else (the
+    reference default) concats last-of-forward with first-of-backward
+    [B,2H].  fwd_*/bwd_* kwargs route per direction."""
+    fwd_kw = {k[4:]: v for k, v in kwargs.items() if k.startswith("fwd_")}
+    bwd_kw = {k[4:]: v for k, v in kwargs.items() if k.startswith("bwd_")}
+    leftover = {
+        k for k in kwargs if not (k.startswith("fwd_") or k.startswith("bwd_"))
+        and k not in ("last_seq_attr", "first_seq_attr", "concat_attr", "concat_act")
+    }
+    assert not leftover, f"bidirectional_lstm got unexpected kwargs {leftover}"
+    fwd = simple_lstm(
+        input, size, reverse=False, name=(name + "_fw") if name else None,
+        **fwd_kw,
+    )
+    bwd = simple_lstm(
+        input, size, reverse=True, name=(name + "_bw") if name else None,
+        **bwd_kw,
+    )
+    if return_concat is not None:  # legacy surface of this package
+        return concat([fwd, bwd]) if return_concat else addto([fwd, bwd])
+    if return_seq:
+        return concat([fwd, bwd], name=name)
+    return concat([last_seq(input=fwd), first_seq(input=bwd)], name=name)
 
 
 def bidirectional_gru(
-    input: LayerOutput, size: int, return_concat: bool = True, name=None
+    input: LayerOutput,
+    size: int,
+    name=None,
+    return_seq: bool = False,
+    return_concat: Optional[bool] = None,
+    **kwargs,
 ) -> LayerOutput:
-    fwd = simple_gru(input, size, reverse=False, name=(name + "_fw") if name else None)
-    bwd = simple_gru(input, size, reverse=True, name=(name + "_bw") if name else None)
-    if return_concat:
-        return concat([fwd, bwd])
-    return addto([fwd, bwd])
+    """reference bidirectional_gru (networks.py:1122): fwd + reversed GRU;
+    return_seq=True concats the two output sequences, else concats
+    last-of-forward with first-of-backward.  fwd_*/bwd_* kwargs route to the
+    respective direction (reference prefix convention)."""
+    fwd_kw = {k[4:]: v for k, v in kwargs.items() if k.startswith("fwd_")}
+    bwd_kw = {k[4:]: v for k, v in kwargs.items() if k.startswith("bwd_")}
+    leftover = {
+        k for k in kwargs if not (k.startswith("fwd_") or k.startswith("bwd_"))
+        and k not in ("last_seq_attr", "first_seq_attr", "concat_attr", "concat_act")
+    }
+    assert not leftover, f"bidirectional_gru got unexpected kwargs {leftover}"
+    fwd = simple_gru2(
+        input, size, reverse=False, name=(name + "_fw") if name else None,
+        **fwd_kw,
+    )
+    bwd = simple_gru2(
+        input, size, reverse=True, name=(name + "_bw") if name else None,
+        **bwd_kw,
+    )
+    if return_concat is not None:  # legacy surface of this package
+        return concat([fwd, bwd]) if return_concat else addto([fwd, bwd])
+    if return_seq:
+        return concat([fwd, bwd], name=name)
+    return concat([last_seq(input=fwd), first_seq(input=bwd)], name=name)
 
 
 def sequence_conv_pool(

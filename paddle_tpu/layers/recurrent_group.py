@@ -75,18 +75,31 @@ def _group_build():
         _current_build = prev
 
 
+class _MemoryOutput(LayerOutput):
+    """memory() handle: supports the reference's deferred-link form
+    ``m = memory(name=None, size=...); ...; m.set_input(layer)``."""
+
+    def set_input(self, layer: LayerOutput) -> None:
+        assert self.conf.type == "memory"
+        self.conf.attrs["link"] = layer.name
+
+
 def memory(
-    name: str,
+    name: Optional[str],
     size: int,
     boot_layer: Optional[LayerOutput] = None,
     boot_with_const_id: Optional[int] = None,
+    is_seq: bool = False,
+    memory_name: Optional[str] = None,
 ) -> LayerOutput:
     """Previous-timestep output of the in-group layer called `name`
     (reference memory(), layers.py; RecurrentGradientMachine "memory frame"
-    links).  boot_layer provides the t=0 value (non-seq [B, size])."""
+    links).  boot_layer provides the t=0 value (non-seq [B, size]).
+    name=None defers the link: call ``.set_input(layer)`` before the group
+    closes (reference memory(name=None).set_input pattern)."""
     assert _current_build is not None, "memory() must be called inside a recurrent_group step"
     conf = LayerConf(
-        name=auto_name(f"memory_{name}"),
+        name=auto_name(f"memory_{name or memory_name or 'deferred'}"),
         type="memory",
         size=size,
         bias=False,
@@ -99,7 +112,7 @@ def memory(
     _current_build.memories.append(conf)
     if boot_layer is not None:
         _current_build.boot_layers[conf.name] = boot_layer
-    return LayerOutput(conf)
+    return _MemoryOutput(conf)
 
 
 @register_layer("memory")
@@ -169,6 +182,12 @@ def recurrent_group(
     # Memory link targets must be part of the sub-topology even when not on
     # the path to the step output.
     sub_topo = Topology(list(step_outputs))
+    unset = [m.name for m in gb.memories if m.attrs["link"] is None]
+    if unset:
+        raise ValueError(
+            f"memories {unset} in recurrent_group {gname!r} have no link: "
+            "pass name= or call .set_input(layer) inside the step"
+        )
     # links may address auxiliary outputs like "<layer>@cell" (lstm_step)
     missing_links = [
         m

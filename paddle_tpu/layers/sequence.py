@@ -39,12 +39,54 @@ def _masked_pool(data, mask, counts, kind):
     return s / jnp.sqrt(n) if kind == "sqrt_n" else s / n
 
 
+def _stride_windows(data, lengths, stride):
+    """Chunk [B, T, ...] into ceil(T/stride) windows of `stride` steps:
+    returns (flat [B*W, stride, D], per-window valid counts [B*W], W,
+    out_lengths [B]) — the reference SequencePoolLayer stride path, which
+    emits a SHORTER sequence of per-window values."""
+    b, t = data.shape[:2]
+    w = -(-t // stride)
+    pad = w * stride - t
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)) + ((0, 0),) * (data.ndim - 2))
+    flat = data.reshape((b * w, stride) + data.shape[2:])
+    win_len = jnp.clip(
+        lengths[:, None] - jnp.arange(w, dtype=lengths.dtype)[None, :] * stride,
+        0,
+        stride,
+    )  # [B, W]
+    out_lengths = (lengths + stride - 1) // stride
+    return flat, win_len.reshape(b * w), w, out_lengths
+
+
 @register_layer("seqpool")
 def seqpool_apply(conf, params, inputs, ctx):
     x = inputs[0]
     assert x.is_seq, f"{conf.name}: seqpool input must be a sequence"
     kind = conf.attr("pool_type", "max")
     to_seq = conf.attr("agg_level", 0) == 1  # AggregateLevel.TO_SEQUENCE
+    stride = conf.attr("stride", -1)
+    assert stride <= 0 or not x.is_nested, (
+        f"{conf.name}: stride pooling is undefined for nested sequences"
+    )
+    if conf.attr("output_max_index", False):
+        # MaxPooling(output_max_index=True): per-feature argmax timestep
+        # (reference MaxPoolingLayer index output)
+        assert not x.is_nested and stride <= 0
+        masked = jnp.where(
+            x.mask(x.data.dtype)[..., None] > 0, x.data, -jnp.inf
+        )
+        return SeqTensor(jnp.argmax(masked, axis=1).astype(jnp.int32))
+    if stride > 0 and not x.is_nested:
+        assert not to_seq, f"{conf.name}: stride pooling is TO_NO_SEQUENCE only"
+        b = x.data.shape[0]
+        flat, counts, w, out_len = _stride_windows(x.data, x.lengths, stride)
+        mask = (
+            jnp.arange(stride, dtype=jnp.int32)[None, :] < counts[:, None]
+        ).astype(x.data.dtype)
+        pooled = _masked_pool(flat, mask, counts, kind).reshape(b, w, -1)
+        out = SeqTensor(pooled, out_len)
+        return out.with_data(out.masked_data())
     if x.is_nested:
         if to_seq:
             # pool each subsequence -> a plain sequence of pooled vectors
@@ -93,6 +135,19 @@ def seqlastins_apply(conf, params, inputs, ctx):
     assert x.is_seq
     first = conf.attr("select_first", False)
     to_seq = conf.attr("agg_level", 0) == 1
+    stride = conf.attr("stride", -1)
+    assert stride <= 0 or not x.is_nested, (
+        f"{conf.name}: stride selection is undefined for nested sequences"
+    )
+    if stride > 0:
+        assert not to_seq, f"{conf.name}: stride selection is TO_NO_SEQUENCE only"
+        b = x.data.shape[0]
+        flat, counts, w, out_len = _stride_windows(x.data, x.lengths, stride)
+        sel = _select_ins(
+            flat.reshape(flat.shape[0], stride, -1), jnp.maximum(counts, 1), first
+        ).reshape(b, w, -1)
+        out = SeqTensor(sel, out_len)
+        return out.with_data(out.masked_data())
     if x.is_nested:
         b, s, t = x.data.shape[:3]
         flat = _select_ins(
@@ -299,8 +354,9 @@ def recurrent_apply(conf, params, inputs, ctx):
 
 def gru_step_init(conf, in_confs, rng):
     h = conf.size
+    std = conf.attr("param_std")
     r1, r2 = jax.random.split(rng)
-    p = {"w_h": init.normal(r1, (h, 2 * h)), "w_c": init.normal(r2, (h, h))}
+    p = {"w_h": init.normal(r1, (h, 2 * h), std), "w_c": init.normal(r2, (h, h), std)}
     if conf.bias:
         p["b"] = init.zeros((3 * h,))
     return p
@@ -327,7 +383,9 @@ def gru_step_apply(conf, params, inputs, ctx):
 
 def lstm_step_init(conf, in_confs, rng):
     h = conf.size
-    p = {"w_h": init.normal(rng, (h, 4 * h))}
+    p = {}
+    if conf.attr("recurrent_weight", True):
+        p["w_h"] = init.normal(rng, (h, 4 * h))
     if conf.bias:
         p["b"] = init.zeros((4 * h,))
     return p
@@ -344,7 +402,7 @@ def lstm_step_apply(conf, params, inputs, ctx):
     f_gate = get_activation(conf.attr("gate_act", "sigmoid"))
     f_act = get_activation(conf.attr("active_type", "tanh"))
     f_state = get_activation(conf.attr("state_act", "tanh"))
-    a = x + h_p @ params["w_h"]
+    a = x + h_p @ params["w_h"] if "w_h" in params else x
     if "b" in params:
         a = a + params["b"]
     a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)

@@ -11,6 +11,11 @@ class BasePoolingType:
 class Max(BasePoolingType):
     name = "max"
 
+    def __init__(self, output_max_index: bool = False):
+        # reference MaxPooling(output_max_index=True): the sequence pool
+        # emits per-feature argmax timestep indices instead of values
+        self.output_max_index = output_max_index
+
 
 class Avg(BasePoolingType):
     name = "average"

@@ -100,14 +100,18 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     mod_path = os.path.join(config_dir, ds.module + ".py")
     sys.path.insert(0, config_dir)  # provider's own sibling imports
     try:
-        if os.path.exists(mod_path):
-            uniq = f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}_{ds.module}"
-            spec = importlib.util.spec_from_file_location(uniq, mod_path)
-            mod = importlib.util.module_from_spec(spec)
-            sys.modules[uniq] = mod
-            spec.loader.exec_module(mod)
-        else:
-            mod = importlib.import_module(ds.module)
+        with _py2_shims():
+            if os.path.exists(mod_path):
+                uniq = f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}_{ds.module}"
+                spec = importlib.util.spec_from_file_location(uniq, mod_path)
+                mod = importlib.util.module_from_spec(spec)
+                # py2-era provider files (reference demos predate python 3)
+                mod.xrange = range
+                mod.unicode = str
+                sys.modules[uniq] = mod
+                spec.loader.exec_module(mod)
+            else:
+                mod = importlib.import_module(ds.module)
     except ImportError:
         return
     finally:
@@ -119,7 +123,8 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     if itypes is None and hasattr(obj, "resolve_input_types"):
         # hook-declared types (reference initializer pattern)
         try:
-            itypes, names = obj.resolve_input_types(**(ds.args or {}))
+            with _py2_shims():
+                itypes, names = obj.resolve_input_types(**(ds.args or {}))
         except Exception as e:
             hook_error = e
             itypes = None
@@ -157,15 +162,46 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     parsed.provider_input_types = resolved
 
 
-def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
-    """Execute a v1 trainer-config python file and return the build result
-    (reference config_parser.parse_config returns the proto; here the typed
+import contextlib
+
+
+@contextlib.contextmanager
+def _py2_shims():
+    """Module-level py2 attributes the reference-era configs/providers touch
+    (sys.maxint in init hooks, string.letters in tokenizers), installed only
+    for the duration of a config exec / provider import."""
+    import string
+
+    added = []
+    if not hasattr(sys, "maxint"):
+        sys.maxint = sys.maxsize
+        added.append((sys, "maxint"))
+    if not hasattr(string, "letters"):
+        string.letters = string.ascii_letters
+        added.append((string, "letters"))
+    try:
+        yield
+    finally:
+        for mod, attr in added:
+            delattr(mod, attr)
+
+
+def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
+    """Execute a v1 trainer-config python file — or CALL a config function
+    (the reference parse_config accepts both, config_parser.py:3669) — and
+    return the build result (reference returns the proto; here the typed
     Topology + settings)."""
     _install_import_shims()
     from paddle_tpu.core.topology import reset_auto_names
 
     reset_auto_names()
-    config_dir = os.path.dirname(os.path.abspath(config_file)) or "."
+    is_callable = callable(config)
+    config_file = None if is_callable else config
+    config_dir = (
+        os.getcwd()
+        if is_callable
+        else os.path.dirname(os.path.abspath(config_file)) or "."
+    )
     from paddle_tpu.core.topology import set_layer_sink
 
     state = _helpers._ParseState(_parse_config_args(config_arg_str))
@@ -176,31 +212,36 @@ def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
     )
     sys.path.insert(0, config_dir)
     try:
-        with open(config_file) as f:
-            src = f.read()
-        ns = {
-            "__file__": os.path.abspath(config_file),
-            "__name__": "__paddle_config__",
-            # py2-era configs: reference v1 configs predate python 3
-            "xrange": range,
-            "unicode": str,
-        }
-        exec(compile(src, config_file, "exec"), ns)
+        with _py2_shims():
+            if is_callable:
+                config()
+            else:
+                with open(config_file) as f:
+                    src = f.read()
+                ns = {
+                    "__file__": os.path.abspath(config_file),
+                    "__name__": "__paddle_config__",
+                    # py2-era configs: reference v1 configs predate python 3
+                    "xrange": range,
+                    "unicode": str,
+                }
+                exec(compile(src, config_file, "exec"), ns)
     finally:
         sys.path.pop(0)
         _helpers._state = prev_state
         set_layer_sink(prev_sink)
 
+    label = config_file or getattr(config, "__name__", "<callable config>")
     if state.pending_output_names:  # capital-O Outputs(name, ...) form
         missing = [n for n in state.pending_output_names if n not in state.all_layers]
         if missing:
             raise KeyError(
-                f"{config_file}: Outputs() names {missing} were never built"
+                f"{label}: Outputs() names {missing} were never built"
             )
         state.outputs.extend(
             state.all_layers[n] for n in state.pending_output_names
         )
-    assert state.outputs, f"{config_file}: config declared no outputs()"
+    assert state.outputs, f"{label}: config declared no outputs()"
     topo = Topology(list(state.outputs))
     parsed = ParsedConfig(
         topology=topo,

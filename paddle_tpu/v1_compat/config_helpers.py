@@ -20,10 +20,15 @@ from paddle_tpu.layers import LayerOutput, data as _data_fn
 from paddle_tpu.layers.networks import (  # noqa: F401
     bidirectional_gru,
     bidirectional_lstm,
+    gru_group,
+    gru_unit,
     img_conv_group,
+    lstmemory_group,
+    lstmemory_unit,
     sequence_conv_pool,
     simple_attention,
     simple_gru,
+    simple_gru2,
     simple_img_conv_pool,
     simple_lstm,
     small_vgg,
@@ -189,6 +194,8 @@ class DataSources:
     obj: Optional[str] = None
     test_obj: Optional[str] = None
     args: Optional[dict] = None
+    # split datasource: a different provider module for the test stream
+    test_module: Optional[str] = None
 
 
 class _ParseState:
@@ -280,9 +287,15 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
         obj, test_obj = obj
     else:
         test_obj = obj
+    if isinstance(module, (list, tuple)):
+        # split datasource: [train_module, test_module] (reference
+        # data_sources.py define_py_data_sources list form)
+        module, test_module = module
+    else:
+        test_module = module
     st.data_sources = DataSources(
         train_list=train_list, test_list=test_list, module=module,
-        obj=obj, test_obj=test_obj, args=args,
+        obj=obj, test_obj=test_obj, args=args, test_module=test_module,
     )
 
 

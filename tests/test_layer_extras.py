@@ -275,3 +275,97 @@ def test_img_cmrnorm_matches_reference_formula():
     x_l = layers.data("x", paddle.data_type.dense_vector(c * hw * hw),
                       height=hw, width=hw)
     check_layer_grad(layers.img_cmrnorm(x_l, size=3), batch_size=2)
+
+
+def test_crop_offsets_align_to_axis():
+    """crop_layer offsets align to the cropped axes starting at `axis`
+    (reference crop_layer: axis=2, offset=[h, w])."""
+    import jax
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu import layers as L
+    import paddle_tpu as paddle
+
+    reset_auto_names()
+    d = L.data("img", paddle.data_type.dense_vector(4 * 5), height=4, width=5)
+    c = L.crop_layer(input=d, axis=2, offset=[1, 2], shape=[2, 2])
+    assert c.size == 2 * 2
+    net = CompiledNetwork(Topology([c]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = np.arange(20, dtype=np.float32).reshape(1, 20)
+    outs, _ = net.apply(params, {"img": SeqTensor(x)}, state=state, train=False)
+    img = x.reshape(4, 5)
+    expect = img[1:3, 2:4].reshape(-1)
+    np.testing.assert_allclose(
+        np.asarray(outs[c.name].data).reshape(-1), expect
+    )
+
+
+def test_error_clipping_threshold_clips_gradient():
+    """ExtraAttr(error_clipping_threshold=t) clips the cotangent flowing
+    into the layer output (reference Layer.cpp backwardActivation)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.attr import ExtraAttr
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu import layers as L
+    from paddle_tpu import activation as A
+    import paddle_tpu as paddle
+
+    reset_auto_names()
+    t = 0.01
+    d = L.data("x", paddle.data_type.dense_vector(3))
+    h = L.fc(
+        d, size=3, act=A.Identity(), bias_attr=False,
+        layer_attr=ExtraAttr(error_clipping_threshold=t),
+    )
+    # cost = 100 * sum(h): dcost/dh = 100 per element -> clipped to t
+    scaled = L.slope_intercept(h, slope=100.0)
+    cost = L.sum_cost(scaled)
+    net = CompiledNetwork(Topology([cost]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {"x": SeqTensor(np.ones((1, 3), np.float32))}
+
+    def loss(p):
+        outs, _ = net.apply(p, batch, state=state, train=True)
+        return jnp.sum(outs[cost.name].data)
+
+    g = jax.grad(loss)(params)[h.name]["w0"]
+    # dL/dW = x^T @ clip(100, t) -> every entry == t
+    np.testing.assert_allclose(np.asarray(g), t, rtol=1e-5)
+    # train=False leaves gradients untouched
+    def loss_eval(p):
+        outs, _ = net.apply(p, batch, state=state, train=False)
+        return jnp.sum(outs[cost.name].data)
+
+    g2 = jax.grad(loss_eval)(params)[h.name]["w0"]
+    np.testing.assert_allclose(np.asarray(g2), 100.0, rtol=1e-5)
+
+
+def test_stride_pooling_rejects_nested():
+    import jax
+    import pytest
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu import layers as L
+    from paddle_tpu import pooling as P
+    import paddle_tpu as paddle
+
+    reset_auto_names()
+    d = L.data(
+        "seq", paddle.data_type.dense_vector_sub_sequence(2)
+    )
+    pooled = L.pooling(d, P.Sum(), stride=2)
+    net = CompiledNetwork(Topology([pooled]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    nested = SeqTensor(
+        np.zeros((1, 2, 3, 2), np.float32),
+        np.asarray([2], np.int32),
+        np.asarray([[3, 2]], np.int32),
+    )
+    with pytest.raises(AssertionError, match="nested"):
+        net.apply(params, {"seq": nested}, state=state, train=False)

@@ -1,0 +1,64 @@
+"""Image preprocessing utility (reference python/paddle/utils/
+preprocess_img.py): dir tree -> batch files + lists + meta -> reader."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.utils import preprocess_img as pp
+
+
+@pytest.fixture()
+def image_tree(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for split, n in (("train", 6), ("test", 2)):
+        for label in ("cat", "dog"):
+            d = tmp_path / split / label
+            d.mkdir(parents=True)
+            for i in range(n):
+                arr = rng.randint(0, 255, size=(12, 10, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(d / f"im{i}.png")
+    return tmp_path
+
+
+def test_create_batches_and_reader(image_tree):
+    creater = pp.ImageClassificationDatasetCreater(
+        str(image_tree), target_size=8, num_per_batch=5
+    )
+    meta = creater.create_batches()
+    assert meta["label_names"] == ["cat", "dog"]
+    assert meta["img_size"] == 8 * 8 * 3
+    assert meta["mean_image"].shape == (8 * 8 * 3,)
+
+    meta2 = pp.load_meta(str(image_tree))
+    assert meta2["label_names"] == meta["label_names"]
+
+    # 12 train images, 5 per batch -> 3 batch files
+    with open(image_tree / "train.list") as f:
+        assert len(f.read().split()) == 3
+
+    reader = pp.batch_reader(str(image_tree / "train.list"), meta)
+    rows = list(reader())
+    assert len(rows) == 12
+    xs = np.stack([r[0] for r in rows])
+    labels = sorted(r[1] for r in rows)
+    assert xs.shape == (12, 8 * 8 * 3)
+    assert labels == [0] * 6 + [1] * 6
+    # mean-subtracted training set has ~zero mean
+    np.testing.assert_allclose(xs.mean(axis=0), 0.0, atol=1e-3)
+
+
+def test_disk_image_npy_and_png_agree(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(1)
+    arr = rng.randint(0, 255, size=(8, 8, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(tmp_path / "a.png")
+    np.save(tmp_path / "a.npy", arr)
+    png = pp.DiskImage(str(tmp_path / "a.png"), 8).convert_to_paddle_format()
+    npy = pp.DiskImage(str(tmp_path / "a.npy"), 8).convert_to_paddle_format()
+    np.testing.assert_allclose(png, npy)
+    assert png.shape == (8 * 8 * 3,)
