@@ -114,6 +114,14 @@ class CompiledNetwork:
             pmap = conf.attr("param_names") or {}
             pname = conf.attr("param_name")
             if pname and not pmap:
+                if pname in key_owners:
+                    ol, ok = key_owners[pname]
+                    raise ValueError(
+                        f"parameter name {pname!r} is declared whole-layer by "
+                        f"{name!r} but per-key by {ol!r}.{ok!r}; sharing across "
+                        "the two layer kinds is not supported — use distinct "
+                        "names"
+                    )
                 if pname in owners:
                     self._param_owner[name] = owners[pname]
                 else:
@@ -121,6 +129,13 @@ class CompiledNetwork:
             for key, gname in pmap.items():
                 if not gname:
                     continue
+                if gname in owners:
+                    raise ValueError(
+                        f"parameter name {gname!r} is declared per-key by "
+                        f"{name!r}.{key!r} but whole-layer by "
+                        f"{owners[gname]!r}; sharing across the two layer "
+                        "kinds is not supported — use distinct names"
+                    )
                 if gname in key_owners:
                     self._shared_keys.setdefault(name, {})[key] = key_owners[gname]
                 else:

@@ -405,8 +405,8 @@ def img_conv(
                 f"transpose conv groups={groups} must divide both in_c "
                 f"({in_c}) and num_filters ({num_filters})"
             )
-        out_h = (in_h - 1) * sh + fh - 2 * ph
-        out_w = (in_w - 1) * sw + fw - 2 * pw
+        out_h = _conv.convt_output_size(in_h, fh, ph, sh)
+        out_w = _conv.convt_output_size(in_w, fw, pw, sw)
     else:
         out_h = cnn_output_size(in_h, fh, ph, sh, caffe_mode)
         out_w = cnn_output_size(in_w, fw, pw, sw, caffe_mode)
@@ -1962,8 +1962,8 @@ def conv_operator(
     ph = padding_y if padding_y is not None else padding
     pw = padding
     if trans:
-        out_h = (in_h - 1) * sh + fh - 2 * ph
-        out_w = (in_w - 1) * sw + fw - 2 * pw
+        out_h = _conv.convt_output_size(in_h, fh, ph, sh)
+        out_w = _conv.convt_output_size(in_w, fw, pw, sw)
     else:
         out_h = cnn_output_size(in_h, fh, ph, sh)
         out_w = cnn_output_size(in_w, fw, pw, sw)

@@ -78,39 +78,40 @@ def conv_apply(conf, params, inputs, ctx):
     return SeqTensor(out, inputs[0].lengths)
 
 
+def convt_output_size(in_size: int, filter_size: int, padding: int, stride: int) -> int:
+    """Transposed-conv spatial output: (in-1)*s + k - 2p — the single
+    source for every convt size computation (DSL + operators)."""
+    return (in_size - 1) * stride + filter_size - 2 * padding
+
+
+def conv_transpose_nhwc(x, w, *, strides, fh, fw, ph, pw, groups: int = 1):
+    """Transposed conv as ONE lhs-dilated conv (the formulation XLA lowers
+    natively, no kernel flip for HWIO weights): pad k-1-p per side on the
+    stride-dilated input, VALID conv.  Shared by the convt layer and
+    conv_operator(trans=True)."""
+    return lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1, 1),
+        padding=[(fh - 1 - ph, fh - 1 - ph), (fw - 1 - pw, fw - 1 - pw)],
+        lhs_dilation=strides,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+    )
+
+
 @register_layer("convt", init=conv_init)
 def convt_apply(conf, params, inputs, ctx):
     a = conf.attrs
     x = to_nhwc(inputs[0].data, a["in_h"], a["in_w"], a["in_c"])
-    groups = a.get("groups", 1)
-    strides = (a.get("stride_h", 1), a.get("stride_w", 1))
-    # lax.conv_transpose explicit pads apply to the stride-dilated input
-    # before a VALID conv; the transpose of a forward conv with padding p
-    # and kernel k pads k-1-p per side (gives out = (in-1)*s + k - 2p,
-    # the size the DSL declares).
-    ph = a["filter_h"] - 1 - a.get("pad_h", 0)
-    pw = a["filter_w"] - 1 - a.get("pad_w", 0)
-    padding = [(ph, ph), (pw, pw)]
-    w = params["w"]
-    if groups == 1:
-        out = lax.conv_transpose(
-            x, w, strides=strides, padding=padding,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
-    else:
-        # Grouped transpose conv as ONE grouped dilated conv (conv_transpose
-        # itself lowers to conv_general_dilated with lhs_dilation and no
-        # kernel flip; feature_group_count gives XLA's native grouped
-        # kernel).  w is already per-group HWIO: (kh, kw, cin/g, cout).
-        out = lax.conv_general_dilated(
-            x,
-            w,
-            window_strides=(1, 1),
-            padding=padding,
-            lhs_dilation=strides,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=groups,
-        )
+    out = conv_transpose_nhwc(
+        x,
+        params["w"],
+        strides=(a.get("stride_h", 1), a.get("stride_w", 1)),
+        fh=a["filter_h"], fw=a["filter_w"],
+        ph=a.get("pad_h", 0), pw=a.get("pad_w", 0),
+        groups=a.get("groups", 1),
+    )
     if "b" in params:
         out = out + params["b"]
     return SeqTensor(out, inputs[0].lengths)

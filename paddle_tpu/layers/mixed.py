@@ -134,18 +134,11 @@ def conv_op_apply(conf, params, inputs, ctx):
 
     def one(x, w):
         if a.get("trans", False):
-            # transposed conv: lhs-dilate by the stride, pad k-1-p (same
-            # formulation as the convt layer — conv.py convt_apply)
-            return jax.lax.conv_general_dilated(
-                x[None],
-                w,
-                window_strides=(1, 1),
-                padding=[
-                    (a["filter_h"] - 1 - ph, a["filter_h"] - 1 - ph),
-                    (a["filter_w"] - 1 - pw, a["filter_w"] - 1 - pw),
-                ],
-                lhs_dilation=(sh, sw),
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            from paddle_tpu.layers.conv import conv_transpose_nhwc
+
+            return conv_transpose_nhwc(
+                x[None], w, strides=(sh, sw),
+                fh=a["filter_h"], fw=a["filter_w"], ph=ph, pw=pw,
             )[0]
         return jax.lax.conv_general_dilated(
             x[None],

@@ -59,6 +59,22 @@ class DiskImage:
             arr = np.load(self.path)
             if arr.ndim == 2:
                 arr = arr[:, :, None]
+            if arr.shape[0] != self.target_size or arr.shape[1] != self.target_size:
+                if arr.dtype != np.uint8:
+                    raise ValueError(
+                        f"{self.path}: non-uint8 .npy images must already be "
+                        f"{self.target_size}x{self.target_size}, got "
+                        f"{arr.shape[:2]}"
+                    )
+                from PIL import Image
+
+                img = resize_image(
+                    Image.fromarray(arr.squeeze(-1) if arr.shape[2] == 1 else arr),
+                    self.target_size,
+                )
+                arr = np.asarray(img)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
         else:
             from PIL import Image
 
@@ -68,7 +84,13 @@ class DiskImage:
             arr = np.asarray(img)
             if arr.ndim == 2:
                 arr = arr[:, :, None]
-        return _center_crop(arr, self.target_size)
+        out = _center_crop(arr, self.target_size)
+        if out.shape[0] != self.target_size or out.shape[1] != self.target_size:
+            raise ValueError(
+                f"{self.path}: image {arr.shape[:2]} smaller than "
+                f"target_size {self.target_size}"
+            )
+        return out
 
     def convert_to_paddle_format(self) -> np.ndarray:
         """HWC uint8 -> flattened CHW float32 (the v1 dense_vector layout)."""
@@ -105,22 +127,36 @@ class ImageClassificationDatasetCreater:
         self.output_path = os.path.join(data_path, "batches")
 
     # -- scanning -------------------------------------------------------
-    def _scan_split(self, split: str) -> Tuple[List[np.ndarray], List[int], List[str]]:
+    def _scan_split(
+        self, split: str, label_set: Optional[Sequence[str]] = None
+    ) -> Tuple[List[np.ndarray], List[int], List[str]]:
+        """label_set pins the label->id mapping (the TRAINING label set) so a
+        test split with missing/extra label dirs cannot silently remap ids."""
         root = os.path.join(self.data_path, split)
         labels = sorted(
             d for d in os.listdir(root)
             if os.path.isdir(os.path.join(root, d)) and not d.startswith(".")
         )
+        if label_set is None:
+            label_set = labels
+        else:
+            unknown = sorted(set(labels) - set(label_set))
+            if unknown:
+                raise ValueError(
+                    f"{split} split has labels {unknown} absent from the "
+                    f"training label set {list(label_set)}"
+                )
+        label_id = {lab: i for i, lab in enumerate(label_set)}
         imgs: List[np.ndarray] = []
         ids: List[int] = []
-        for li, lab in enumerate(labels):
+        for lab in labels:
             for f in list_images(os.path.join(root, lab)):
                 imgs.append(
                     DiskImage(f, self.target_size, self.color)
                     .convert_to_paddle_format()
                 )
-                ids.append(li)
-        return imgs, ids, labels
+                ids.append(label_id[lab])
+        return imgs, ids, list(label_set)
 
     def _write_batches(
         self, split: str, imgs: Sequence[np.ndarray], ids: Sequence[int]
@@ -156,7 +192,7 @@ class ImageClassificationDatasetCreater:
         self._write_batches("train", tr_imgs, tr_ids)
         te_dir = os.path.join(self.data_path, "test")
         if os.path.isdir(te_dir):
-            te_imgs, te_ids, _ = self._scan_split("test")
+            te_imgs, te_ids, _ = self._scan_split("test", label_set=labels)
             self._write_batches("test", te_imgs, te_ids)
         meta = {
             "label_names": labels,

@@ -1,0 +1,131 @@
+"""Network-compare tests (reference test strategy: gserver/tests/
+test_NetworkCompare.cpp + test_RecurrentLayer.cpp — two equivalent
+configurations must produce identical outputs).  Here: the recurrent_group
+compositions (gru_group / lstmemory_group) vs the fused single-scan layers
+(grumemory / lstmemory) with tied parameters, on variable-length batches."""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import activation as A
+from paddle_tpu.core.batch import SeqTensor, seq as mkseq
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+from paddle_tpu.layers import networks
+import paddle_tpu.layers as L
+
+H = 6
+B, T = 3, 5
+
+
+LENS = np.asarray([T, 3, 1], np.int32)
+
+
+def _var_len_batch(dim, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(B, T, dim).astype(np.float32)
+    for i, n in enumerate(LENS):
+        x[i, n:] = 0.0
+    return mkseq(x, LENS)
+
+
+def _assert_valid_close(a, b):
+    """Compare only the VALID timesteps — the two forms differ in what they
+    leave in padding (zeros vs carried state), which no downstream masked
+    layer ever reads."""
+    mask = (np.arange(T)[None, :] < LENS[:, None])[..., None]
+    np.testing.assert_allclose(
+        np.asarray(a) * mask, np.asarray(b) * mask, rtol=1e-5, atol=1e-6
+    )
+
+
+def _single_subparam(params, group_name):
+    """The one param-bearing inner layer of a group's sub-topology."""
+    sub = params[group_name]
+    assert len(sub) == 1, f"expected one inner param layer, got {list(sub)}"
+    return next(iter(sub.values()))
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_gru_group_matches_fused_grumemory(reverse):
+    reset_auto_names()
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+    fused = L.grumemory(din, size=H, reverse=reverse, name="fused")
+    group = networks.gru_group(din, size=H, reverse=reverse, name="group")
+    net = CompiledNetwork(Topology([fused, group]))
+    params, state = net.init(jax.random.PRNGKey(0))
+
+    # tie the group's step params (w_h [H,2H], w_c [H,H], b [3H]) to the
+    # fused layer's — identical layout by design
+    inner = _single_subparam(params, "group")
+    for k in ("w_h", "w_c", "b"):
+        inner[k] = params["fused"][k]
+
+    batch = {"x": _var_len_batch(3 * H)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    _assert_valid_close(outs["group"].data, outs["fused"].data)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_lstm_group_matches_fused_lstmemory_without_peepholes(reverse):
+    """lstmemory_group (mixed recurrence + weightless lstm_step) equals the
+    fused lstmemory when the fused peepholes are zeroed (the reference
+    lstm_step form has no peepholes — lstm_step_layer docs)."""
+    reset_auto_names()
+    din = L.data("x", paddle.data_type.dense_vector_sequence(4 * H))
+    fused = L.lstmemory(din, size=H, reverse=reverse, name="fused")
+    group = networks.lstmemory_group(din, size=H, reverse=reverse, name="group")
+    net = CompiledNetwork(Topology([fused, group]))
+    params, state = net.init(jax.random.PRNGKey(0))
+
+    for k in ("w_ci", "w_cf", "w_co"):
+        params["fused"][k] = np.zeros_like(params["fused"][k])
+    # group inner layers: the mixed input_recurrent (p1_w = W_h) and the
+    # lstm_step (b)
+    sub = params["group"]
+    mixed_name = [n for n in sub if "input_recurrent" in n][0]
+    step_name = [n for n in sub if n != mixed_name][0]
+    sub[mixed_name]["p1_w"] = params["fused"]["w_h"]
+    sub[step_name]["b"] = params["fused"]["b"]
+
+    batch = {"x": _var_len_batch(4 * H, seed=1)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    _assert_valid_close(outs["group"].data, outs["fused"].data)
+
+
+def test_simple_gru_matches_simple_gru2():
+    """simple_gru (recurrent_group form) and simple_gru2 (fused form) are
+    the same function of the same parameters (reference networks.py doc:
+    'gru_memory ... does same calculation with gru_group')."""
+    reset_auto_names()
+    din = L.data("x", paddle.data_type.dense_vector_sequence(4))
+    g1 = networks.simple_gru(din, size=H, name="a")
+    g2 = networks.simple_gru2(din, size=H, name="b")
+    net = CompiledNetwork(Topology([g1, g2]))
+    params, state = net.init(jax.random.PRNGKey(0))
+
+    params["b_transform"]["w0"] = params["a_transform"]["w0"]
+    inner = _single_subparam(params, "a")
+    for k in ("w_h", "w_c", "b"):
+        params["b"][k] = inner[k]
+
+    batch = {"x": _var_len_batch(4, seed=2)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    _assert_valid_close(outs["a"].data, outs["b"].data)
+
+
+def test_mixed_sharing_registries_cannot_cross():
+    """A parameter name used both whole-layer (embedding) and per-key
+    (fc/projection) must fail loudly at build, not silently diverge."""
+    reset_auto_names()
+    from paddle_tpu.attr import ParamAttr
+
+    shared = ParamAttr(name="tied")
+    ids = L.data("ids", paddle.data_type.integer_value_sequence(7))
+    emb = L.embedding(ids, size=4, param_attr=shared)
+    vec = L.data("v", paddle.data_type.dense_vector(7))
+    fcw = L.fc(vec, size=4, param_attr=shared, bias_attr=False)
+    with pytest.raises(ValueError, match="whole-layer"):
+        CompiledNetwork(Topology([emb, fcw]))

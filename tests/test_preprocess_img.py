@@ -62,3 +62,54 @@ def test_disk_image_npy_and_png_agree(tmp_path):
     npy = pp.DiskImage(str(tmp_path / "a.npy"), 8).convert_to_paddle_format()
     np.testing.assert_allclose(png, npy)
     assert png.shape == (8 * 8 * 3,)
+
+
+def test_test_split_labels_map_to_training_label_set(tmp_path):
+    """Test-split ids must follow the TRAINING label set even when the test
+    dir is missing a label."""
+    from PIL import Image
+
+    rng = np.random.RandomState(2)
+    for label in ("ant", "bee", "cow"):
+        d = tmp_path / "train" / label
+        d.mkdir(parents=True)
+        Image.fromarray(
+            rng.randint(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        ).save(d / "x.png")
+    # test split only has the LAST two labels
+    for label in ("bee", "cow"):
+        d = tmp_path / "test" / label
+        d.mkdir(parents=True)
+        Image.fromarray(
+            rng.randint(0, 255, size=(8, 8, 3), dtype=np.uint8)
+        ).save(d / "y.png")
+    creater = pp.ImageClassificationDatasetCreater(str(tmp_path), target_size=8)
+    meta = creater.create_batches()
+    rows = list(pp.batch_reader(str(tmp_path / "test.list"))())
+    assert sorted(r[1] for r in rows) == [1, 2]  # bee=1, cow=2 per training set
+    assert meta["label_names"] == ["ant", "bee", "cow"]
+
+
+def test_unknown_test_label_rejected(tmp_path):
+    from PIL import Image
+    import pytest
+
+    rng = np.random.RandomState(3)
+    for split, labels in (("train", ["a"]), ("test", ["a", "zz"])):
+        for label in labels:
+            d = tmp_path / split / label
+            d.mkdir(parents=True)
+            Image.fromarray(
+                rng.randint(0, 255, size=(8, 8, 3), dtype=np.uint8)
+            ).save(d / "x.png")
+    creater = pp.ImageClassificationDatasetCreater(str(tmp_path), target_size=8)
+    with pytest.raises(ValueError, match="zz"):
+        creater.create_batches()
+
+
+def test_small_npy_resized_like_png(tmp_path):
+    rng = np.random.RandomState(4)
+    arr = rng.randint(0, 255, size=(6, 6, 3), dtype=np.uint8)
+    np.save(tmp_path / "small.npy", arr)
+    vec = pp.DiskImage(str(tmp_path / "small.npy"), 8).convert_to_paddle_format()
+    assert vec.shape == (8 * 8 * 3,)

@@ -453,3 +453,24 @@ def test_repeat_and_gated_unit_and_weighted_cost():
     np.testing.assert_allclose(
         np.asarray(outs[cost.name].data), [[0.5 * unweighted]], rtol=1e-5
     )
+
+
+_GOLDEN_DSL = [
+    "projections", "test_cost_layers", "last_first_seq", "test_rnn_group",
+    "img_layers", "test_sequence_pooling", "shared_lstm", "test_ntm_layers",
+]
+
+
+@pytest.mark.parametrize("name", _GOLDEN_DSL)
+def test_reference_dsl_config_golden_serialize(name):
+    """Golden-snapshot testing of the DSL compiler (reference protostr
+    goldens, trainer_config_helpers/tests/configs/protostr): the built
+    Topology's deterministic serialize() must not drift.  Regenerate a
+    golden by deleting tests/goldens/dsl_<name>.topo and re-running."""
+    p = parse_config(os.path.join(DSL_CONFIGS_DIR, name + ".py"))
+    golden_path = os.path.join(HERE, "goldens", f"dsl_{name}.topo")
+    if not os.path.exists(golden_path):  # pragma: no cover - regen path
+        with open(golden_path, "w") as f:
+            f.write(p.serialize())
+    golden = open(golden_path).read()
+    assert p.serialize() == golden
