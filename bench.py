@@ -6,7 +6,10 @@ metric (the first line is the headline ResNet-50 number the driver parses):
   3. allreduce_bw_gbps                       — psum bandwidth over the mesh
   4. transformer_base_tokens_per_sec         — Transformer-base MT train step
   5. lstm_textcls_ms_per_batch               — 2xLSTM text cls (benchmark/paddle/rnn)
-  6. resnet50_pipeline_images_per_sec        — ResNet-50 through the real data plane
+  6. alexnet_ms_per_batch                    — reference alexnet.py config, unmodified
+  7. googlenet_ms_per_batch                  — reference googlenet.py config, unmodified
+  8. smallnet_ms_per_batch                   — reference smallnet_mnist_cifar.py config
+  9. resnet50_pipeline_images_per_sec        — ResNet-50 through the real data plane
 
 Methodology: every step consumes a different pre-staged device batch (cycled)
 and a fresh PRNG key, and timing syncs via a host fetch of the cost scalar —
@@ -414,6 +417,101 @@ def bench_lstm_textcls() -> dict:
     }
 
 
+def _bench_reference_image_config(
+    config_name: str, config_args: str, metric: str, ref_ms: float,
+    batch_size: int, img_pixels: int, num_class: int, iters: int = 20,
+) -> dict:
+    """Train the reference's OWN benchmark config file (benchmark/paddle/
+    image/*.py, parsed unmodified by v1_compat.parse_config) and report
+    ms/batch against the published K40m number (benchmark/README.md tables;
+    vs_baseline = reference_ms / our_ms)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.trainer.step import make_train_step
+    from paddle_tpu.v1_compat import make_optimizer, parse_config
+
+    p = parse_config(
+        f"/root/reference/benchmark/paddle/image/{config_name}.py", config_args
+    )
+    net = CompiledNetwork(p.topology, compute_dtype=jnp.bfloat16)
+    params, state = net.init(jax.random.PRNGKey(0))
+    opt = make_optimizer(p.settings)
+    opt_state = opt.init(params)
+    step = make_train_step(net, opt, mesh=None)
+
+    rng = np.random.RandomState(0)
+    # resolve slot names from the parsed topology — the configs disagree
+    # (alexnet/smallnet: 'data', googlenet: 'input'); the image slot is the
+    # one whose declared size matches the pixel count
+    data_layers = list(p.topology.data_layers().values())
+    data_name = next(c.name for c in data_layers if c.size == img_pixels)
+    label_name = next(c.name for c in data_layers if c.name != data_name)
+    batches = [
+        {
+            data_name: SeqTensor(
+                jax.device_put(
+                    rng.randn(batch_size, img_pixels).astype(np.float32)
+                )
+            ),
+            label_name: SeqTensor(
+                jax.device_put(
+                    rng.randint(0, num_class, size=batch_size).astype(np.int32)
+                )
+            ),
+        }
+        for _ in range(4)
+    ]
+    params, state, opt_state, m = step(
+        params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
+    _sync(m)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, state, opt_state, m = step(
+            params, state, opt_state, batches[i % len(batches)],
+            jax.random.PRNGKey(i),
+        )
+    _sync(m)
+    ms = (time.perf_counter() - t0) / iters * 1000.0
+    return {
+        "metric": metric,
+        "value": round(ms, 2),
+        "unit": "ms/batch",
+        "vs_baseline": round(ref_ms / ms, 4),
+    }
+
+
+def bench_alexnet() -> dict:
+    """Reference benchmark/paddle/image/alexnet.py unmodified; K40m bs=128:
+    334 ms/batch (benchmark/README.md:34-39)."""
+    return _bench_reference_image_config(
+        "alexnet", "batch_size=128", "alexnet_ms_per_batch", 334.0,
+        batch_size=128, img_pixels=227 * 227 * 3, num_class=1000,
+    )
+
+
+def bench_googlenet() -> dict:
+    """Reference benchmark/paddle/image/googlenet.py unmodified; K40m
+    bs=128: 1149 ms/batch (benchmark/README.md:44-51)."""
+    return _bench_reference_image_config(
+        "googlenet", "batch_size=128", "googlenet_ms_per_batch", 1149.0,
+        batch_size=128, img_pixels=224 * 224 * 3, num_class=1000,
+    )
+
+
+def bench_smallnet() -> dict:
+    """Reference benchmark/paddle/image/smallnet_mnist_cifar.py unmodified;
+    K40m bs=64: 10.46 ms/batch (benchmark/README.md:53-60)."""
+    return _bench_reference_image_config(
+        "smallnet_mnist_cifar", "batch_size=64", "smallnet_ms_per_batch",
+        10.46, batch_size=64, img_pixels=32 * 32 * 3, num_class=10, iters=40,
+    )
+
+
 def bench_allreduce() -> dict:
     """Gradient-allreduce bandwidth over the mesh data axis — the path that
     replaces the reference pserver push/pull (ParameterServer2 addGradient /
@@ -467,7 +565,8 @@ def bench_allreduce() -> dict:
 
 def main() -> None:
     for fn in (bench_resnet, bench_nmt, bench_allreduce, bench_transformer,
-               bench_lstm_textcls, bench_resnet_pipeline):
+               bench_lstm_textcls, bench_alexnet, bench_googlenet,
+               bench_smallnet, bench_resnet_pipeline):
         try:
             print(json.dumps(fn()), flush=True)
         except Exception as e:  # keep later metrics alive if one fails

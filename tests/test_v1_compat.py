@@ -474,3 +474,23 @@ def test_reference_dsl_config_golden_serialize(name):
             f.write(p.serialize())
     golden = open(golden_path).read()
     assert p.serialize() == golden
+
+
+@pytest.mark.parametrize(
+    "name,args,min_layers",
+    [
+        ("alexnet", "batch_size=128", 15),
+        ("googlenet", "batch_size=128", 80),
+        ("smallnet_mnist_cifar", "batch_size=64", 10),
+    ],
+)
+def test_reference_benchmark_configs_build(name, args, min_layers):
+    """The reference's own benchmark driver configs (benchmark/paddle/image)
+    parse and build unmodified — bench.py trains these for the ms/batch
+    comparison against benchmark/README.md's K40m tables."""
+    p = parse_config(f"/root/reference/benchmark/paddle/image/{name}.py", args)
+    assert len(p.topology.order) >= min_layers
+    assert p.settings.learning_method.kind == "momentum"
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    CompiledNetwork(p.topology)  # every layer type resolves
