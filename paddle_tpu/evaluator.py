@@ -425,13 +425,21 @@ def seq_text_printer_evaluator(
     input: LayerOutput,
     id_to_word=None,
     result_file: Optional[str] = None,
+    id_input: Optional[LayerOutput] = None,
+    dict_file: Optional[str] = None,
     name: Optional[str] = None,
 ) -> Evaluator:
     """Print id sequences as text (reference seqtext_printer_evaluator,
     trainer_config_helpers/evaluators.py: dict_file + result_file).
     `id_to_word` maps id→token (dict/list/callable); None prints raw ids.
+    `dict_file` loads that mapping one token per line (the reference's
+    surface); `id_input` (reference: separate id stream alongside the text
+    stream) is accepted — the ids printed are the input layer's.
     The print runs host-side via io_callback so it works under jit."""
     nm = name or auto_name("seq_text_printer")
+    if id_to_word is None and dict_file:
+        with open(dict_file) as f:
+            id_to_word = [ln.rstrip("\n").split("\t")[0] for ln in f]
 
     def to_text(ids, lengths):
         import numpy as np

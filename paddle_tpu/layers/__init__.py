@@ -355,12 +355,17 @@ def _img_attrs(input: LayerOutput, num_channels: Optional[int]):
     in_h = a.get("out_h") or a.get("in_h")
     in_w = a.get("out_w") or a.get("in_w")
     if in_h is None:
-        # flat input: assume square image, CHW order
+        # flat input, CHW order: width = floor(sqrt(pixels)), height =
+        # pixels // width (reference config_parser.get_img_size:1157 —
+        # square when possible, otherwise the 3x4-style factorization)
         assert in_c, f"num_channels required for flat input {input.name}"
         hw = input.size // in_c
-        side = int(math.isqrt(hw))
-        assert side * side == hw, f"cannot infer square image from size {input.size}"
-        in_h = in_w = side
+        in_w = int(math.isqrt(hw))
+        in_h = hw // in_w
+        assert in_h * in_w == hw, (
+            f"{input.name}: cannot factor {hw} pixels into height x width "
+            f"(got {in_h}x{in_w})"
+        )
     return int(in_c), int(in_h), int(in_w)
 
 
@@ -381,6 +386,7 @@ def img_conv(
     stride_y: Optional[int] = None,
     padding_y: Optional[int] = None,
     shared_biases: bool = True,  # v1 per-channel bias sharing: always true here
+    layer_type: Optional[str] = None,  # 'exconv'/'cudnn_conv' backend hint: XLA picks
     name: Optional[str] = None,
     layer_attr: Optional[ExtraAttr] = None,
 ) -> LayerOutput:
@@ -1642,12 +1648,15 @@ def nce(
     num_classes: Optional[int] = None,
     num_neg_samples: int = 10,
     noise_dist: Optional[Sequence[float]] = None,
+    neg_distribution: Optional[Sequence[float]] = None,  # reference name
     bias_attr: Union[bool, ParamAttr] = True,
     param_attr: Optional[ParamAttr] = None,
     weight: Optional[LayerOutput] = None,
     name: Optional[str] = None,
     layer_attr=None,
 ) -> LayerOutput:
+    if noise_dist is None:
+        noise_dist = neg_distribution
     feats = _as_list(input)
     c = num_classes or label.size
     conf = LayerConf(
@@ -1918,6 +1927,9 @@ def conv_projection(
     padding: int = 0,
     groups: int = 1,
     trans: bool = False,
+    filter_size_y: Optional[int] = None,
+    stride_y: Optional[int] = None,
+    padding_y: Optional[int] = None,
     param_attr: Optional[ParamAttr] = None,
     name: Optional[str] = None,
 ) -> LayerOutput:
@@ -1932,6 +1944,9 @@ def conv_projection(
         padding=padding,
         groups=groups,
         trans=trans,
+        filter_size_y=filter_size_y,
+        stride_y=stride_y,
+        padding_y=padding_y,
         act=_act_mod.Identity(),
         bias_attr=False,
         param_attr=param_attr,
@@ -2025,7 +2040,10 @@ def mixed(
         inferred = [
             parents[s["in"]].size for s in specs
             if s["kind"] in ("identity", "dotmul", "scaling")
-        ] + [s["size"] for s in specs if s.get("size")]
+        ] + [s["size"] for s in specs if s.get("size")] + [
+            sum(e - b for b, e in s["slices"])
+            for s in specs if s["kind"] == "slice"
+        ]
         assert inferred, "mixed() needs an explicit size"
         size = inferred[0]
     pnames = {

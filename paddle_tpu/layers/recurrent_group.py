@@ -33,11 +33,18 @@ from paddle_tpu.layers.base import ApplyContext, register_layer
 
 class StaticInput:
     """Marks an outer layer as visible-every-step instead of scanned
-    (reference StaticInput, trainer_config_helpers/layers.py)."""
+    (reference StaticInput, trainer_config_helpers/layers.py).  `size` is
+    accepted for config compatibility (the reference validates it against
+    input.size; here the topology already carries it)."""
 
-    def __init__(self, input: LayerOutput, is_seq: bool = False):
+    def __init__(self, input: LayerOutput, is_seq: bool = False,
+                 size: int = 0):
         self.input = input
         self.is_seq = is_seq
+        if size and size != input.size:
+            raise ValueError(
+                f"StaticInput size {size} != input layer size {input.size}"
+            )
 
 
 class SubsequenceInput:
@@ -154,6 +161,44 @@ def recurrent_group(
     gname = name or auto_name("recurrent_group")
 
     # ---- trace the step function into a sub-topology ------------------
+    step_args, scan_placeholders, static_placeholders = _make_placeholders(
+        gname, scanned, sub_scanned, statics
+    )
+
+    with _trace_capture() as (gb, created):
+        out = step(*step_args)
+    step_outputs: List[LayerOutput] = out if isinstance(out, (list, tuple)) else [out]
+    return _finalize_group(
+        gname, scanned, sub_scanned, statics, scan_placeholders,
+        static_placeholders, gb, created, step_outputs, reverse,
+    )
+
+
+@contextlib.contextmanager
+def _trace_capture():
+    """Group-trace context shared by the step-function face above and the
+    raw RecurrentLayerGroupBegin/End face: opens a _GroupBuild for memory
+    declarations and captures every LayerOutput built inside (chaining any
+    outer layer sink), restoring both on exit — including the error path."""
+    from paddle_tpu.core.topology import set_layer_sink
+
+    created: Dict[str, LayerOutput] = {}
+
+    def _capture(lo: LayerOutput) -> None:
+        created[lo.conf.name] = lo
+        if prev_sink is not None:
+            prev_sink(lo)
+
+    with _group_build() as gb:
+        prev_sink = set_layer_sink(_capture)
+        try:
+            yield gb, created
+        finally:
+            set_layer_sink(prev_sink)
+
+
+def _make_placeholders(gname, scanned, sub_scanned, statics):
+    """Scan/static step-input placeholder confs for a group being built."""
     step_args: List[LayerOutput] = []
     scan_placeholders: List[LayerConf] = []
     static_placeholders: List[LayerConf] = []
@@ -174,20 +219,37 @@ def recurrent_group(
         )
         static_placeholders.append(conf)
         step_args.append(LayerOutput(conf))
+    return step_args, scan_placeholders, static_placeholders
 
-    with _group_build() as gb:
-        out = step(*step_args)
-    step_outputs: List[LayerOutput] = out if isinstance(out, (list, tuple)) else [out]
 
-    # Memory link targets must be part of the sub-topology even when not on
-    # the path to the step output.
-    sub_topo = Topology(list(step_outputs))
+def _finalize_group(
+    gname, scanned, sub_scanned, statics, scan_placeholders,
+    static_placeholders, gb, created, step_outputs, reverse,
+) -> LayerOutput:
+    """Assemble the recurrent_group LayerConf from a traced step body —
+    shared by the step-function form above and the raw
+    RecurrentLayerGroupBegin/End config face (v1_compat.raw_face)."""
     unset = [m.name for m in gb.memories if m.attrs["link"] is None]
     if unset:
         raise ValueError(
             f"memories {unset} in recurrent_group {gname!r} have no link: "
             "pass name= or call .set_input(layer) inside the step"
         )
+    # Memory link targets must be part of the sub-topology even when not on
+    # the path to the step output (reference: a memory may link a layer
+    # built purely for the recurrence, e.g. last_seq over the inner rnn in
+    # sequence_nest_rnn.conf) — add those as extra sub-topology roots.
+    sub_topo = Topology(list(step_outputs))
+    link_bases = list(dict.fromkeys(  # order-preserving dedup: deterministic
+        m.attrs["link"].split("@")[0] for m in gb.memories
+    ))
+    extra_roots = [
+        created[base]
+        for base in link_bases
+        if base not in sub_topo.layers and base in created
+    ]
+    if extra_roots:
+        sub_topo = Topology(list(step_outputs) + extra_roots)
     # links may address auxiliary outputs like "<layer>@cell" (lstm_step)
     missing_links = [
         m

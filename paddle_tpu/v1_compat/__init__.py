@@ -71,6 +71,9 @@ class ParsedConfig:
     output_layers: List[str]
     evaluators: List = dataclasses.field(default_factory=list)
     provider_input_types: Optional[dict] = None  # name -> InputType (if resolved)
+    # old-face TrainData/TestData declarations (config_parser.py:1115)
+    train_data: Optional[object] = None
+    test_data: Optional[object] = None
 
     def serialize(self) -> str:
         return self.topology.serialize()
@@ -218,21 +221,45 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
             else:
                 with open(config_file) as f:
                     src = f.read()
+                # Pre-populate the namespace with the full helper surface —
+                # the reference execs configs inside config_parser's own
+                # namespace, so old-face .conf files use Layer/TrainData/
+                # Settings/default_* WITHOUT any import.
                 ns = {
+                    k: v
+                    for k, v in vars(_helpers).items()
+                    if not k.startswith("_")
+                }
+                ns.update({
                     "__file__": os.path.abspath(config_file),
                     "__name__": "__paddle_config__",
                     # py2-era configs: reference v1 configs predate python 3
                     "xrange": range,
                     "unicode": str,
-                }
+                })
                 exec(compile(src, config_file, "exec"), ns)
     finally:
         sys.path.pop(0)
         _helpers._state = prev_state
         set_layer_sink(prev_sink)
+        # a config that died inside RecurrentLayerGroupBegin/End must not
+        # leave the raw-group trace open for the next parse
+        from paddle_tpu.v1_compat.raw_face import reset_raw_state
+
+        reset_raw_state()
 
     label = config_file or getattr(config, "__name__", "<callable config>")
     if state.pending_output_names:  # capital-O Outputs(name, ...) form
+        # reference alias: the beam-search generator registers its predict
+        # layer as __beam_search_predict__ (config_parser) — map it to the
+        # beam_search layer built during the exec
+        if "__beam_search_predict__" in state.pending_output_names:
+            beams = [
+                lo for lo in state.all_layers.values()
+                if lo.conf.type == "beam_search"
+            ]
+            if len(beams) == 1:
+                state.all_layers["__beam_search_predict__"] = beams[0]
         missing = [n for n in state.pending_output_names if n not in state.all_layers]
         if missing:
             raise KeyError(
@@ -247,6 +274,8 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
         topology=topo,
         settings=state.settings,
         data_sources=state.data_sources,
+        train_data=state.train_data,
+        test_data=state.test_data,
         input_layers=(
             [l.name for l in state.inputs]
             if state.inputs

@@ -37,6 +37,23 @@ from paddle_tpu.layers.networks import (  # noqa: F401
 from paddle_tpu import evaluator as _ev
 from paddle_tpu import activation as _A
 from paddle_tpu import pooling as _P
+from paddle_tpu.v1_compat.raw_face import (  # noqa: F401
+    Bias,
+    ContextProjection,
+    DotMulProjection,
+    Evaluator,
+    FullMatrixProjection,
+    IdentityOffsetProjection,
+    IdentityProjection,
+    Input,
+    Layer,
+    Memory,
+    RecurrentLayerGroupBegin,
+    RecurrentLayerGroupEnd,
+    TableProjection,
+    TransposedFullMatrixProjection,
+    model_type,
+)
 from paddle_tpu.attr import ExtraAttr, ParamAttr
 from paddle_tpu.core import data_types as _dt
 
@@ -63,6 +80,8 @@ MaxPooling = _P.Max
 AvgPooling = _P.Avg
 SumPooling = _P.Sum
 SquareRootNPooling = _P.SquareRootN
+CudnnMaxPooling = _P.CudnnMax
+CudnnAvgPooling = _P.CudnnAvg
 
 # Attributes
 ParameterAttribute = ParamAttr
@@ -203,6 +222,8 @@ class _ParseState:
         self.config_args = config_args
         self.settings = TrainerSettings()
         self.data_sources: Optional[DataSources] = None
+        self.train_data: Optional[DataConfig] = None
+        self.test_data: Optional[DataConfig] = None
         self.inputs: List[LayerOutput] = []
         self.outputs: List[LayerOutput] = []
         self.evaluators: List[Any] = []
@@ -279,6 +300,60 @@ def Settings(batch_size=1, learning_rate=1e-3, algorithm="sgd", **kw):
             v = _METHOD_BY_NAME[v]()
         if hasattr(st.settings, k):
             setattr(st.settings, k, v)
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Old-face data declaration (reference config_parser.py SimpleData:986,
+    ProtoData, PyData): records the provider kind + its knobs; the TPU data
+    plane reads these as plain config, the reference's C++ providers are
+    replaced by the reader pipeline."""
+
+    kind: str = "simple"
+    files: Optional[str] = None
+    feat_dim: Optional[int] = None
+    context_len: int = 0
+    buffer_capacity: int = 0
+    type: Optional[str] = None
+    load_data_module: Optional[str] = None
+    load_data_object: Optional[str] = None
+    load_data_args: Optional[str] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def SimpleData(files=None, feat_dim=None, context_len=0, buffer_capacity=0,
+               **kw):
+    return DataConfig(
+        kind="simple", files=files, feat_dim=feat_dim,
+        context_len=context_len or 0, buffer_capacity=buffer_capacity,
+        extra=kw,
+    )
+
+
+def ProtoData(files=None, type=None, feat_dim=None, buffer_capacity=0, **kw):
+    return DataConfig(
+        kind="proto", files=files, type=type, feat_dim=feat_dim,
+        buffer_capacity=buffer_capacity, extra=kw,
+    )
+
+
+def PyData(files=None, type=None, load_data_module=None,
+           load_data_object=None, load_data_args=None, **kw):
+    return DataConfig(
+        kind="py", files=files, type=type,
+        load_data_module=load_data_module, load_data_object=load_data_object,
+        load_data_args=load_data_args, extra=kw,
+    )
+
+
+def TrainData(data_config, async_load_data=None):
+    """reference config_parser.py:1115 — declare the training data config."""
+    _require_state().train_data = data_config
+
+
+def TestData(data_config, async_load_data=None):
+    """reference config_parser.py:1127."""
+    _require_state().test_data = data_config
 
 
 def define_py_data_sources2(train_list, test_list, module, obj, args=None):

@@ -129,3 +129,127 @@ def test_mixed_sharing_registries_cannot_cross():
     fcw = L.fc(vec, size=4, param_attr=shared, bias_attr=False)
     with pytest.raises(ValueError, match="whole-layer"):
         CompiledNetwork(Topology([emb, fcw]))
+
+
+# ---------------------------------------------------------------------------
+# The reference's OWN NetworkCompare fixtures (gserver/tests/*.conf pairs,
+# driver: test_NetworkCompare.cpp) — two config files that must compute the
+# same function.  We parse both unmodified, tie parameters by signature,
+# and require numerically equal outputs.
+# ---------------------------------------------------------------------------
+
+GSERVER = "/root/reference/paddle/gserver/tests"
+
+
+def _param_dicts(tree):
+    """Innermost param dicts (those holding arrays) in deterministic
+    traversal order."""
+    out = []
+
+    def walk(d):
+        if not isinstance(d, dict):
+            return
+        if any(not isinstance(v, dict) for v in d.values()):
+            out.append(d)
+        for v in d.values():
+            walk(v)
+
+    walk(tree)
+    return out
+
+
+def _tie_by_signature(src_tree, dst_tree):
+    """Copy src param values into dst, pairing innermost param dicts by
+    their shape multiset in traversal order (key NAMES differ across
+    equivalent forms: fc 'w0' vs mixed 'p0_w')."""
+    src = _param_dicts(src_tree)
+    dst = _param_dicts(dst_tree)
+
+    def sig(d):
+        return tuple(sorted(np.shape(v) for v in d.values()))
+
+    def ordered_keys(d):
+        return [k for _, k in sorted((np.shape(d[k]), k) for k in d)]
+
+    unused = list(src)
+    for d in dst:
+        i = next(j for j, s in enumerate(unused) if sig(s) == sig(d))
+        s = unused.pop(i)
+        for dk, sk in zip(ordered_keys(d), ordered_keys(s)):
+            d[dk] = s[sk]
+
+
+def _build(conf_path, config_args=""):
+    import os
+
+    from paddle_tpu.v1_compat import parse_config
+
+    old = os.getcwd()
+    os.chdir("/root/reference/paddle")  # configs open data files relatively
+    try:
+        p = parse_config(conf_path, config_args)
+    finally:
+        os.chdir(old)
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    return p, net, params, state
+
+
+@pytest.mark.parametrize(
+    "pair",
+    ["concat_dotmul", "concat_fullmatrix", "concat_slice", "concat_table",
+     "img_pool"],
+)
+def test_reference_network_compare_pairs(pair):
+    reset_auto_names()
+    pa, neta, params_a, state_a = _build(f"{GSERVER}/{pair}_a.conf")
+    reset_auto_names()
+    pb, netb, params_b, state_b = _build(f"{GSERVER}/{pair}_b.conf")
+    _tie_by_signature(params_a, params_b)
+
+    rng = np.random.RandomState(0)
+    size = next(iter(pa.topology.data_layers().values())).size
+    name = next(iter(pa.topology.data_layers()))
+    if pair == "concat_table":
+        x = rng.randint(0, size, size=(4, 1)).astype(np.int32)
+    else:
+        x = rng.randn(4, size).astype(np.float32)
+    batch = {name: SeqTensor(x)}
+    outs_a, _ = neta.apply(params_a, batch, state=state_a, train=False)
+    outs_b, _ = netb.apply(params_b, batch, state=state_b, train=False)
+    for oa, ob in zip(pa.output_layers, pb.output_layers):
+        np.testing.assert_allclose(
+            np.asarray(outs_a[oa].data),
+            np.asarray(outs_b[ob].data),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+
+def test_reference_nested_rnn_equals_flat_rnn():
+    """sequence_nest_rnn.conf vs sequence_rnn.conf (reference
+    test_RecurrentGradientMachine): the hierarchical RNN whose inner memory
+    boots from the previous subsequence's last state computes exactly the
+    flat RNN over the concatenated tokens."""
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    reset_auto_names()
+    pn, netn, params_n, state_n = _build(f"{GSERVER}/sequence_nest_rnn.conf")
+    reset_auto_names()
+    pf, netf, params_f, state_f = _build(f"{GSERVER}/sequence_rnn.conf")
+    _tie_by_signature(params_f, params_n)
+
+    nested_rows = [
+        ([[1, 3, 2], [4, 5, 2]], 0),
+        ([[0, 2], [2, 5], [0, 1, 2]], 1),
+    ]
+    flat_rows = [
+        ([t for sub in row for t in sub], lab) for row, lab in nested_rows
+    ]
+    fn = DataFeeder(pn.topology.data_types())
+    ff = DataFeeder(pf.topology.data_types())
+    outs_n, _ = netn.apply(params_n, fn(nested_rows), state=state_n, train=False)
+    outs_f, _ = netf.apply(params_f, ff(flat_rows), state=state_f, train=False)
+    cost_n = np.asarray(outs_n[pn.output_layers[0]].data)
+    cost_f = np.asarray(outs_f[pf.output_layers[0]].data)
+    np.testing.assert_allclose(cost_n, cost_f, rtol=1e-5, atol=1e-6)

@@ -494,3 +494,157 @@ def test_reference_benchmark_configs_build(name, args, min_layers):
     from paddle_tpu.core.compiler import CompiledNetwork
 
     CompiledNetwork(p.topology)  # every layer type resolves
+
+
+# ---------------------------------------------------------------------------
+# reference C++ test fixtures: gserver/tests/*.conf + trainer/tests/*.conf
+# (raw config_parser face: Layer/Input/Memory/RecurrentLayerGroupBegin,
+# TrainData/ProtoData, model_type, Evaluator) — all parse and every layer
+# type resolves to a registered implementation.
+# ---------------------------------------------------------------------------
+
+_FIXTURE_DIRS = [
+    "/root/reference/paddle/gserver/tests",
+    "/root/reference/paddle/trainer/tests",
+]
+
+
+def _fixture_configs():
+    import glob
+
+    out = []
+    for d in _FIXTURE_DIRS:
+        out.extend(sorted(glob.glob(os.path.join(d, "*.conf"))))
+    return out
+
+
+def _parse_fixture(path, config_args=""):
+    old = os.getcwd()
+    os.chdir("/root/reference/paddle")  # fixtures open data files relatively
+    try:
+        return parse_config(path, config_args)
+    finally:
+        os.chdir(old)
+
+
+@pytest.mark.parametrize(
+    "cfg", _fixture_configs(), ids=lambda f: os.path.basename(f)[:-5]
+)
+def test_reference_cpp_fixture_config_builds(cfg):
+    from paddle_tpu.layers.base import get_layer_impl
+
+    p = _parse_fixture(cfg)
+    assert p.topology.order and p.output_layers
+    for n in p.topology.order:
+        get_layer_impl(p.topology.layers[n].type)
+
+
+def test_raw_face_chunking_crf_forward():
+    """chunking.conf (raw Layer/Input/Evaluator face incl. crf sharing the
+    'crfw' parameter with crf_decoding) builds AND runs a forward pass."""
+    import jax
+    from paddle_tpu.core.batch import SeqTensor, seq as mkseq
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    p = _parse_fixture("/root/reference/paddle/trainer/tests/chunking.conf")
+    assert p.train_data is not None and p.train_data.kind == "proto"
+    assert p.output_layers == ["crf"]
+    assert len(p.evaluators) == 1  # the raw Evaluator("error", "sum") decl
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    b, t = 2, 5
+    lens = np.asarray([5, 3], np.int32)
+    feats = rng.randn(b, t, 4339).astype(np.float32)
+    batch = {
+        "features": mkseq(feats, lens),
+        "word": mkseq(rng.randint(0, 478, size=(b, t)).astype(np.int32), lens),
+        "pos": mkseq(rng.randint(0, 45, size=(b, t)).astype(np.int32), lens),
+        "chunk": mkseq(rng.randint(0, 23, size=(b, t)).astype(np.int32), lens),
+    }
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    cost = np.asarray(outs["crf"].data)
+    assert cost.shape[0] == b and np.isfinite(cost).all()
+
+
+def test_raw_face_recurrent_group_forward():
+    """A raw RecurrentLayerGroupBegin/Memory/Layer(mixed)/End group computes
+    the same function as the DSL recurrent_group it lowers to."""
+    import jax
+    from paddle_tpu.core.batch import seq as mkseq
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.v1_compat import config_helpers as H
+
+    def configs():
+        H.Layer(name="in", type="data", size=6)
+        H.RecurrentLayerGroupBegin(
+            "g_layer_group", in_links=["in"], out_links=["g"]
+        )
+        mem = H.Memory(name="g", size=6)
+        H.Layer(
+            name="g", type="mixed", size=6, active_type="tanh", bias=False,
+            inputs=[
+                H.IdentityProjection("in"),
+                H.FullMatrixProjection(mem, parameter_name="rec_w"),
+            ],
+        )
+        H.RecurrentLayerGroupEnd("g_layer_group")
+        H.settings(batch_size=4, learning_rate=1e-3)
+        H.Outputs("g")
+
+    p = parse_config(configs)
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 6).astype(np.float32)
+    lens = np.asarray([4, 2], np.int32)
+    outs, _ = net.apply(params, {"in": mkseq(x, lens)}, state=state, train=False)
+    got = np.asarray(outs[p.output_layers[0]].data)
+    # hand-rolled recurrence: h_t = tanh(x_t + h_{t-1} W)
+    group_params = [v for v in params.values()][0]
+    w = np.asarray(next(iter(group_params.values()))["p1_w"])
+    h = np.zeros((2, 6), np.float32)
+    for t in range(4):
+        h = np.tanh(x[:, t] + h @ w)
+        mask = (t < lens).astype(np.float32)[:, None]
+        np.testing.assert_allclose(
+            got[:, t] * mask, h * mask, rtol=1e-5, atol=1e-6
+        )
+
+
+def test_malformed_raw_group_does_not_poison_next_parse():
+    """A config dying inside RecurrentLayerGroupBegin/End must leave no
+    stale raw-group or trace state behind (parse_config resets it)."""
+    from paddle_tpu.v1_compat import config_helpers as H
+
+    def bad():
+        H.Layer(name="in", type="data", size=4)
+        H.RecurrentLayerGroupBegin("g_layer_group", in_links=["in"],
+                                   out_links=["g"])
+        H.Layer(name="g", type="no_such_type", size=4)
+
+    with pytest.raises(KeyError):
+        parse_config(bad)
+
+    # the next parse is clean: a fresh group works, and memory() outside a
+    # group is rejected again
+    def good():
+        H.Layer(name="in", type="data", size=4)
+        H.RecurrentLayerGroupBegin("g2_layer_group", in_links=["in"],
+                                   out_links=["g2"])
+        mem = H.Memory(name="g2", size=4)
+        H.Layer(name="g2", type="mixed", size=4, active_type="tanh",
+                bias=False,
+                inputs=[H.IdentityProjection("in"),
+                        H.FullMatrixProjection(mem)])
+        H.RecurrentLayerGroupEnd("g2_layer_group")
+        H.settings(batch_size=4, learning_rate=1e-3)
+        H.Outputs("g2")
+
+    p = parse_config(good)
+    # Outputs("g2") resolves the out_link alias to the group layer itself
+    assert p.output_layers == ["g2_layer_group"]
+    from paddle_tpu.layers import memory as dsl_memory
+
+    with pytest.raises(AssertionError, match="inside a recurrent_group"):
+        dsl_memory(name="x", size=3)
