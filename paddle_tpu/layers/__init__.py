@@ -43,6 +43,7 @@ from paddle_tpu.layers.generation import (  # noqa: F401
 from paddle_tpu.layers import attention as _attention  # noqa: F401
 from paddle_tpu.layers import detection as _detection  # noqa: F401
 from paddle_tpu.layers import mdlstm as _mdlstm  # noqa: F401
+from paddle_tpu.layers import moe as _moe  # noqa: F401
 from paddle_tpu.layers import layer_math  # noqa: F401  (also patches LayerOutput operators)
 
 
@@ -883,6 +884,47 @@ def dotmul_operator(a: LayerOutput, b: LayerOutput, scale: float = 1.0, name=Non
         attrs={"scale": scale},
     )
     return LayerOutput(conf, [a, b])
+
+
+def moe(
+    input: LayerOutput,
+    expert_hidden: int,
+    num_experts: int,
+    size: Optional[int] = None,
+    capacity_factor: float = 1.25,
+    act=None,
+    bias_attr: Union[bool, ParamAttr] = True,
+    param_attr: Optional[ParamAttr] = None,
+    layer_attr: Optional[ExtraAttr] = None,
+    name: Optional[str] = None,
+) -> LayerOutput:
+    """Mixture-of-experts FFN with top-1 capacity routing (layers/moe.py).
+    ``layer_attr=ExtraAttr(shard_axis='model')`` shards the experts over the
+    mesh model axis — EXPERT PARALLELISM, with XLA inserting the dispatch/
+    combine all-to-all.  The router's load-balance term rides the aux output
+    ``<name>@aux_loss`` (pick it up via get_output + sum_cost)."""
+    drop, shard = _extra(layer_attr)
+    conf = LayerConf(
+        name=name or auto_name("moe"),
+        type="moe",
+        size=size or input.size,
+        inputs=(input.name,),
+        bias=bool(bias_attr),
+        drop_rate=drop,
+        shard_axis=shard,
+        attrs={
+            "num_experts": num_experts,
+            "expert_hidden": expert_hidden,
+            "capacity_factor": capacity_factor,
+            "active_type": act_name(act if act is not None else _act_mod.Relu()),
+            **_param_attrs(param_attr),
+        },
+    )
+    _set_error_clip(conf, layer_attr)
+    return LayerOutput(conf, [input])
+
+
+moe_layer = moe
 
 
 def gated_unit(

@@ -1,0 +1,130 @@
+"""Mixture-of-Experts layer with EXPERT PARALLELISM over the mesh model
+axis.
+
+The 2017 reference predates MoE; this is a first-class TPU-native addition
+(task spec: distributed modes incl. expert parallelism are first-class).
+Design follows the XLA-friendly capacity-based dispatch of Switch/GShard:
+top-1 routing, fixed expert capacity C, one-hot dispatch/combine einsums —
+all static shapes, so the whole layer jits into dense MXU work.
+
+Under a mesh whose ``model`` axis is >1, the expert-major tensors
+([E, C, D] dispatch buffers and the [E, ...] expert weights) carry
+``with_sharding_constraint(P('model', ...))``: XLA's SPMD partitioner
+places each expert group on its own devices and inserts the token
+all-to-all for dispatch/combine — the hand-written NCCL alltoall of
+GPU MoE frameworks becomes two sharding annotations.
+
+The router's load-balancing auxiliary (Switch Transformer eq. 4,
+``num_experts * Σ_e fraction_e * prob_e``) is exposed as the aux output
+``<name>@aux_loss`` for the cost to pick up.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import initializers as init
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.layers.base import ApplyContext, register_layer
+from paddle_tpu.parallel.mesh import MODEL_AXIS
+
+
+def moe_init(conf, in_confs, rng):
+    d = in_confs[0].size
+    e = conf.attr("num_experts")
+    h = conf.attr("expert_hidden")
+    std = conf.attr("param_std")
+    r = jax.random.split(rng, 3)
+    # explicit fan-in stds: the default heuristic reads shape[0], which for
+    # expert-major [E, D, H] tensors would be 1/sqrt(num_experts)
+    p = {
+        "router": init.normal(r[0], (d, e), std or init.default_std(d)),
+        "w1": init.normal(r[1], (e, d, h), std or init.default_std(d)),
+        "w2": init.normal(r[2], (e, h, conf.size), std or init.default_std(h)),
+    }
+    if conf.bias:
+        p["b1"] = init.zeros((e, h))
+        p["b2"] = init.zeros((e, conf.size))
+    return p
+
+
+def _expert_sharding(ctx: ApplyContext, conf):
+    """NamedSharding for expert-major [E, C, D] buffers when the layer opted
+    into model-axis sharding on a >1 model axis, else None."""
+    mesh = ctx.mesh
+    if (
+        mesh is None
+        or conf.shard_axis != MODEL_AXIS
+        or mesh.shape.get(MODEL_AXIS, 1) <= 1
+    ):
+        return None
+    return NamedSharding(mesh, P(MODEL_AXIS, None, None))
+
+
+@register_layer("moe", init=moe_init, auto_activation=False)
+def moe_apply(conf, params, inputs, ctx: ApplyContext):
+    from paddle_tpu.ops.activations import get_activation
+
+    x = inputs[0]
+    d = x.data.shape[-1]
+    e = conf.attr("num_experts")
+    f_act = get_activation(conf.attr("active_type", "relu"))
+    cap_factor = conf.attr("capacity_factor", 1.25)
+
+    tokens = x.data.reshape(-1, d)  # [N, D]
+    n = tokens.shape[0]
+    cap = max(int(n / e * cap_factor), 1)
+
+    logits = tokens @ params["router"].astype(tokens.dtype)
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [N, E]
+    valid = None
+    if x.is_nested:
+        valid = x.sub_mask(jnp.float32).reshape(-1)
+    elif x.is_seq:
+        valid = x.mask(jnp.float32).reshape(-1)
+    if valid is not None:
+        # padded tokens must not consume expert capacity
+        gates = gates * valid[:, None]
+    top_gate = jnp.max(gates, axis=-1)  # [N]
+    top_idx = jnp.argmax(gates, axis=-1)  # [N]
+    onehot = jax.nn.one_hot(top_idx, e, dtype=jnp.float32)
+    if valid is not None:
+        onehot = onehot * valid[:, None]
+
+    # position of each token within its expert's capacity (exclusive cumsum)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N, E]
+    keep = (pos < cap).astype(jnp.float32) * onehot
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+    dispatch = keep[..., None] * pos_oh  # [N, E, C]
+    combine = dispatch * top_gate[:, None, None]
+
+    sh = _expert_sharding(ctx, conf)
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(tokens.dtype), tokens)
+    if sh is not None:
+        xin = jax.lax.with_sharding_constraint(xin, sh)
+    h = jnp.einsum("ecd,edh->ech", xin, params["w1"])
+    if "b1" in params:
+        h = h + params["b1"][:, None, :]
+    h = f_act(h)
+    y = jnp.einsum("ech,ehd->ecd", h, params["w2"])
+    if "b2" in params:
+        y = y + params["b2"][:, None, :]
+    if sh is not None:
+        y = jax.lax.with_sharding_constraint(y, sh)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(y.dtype), y)  # [N, Dout]
+
+    # Switch load-balance aux: E * sum_e fraction_of_tokens_e * mean_prob_e
+    denom = jnp.maximum(jnp.sum(onehot), 1.0)
+    frac = jnp.sum(onehot, axis=0) / denom
+    prob = jnp.sum(gates, axis=0) / denom
+    aux = e * jnp.sum(frac * prob)
+    ctx.outputs[conf.name + "@aux_loss"] = SeqTensor(
+        jnp.broadcast_to(aux, (x.data.shape[0], 1))
+    )
+
+    if valid is not None:
+        out = out * valid[:, None].astype(out.dtype)
+    out = out.reshape(x.data.shape[:-1] + (conf.size,))
+    return SeqTensor(out, x.lengths, x.sub_lengths)

@@ -45,6 +45,10 @@ def _spec_for(conf, param_name: str, value) -> P:
             return P(None, MODEL_AXIS)  # column-parallel
         if param_name == "b" and ndim == 1:
             return P(MODEL_AXIS)
+    if conf.type == "moe" and param_name != "router":
+        # expert parallelism: every expert-major [E, ...] tensor splits its
+        # expert axis across the model axis (layers/moe.py)
+        return P(MODEL_AXIS, *([None] * (ndim - 1))) if ndim >= 1 else P()
     return P()
 
 

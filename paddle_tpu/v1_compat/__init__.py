@@ -241,12 +241,15 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
     finally:
         sys.path.pop(0)
         _helpers._state = prev_state
-        set_layer_sink(prev_sink)
         # a config that died inside RecurrentLayerGroupBegin/End must not
-        # leave the raw-group trace open for the next parse
+        # leave the raw-group trace open for the next parse.  Unwind it
+        # BEFORE restoring the sink: the trace context's own exit restores
+        # the sink that was active when the group opened (this parse's),
+        # which would clobber the restoration below if ordered after it.
         from paddle_tpu.v1_compat.raw_face import reset_raw_state
 
         reset_raw_state()
+        set_layer_sink(prev_sink)
 
     label = config_file or getattr(config, "__name__", "<callable config>")
     if state.pending_output_names:  # capital-O Outputs(name, ...) form
