@@ -24,18 +24,29 @@ L = paddle.layer
 A = paddle.activation
 
 
-def _ffn(x: LayerOutput, d_model: int, d_ff: int, name: str) -> LayerOutput:
+def _ffn(x: LayerOutput, d_model: int, d_ff: int, name: str,
+         moe_experts: int = 0) -> LayerOutput:
+    if moe_experts:
+        # sparse FFN: top-1-routed experts, sharded over the mesh model
+        # axis (expert parallelism — layers/moe.py)
+        return L.moe_layer(
+            x, expert_hidden=d_ff, num_experts=moe_experts, size=d_model,
+            layer_attr=paddle.attr.ExtraAttr(shard_axis="model"),
+            name=f"{name}_moe",
+        )
     h = L.fc(x, size=d_ff, act=A.Relu(), name=f"{name}_ff1")
     return L.fc(h, size=d_model, act=A.Identity(), name=f"{name}_ff2")
 
 
-def _encoder_layer(x, d_model, n_heads, d_ff, name, sp_axis=None):
+def _encoder_layer(x, d_model, n_heads, d_ff, name, sp_axis=None,
+                   moe_experts=0):
     att = L.multi_head_attention(
         L.layer_norm(x, name=f"{name}_ln1"), n_heads=n_heads,
         seq_parallel_axis=sp_axis, name=f"{name}_att"
     )
     x = L.addto([x, att], act=A.Identity(), bias_attr=False, name=f"{name}_res1")
-    ff = _ffn(L.layer_norm(x, name=f"{name}_ln2"), d_model, d_ff, name)
+    ff = _ffn(L.layer_norm(x, name=f"{name}_ln2"), d_model, d_ff, name,
+              moe_experts)
     return L.addto([x, ff], act=A.Identity(), bias_attr=False, name=f"{name}_res2")
 
 
@@ -67,10 +78,12 @@ def transformer_cost(
     n_layers: int = 6,
     d_ff: int = 2048,
     seq_parallel_axis=None,
+    moe_experts: int = 0,
 ) -> Tuple[LayerOutput, LayerOutput]:
     """Training topology.  Data slots: src_word ids, trg_word ids (bos-led
     decoder input), trg_next ids (shifted targets) — same slot convention as
-    models/seq2seq.py so the NMT readers interchange."""
+    models/seq2seq.py so the NMT readers interchange.  moe_experts>0 swaps
+    the encoder FFNs for expert-parallel MoE blocks."""
     src = L.data("src_word", paddle.data_type.integer_value_sequence(src_vocab))
     trg = L.data("trg_word", paddle.data_type.integer_value_sequence(trg_vocab))
     lbl = L.data("trg_next", paddle.data_type.integer_value_sequence(trg_vocab))
@@ -80,7 +93,8 @@ def transformer_cost(
         L.embedding(src, size=d_model, name="src_emb"), emb_scale=scale
     )
     for i in range(n_layers):
-        x = _encoder_layer(x, d_model, n_heads, d_ff, f"enc{i}", seq_parallel_axis)
+        x = _encoder_layer(x, d_model, n_heads, d_ff, f"enc{i}",
+                           seq_parallel_axis, moe_experts)
     enc = L.layer_norm(x, name="enc_ln")
 
     y = L.pos_encoding(
