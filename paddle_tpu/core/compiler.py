@@ -76,6 +76,62 @@ def _cast_floats(tree, dtype):
     return jax.tree_util.tree_map(cast, tree)
 
 
+def _walk_layers(topology, prefix=()):
+    """(path, conf) over a topology INCLUDING recurrent_group sub-topologies
+    (path = (top_layer, inner..., layer)) — the traversal behind the global
+    parameter table: named parameters share storage wherever they live, like
+    the reference's per-name Parameter map (config_parser.py Parameters /
+    gserver's global parameter table), including inside recurrent groups."""
+    for name in topology.order:
+        conf = topology.layers[name]
+        yield prefix + (name,), conf
+        sub = conf.attrs.get("_sub_topology")
+        if sub is not None:
+            yield from _walk_layers(sub, prefix + (name,))
+
+
+def _get_path(d, path):
+    for k in path:
+        d = d[k]
+    return d
+
+
+def _set_path(d, path, v):
+    """Set d[path] with copy-on-write of every intermediate dict (the caller
+    has already shallow-copied `d` itself), so grafting shared values never
+    mutates the canonical params tree."""
+    cur = d
+    for k in path[:-1]:
+        nxt = dict(cur.get(k, {}))
+        cur[k] = nxt
+        cur = nxt
+    cur[path[-1]] = v
+
+
+def _mixed_forms_error(key_owners, g, path, decl) -> ValueError:
+    """Mixed whole-layer/per-key declaration of one global parameter name."""
+    ol, ok, owhole = key_owners[g]
+    kind = "whole-layer inside a recurrent_group" if owhole else "per-key"
+    return ValueError(
+        f"parameter name {g!r} is declared {decl} by {'.'.join(path)!r} but "
+        f"{kind} by {ol!r}.{'.'.join(ok)!r}; sharing across the two forms "
+        "is not supported — use distinct names"
+    )
+
+
+def _del_path(d, path):
+    """Delete d[path], pruning dicts emptied by the deletion."""
+    stack = []
+    cur = d
+    for k in path[:-1]:
+        stack.append((cur, k))
+        cur = cur[k]
+    del cur[path[-1]]
+    for parent, k in reversed(stack):
+        if not parent[k]:
+            del parent[k]
+
+
 class CompiledNetwork:
     """init/apply view over a Topology."""
 
@@ -105,41 +161,92 @@ class CompiledNetwork:
         #                         (fc per-input weights, mixed projections,
         #                         named bias attrs) — including intra-layer
         #                         duplicates like fc param_attr=[p, p].
+        # _shared_keys: sharer top-level layer -> {relpath: (owner top-level
+        # layer, owner relpath)}.  relpath is a tuple of dict keys into the
+        # layer's param subtree — one element for a flat layer key, longer
+        # for parameters inside a recurrent_group's nested params (and a
+        # whole inner-layer dict for legacy one-parameter layers inside a
+        # group).  Sharing WITHIN one group's subtree is handled by that
+        # group's own sub-CompiledNetwork running this same scan.
         self._param_owner: Dict[str, str] = {}
-        self._shared_keys: Dict[str, Dict[str, tuple]] = {}
+        self._shared_keys: Dict[str, Dict[tuple, tuple]] = {}
         owners: Dict[str, str] = {}
         key_owners: Dict[str, tuple] = {}
-        for name in topology.order:
-            conf = topology.layers[name]
+        inner_seen: set = set()  # (global name, top layer) with an inner decl
+        for path, conf in _walk_layers(topology):
+            name, rel = path[0], tuple(path[1:])
             pmap = conf.attr("param_names") or {}
             pname = conf.attr("param_name")
             if pname and not pmap:
-                if pname in key_owners:
-                    ol, ok = key_owners[pname]
-                    raise ValueError(
-                        f"parameter name {pname!r} is declared whole-layer by "
-                        f"{name!r} but per-key by {ol!r}.{ok!r}; sharing across "
-                        "the two layer kinds is not supported — use distinct "
-                        "names"
-                    )
-                if pname in owners:
-                    self._param_owner[name] = owners[pname]
+                if not rel:
+                    if pname in key_owners:
+                        raise _mixed_forms_error(
+                            key_owners, pname, path, "whole-layer"
+                        )
+                    if pname in owners:
+                        self._param_owner[name] = owners[pname]
+                    else:
+                        owners[pname] = name
                 else:
-                    owners[pname] = name
+                    # legacy one-parameter layer inside a group: share its
+                    # whole inner dict at `rel`
+                    if pname in owners:
+                        raise ValueError(
+                            f"parameter name {pname!r} is declared whole-layer "
+                            f"both at top level ({owners[pname]!r}) and inside "
+                            f"a recurrent_group ({'.'.join(path)!r}); use "
+                            "distinct names"
+                        )
+                    if pname in key_owners and not key_owners[pname][2]:
+                        raise _mixed_forms_error(
+                            key_owners, pname, path,
+                            "whole-layer inside a recurrent_group",
+                        )
+                    owner = self._inner_key_owner(
+                        key_owners, inner_seen, pname, name, rel,
+                        inner=True, whole=True,
+                    )
+                    if owner is not None:
+                        self._shared_keys.setdefault(name, {})[rel] = owner
             for key, gname in pmap.items():
                 if not gname:
                     continue
                 if gname in owners:
                     raise ValueError(
                         f"parameter name {gname!r} is declared per-key by "
-                        f"{name!r}.{key!r} but whole-layer by "
+                        f"{'.'.join(path)!r}.{key!r} but whole-layer by "
                         f"{owners[gname]!r}; sharing across the two layer "
                         "kinds is not supported — use distinct names"
                     )
-                if gname in key_owners:
-                    self._shared_keys.setdefault(name, {})[key] = key_owners[gname]
-                else:
-                    key_owners[gname] = (name, key)
+                if gname in key_owners and key_owners[gname][2]:
+                    raise _mixed_forms_error(key_owners, gname, path, "per-key")
+                kp = rel + (key,)
+                owner = self._inner_key_owner(
+                    key_owners, inner_seen, gname, name, kp,
+                    inner=bool(rel), whole=False,
+                )
+                if owner is not None:
+                    self._shared_keys.setdefault(name, {})[kp] = owner
+
+    @staticmethod
+    def _inner_key_owner(key_owners, inner_seen, gname, top, relpath, inner,
+                         whole):
+        """First declarer of `gname` wins ownership; a later declarer gets
+        the owner's address back — except a second declaration INSIDE the
+        same top-level layer's subtree, where the group's own sub-network
+        scan already chains it to the subtree's first declarer (returning
+        None avoids double handling — and that first declarer is itself
+        grafted from the global owner, so the chain stays correct even when
+        the global owner lives outside the subtree)."""
+        if inner:
+            if (gname, top) in inner_seen:
+                return None  # sub-CompiledNetwork chains this to the first
+            inner_seen.add((gname, top))
+        if gname not in key_owners:
+            key_owners[gname] = (top, relpath, whole)
+            return None
+        otop, orel, _ = key_owners[gname]
+        return (otop, orel)
 
     # ------------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> Params:
@@ -164,15 +271,21 @@ class CompiledNetwork:
                         f"expects shapes {want} != owner's {have}"
                     )
                 continue
-            for key, (ol, ok) in self._shared_keys.get(name, {}).items():
-                owner_val = p[ok] if ol == name else params[ol][ok]
-                if jnp.shape(p[key]) != jnp.shape(owner_val):
+            for relpath, (ol, orel) in self._shared_keys.get(name, {}).items():
+                owner_val = (
+                    _get_path(p, orel) if ol == name
+                    else _get_path(params[ol], orel)
+                )
+                mine = _get_path(p, relpath)
+                want = jax.tree_util.tree_map(jnp.shape, mine)
+                have = jax.tree_util.tree_map(jnp.shape, owner_val)
+                if want != have:
                     raise ValueError(
-                        f"layer {name!r} parameter {key!r} shares storage "
-                        f"with {ol!r}.{ok!r} but expects shape "
-                        f"{jnp.shape(p[key])} != owner's {jnp.shape(owner_val)}"
+                        f"layer {name!r} parameter {'.'.join(relpath)!r} "
+                        f"shares storage with {ol!r}.{'.'.join(orel)!r} but "
+                        f"expects shapes {want} != owner's {have}"
                     )
-                del p[key]
+                _del_path(p, relpath)
             if p:
                 params[name] = p
         return params
@@ -208,18 +321,41 @@ class CompiledNetwork:
         )
 
     # ------------------------------------------------------------------
+    def layer_params(self, params: Params, name: str):
+        """This layer's effective param dict: owner lookup for whole-layer
+        sharing plus per-key grafts of shared storage (copy-on-write — the
+        canonical params tree is never mutated)."""
+        p = params.get(self._param_owner.get(name, name), {})
+        shared = self._shared_keys.get(name)
+        if shared:
+            p = dict(p)
+            for relpath, (ol, orel) in shared.items():
+                src = (
+                    _get_path(p, orel) if ol == name
+                    else _get_path(params[ol], orel)
+                )
+                _set_path(p, relpath, src)
+        return p
+
+    def materialize_shared(self, params: Params) -> Params:
+        """Params with every shared key grafted back in place, per top-level
+        layer.  For feeding a sub-network or pruned subgraph that was
+        compiled WITHOUT this network's sharing maps (e.g. generation-time
+        decoder stepping reads params['decoder'] directly)."""
+        out: Params = {}
+        for name in self.topology.order:
+            p = self.layer_params(params, name)
+            if p:
+                out[name] = p
+        return out
+
     def resolve_layer_call(self, name: str, params: Params, ins):
         """(layer params, inputs) as the apply loop would hand them to the
         impl: shared-parameter owner lookup + mixed-precision casts.  Used
         by apply() and by utils.debug.profile_layers so the profiler times
         exactly what training runs."""
         impl = self._impls[name]
-        p = params.get(self._param_owner.get(name, name), {})
-        shared = self._shared_keys.get(name)
-        if shared:
-            p = dict(p)
-            for key, (ol, ok) in shared.items():
-                p[key] = p[ok] if ol == name else params[ol][ok]
+        p = self.layer_params(params, name)
         if self.compute_dtype != jnp.dtype(jnp.float32):
             if impl.full_precision:
                 ins = [_cast_floats(x, jnp.float32) for x in ins]

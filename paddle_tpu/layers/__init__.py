@@ -11,6 +11,7 @@ arithmetic, implicit flatten) mirrors config_parser.py cnn_output_size.
 from __future__ import annotations
 
 import math
+import warnings as _warnings
 from typing import Optional, Sequence, Union
 
 from paddle_tpu import activation as _act_mod
@@ -195,6 +196,16 @@ def fc(
     name: Optional[str] = None,
 ) -> LayerOutput:
     ins = _as_list(input)
+    for i in ins:
+        if i.conf.attr("dynamic_size"):
+            _warnings.warn(
+                f"fc input {i.name!r} has a dynamic (runtime-batch-dependent) "
+                f"width — e.g. trans(height=None) — but weights are built for "
+                f"its static size {i.size}; this only runs when the batch "
+                "size equals that static size (the reference has the same "
+                "latent constraint, TransLayer config_parser.py:2129)",
+                stacklevel=2,
+            )
     drop, shard = _extra(layer_attr)
     if isinstance(param_attr, (list, tuple)):
         # per-input weight attrs (reference fc_layer param_attr list): each
@@ -736,6 +747,10 @@ crop_layer = crop
 
 
 def _unary(type_: str, input: LayerOutput, size=None, name=None, **attrs) -> LayerOutput:
+    if size is None and input.conf.attr("dynamic_size"):
+        # width-preserving op over a runtime-batch-wide input (e.g. stacked
+        # on trans(height=None)): the dynamic-width hazard propagates
+        attrs.setdefault("dynamic_size", True)
     conf = LayerConf(
         name=name or auto_name(type_),
         type=type_,
@@ -824,8 +839,15 @@ maxid_layer = maxid
 def trans(input, height: Optional[int] = None, name=None, layer_attr=None):
     """height=None: whole-minibatch transpose (reference trans_layer →
     TransLayer.cpp); height=H: per-sample [H, W] feature-block transpose
-    (the rotate/trans feature-map variant)."""
-    return _unary("trans", input, name=name, height=height)
+    (the rotate/trans feature-map variant).
+
+    For height=None the output feature width is the RUNTIME batch size; the
+    static conf size stays input.size for config parity with the reference
+    parser (TransLayer, config_parser.py:2122-2129 keeps input size), but
+    the conf is tagged dynamic_size so size-consuming consumers (fc) warn
+    that their static weight shape only matches batch == input.size."""
+    dyn = {"dynamic_size": True} if height is None else {}
+    return _unary("trans", input, name=name, height=height, **dyn)
 
 
 trans_layer = trans
@@ -1426,9 +1448,11 @@ def gru_step(
     param_attr: Optional[ParamAttr] = None,
     layer_attr=None,
     name: Optional[str] = None,
+    naive: bool = False,
 ) -> LayerOutput:
     """One GRU step (reference gru_step_layer): input pre-projected to 3H,
-    output_mem = previous state (usually a memory)."""
+    output_mem = previous state (usually a memory).  naive=True selects the
+    gru_step_naive_layer math (see gru_step_apply)."""
     size = size or output_mem.size
     assert input.size == 3 * size
     pnames = _step_param_names(param_attr, bias_attr, ("w_h", "w_c"))
@@ -1442,6 +1466,7 @@ def gru_step(
             "active_type": act_name(act if act is not None else _act_mod.Tanh()),
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
             "param_std": _param_std(param_attr),
+            **({"naive": True} if naive else {}),
             **({"param_names": pnames} if pnames else {}),
         },
     )

@@ -115,7 +115,12 @@ def moe_apply(conf, params, inputs, ctx: ApplyContext):
         y = jax.lax.with_sharding_constraint(y, sh)
     out = jnp.einsum("nec,ecd->nd", combine.astype(y.dtype), y)  # [N, Dout]
 
-    # Switch load-balance aux: E * sum_e fraction_of_tokens_e * mean_prob_e
+    # Switch load-balance aux: E * sum_e fraction_of_tokens_e * mean_prob_e.
+    # Emitted as a per-row [B, 1] tensor where EVERY row equals the scalar
+    # aux: the documented pickup (get_output + sum_cost) reduces per ROW
+    # (sum_cost sums axis=-1, cost.py) and CompiledNetwork.cost() then takes
+    # the batch MEAN — so the effective coefficient is already batch-size
+    # invariant (mean of B identical rows = aux).  Do not pre-divide by B.
     denom = jnp.maximum(jnp.sum(onehot), 1.0)
     frac = jnp.sum(onehot, axis=0) / denom
     prob = jnp.sum(gates, axis=0) / denom

@@ -209,30 +209,6 @@ def simple_lstm(
     )
 
 
-def _group_share_tag(param_attr, *bias_attrs) -> Optional[str]:
-    """Cross-group parameter sharing tag (shared_gru/shared_lstm configs):
-    non-None when the recurrent param AND every in-group bias are either
-    named or absent, so two groups built with the same names can share one
-    sub-param subtree (the reference shares individual parameters through
-    its global table; here the group layer's whole param dict is the unit
-    of sharing, which is exact when nothing inside is unnamed).  The tag
-    also names the in-group unit layers so the two subtrees are
-    structurally identical."""
-    from paddle_tpu.attr import ParamAttr
-
-    if param_attr is None or not param_attr.name:
-        return None
-    parts = [param_attr.name]
-    for b in bias_attrs:
-        if b is False:
-            parts.append("-")
-        elif isinstance(b, ParamAttr) and b.name:
-            parts.append(b.name)
-        else:
-            return None  # an unnamed default bias — sharing would overreach
-    return "rg:" + "|".join(parts)
-
-
 def gru_unit(
     input: LayerOutput,
     memory_boot: Optional[LayerOutput] = None,
@@ -259,6 +235,7 @@ def gru_unit(
         act=act,
         gate_act=gate_act,
         name=name,
+        naive=naive,
     )
 
 
@@ -273,25 +250,28 @@ def gru_group(
     act=None,
     gate_act=None,
     gru_layer_attr=None,
+    naive: bool = False,
 ) -> LayerOutput:
     """GRU as a recurrent_group of gru_step (reference gru_group,
     networks.py:902): same math as grumemory, composable step."""
     size = size or input.size // 3
     name = name or auto_name("gru_group")
-    tag = _group_share_tag(gru_param_attr, gru_bias_attr)
-    unit_name = f"{tag}_unit" if tag else f"{name}_unit"
 
+    # Cross-group sharing rides the per-key parameter table: the in-group
+    # gru_step declares its weight keys under gru_param_attr.name (and the
+    # bias under a named gru_bias_attr), so two groups naming the same
+    # params share exactly those keys — the reference's per-parameter
+    # global-table semantics (a named weight + default bias shares the
+    # weight only).
     def step(x):
         return gru_unit(
             input=x, memory_boot=memory_boot, size=size,
-            name=unit_name, gru_bias_attr=gru_bias_attr,
+            name=f"{name}_unit", gru_bias_attr=gru_bias_attr,
             gru_param_attr=gru_param_attr, act=act, gate_act=gate_act,
+            naive=naive,
         )
 
-    group = recurrent_group(step=step, input=input, reverse=reverse, name=name)
-    if tag:
-        group.conf.attrs["param_name"] = tag
-    return group
+    return recurrent_group(step=step, input=input, reverse=reverse, name=name)
 
 
 def lstmemory_unit(
@@ -366,15 +346,13 @@ def lstmemory_group(
     lstmemory_group, networks.py:744)."""
     size = size or input.size // 4
     name = name or auto_name("lstm_group")
-    tag = _group_share_tag(
-        param_attr, lstm_bias_attr,
-        input_proj_bias_attr if input_proj_bias_attr is not None else False,
-    )
-    unit_name = f"{tag}_unit" if tag else f"{name}_unit"
 
+    # Cross-group sharing rides the per-key parameter table (see gru_group):
+    # the inner mixed projection declares param_attr.name and the lstm_step
+    # a named lstm_bias_attr, so same-named groups share per parameter.
     def step(x):
         return lstmemory_unit(
-            input=x, out_memory=out_memory, name=unit_name, size=size,
+            input=x, out_memory=out_memory, name=f"{name}_unit", size=size,
             param_attr=param_attr, act=act, gate_act=gate_act,
             state_act=state_act,
             input_proj_bias_attr=input_proj_bias_attr,
@@ -382,10 +360,7 @@ def lstmemory_group(
             lstm_bias_attr=lstm_bias_attr, lstm_layer_attr=lstm_layer_attr,
         )
 
-    group = recurrent_group(step=step, input=input, reverse=reverse, name=name)
-    if tag:
-        group.conf.attrs["param_name"] = tag
-    return group
+    return recurrent_group(step=step, input=input, reverse=reverse, name=name)
 
 
 def simple_gru(
@@ -419,6 +394,7 @@ def simple_gru(
         proj, size=size, name=name, reverse=reverse,
         gru_bias_attr=gru_bias_attr, gru_param_attr=gru_param_attr,
         act=act, gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+        naive=naive,
     )
 
 

@@ -364,6 +364,11 @@ def gru_step_init(conf, in_confs, rng):
 
 @register_layer("gru_step", init=gru_step_init, auto_activation=False)
 def gru_step_apply(conf, params, inputs, ctx):
+    """Fused form follows GruStepLayer.cpp / hl_gpu_gru.cuh; naive=True is
+    the reference's gru_step_naive_layer (trainer_config_helpers/layers.py
+    gru_step_naive_layer): the reset gate multiplies the PREVIOUS STATE
+    before the candidate matmul ((h⊙r)·W vs r⊙(h·W)) and the update gate
+    mixes the other way around (h·(1-u) + c·u)."""
     from paddle_tpu.ops.activations import get_activation
 
     x, h_p = inputs[0].data, inputs[1].data  # [B, 3H], [B, H]
@@ -376,8 +381,12 @@ def gru_step_apply(conf, params, inputs, ctx):
     ur = h_p @ params["w_h"]
     u_t = f_gate(x_u + ur[:, :h])
     r_t = f_gate(x_r + ur[:, h:])
-    c_t = f_act(x_c + r_t * (h_p @ params["w_c"]))
-    h_t = u_t * h_p + (1.0 - u_t) * c_t
+    if conf.attr("naive", False):
+        c_t = f_act(x_c + (r_t * h_p) @ params["w_c"])
+        h_t = (1.0 - u_t) * h_p + u_t * c_t
+    else:
+        c_t = f_act(x_c + r_t * (h_p @ params["w_c"]))
+        h_t = u_t * h_p + (1.0 - u_t) * c_t
     return SeqTensor(h_t)
 
 

@@ -79,6 +79,7 @@ class Service:
         failure_max: int = 3,
         auto_rotate: bool = True,
         snapshot_min_interval_s: float = 1.0,
+        clock=time.time,
     ):
         """auto_rotate=True mirrors the reference: the moment a pass drains,
         done tasks recycle into todo and other trainers stream straight into
@@ -86,6 +87,7 @@ class Service:
         auto_rotate=False holds the pass boundary until start_new_pass() —
         the synchronized-pass mode a sync-SGD trainer wants."""
         self._lock = threading.RLock()
+        self._clock = clock  # injectable for deterministic lease tests
         self.chunks_per_task = chunks_per_task
         self.timeout_s = timeout_s
         self.failure_max = failure_max
@@ -143,7 +145,7 @@ class Service:
             if not self.todo:
                 return "wait" if self.pending else None
             task = self.todo.pop(0)
-            self.pending[task.task_id] = (task, time.time() + self.timeout_s)
+            self.pending[task.task_id] = (task, self._clock() + self.timeout_s)
             self._snapshot()
             return {
                 "task": task.to_json(),
@@ -176,7 +178,7 @@ class Service:
             ent = self.pending.get(task_id)
             if ent is None or ent[0].epoch != epoch:
                 return False
-            self.pending[task_id] = (ent[0], time.time() + self.timeout_s)
+            self.pending[task_id] = (ent[0], self._clock() + self.timeout_s)
             return True
 
     def task_finished(self, task_id: int, epoch: Optional[int] = None) -> bool:
@@ -227,7 +229,7 @@ class Service:
             self.todo.append(task)
 
     def _requeue_expired(self) -> None:
-        now = time.time()
+        now = self._clock()
         expired = [tid for tid, (_, dl) in self.pending.items() if dl < now]
         for tid in expired:
             task, _ = self.pending.pop(tid)
@@ -237,7 +239,7 @@ class Service:
     def request_save_model(self, trainer_id: str, block_secs: float) -> bool:
         """Exactly one trainer in each window gets True."""
         with self._lock:
-            now = time.time()
+            now = self._clock()
             if self._save_holder and self._save_holder[1] > now:
                 return self._save_holder[0] == trainer_id
             self._save_holder = (trainer_id, now + block_secs)

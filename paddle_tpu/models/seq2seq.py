@@ -206,17 +206,17 @@ class Seq2SeqGenerator:
         )
 
     # -- encoder forward up to the decoder's static inputs ---------------
-    def _encode(self, batch):
+    def _encode(self, batch, gp):
         outs, _ = self._enc_net.apply(
-            self.params.params, batch, state=self.params.state, train=False
+            gp, batch, state=self.params.state, train=False
         )
         return outs
 
-    def _step_fn(self, statics):
+    def _step_fn(self, statics, gp):
         """Build step_fn(ids, carry) for beam/greedy: embeds ids with the
         trained trg_emb table, runs the decoder sub-network once."""
-        emb_w = self.params.params["trg_emb"]["w"]
-        sub_params = self.params.params["decoder"]
+        emb_w = gp["trg_emb"]["w"]
+        sub_params = gp["decoder"]
 
         def step_fn(ids, carry):
             sub_batch = dict(statics)
@@ -232,7 +232,12 @@ class Seq2SeqGenerator:
         return step_fn
 
     def _prepare(self, batch):
-        outs = self._encode(batch)
+        # materialize once per batch: the pruned encoder net and the decoder
+        # sub-network were compiled without the full net's sharing maps, so
+        # shared keys (tied embeddings, ...) must be grafted back before
+        # either reads params by layer name
+        gp = self.net.materialize_shared(self.params.params)
+        outs = self._encode(batch, gp)
         statics = {}
         static_layers = ["enc", "enc_proj"]
         for (pname, is_seq), lname in zip(self._static_info, static_layers):
@@ -241,14 +246,14 @@ class Seq2SeqGenerator:
         boot = outs["dec_boot"].data
         carry = {m.name: boot for m in self._memories}
         b = boot.shape[0]
-        return statics, carry, b
+        return statics, carry, b, gp
 
     def generate(self, batch, beam_size: Optional[int] = None):
         """Beam-search decode; returns (sequences [B,K,T], scores [B,K])."""
         from paddle_tpu.ops.beam import beam_search
 
         k = beam_size or self.beam_size
-        statics, carry, b = self._prepare(batch)
+        statics, carry, b, gp = self._prepare(batch)
         # static tensors must be expanded to B*K rows inside beam_search —
         # it repeats carry but statics stay per-row: expand here.
         statics_k = {
@@ -259,7 +264,7 @@ class Seq2SeqGenerator:
             for n, t in statics.items()
         }
         return beam_search(
-            self._step_fn(statics_k),
+            self._step_fn(statics_k, gp),
             carry,
             batch_size=b,
             beam_size=k,
@@ -272,9 +277,9 @@ class Seq2SeqGenerator:
     def generate_greedy(self, batch):
         from paddle_tpu.ops.beam import greedy_search
 
-        statics, carry, b = self._prepare(batch)
+        statics, carry, b, gp = self._prepare(batch)
         return greedy_search(
-            self._step_fn(statics),
+            self._step_fn(statics, gp),
             carry,
             batch_size=b,
             bos_id=self.bos_id,

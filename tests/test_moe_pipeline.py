@@ -42,7 +42,12 @@ def test_moe_matches_dense_reference_when_capacity_ample():
     want = _dense_moe_reference(x, params[m.name])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
     aux = np.asarray(outs[m.name + "@aux_loss"].data)
-    assert aux.shape == (12, 1) and np.isfinite(aux).all() and aux.min() >= 1.0
+    # every row carries the scalar Switch aux (>= 1 by Cauchy-Schwarz when
+    # every token routes); sum_cost reduces per row and the trainer takes the
+    # batch mean, so this form is batch-size invariant as-is
+    assert aux.shape == (12, 1) and np.isfinite(aux).all()
+    assert aux.min() >= 1.0 - 1e-5
+    np.testing.assert_allclose(aux, aux[0, 0], rtol=1e-6)
 
 
 def test_moe_capacity_drops_tokens_and_masks_padding():

@@ -253,3 +253,127 @@ def test_reference_nested_rnn_equals_flat_rnn():
     cost_n = np.asarray(outs_n[pn.output_layers[0]].data)
     cost_f = np.asarray(outs_f[pf.output_layers[0]].data)
     np.testing.assert_allclose(cost_n, cost_f, rtol=1e-5, atol=1e-6)
+
+
+def test_gru_group_partial_sharing_named_weight_unnamed_bias():
+    """ADVICE r2 (medium): a named recurrent param + unnamed default bias
+    must share the WEIGHTS across groups (per-key, like the reference's
+    global parameter table) while each group keeps its own bias."""
+    reset_auto_names()
+    pa = paddle.attr.ParamAttr(name="shared_gru_w")
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+    g1 = networks.gru_group(din, size=H, name="g1", gru_param_attr=pa)
+    g2 = networks.gru_group(din, size=H, name="g2", gru_param_attr=pa)
+    net = CompiledNetwork(Topology([g1, g2]))
+    params, state = net.init(jax.random.PRNGKey(0))
+
+    # g1 owns the named weights; g2's subtree keeps ONLY its own bias
+    p1 = params["g1"]["g1_unit"]
+    p2 = params["g2"]["g2_unit"]
+    assert "w_h" in p1 and "w_c" in p1 and "b" in p1
+    assert "w_h" not in p2 and "w_c" not in p2 and "b" in p2
+
+    # with equal biases the two groups compute identically (same weights)
+    params["g2"]["g2_unit"]["b"] = params["g1"]["g1_unit"]["b"]
+    batch = {"x": _var_len_batch(3 * H)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    _assert_valid_close(outs["g1"].data, outs["g2"].data)
+
+    # ...and with different biases they diverge (biases are NOT shared)
+    params["g2"]["g2_unit"]["b"] = params["g1"]["g1_unit"]["b"] + 1.0
+    outs2, _ = net.apply(params, batch, state=state, train=False)
+    a = np.asarray(outs2["g1"].data)
+    b = np.asarray(outs2["g2"].data)
+    assert not np.allclose(a[:, :1], b[:, :1], rtol=1e-5, atol=1e-6)
+
+
+def test_inner_group_param_shares_with_outer_layer():
+    """Per-key sharing crosses the group boundary in both directions: an fc
+    OUTSIDE a group and the gru_step INSIDE one can't collide, but a named
+    bias ties an outer fc bias to the in-group step bias (global table)."""
+    reset_auto_names()
+    bname = paddle.attr.ParamAttr(name="tied_bias")
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+    outer = L.fc(
+        L.first_seq(din), size=3 * H, bias_attr=bname, act=A.Identity(),
+        name="outer_fc",
+    )
+    g = networks.gru_group(din, size=H, name="g", gru_bias_attr=bname)
+    net = CompiledNetwork(Topology([outer, g]))
+    params, _ = net.init(jax.random.PRNGKey(0))
+    # owner: outer_fc (earlier in order); the group's step bias is grafted
+    assert "b" in params["outer_fc"]
+    assert "b" not in params.get("g", {}).get("g_unit", {})
+
+
+def test_gru_naive_math_differs_and_matches_reference_formula():
+    """gru_step(naive=True) = the reference's gru_step_naive_layer: reset
+    applied to the previous state BEFORE the candidate matmul, and the
+    update gate mixing inverted (h*(1-u) + c*u)."""
+    reset_auto_names()
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+    fused = networks.gru_group(din, size=H, name="fused")
+    naive = networks.gru_group(din, size=H, name="naive", naive=True)
+    net = CompiledNetwork(Topology([fused, naive]))
+    params, state = net.init(jax.random.PRNGKey(2))
+    params["naive"]["naive_unit"] = jax.tree_util.tree_map(
+        lambda x: x, params["fused"]["fused_unit"]
+    )
+    batch = {"x": _var_len_batch(3 * H, seed=3)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+
+    # same params, different math
+    a = np.asarray(outs["fused"].data)
+    b = np.asarray(outs["naive"].data)
+    assert not np.allclose(a[:, :1], b[:, :1], rtol=1e-4)
+
+    # numpy transcription of the reference naive formulas
+    p = jax.tree_util.tree_map(np.asarray, params["naive"]["naive_unit"])
+    x = np.asarray(batch["x"].data)
+    h_prev = np.zeros((B, H), np.float32)
+    want = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        xt = x[:, t] + p["b"]
+        x_u, x_r, x_c = np.split(xt, 3, axis=-1)
+        ur = h_prev @ p["w_h"]
+        u = 1.0 / (1.0 + np.exp(-(x_u + ur[:, :H])))
+        r = 1.0 / (1.0 + np.exp(-(x_r + ur[:, H:])))
+        c = np.tanh(x_c + (r * h_prev) @ p["w_c"])
+        h_t = (1.0 - u) * h_prev + u * c
+        alive = (t < LENS)[:, None]
+        h_prev = np.where(alive, h_t, h_prev)
+        want[:, t] = h_prev
+    _assert_valid_close(outs["naive"].data, want)
+
+
+def test_two_inner_declarers_chain_to_outer_owner():
+    """Two in-group layers declaring the SAME global name while the owner is
+    an outer layer: the group's sub-network chains the second to the first,
+    the first grafts from the outer owner — no KeyError, one storage."""
+    from paddle_tpu.layers.recurrent_group import memory, recurrent_group
+
+    reset_auto_names()
+    bname = paddle.attr.ParamAttr(name="tri_bias")
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+    outer = L.fc(
+        L.first_seq(din), size=3 * H, bias_attr=bname, act=A.Identity(),
+        name="owner_fc",
+    )
+
+    def step(x):
+        m1 = memory(name="s1", size=H)
+        m2 = memory(name="s2", size=H)
+        s1 = L.gru_step(x, output_mem=m1, size=H, bias_attr=bname, name="s1")
+        s2 = L.gru_step(x, output_mem=m2, size=H, bias_attr=bname, name="s2")
+        return L.addto([s1, s2], act=A.Identity(), name="both")
+
+    g = recurrent_group(step=step, input=din, name="g")
+    net = CompiledNetwork(Topology([outer, g]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    assert "b" in params["owner_fc"]
+    assert "b" not in params.get("g", {}).get("s1", {})
+    assert "b" not in params.get("g", {}).get("s2", {})
+    outs, _ = net.apply(
+        params, {"x": _var_len_batch(3 * H)}, state=state, train=False
+    )
+    assert np.isfinite(np.asarray(outs["g"].data)).all()

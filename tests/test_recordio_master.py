@@ -108,6 +108,20 @@ def test_prefetcher(tmp_path):
 # master service
 # ---------------------------------------------------------------------------
 
+class _FakeClock:
+    """Deterministic clock injected into Service so lease-expiry tests don't
+    depend on wall time (suite load made 0.05s leases double-expire)."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
 def _make_service(tmp_path, n_files=2, n_records=200, **kw):
     for k in range(n_files):
         _write(str(tmp_path / f"d{k}.rio"), n_records, chunk=25, tag=f"{k}:")
@@ -138,10 +152,11 @@ def test_master_full_pass(tmp_path):
 
 
 def test_master_timeout_requeue(tmp_path):
-    svc = _make_service(tmp_path, timeout_s=0.05)
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, timeout_s=5.0, clock=clk)
     t1 = svc.get_task()
     assert t1 is not None
-    time.sleep(0.1)
+    clk.advance(10.0)  # lease expires; no real waiting
     # expired lease goes back to todo with epoch+1
     tasks = []
     while True:
@@ -377,14 +392,14 @@ def test_master_lease_renewal(tmp_path):
     into the failure/discard path."""
     p = str(tmp_path / "a.rio")
     _write(p, 4, chunk=4)
-    svc = master_mod.Service(timeout_s=0.2, chunks_per_task=1, auto_rotate=False)
+    svc = master_mod.Service(timeout_s=0.8, chunks_per_task=1, auto_rotate=False)
     client = master_mod.Client(svc)
     client.lease_renew_secs = 0.05
     client.set_dataset([p])
     got = []
     for _ in range(4):
         got.append(client.next_record())
-        time.sleep(0.1)  # total consumption time > timeout_s
+        time.sleep(0.25)  # total consumption time (1s) > timeout_s (0.8s)
     assert all(r is not None for r in got)
     assert client.next_record() is None
     assert not svc.pending and len(svc.done) == 1 and not svc.discarded
@@ -393,11 +408,14 @@ def test_master_stale_ack_rejected(tmp_path):
     """An expired holder must not ack a task re-served at a higher epoch."""
     p = str(tmp_path / "a.rio")
     _write(p, 8, chunk=4)
-    svc = master_mod.Service(timeout_s=0.05, chunks_per_task=1, auto_rotate=False)
+    clk = _FakeClock()
+    svc = master_mod.Service(
+        timeout_s=5.0, chunks_per_task=1, auto_rotate=False, clock=clk
+    )
     svc.set_dataset([p])
     t = svc.get_task()
     tid, ep = t["task"]["task_id"], t["epoch"]
-    time.sleep(0.1)  # lease expires
+    clk.advance(10.0)  # lease expires; clock then freezes — exactly one expiry
     # re-served at epoch+1 (possibly after draining the other task first)
     while True:
         t2 = svc.get_task()
@@ -443,10 +461,11 @@ def test_client_close_acks_drained_task(tmp_path):
 def test_task_failed_stale_epoch_keeps_lease(tmp_path):
     """A stale holder's failure report must not evict the current holder's
     pending entry (epoch guard checks BEFORE removal)."""
-    svc = _make_service(tmp_path, timeout_s=0.05)
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, timeout_s=5.0, clock=clk)
     t1 = svc.get_task()
     tid, epoch = t1["task"]["task_id"], t1["epoch"]
-    time.sleep(0.1)  # lease expires; task re-served at epoch+1
+    clk.advance(10.0)  # lease expires; clock then freezes — exactly one expiry
     t2 = None
     while True:
         t = svc.get_task()
