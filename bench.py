@@ -222,8 +222,25 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
     # cast+scale into the first conv's input read.
     decode = jax.jit(lambda u8: u8.astype(jnp.float32) * (1.0 / 255.0))
 
-    def batches():
-        """uint8 batches from the prefetcher, forever."""
+    # Isolated host->device bandwidth (device idle), best of 3 — the
+    # environment's transfer capability when nothing else runs.  The axon
+    # tunnel backend SERIALIZES transfers with compute (a put issued while
+    # the stream is busy completes only after the queued compute drains),
+    # so the honest per-environment ceiling for an interleaved pipeline is
+    # serial: batch transfer at isolated bw + one step, back to back.
+    probe = np.zeros(16 << 20, np.uint8)
+    jax.device_put(probe[: 1 << 20]).block_until_ready()  # warm the path
+    h2d_bytes_per_s = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_put(probe).block_until_ready()
+        h2d_bytes_per_s = max(
+            h2d_bytes_per_s, probe.nbytes / (time.perf_counter() - t0)
+        )
+    batch_bytes = batch_size * (img_size * img_size * 3 + 4)
+
+    def raw_batches():
+        """(uint8 pixels [B, HWC], int32 labels [B]) host batches, forever."""
         while True:
             pf = recordio.Prefetcher([path])
             try:
@@ -235,34 +252,63 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
                     imgs.append(np.frombuffer(rec[:-1], np.uint8))
                     labels.append(rec[-1])
                     if len(imgs) == batch_size:
-                        u8 = jax.device_put(np.stack(imgs))
-                        yield {
-                            "image": SeqTensor(decode(u8)),
-                            "label": SeqTensor(
-                                jax.device_put(np.asarray(labels, np.int32))
-                            ),
-                        }
+                        yield np.stack(imgs), np.asarray(labels, np.int32)
                         imgs, labels = [], []
             finally:
                 pf.close()
 
-    it = batches()
+    def stage(pair):
+        """Background-thread half of the feed: issue the H2D transfers so
+        they overlap the main thread's step dispatch/compute."""
+        u8, labels = pair
+        return {
+            "image": SeqTensor(decode(jax.device_put(u8))),
+            "label": SeqTensor(jax.device_put(labels)),
+        }
+
+    from paddle_tpu.reader.prefetch import DevicePrefetcher
+
+    it = DevicePrefetcher(raw_batches(), stage, depth=2)
     m = None
+    warm = next(it)
     for _ in range(4):  # warm compile + caches
         params, state, opt_state, m = step(
-            params, state, opt_state, next(it), jax.random.PRNGKey(0)
+            params, state, opt_state, warm, jax.random.PRNGKey(0)
         )
     _sync(m)
 
-    iters = 16
+    # pure step time on an already-staged batch (same run, same weather):
+    # isolates the compute term of the serial ceiling
+    t0 = time.perf_counter()
+    for i in range(8):
+        params, state, opt_state, m = step(
+            params, state, opt_state, warm, jax.random.PRNGKey(i)
+        )
+    _sync(m)
+    step_s = (time.perf_counter() - t0) / 8
+
+    iters = 24
+    it.wait_s = 0.0
     t0 = time.perf_counter()
     for i in range(iters):
-        # async dispatch: the host decodes batch i+1 while the device runs i
+        # double-buffered: the worker thread stages batch i+1 (decode +
+        # device_put) while the device runs step i
         params, state, opt_state, m = step(
             params, state, opt_state, next(it), jax.random.PRNGKey(i)
         )
     _sync(m)
     dt = time.perf_counter() - t0
+    feed_wait_s = it.wait_s
+    it.close()
+    # what the interleaved transfers actually sustained; only meaningful
+    # when transfers visibly serialize with compute (non-transfer time is a
+    # sizeable share of the wall) — on hardware that overlaps copies this
+    # residual is ~0 and the figure would be noise
+    xfer_s = dt - iters * step_s
+    interleaved_mb_s = (
+        iters * batch_bytes / xfer_s / 1e6 if xfer_s > 0.2 * dt else None
+    )
+    serial_ceiling_img_s = batch_size / (batch_bytes / h2d_bytes_per_s + step_s)
 
     img_per_sec = batch_size * iters / dt
     return {
@@ -270,11 +316,25 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / TARGET_IMG_S, 4),
+        "serial_ceiling_img_s": round(serial_ceiling_img_s, 1),
         "note": (
-            "host->device transfer bound in this environment (TPU reached "
-            "through the axon network tunnel, ~30 MB/s); tracks data-plane "
-            "regressions, not chip throughput — see "
-            "resnet50_train_images_per_sec_per_chip for the compute number"
+            "background double-buffered feeder (reader/prefetch.py): feed "
+            f"wait {feed_wait_s:.1f}s of {dt:.1f}s wall (host side fully "
+            "hidden)."
+            + (
+                "  Environment-bound: the axon tunnel backend serializes "
+                "H2D with compute — isolated transfer "
+                f"{h2d_bytes_per_s / 1e6:.0f} MB/s but only "
+                f"{interleaved_mb_s:.0f} MB/s once interleaved with steps "
+                f"({step_s * 1e3:.0f} ms/step pure), capping this metric at "
+                f"~{serial_ceiling_img_s:.0f} img/s even with zero overlap "
+                "loss; on hardware with normal async copy engines the same "
+                "code overlaps transfer with compute."
+                if interleaved_mb_s is not None
+                else "  Transfers fully overlapped compute this run."
+            )
+            + " See resnet50_train_images_per_sec_per_chip for chip "
+            "throughput"
         ),
     }
 

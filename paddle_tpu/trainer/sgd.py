@@ -182,14 +182,25 @@ class SGD:
         saving_period_by_batches: Optional[int] = None,
         start_pass: int = 0,
         show_parameter_stats_period: Optional[int] = None,
+        async_load_data: bool = True,
     ) -> None:
         """Pass loop with the reference trainer's checkpoint cadence: every
         `saving_period` passes (and optionally every `saving_period_by_batches`
         batches) write pass-%05d under save_dir; `start_pass` resumes numbering
         (reference: Trainer.cpp:454-488, flags saving_period /
-        saving_period_by_batches / start_pass)."""
+        saving_period_by_batches / start_pass).
+
+        async_load_data (reference TrainData(async_load_data=...) +
+        DataProvider.h's double-buffer queue): run the host-side feed —
+        converters, sharding, the device_put issue — on a background thread
+        so batch N+1's host→device transfer overlaps step N's compute.
+        JAX's async dispatch handles the device side; this hides the host
+        side.  The reader runs up to 3 batches ahead of the consuming step;
+        set False for inline single-thread feeding if the reader mutates
+        state the training loop observes (or isn't thread-compatible)."""
         if event_handler is None:
             event_handler = lambda e: None
+        from paddle_tpu.reader.prefetch import prefetch
         from paddle_tpu.utils import flags as _flags
 
         if show_parameter_stats_period is None:  # explicit 0 still disables
@@ -198,17 +209,24 @@ class SGD:
             )
         log_period = _flags.get_flag("log_period")
         feeder = self._make_feeder(feeding)
+
+        def _stage(data_batch):
+            with stat_timer("feed"):
+                return shard_batch(feeder(data_batch), self.mesh)
+
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state
         for pass_id in range(start_pass, start_pass + num_passes):
             event_handler(v2_event.BeginPass(pass_id))
             pass_costs: List[float] = []
             pass_accums: Dict[str, np.ndarray] = {}
-            for batch_id, data_batch in enumerate(reader()):
+            batches = (
+                prefetch(reader(), _stage)
+                if async_load_data
+                else map(_stage, reader())
+            )
+            for batch_id, batch in enumerate(batches):
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
-                with stat_timer("feed"):
-                    batch = feeder(data_batch)
-                    batch = shard_batch(batch, self.mesh)
                 with stat_timer("train_step"):
                     self._rng, step_rng = jax.random.split(self._rng)
                     params, state, opt_state, metrics = self._train_step(
@@ -266,14 +284,22 @@ class SGD:
         self._opt_state = opt_state
 
     # ------------------------------------------------------------------
-    def test(self, reader: Callable, feeding=None) -> v2_event.TestResult:
+    def test(
+        self, reader: Callable, feeding=None, async_load_data: bool = True
+    ) -> v2_event.TestResult:
+        from paddle_tpu.reader.prefetch import prefetch
+
         feeder = self._make_feeder(feeding)
         costs: List[float] = []
         sums: Dict[str, float] = {}
         accum_sums: Dict[str, np.ndarray] = {}
         n = 0
-        for data_batch in reader():
-            batch = shard_batch(feeder(data_batch), self.mesh)
+        stage = lambda b: shard_batch(feeder(b), self.mesh)
+        batches = (
+            prefetch(reader(), stage) if async_load_data
+            else map(stage, reader())
+        )
+        for batch in batches:
             metrics = self._eval_step(
                 self.parameters.params, self.parameters.state, batch
             )
