@@ -578,25 +578,32 @@ def _bench_reference_image_config(
     step = make_train_step(net, opt, mesh=None)
 
     rng = np.random.RandomState(0)
-    # resolve slot names from the parsed topology — the configs disagree
-    # (alexnet/smallnet: 'data', googlenet: 'input'); the image slot is the
-    # one whose declared size matches the pixel count
-    data_layers = list(p.topology.data_layers().values())
-    data_name = next(c.name for c in data_layers if c.size == img_pixels)
-    label_name = next(c.name for c in data_layers if c.name != data_name)
+    # Feed through the REAL converter with the provider-resolved slot types
+    # (PyDataProvider2 runtime input_types): rows follow data-layer
+    # declaration order; the image slot is the one whose declared size
+    # matches the pixel count, the label slot feeds as an integer id.
+    from paddle_tpu.core.data_types import SlotKind
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    dtypes = p.topology.data_types()  # raises if provider types unresolved
+    assert any(t.kind == SlotKind.INDEX for _, t in dtypes), (
+        f"{config_name}: label slot did not resolve to an index type"
+    )
+    feeder = DataFeeder(dtypes)
+
+    def row():
+        out = []
+        for name, t in dtypes:
+            if t.kind == SlotKind.DENSE:
+                out.append(rng.randn(img_pixels).astype(np.float32))
+            else:
+                out.append(int(rng.randint(num_class)))
+        return tuple(out)
+
     batches = [
-        {
-            data_name: SeqTensor(
-                jax.device_put(
-                    rng.randn(batch_size, img_pixels).astype(np.float32)
-                )
-            ),
-            label_name: SeqTensor(
-                jax.device_put(
-                    rng.randint(0, num_class, size=batch_size).astype(np.int32)
-                )
-            ),
-        }
+        jax.tree_util.tree_map(
+            jax.device_put, feeder([row() for _ in range(batch_size)])
+        )
         for _ in range(4)
     ]
     params, state, opt_state, m = step(

@@ -136,9 +136,21 @@ class Topology:
 
     def data_types(self) -> List[Tuple[str, InputType]]:
         """[(name, InputType)] — same contract as v2 Topology.data_type()
-        (reference: python/paddle/v2/topology.py:84-100)."""
+        (reference: python/paddle/v2/topology.py:84-100).  Raises for v1
+        slots whose provider types could not be resolved: feeding those with
+        the parse-time dense placeholder would be silently wrong for
+        index/sequence slots, so it is a hard error here at the feed
+        boundary (the topology itself stays buildable/inspectable)."""
         out = []
         for name, conf in self.data_layers().items():
+            why = conf.attrs.get("_v1_unresolved")
+            if why:
+                raise ValueError(
+                    f"cannot feed data layer {name!r}: {why}.  Fix the "
+                    "provider (declare input_types, or make its init_hook "
+                    "runnable — e.g. fetch the dataset it reads), or feed "
+                    "through an explicit DataFeeder with the true types."
+                )
             assert conf.input_type is not None, f"data layer {name} missing input_type"
             out.append((name, conf.input_type))
         return out

@@ -78,19 +78,29 @@ class CacheType:
 
 class _Settings:
     """The object handed to init_hook (reference PyDataProvider2.py:356-377:
-    'settings' carries input_types plus user args)."""
+    'settings' carries input_types plus user args).  Reference hooks declare
+    types by assigning EITHER ``settings.input_types`` (PyDataProvider2.py
+    initializer pattern) OR ``settings.slots`` (the benchmark image provider,
+    benchmark/paddle/image/provider.py initHook) — ``declared_types`` reads
+    whichever was set."""
 
     def __init__(self, **kwargs):
+        import logging
+
         self.input_types: Optional[Sequence[dt.InputType]] = None
         self.slots: Optional[Sequence[dt.InputType]] = None
         self.should_shuffle: Optional[bool] = None
-        self.logger = None
+        # reference hooks log through settings.logger (sequenceGen.py hook)
+        self.logger = logging.getLogger("paddle_tpu.provider")
         for k, v in kwargs.items():
             setattr(self, k, v)
 
     def set_input_types(self, input_types):
         self.input_types = input_types
         self.slots = input_types
+
+    def declared_types(self):
+        return self.input_types if self.input_types is not None else self.slots
 
 
 def _normalize_types(
@@ -158,12 +168,13 @@ def provider(
             settings.should_shuffle = should_shuffle
             if init_hook is not None:
                 init_hook(settings, file_list=list(files), **hook_kwargs)
-            # init_hook may (re)declare input_types (the reference
-            # initializer pattern) — re-normalize so dict samples and checks
-            # use the hook's declaration.
+            # init_hook may (re)declare input_types — or settings.slots —
+            # (the reference initializer pattern); re-normalize so dict
+            # samples and checks use the hook's declaration.
             eff_types, eff_names = types, names
-            if settings.input_types is not None and settings.input_types is not types:
-                eff_types, eff_names = _normalize_types(settings.input_types)
+            declared = settings.declared_types()
+            if declared is not None and declared is not types:
+                eff_types, eff_names = _normalize_types(declared)
 
             def base_reader():
                 file_list = files if files else (None,)
@@ -198,17 +209,18 @@ def provider(
                 rd = reader_dec.shuffle(rd, pool_size)
             return rd
 
-        def resolve_input_types(**hook_kwargs):
+        def resolve_input_types(file_list=(), **hook_kwargs):
             """Run init_hook (if any) on a fresh settings object and return
             (types, slot_names) — parse_config uses this to learn slot types
             that the provider only declares inside its hook (reference
-            PyDataProvider2 initializer pattern)."""
+            PyDataProvider2 initializer pattern, run with the config's real
+            args + file list like PyDataProvider2.cpp:665 does)."""
             settings = _Settings(**outter_kwargs)
             if types is not None:
                 settings.set_input_types(types)
             if init_hook is not None:
-                init_hook(settings, file_list=[], **hook_kwargs)
-            return _normalize_types(settings.input_types)
+                init_hook(settings, file_list=list(file_list), **hook_kwargs)
+            return _normalize_types(settings.declared_types())
 
         factory.input_types = types
         factory.slot_names = names

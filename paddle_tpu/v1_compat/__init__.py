@@ -89,10 +89,83 @@ def _parse_config_args(config_arg_str: str) -> Dict[str, str]:
     return out
 
 
+def _read_file_list(list_path: Optional[str], config_dir: str) -> list:
+    """Entries of a train/test .list file (one data path per line), resolved
+    like the reference trainer does — relative to the run directory."""
+    if not list_path:
+        return []
+    p = list_path if os.path.isabs(list_path) else os.path.join(config_dir, list_path)
+    if not os.path.exists(p):
+        return []
+    with open(p) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def _infer_slot_type(value, size: int):
+    """Infer a slot's InputType from one sample value + the data layer's
+    declared size (the first-batch introspection fallback; the reference
+    always gets types from the provider object — PyDataProvider2.cpp:54-69 —
+    so this only covers providers whose hook ran but declared nothing).
+    Returns None when the value shape is ambiguous."""
+    import numpy as _np
+
+    from paddle_tpu.core import data_types as _dt
+
+    if isinstance(value, (int, _np.integer)):
+        return _dt.integer_value(size)
+    if isinstance(value, (float, _np.floating)):
+        return _dt.dense_vector(1) if size == 1 else None
+    if isinstance(value, _np.ndarray):
+        if value.ndim == 1 and value.size == size:
+            return _dt.dense_vector(size)
+        if value.ndim == 2 and value.shape[1] == size:
+            return _dt.dense_vector_sequence(size)
+        return None
+    if isinstance(value, (list, tuple)):
+        if not value:
+            return None
+        first = value[0]
+        if isinstance(first, (int, _np.integer)):
+            ints = all(isinstance(v, (int, _np.integer)) for v in value)
+            if ints and len(value) != size:
+                return _dt.integer_value_sequence(size)
+            if len(value) == size:
+                return _dt.dense_vector(size)
+            return None
+        if isinstance(first, (float, _np.floating)):
+            return _dt.dense_vector(size) if len(value) == size else None
+        if isinstance(first, (list, tuple, _np.ndarray)):
+            if (
+                first
+                and isinstance(first, (list, tuple))
+                and len(first) == 2
+                and isinstance(first[0], (int, _np.integer))
+                and isinstance(first[1], (float, _np.floating))
+            ):
+                return _dt.sparse_float_vector(size)
+            inner = [len(v) for v in value]
+            if all(n == size for n in inner):
+                return _dt.dense_vector_sequence(size)
+            return None
+    return None
+
+
+def _first_sample(obj, ds, config_dir: str):
+    """One sample from the provider, shuffle disabled (is_train=False keeps
+    the pool from buffering 1024 samples before the first yield)."""
+    files = _read_file_list(ds.train_list, config_dir)
+    rd = obj(*files, is_train=False, **(ds.args or {}))
+    return next(iter(rd()))
+
+
 def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     """Import the declared provider module and patch data-layer InputTypes
-    from its @provider(input_types=...) declaration (by slot name when the
-    provider declared a dict, else by data-layer declaration order)."""
+    from the provider object itself: its @provider(input_types=...)
+    declaration, else its init_hook run with the config's real args + file
+    list (reference PyDataProvider2.cpp:665 embeds CPython and reads
+    input_types after init_hook), else first-batch introspection.  Slots
+    still unresolved are marked so feeding raises instead of silently using
+    a dense placeholder."""
     ds = parsed.data_sources
     if ds is None or not ds.module:
         return
@@ -115,7 +188,8 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
                 spec.loader.exec_module(mod)
             else:
                 mod = importlib.import_module(ds.module)
-    except ImportError:
+    except ImportError as e:
+        _mark_unresolved(parsed, ds, f"provider module import failed: {e!r}")
         return
     finally:
         sys.path.pop(0)
@@ -123,33 +197,55 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     itypes = getattr(obj, "input_types", None)
     names = getattr(obj, "slot_names", None)
     hook_error: Optional[BaseException] = None
+    cwd = os.getcwd()
     if itypes is None and hasattr(obj, "resolve_input_types"):
-        # hook-declared types (reference initializer pattern)
+        # hook-declared types (reference initializer pattern); hooks open
+        # data files relative to the config/run dir, so resolve from there
         try:
+            os.chdir(config_dir)
             with _py2_shims():
-                itypes, names = obj.resolve_input_types(**(ds.args or {}))
+                itypes, names = obj.resolve_input_types(
+                    file_list=_read_file_list(ds.train_list, config_dir),
+                    **(ds.args or {}),
+                )
         except Exception as e:
             hook_error = e
             itypes = None
+        finally:
+            os.chdir(cwd)
+    data_confs = list(parsed.topology.data_layers().values())
+    if itypes is None and obj is not None:
+        # last resort: pull one real sample and infer each slot's type from
+        # its value + the data layer's declared size
+        try:
+            os.chdir(config_dir)
+            with _py2_shims():
+                sample = _first_sample(obj, ds, config_dir)
+        except Exception as e:
+            hook_error = hook_error or e
+            sample = None
+        finally:
+            os.chdir(cwd)
+        if sample is not None:
+            items = sample if isinstance(sample, (list, tuple)) else (sample,)
+            inferred = [
+                _infer_slot_type(v, c.size) for v, c in zip(items, data_confs)
+            ]
+            if len(items) == len(data_confs) and all(
+                t is not None for t in inferred
+            ):
+                itypes, names = inferred, None
     if itypes is None:
-        unresolved = [
-            c.name
-            for c in parsed.topology.data_layers().values()
-            if c.attrs.get("_v1_size_only")
-        ]
-        if unresolved:
-            warnings.warn(
-                f"could not resolve provider input types for data slots "
-                f"{unresolved} (provider {ds.module}.{ds.obj}"
-                + (f"; init_hook failed: {hook_error!r}" if hook_error else "")
-                + "); they keep the dense_vector placeholder — feeding will "
-                "be wrong for index/sequence slots",
-                stacklevel=2,
-            )
+        _mark_unresolved(
+            parsed,
+            ds,
+            f"init_hook/introspection failed: {hook_error!r}"
+            if hook_error
+            else "provider declares no input_types",
+        )
         return
     # Declaration order, NOT graph-traversal order — positional provider
     # types pair with data layers the way readers yield tuples.
-    data_confs = list(parsed.topology.data_layers().values())
     by_name = dict(zip(names, itypes)) if names else None
     resolved = {}
     for i, conf in enumerate(data_confs):
@@ -161,8 +257,21 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
             # LayerConf is frozen; parse-time resolution happens before any
             # compilation, so this is the one sanctioned mutation point.
             object.__setattr__(conf, "input_type", t)
+            conf.attrs.pop("_v1_size_only", None)
             resolved[conf.name] = t
     parsed.provider_input_types = resolved
+
+
+def _mark_unresolved(parsed: ParsedConfig, ds, reason: str) -> None:
+    """Provider types could not be resolved: leave the parse-time dense
+    placeholders in place (building/inspecting the topology stays fine) but
+    tag the slots so data_types()/feeding raises a hard error instead of
+    silently feeding index/sequence slots as dense vectors."""
+    for c in parsed.topology.data_layers().values():
+        if c.attrs.get("_v1_size_only"):
+            c.attrs["_v1_unresolved"] = (
+                f"slot types unknown: provider {ds.module}.{ds.obj} — {reason}"
+            )
 
 
 import contextlib
