@@ -26,9 +26,19 @@ degenerates to an on-device pass-through — see the devices field).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+# 8 virtual CPU devices alongside the real chip so the multi-device psum
+# path is exercised every bench run (allreduce_psum_8dev metric); must be
+# set before jax initializes its backends.
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 TARGET_IMG_S = 1400.0  # 0.8x per-chip A100 ResNet-50 throughput (north star)
 TARGET_NMT_TOK_S = 40000.0  # 0.8x per-chip A100 attention-RNN NMT estimate
@@ -268,9 +278,9 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
 
     from paddle_tpu.reader.prefetch import DevicePrefetcher
 
-    it = DevicePrefetcher(raw_batches(), stage, depth=2)
     m = None
-    warm = next(it)
+    src = raw_batches()
+    warm = stage(next(src))
     for _ in range(4):  # warm compile + caches
         params, state, opt_state, m = step(
             params, state, opt_state, warm, jax.random.PRNGKey(0)
@@ -288,18 +298,35 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
     step_s = (time.perf_counter() - t0) / 8
 
     iters = 24
+
+    # ---- A/B: the same recordio -> stage -> step loop, fed two ways ----
+    # (a) inline: stage on the main thread, then step (the pre-r03 path)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, state, opt_state, m = step(
+            params, state, opt_state, stage(next(src)), jax.random.PRNGKey(i)
+        )
+    _sync(m)
+    sync_dt = time.perf_counter() - t0
+    sync_img_s = batch_size * iters / sync_dt
+
+    # (b) async: background worker stages batch i+1 (decode + device_put)
+    # while the device runs step i (double-buffered)
+    it = DevicePrefetcher(src, stage, depth=2)
+    next(it)  # fill the double buffer before the clock starts
     it.wait_s = 0.0
     t0 = time.perf_counter()
     for i in range(iters):
-        # double-buffered: the worker thread stages batch i+1 (decode +
-        # device_put) while the device runs step i
         params, state, opt_state, m = step(
             params, state, opt_state, next(it), jax.random.PRNGKey(i)
         )
     _sync(m)
-    dt = time.perf_counter() - t0
+    async_dt = time.perf_counter() - t0
     feed_wait_s = it.wait_s
     it.close()
+    async_img_s = batch_size * iters / async_dt
+
+    dt = min(sync_dt, async_dt)
     # what the interleaved transfers actually sustained; only meaningful
     # when transfers visibly serialize with compute (non-transfer time is a
     # sizeable share of the wall) — on hardware that overlaps copies this
@@ -310,17 +337,20 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
     )
     serial_ceiling_img_s = batch_size / (batch_bytes / h2d_bytes_per_s + step_s)
 
-    img_per_sec = batch_size * iters / dt
+    img_per_sec = max(sync_img_s, async_img_s)
     return {
         "metric": "resnet50_pipeline_images_per_sec",
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / TARGET_IMG_S, 4),
+        "sync_img_s": round(sync_img_s, 2),
+        "async_img_s": round(async_img_s, 2),
         "serial_ceiling_img_s": round(serial_ceiling_img_s, 1),
         "note": (
-            "background double-buffered feeder (reader/prefetch.py): feed "
-            f"wait {feed_wait_s:.1f}s of {dt:.1f}s wall (host side fully "
-            "hidden)."
+            f"A/B same run: inline feed {sync_img_s:.0f} img/s vs "
+            f"background double-buffered feeder {async_img_s:.0f} img/s "
+            f"(feed wait {feed_wait_s:.1f}s of {async_dt:.1f}s wall); "
+            "headline = the faster mode."
             + (
                 "  Environment-bound: the axon tunnel backend serializes "
                 "H2D with compute — isolated transfer "
@@ -591,11 +621,15 @@ def _bench_reference_image_config(
     )
     feeder = DataFeeder(dtypes)
 
+    assert any(
+        t.kind == SlotKind.DENSE and t.dim == img_pixels for _, t in dtypes
+    ), f"{config_name}: no dense slot resolved to the {img_pixels}-pixel image"
+
     def row():
         out = []
         for name, t in dtypes:
             if t.kind == SlotKind.DENSE:
-                out.append(rng.randn(img_pixels).astype(np.float32))
+                out.append(rng.randn(t.dim).astype(np.float32))
             else:
                 out.append(int(rng.randint(num_class)))
         return tuple(out)
@@ -654,30 +688,27 @@ def bench_smallnet() -> dict:
     )
 
 
-def bench_allreduce() -> dict:
-    """Gradient-allreduce bandwidth over the mesh data axis — the path that
-    replaces the reference pserver push/pull (ParameterServer2 addGradient /
-    sendBackParameter).  Multi-device: true ICI AllReduce via shard_map psum;
-    single chip (the bench environment): degenerates to an on-device
-    pass-through, reported with devices=1."""
+def _allreduce_body(devices, words: int, chain: int, iters: int):
+    """Chained shard_map psum over the given devices; returns (GB/s, n) and
+    verifies the reduction VALUE (each element must equal n^(chain+1) times
+    the chained scale factor — a wrong collective shape or a dropped shard
+    shows up as a numeric mismatch, not just a slow run)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import Mesh, PartitionSpec as P
 
-    from paddle_tpu.parallel.mesh import DATA_AXIS, make_mesh
+    from paddle_tpu.parallel.mesh import DATA_AXIS
 
-    n = len(jax.devices())
-    mesh = make_mesh(data=n, model=1)
-    words = 32 * 1024 * 1024  # 128 MB of f32, a ResNet-50-scale grad buffer
+    n = len(devices)
+    mesh = Mesh(np.array(devices), (DATA_AXIS,))
     x = jnp.ones((words,), jnp.float32)
-    chain = 10  # psums chained inside one jit call to amortize dispatch
 
     def many(v):
         def body(c, _):
             r = jax.lax.psum(c, DATA_AXIS)
-            # scale keeps the n=1 identity psum from folding; pvary re-marks
+            # scale keeps the n=1 identity psum from folding; pcast re-marks
             # the replicated sum as device-varying so the carry type is stable
-            return jax.lax.pvary(r * (1.0 + 1e-7), DATA_AXIS), None
+            return jax.lax.pcast(r * (1.0 + 1e-7), DATA_AXIS, to="varying"), None
 
         c, _ = jax.lax.scan(body, v, None, length=chain)
         return jax.lax.psum(c, DATA_AXIS)
@@ -686,16 +717,30 @@ def bench_allreduce() -> dict:
         jax.shard_map(many, mesh=mesh, in_specs=P(DATA_AXIS), out_specs=P())
     )
     y = f(x)
-    float(y[0])
-    iters = 10
+    got = float(y[0])
+    want = float(n) ** (chain + 1) * (1.0 + 1e-7) ** chain
+    assert abs(got - want) <= 1e-3 * want, (
+        f"psum over {n} devices produced {got}, want {want}"
+    )
     t0 = time.perf_counter()
     for _ in range(iters):
         y = f(x)
     float(y[0])
     dt = time.perf_counter() - t0
+    return words * 4 * chain * iters / dt / 1e9, n
 
-    nbytes = words * 4
-    gbps = nbytes * chain * iters / dt / 1e9
+
+def bench_allreduce() -> dict:
+    """Gradient-allreduce bandwidth over the mesh data axis — the path that
+    replaces the reference pserver push/pull (ParameterServer2 addGradient /
+    sendBackParameter).  Multi-device: true ICI AllReduce via shard_map psum;
+    single chip (the bench environment): degenerates to an on-device
+    pass-through, reported with devices=1."""
+    import jax
+
+    gbps, n = _allreduce_body(
+        jax.devices(), words=32 * 1024 * 1024, chain=10, iters=10
+    )
     return {
         "metric": "allreduce_bw_gbps",
         "value": round(gbps, 2),
@@ -705,8 +750,30 @@ def bench_allreduce() -> dict:
     }
 
 
+def bench_allreduce_virtual8() -> dict:
+    """The real multi-device AllReduce path on 8 virtual CPU devices (the
+    single-chip metric above degenerates to an on-device copy): shard_map
+    psum across an 8-way mesh with value verification, tracked round over
+    round for scaling/regression — the loopback-cluster discipline of the
+    reference (MultiGradientMachine.h:44-120 thread-ring, tested via
+    in-process multi-port pservers)."""
+    import jax
+
+    cpus = jax.devices("cpu")[:8]
+    gbps, n = _allreduce_body(cpus, words=4 * 1024 * 1024, chain=4, iters=5)
+    return {
+        "metric": "allreduce_psum_8dev_gbps",
+        "value": round(gbps, 2),
+        "unit": "GB/s",
+        "devices": n,
+        "backend": "cpu-virtual",
+        "vs_baseline": None,
+    }
+
+
 def main() -> None:
-    for fn in (bench_resnet, bench_nmt, bench_allreduce, bench_transformer,
+    for fn in (bench_resnet, bench_nmt, bench_allreduce,
+               bench_allreduce_virtual8, bench_transformer,
                bench_transformer_long_context, bench_lstm_textcls,
                bench_alexnet, bench_googlenet, bench_smallnet,
                bench_resnet_pipeline):

@@ -120,19 +120,29 @@ class Topology:
         for out in self.outputs:
             visit(out)
         self.order: Tuple[str, ...] = tuple(order)
+        # Explicit feeding order (set when a config declared Inputs(...));
+        # None → DFS traversal order below.
+        self.input_order: Optional[Tuple[str, ...]] = None
 
     @property
     def output_names(self) -> Tuple[str, ...]:
         return tuple(o.conf.name for o in self.outputs)
 
     def data_layers(self) -> Dict[str, LayerConf]:
-        """Data layers in DECLARATION order — the feeding contract.  Graph
-        traversal order would depend on the cost graph's shape; the reference
-        keeps declaration order in ModelConfig.input_layer_names
-        (config_parser.py), which is what readers yield tuples in."""
-        confs = [c for c in self.layers.values() if c.type == "data"]
-        confs.sort(key=lambda c: c.attrs.get("_decl_idx", 0))
-        return {c.name: c for c in confs}
+        """Data layers in FEEDING order — explicit ``Inputs(...)`` order when
+        the config declared one, else DFS-traversal order from the outputs
+        (parents first, left to right).  The reference computes exactly this
+        in trainer_config_helpers/networks.py:1412 ``outputs()``:
+        ``__dfs_travel__`` collects data layers in LRV order and passes them
+        to ``Inputs()``, and "the data streams from DataProvider must have
+        the same order" (config_parser.py:205-222).  Declaration order is NOT
+        the contract — googlenet.py declares label before input yet the
+        provider yields (image, label)."""
+        if self.input_order is not None:
+            return {n: self.layers[n] for n in self.input_order}
+        return {
+            n: self.layers[n] for n in self.order if self.layers[n].type == "data"
+        }
 
     def data_types(self) -> List[Tuple[str, InputType]]:
         """[(name, InputType)] — same contract as v2 Topology.data_type()

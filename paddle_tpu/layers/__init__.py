@@ -162,14 +162,11 @@ def cnn_output_size(
 # ---------------------------------------------------------------------------
 
 
-_DATA_DECL_COUNTER = [0]
-
-
 def data(name: str, type: InputType, height: int = 0, width: int = 0) -> LayerOutput:
-    """Declare an input slot (reference data_layer, layers.py).  Declaration
-    order defines the default reader-tuple feeding order."""
-    attrs = {"_decl_idx": _DATA_DECL_COUNTER[0]}
-    _DATA_DECL_COUNTER[0] += 1
+    """Declare an input slot (reference data_layer, layers.py).  Feeding
+    order is DFS from the outputs, or explicit Inputs(...) — see
+    Topology.data_layers."""
+    attrs = {}
     if height and width:
         attrs.update(in_h=height, in_w=width, in_c=max(type.dim // (height * width), 1))
     conf = LayerConf(
@@ -1451,11 +1448,20 @@ def gru_step(
     naive: bool = False,
 ) -> LayerOutput:
     """One GRU step (reference gru_step_layer): input pre-projected to 3H,
-    output_mem = previous state (usually a memory).  naive=True selects the
-    gru_step_naive_layer math (see gru_step_apply)."""
+    output_mem = previous state (usually a memory).  naive=True is the
+    reference gru_step_naive_layer — the SAME recurrence (GruCompute) built
+    from three separate projections; its one behavioral difference is that a
+    NAMED param_attr ties all three recurrent blocks to ONE H×H matrix
+    (each full_matrix_projection receives the same param name), which maps
+    to tied_weights here."""
     size = size or output_mem.size
     assert input.size == 3 * size
-    pnames = _step_param_names(param_attr, bias_attr, ("w_h", "w_c"))
+    tied = naive and _param_name(param_attr) is not None
+    if tied:
+        pnames = _step_param_names(param_attr, bias_attr, ("w",))
+        pnames["w"] = _param_name(param_attr)
+    else:
+        pnames = _step_param_names(param_attr, bias_attr, ("w_h", "w_c"))
     conf = LayerConf(
         name=name or auto_name("gru_step"),
         type="gru_step",
@@ -1467,6 +1473,7 @@ def gru_step(
             "gate_act": act_name(gate_act if gate_act is not None else _act_mod.Sigmoid()),
             "param_std": _param_std(param_attr),
             **({"naive": True} if naive else {}),
+            **({"tied_weights": True} if tied else {}),
             **({"param_names": pnames} if pnames else {}),
         },
     )

@@ -57,12 +57,20 @@ def beam_search(
     max_length: int = 30,
     num_results_per_sample: Optional[int] = None,
     name: Optional[str] = None,
+    candidate_adjust_fn=None,
+    drop_fn=None,
+    norm_fn=None,
 ) -> LayerOutput:
     """Build a generation layer.  `step` is the same step function a training
     ``recurrent_group`` would use; its GeneratedInput argument receives the
     embedded previous token ([B, embedding_size]), StaticInputs behave as in
     recurrent_group, and ``memory()`` links carry decoder state across steps.
     The step must end in a softmax over the vocabulary.
+
+    The three optional hooks are the user beam-search callback surface
+    (reference BeamSearchControlCallbacks, RecurrentGradientMachine.h:70-120
+    + diy_beam_search_prob_so .cpp:27) as restricted in-graph functions —
+    see ops/beam.py's module docstring for signatures.
 
     Output: int32 ids [B, K, T] sorted best-first; beam scores are exposed as
     the auxiliary output ``<name>@scores`` ([B, K]).
@@ -127,6 +135,13 @@ def beam_search(
             "eos_id": eos_id,
             "beam_size": beam_size,
             "max_length": max_length,
+            **(
+                {"_candidate_adjust_fn": candidate_adjust_fn}
+                if candidate_adjust_fn
+                else {}
+            ),
+            **({"_drop_fn": drop_fn} if drop_fn else {}),
+            **({"_norm_fn": norm_fn} if norm_fn else {}),
         },
     )
     return LayerOutput(conf, outer_inputs)
@@ -200,6 +215,9 @@ def beam_search_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         bos_id=a["bos_id"],
         eos_id=a["eos_id"],
         max_len=a["max_length"],
+        candidate_adjust_fn=a.get("_candidate_adjust_fn"),
+        drop_fn=a.get("_drop_fn"),
+        norm_fn=a.get("_norm_fn"),
     )
     ctx.outputs[conf.name + "@scores"] = SeqTensor(scores)
     return SeqTensor(seqs)

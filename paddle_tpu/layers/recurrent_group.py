@@ -103,7 +103,15 @@ def memory(
     (reference memory(), layers.py; RecurrentGradientMachine "memory frame"
     links).  boot_layer provides the t=0 value (non-seq [B, size]).
     name=None defers the link: call ``.set_input(layer)`` before the group
-    closes (reference memory(name=None).set_input pattern)."""
+    closes (reference memory(name=None).set_input pattern).
+
+    is_seq=True carries a WHOLE SEQUENCE between outer steps (reference
+    sequence-memory frames, RecurrentGradientMachine.cpp:530-608): the step
+    sees the linked layer's previous-step [B, T_mem, size] sequence (with
+    its lengths), so sequence layers / an inner group can consume it.  The
+    boot value is the boot_layer's sequence (or an empty zero-length
+    sequence when unbooted); under the static-shape scan the linked layer's
+    padded width must be step-invariant."""
     assert _current_build is not None, "memory() must be called inside a recurrent_group step"
     conf = LayerConf(
         name=auto_name(f"memory_{name or memory_name or 'deferred'}"),
@@ -114,6 +122,7 @@ def memory(
             "link": name,
             "boot": boot_layer.name if boot_layer is not None else None,
             "boot_const_id": boot_with_const_id,
+            **({"is_seq": True} if is_seq else {}),
         },
     )
     _current_build.memories.append(conf)
@@ -365,12 +374,55 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         valid = tpos < lengths[None, :]
     mask_seq = valid[..., None].astype(jnp.float32)  # [T, B, 1]
 
+    static_batch = {
+        pname: (st if is_seq else SeqTensor(st.data))
+        for (pname, is_seq), st in zip(static_info, statics)
+    }
+    sub_state0 = ctx.state.get(conf.name, {})
+
+    # Sequence-valued memories (reference sequence-memory frames,
+    # RecurrentGradientMachine.cpp:530-608) carry a whole padded sequence:
+    # their static width must equal the linked layer's per-step padded
+    # width, found by abstract evaluation of the step body (fixed-point
+    # iteration: a link whose width depends on the memory's own width — e.g.
+    # an elementwise transform — converges in one extra round).
+    seq_widths = _seq_memory_widths(
+        conf, subnet, params, memories, scan_names, static_batch, xs,
+        ctx, sub_state0, b,
+    )
+
     # initial memory carries
     init_carry = {}
     for m in memories:
         boot = m.attrs.get("boot")
         boot_const = m.attrs.get("boot_const_id")
-        if boot is not None:
+        if m.attrs.get("is_seq"):
+            w = seq_widths[m.name]
+            if boot is not None:
+                bt = ctx.outputs[boot]
+                if bt.is_seq:
+                    d = bt.data[:, :w]
+                    if d.shape[1] < w:
+                        pad = [(0, 0), (0, w - d.shape[1])] + [(0, 0)] * (
+                            d.ndim - 2
+                        )
+                        d = jnp.pad(d, pad)
+                    init_carry[m.name] = SeqTensor(
+                        d, jnp.minimum(bt.lengths, w).astype(jnp.int32)
+                    )
+                else:  # non-seq boot -> a length-1 sequence
+                    d = jnp.pad(
+                        bt.data[:, None], [(0, 0), (0, w - 1), (0, 0)]
+                    )
+                    init_carry[m.name] = SeqTensor(
+                        d, jnp.ones((b,), jnp.int32)
+                    )
+            else:  # unbooted: EMPTY sequence (zero lengths), not zeros-as-data
+                init_carry[m.name] = SeqTensor(
+                    jnp.zeros((b, w, m.size), scanned[0].data.dtype),
+                    jnp.zeros((b,), jnp.int32),
+                )
+        elif boot is not None:
             init_carry[m.name] = ctx.outputs[boot].data
         elif boot_const is not None:
             # id-type memory booted with a constant id (reference
@@ -381,14 +433,8 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         else:
             init_carry[m.name] = jnp.zeros((b, m.size), scanned[0].data.dtype)
 
-    static_batch = {
-        pname: (st if is_seq else SeqTensor(st.data))
-        for (pname, is_seq), st in zip(static_info, statics)
-    }
-
     step_rng = ctx.layer_rng(conf.name)
     t_iota = jnp.arange(t_max, dtype=jnp.uint32)
-    sub_state0 = ctx.state.get(conf.name, {})
 
     def body(carry_all, scan_in):
         carry, sub_state = carry_all
@@ -399,7 +445,10 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         for pname, x in zip(scan_names, xt):
             sub_batch[pname] = x  # SeqTensor: a sequence when SubsequenceInput
         for m in memories:
-            sub_batch[m.name] = SeqTensor(carry[m.name])
+            if m.attrs.get("is_seq"):
+                sub_batch[m.name] = carry[m.name]  # whole-sequence SeqTensor
+            else:
+                sub_batch[m.name] = SeqTensor(carry[m.name])
         # fold the timestep in so dropout/sampling decorrelate across steps
         rng_t = None if step_rng is None else jax.random.fold_in(step_rng, t_idx)
         outs, new_sub_state = subnet.apply(
@@ -407,10 +456,25 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         )
         new_carry = {}
         for m in memories:
-            upd = outs[m.attrs["link"]].data
-            new_carry[m.name] = jnp.where(
-                m_t > 0, upd, carry[m.name].astype(upd.dtype)
-            )
+            upd = outs[m.attrs["link"]]
+            if m.attrs.get("is_seq"):
+                old = carry[m.name]
+                assert upd.lengths is not None, (
+                    f"{conf.name}: seq memory {m.name} links "
+                    f"{m.attrs['link']!r}, which is not a sequence"
+                )
+                new_carry[m.name] = SeqTensor(
+                    jnp.where(
+                        m_t[..., None] > 0,
+                        upd.data,
+                        old.data.astype(upd.data.dtype),
+                    ),
+                    jnp.where(m_t[:, 0] > 0, upd.lengths, old.lengths),
+                )
+            else:
+                new_carry[m.name] = jnp.where(
+                    m_t > 0, upd.data, carry[m.name].astype(upd.data.dtype)
+                )
         # Return the whole SeqTensor so a seq-valued step output stacks its
         # per-step lengths too (the nested-output case).
         return (new_carry, new_sub_state), outs[out_name]
@@ -436,6 +500,83 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     ys = jnp.swapaxes(ys, 0, 1)  # [B, T, D]
     ys = ys * mask_like(ys, lengths)
     return SeqTensor(ys, lengths)
+
+
+def _seq_memory_widths(
+    conf, subnet, params, memories, scan_names, static_batch, xs,
+    ctx, sub_state0, b,
+) -> Dict[str, int]:
+    """Static padded width of each sequence-valued memory = the linked
+    layer's per-step padded width, found by abstract evaluation
+    (jax.eval_shape) of the step body — no FLOPs, shapes only.  Iterates to
+    a fixed point because a link's width can depend on the memory's own
+    width (elementwise transforms of the memory); widths that keep changing
+    (e.g. a concat that grows every step) cannot be a static scan carry and
+    raise."""
+    seq_mems = [m for m in memories if m.attrs.get("is_seq")]
+    if not seq_mems:
+        return {}
+    # first-step slices of the scanned inputs, exactly as lax.scan hands
+    # them to the body ([T,B,...] -> [B,...], nested sub-lengths included)
+    x0 = [jax.tree_util.tree_map(lambda v: v[0], x) for x in xs]
+
+    # initial guess: boot width, else the inner width of a nested scanned
+    # input (the usual link target in hierarchical steps — a bad guess can
+    # make the probe fail outright, e.g. addto(memory, subsequence) with
+    # mismatched widths, before the fixed point is ever reached)
+    nested_w = next(
+        (x.data.shape[1] for x in x0 if getattr(x, "lengths", None) is not None),
+        1,
+    )
+    widths: Dict[str, int] = {}
+    for m in seq_mems:
+        boot = m.attrs.get("boot")
+        if boot is not None and ctx.outputs[boot].is_seq:
+            widths[m.name] = ctx.outputs[boot].max_len
+        else:
+            widths[m.name] = nested_w
+
+    def run_shapes(pb):
+        return jax.eval_shape(
+            lambda p, bb: subnet.apply(
+                p, bb, state=sub_state0, train=ctx.train, rng=None
+            )[0],
+            params,
+            pb,
+        )
+
+    for _ in range(3):
+        pb = dict(static_batch)
+        for pname, x in zip(scan_names, x0):
+            pb[pname] = x
+        for m in memories:
+            if m.attrs.get("is_seq"):
+                pb[m.name] = SeqTensor(
+                    jnp.zeros((b, widths[m.name], m.size), jnp.float32),
+                    jnp.zeros((b,), jnp.int32),
+                )
+            else:
+                pb[m.name] = SeqTensor(jnp.zeros((b, m.size), jnp.float32))
+        outs = run_shapes(pb)
+        new_widths: Dict[str, int] = {}
+        stable = True
+        for m in seq_mems:
+            out = outs[m.attrs["link"]]
+            if out.lengths is None:
+                raise ValueError(
+                    f"{conf.name}: memory(is_seq=True) {m.name} links "
+                    f"{m.attrs['link']!r}, which is not a sequence layer"
+                )
+            new_widths[m.name] = out.data.shape[1]
+            stable = stable and new_widths[m.name] == widths[m.name]
+        if stable:
+            return widths
+        widths = new_widths
+    raise ValueError(
+        f"{conf.name}: sequence-memory padded width did not reach a fixed "
+        f"point (last {widths}); a step whose linked sequence grows every "
+        "iteration cannot be carried through a static-shape scan"
+    )
 
 
 def mask_like(ys: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:

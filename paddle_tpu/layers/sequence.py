@@ -355,8 +355,14 @@ def recurrent_apply(conf, params, inputs, ctx):
 def gru_step_init(conf, in_confs, rng):
     h = conf.size
     std = conf.attr("param_std")
-    r1, r2 = jax.random.split(rng)
-    p = {"w_h": init.normal(r1, (h, 2 * h), std), "w_c": init.normal(r2, (h, h), std)}
+    if conf.attr("tied_weights", False):
+        p = {"w": init.normal(rng, (h, h), std)}
+    else:
+        r1, r2 = jax.random.split(rng)
+        p = {
+            "w_h": init.normal(r1, (h, 2 * h), std),
+            "w_c": init.normal(r2, (h, h), std),
+        }
     if conf.bias:
         p["b"] = init.zeros((3 * h,))
     return p
@@ -364,11 +370,16 @@ def gru_step_init(conf, in_confs, rng):
 
 @register_layer("gru_step", init=gru_step_init, auto_activation=False)
 def gru_step_apply(conf, params, inputs, ctx):
-    """Fused form follows GruStepLayer.cpp / hl_gpu_gru.cuh; naive=True is
-    the reference's gru_step_naive_layer (trainer_config_helpers/layers.py
-    gru_step_naive_layer): the reset gate multiplies the PREVIOUS STATE
-    before the candidate matmul ((h⊙r)·W vs r⊙(h·W)) and the update gate
-    mixes the other way around (h·(1-u) + c·u)."""
+    """Reference GRU recurrence — GruStepLayer.cpp AND gru_step_naive_layer
+    share the SAME math (both lower to GruCompute → hl_gru_ops.cuh
+    gru_resetOutput/gru_finalOutput):
+        u = σ(x_u + h₋·U_u),  r = σ(x_r + h₋·U_r)
+        c = act(x_c + (r⊙h₋)·W_c)          # resetOutput = prevOut*r first
+        h = (1-u)⊙h₋ + u⊙c                  # prevOut - u·prevOut + u·c
+    naive=True differs only in parameter ASSEMBLY (three separate
+    full_matrix_projections instead of the fused 3H gate weight); with a
+    NAMED param_attr the reference ties all three projections to ONE H×H
+    matrix — that case sets tied_weights and uses a single `w`."""
     from paddle_tpu.ops.activations import get_activation
 
     x, h_p = inputs[0].data, inputs[1].data  # [B, 3H], [B, H]
@@ -378,15 +389,19 @@ def gru_step_apply(conf, params, inputs, ctx):
     if "b" in params:
         x = x + params["b"]
     x_u, x_r, x_c = jnp.split(x, 3, axis=-1)
-    ur = h_p @ params["w_h"]
-    u_t = f_gate(x_u + ur[:, :h])
-    r_t = f_gate(x_r + ur[:, h:])
-    if conf.attr("naive", False):
-        c_t = f_act(x_c + (r_t * h_p) @ params["w_c"])
-        h_t = (1.0 - u_t) * h_p + u_t * c_t
+    if conf.attr("tied_weights", False):
+        w = params["w"]
+        hw = h_p @ w
+        u_t = f_gate(x_u + hw)
+        r_t = f_gate(x_r + hw)
+        w_c = w
     else:
-        c_t = f_act(x_c + r_t * (h_p @ params["w_c"]))
-        h_t = u_t * h_p + (1.0 - u_t) * c_t
+        ur = h_p @ params["w_h"]
+        u_t = f_gate(x_u + ur[:, :h])
+        r_t = f_gate(x_r + ur[:, h:])
+        w_c = params["w_c"]
+    c_t = f_act(x_c + (r_t * h_p) @ w_c)
+    h_t = (1.0 - u_t) * h_p + u_t * c_t
     return SeqTensor(h_t)
 
 

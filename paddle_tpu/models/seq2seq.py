@@ -182,6 +182,9 @@ class Seq2SeqGenerator:
         eos_id: int = 1,
         max_length: int = 32,
         beam_size: int = 4,
+        candidate_adjust_fn=None,
+        drop_fn=None,
+        norm_fn=None,
     ):
         self.params = parameters
         self.net = parameters.network
@@ -192,6 +195,11 @@ class Seq2SeqGenerator:
         self.eos_id = eos_id
         self.max_length = max_length
         self.beam_size = beam_size
+        # user beam-search control hooks (ops/beam.py module docstring;
+        # reference RecurrentGradientMachine.h:70-120 callbacks)
+        self.candidate_adjust_fn = candidate_adjust_fn
+        self.drop_fn = drop_fn
+        self.norm_fn = norm_fn
 
         dec_conf = self.topo.get("decoder")
         self._sub_topo = dec_conf.attrs["_sub_topology"]
@@ -272,6 +280,9 @@ class Seq2SeqGenerator:
             bos_id=self.bos_id,
             eos_id=self.eos_id,
             max_len=self.max_length,
+            candidate_adjust_fn=self.candidate_adjust_fn,
+            drop_fn=self.drop_fn,
+            norm_fn=self.norm_fn,
         )
 
     def generate_greedy(self, batch):

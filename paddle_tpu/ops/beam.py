@@ -3,11 +3,28 @@ reference's host-side beam search (reference: paddle/gserver/
 gradientmachines/RecurrentGradientMachine.cpp:1393 beamSearch, .cpp:964
 generateSequence): fixed beam width K and max length T, padded beams, eos
 handling via finished masks — no data-dependent control flow.
+
+User hook surface (reference BeamSearchControlCallbacks,
+RecurrentGradientMachine.h:70-120, and the ``diy_beam_search_prob_so``
+user-.so probability hook, .cpp:27): the reference invokes host std::function
+callbacks between steps; here the hooks are restricted IN-GRAPH functions
+traced into the same jitted scan — they must be jax-traceable (no
+data-dependent Python control flow).  A hook that genuinely needs host code
+can wrap it in ``jax.pure_callback`` itself.
+
+  candidate_adjust_fn(logp [B*K, V], seqs [B*K, T], t) -> logp
+      BeamSearchCandidatesAdjustCallback + diy prob .so: restrict/adjust
+      the candidate distribution given the formed prefixes and step number.
+  drop_fn(seqs [B*K, T], ids [B*K], scores [B*K], t) -> bool [B*K]
+      DropCallback: True drops the expanded path (score pinned to -inf).
+  norm_fn(scores [B, K], seqs [B, K, T], lengths [B, K]) -> scores
+      NormOrDropNodeCallback on completed paths: rescore (e.g. length
+      normalization) before the final best-first sort; return -inf to drop.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +41,9 @@ def beam_search(
     bos_id: int,
     eos_id: int,
     max_len: int,
+    candidate_adjust_fn: Optional[Callable] = None,
+    drop_fn: Optional[Callable] = None,
+    norm_fn: Optional[Callable] = None,
 ):
     """Generic beam search.
 
@@ -31,7 +51,7 @@ def beam_search(
     leaves must have leading dim B*K.  Returns (sequences [B, K, T] int32,
     scores [B, K]) sorted best-first.  Finished beams propagate only via the
     eos column so shorter hypotheses stay comparable (the reference's
-    eosFrameLine_ bookkeeping).
+    eosFrameLine_ bookkeeping).  See module docstring for the hook surface.
     """
     bk = batch_size * beam_size
 
@@ -58,6 +78,10 @@ def beam_search(
             jnp.arange(vocab_size) == eos_id, 0.0, NEG_INF
         ).astype(logp.dtype)
         logp = jnp.where(finished[:, None], eos_row[None, :], logp)
+        if candidate_adjust_fn is not None:
+            # adjusted distribution must keep finished beams frozen on eos
+            adj = candidate_adjust_fn(logp, seqs, t)
+            logp = jnp.where(finished[:, None], eos_row[None, :], adj)
         cand = scores[:, None] + logp  # [B*K, V]
         cand = cand.reshape(batch_size, beam_size * vocab_size)
         top_scores, top_idx = jax.lax.top_k(cand, beam_size)  # [B, K]
@@ -76,6 +100,9 @@ def beam_search(
         )
         new_seqs = jnp.take(seqs, parent, axis=0)  # reorder histories
         new_seqs = new_seqs.at[:, t].set(new_ids)
+        if drop_fn is not None:
+            drop = drop_fn(new_seqs, new_ids, new_scores, t)
+            new_scores = jnp.where(drop, NEG_INF, new_scores)
         return (new_ids, new_scores, new_finished, new_carry, new_seqs, t + 1), None
 
     seqs0 = jnp.zeros((bk, max_len), jnp.int32)
@@ -85,6 +112,12 @@ def beam_search(
     )
     seqs = seqs.reshape(batch_size, beam_size, max_len)
     scores = scores.reshape(batch_size, beam_size)
+    if norm_fn is not None:
+        is_eos = seqs == eos_id
+        any_eos = jnp.any(is_eos, axis=-1)
+        first_eos = jnp.argmax(is_eos.astype(jnp.int32), axis=-1)
+        lengths = jnp.where(any_eos, first_eos, max_len).astype(jnp.int32)
+        scores = norm_fn(scores, seqs, lengths)
     order = jnp.argsort(-scores, axis=1)
     seqs = jnp.take_along_axis(seqs, order[:, :, None], axis=1)
     scores = jnp.take_along_axis(scores, order, axis=1)

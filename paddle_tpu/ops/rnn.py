@@ -117,10 +117,11 @@ def gru_scan(
     reverse: bool = False,
     h0: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Paddle-v1 GRU (GatedRecurrentLayer.cpp / hl_cpu_gru.cuh):
+    """Paddle-v1 GRU (GatedRecurrentLayer.cpp / hl_cpu_gru.cuh:238-253,
+    hl_gru_ops.cuh gru_resetOutput/gru_finalOutput):
         u = σ(x_u + U_u h₋)   r = σ(x_r + U_r h₋)
-        c = act(x_c + r∘(U_c h₋))
-        h = u∘h₋ + (1-u)∘c
+        c = act(x_c + (r∘h₋) U_c)        # resetOutput = prevOut*r, THEN gemm
+        h = (1-u)∘h₋ + u∘c               # prevOut - u*prevOut + u*frameState
     Returns ([B, T, H], h_last)."""
     b, t, g3 = gates.shape
     h = g3 // 3
@@ -145,8 +146,8 @@ def gru_scan(
         ur = h_p @ w_h
         u_t = f_gate(x_u + ur[:, :h])
         r_t = f_gate(x_r + ur[:, h:])
-        c_t = f_act(x_c + r_t * (h_p @ w_c))
-        h_t = u_t * h_p + (1.0 - u_t) * c_t
+        c_t = f_act(x_c + (r_t * h_p) @ w_c)
+        h_t = (1.0 - u_t) * h_p + u_t * c_t
         if m is not None:
             h_t = jnp.where(m, h_t, h_p)
         return h_t, h_t

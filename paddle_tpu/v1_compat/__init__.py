@@ -22,6 +22,7 @@ import importlib
 import importlib.util
 import os
 import sys
+import threading
 import types
 import warnings
 from typing import Dict, List, Optional
@@ -34,7 +35,12 @@ from paddle_tpu.v1_compat.config_helpers import (  # noqa: F401
     TrainerSettings,
 )
 
-__all__ = ["parse_config", "ParsedConfig", "make_optimizer"]
+__all__ = [
+    "parse_config",
+    "ParsedConfig",
+    "make_optimizer",
+    "make_data_reader",
+]
 
 
 def _install_import_shims() -> None:
@@ -126,11 +132,13 @@ def _infer_slot_type(value, size: int):
             return None
         first = value[0]
         if isinstance(first, (int, _np.integer)):
-            ints = all(isinstance(v, (int, _np.integer)) for v in value)
-            if ints and len(value) != size:
+            # A list of ints is ALWAYS an id sequence in v1 providers —
+            # dense values come as floats/ndarrays (PyDataProvider2.cpp
+            # slot types).  Never fall back to dense on len==size; that
+            # coincidence mis-fed small-size configs and is first-sample-
+            # dependent.
+            if all(isinstance(v, (int, _np.integer)) for v in value):
                 return _dt.integer_value_sequence(size)
-            if len(value) == size:
-                return _dt.dense_vector(size)
             return None
         if isinstance(first, (float, _np.floating)):
             return _dt.dense_vector(size) if len(value) == size else None
@@ -150,6 +158,232 @@ def _infer_slot_type(value, size: int):
     return None
 
 
+def _resolve_data_path(p: str, config_dir: str) -> Optional[str]:
+    """Reference data paths are relative to the RUN directory (the trainer
+    is launched from the source root: ``trainer/tests/mnist.list``), not the
+    config file — try the config dir, then each ancestor, then the bare
+    basename next to the config."""
+    if os.path.isabs(p):
+        return p if os.path.exists(p) else None
+    cands = [p, os.path.join(config_dir, p)]
+    d = config_dir
+    for _ in range(4):
+        d = os.path.dirname(d) or "/"
+        cands.append(os.path.join(d, p))
+    cands.append(os.path.join(config_dir, os.path.basename(p)))
+    for c in cands:
+        if os.path.exists(c):
+            return c
+    return None
+
+
+def _proto_data_files(dc, config_dir: str) -> list:
+    """Expand a ProtoData files= declaration (a .list file of data paths, or
+    a direct data path) into existing absolute paths."""
+    if not dc or not dc.files:
+        return []
+    lst = _resolve_data_path(dc.files, config_dir)
+    if lst is None:
+        return []
+    if lst.endswith(".list") or lst.endswith(".txt"):
+        with open(lst) as f:
+            entries = [ln.strip() for ln in f if ln.strip()]
+        out = []
+        for e in entries:
+            r = _resolve_data_path(e, config_dir) or _resolve_data_path(
+                e, os.path.dirname(lst)
+            )
+            if r:
+                out.append(r)
+        return out
+    return [lst]
+
+
+def _resolve_proto_data_types(parsed: ParsedConfig, config_dir: str) -> bool:
+    """Old-face ``TrainData(ProtoData(files=...))``: the binary data's OWN
+    DataHeader is the authoritative slot-type source
+    (ProtoDataProvider.cpp:84 checkDataHeader) — read it and bind the slots
+    to the data layers in feeding order.  Returns True when handled."""
+    td = parsed.train_data
+    if td is None or getattr(td, "kind", None) != "proto":
+        return False
+    files = _proto_data_files(td, config_dir)
+    if not files:
+        _mark_unresolved_msg(
+            parsed, f"proto data files {td.files!r} not found under {config_dir}"
+        )
+        return True
+    from paddle_tpu.io.protodata import read_proto_header, slot_input_types
+
+    defs = read_proto_header(files[0])
+    sequence = (getattr(td, "type", None) or "").endswith("sequence")
+    try:
+        itypes = slot_input_types(defs, sequence=sequence)
+        data_confs = list(parsed.topology.data_layers().values())
+        aligned = _bind_slots(itypes, data_confs, f"ProtoData({td.files})")
+    except ValueError as e:
+        # building/inspecting the topology must survive a data mismatch
+        # (e.g. a fixture config whose slots feed raw-face groups we map
+        # differently); the error surfaces at FEED time instead
+        _mark_unresolved_msg(parsed, str(e))
+        return True
+    resolved = {}
+    for conf, t in zip(data_confs, aligned):
+        if t is not None and conf.attrs.get("_v1_size_only"):
+            object.__setattr__(conf, "input_type", t)
+            conf.attrs.pop("_v1_size_only", None)
+            resolved[conf.name] = t
+    parsed.provider_input_types = resolved
+    return True
+
+
+def make_data_reader(
+    parsed: ParsedConfig,
+    config_dir: str,
+    train: bool = True,
+    shuffle: bool = True,
+):
+    """Reader over a parsed config's old-face data declaration (currently
+    the ProtoData binary format; py/simple providers feed through
+    define_py_data_sources2 instead).  Returns a v2-style reader callable
+    yielding sample tuples in the config's feeding order.
+
+    shuffle=True matches ProtoDataProvider::reset, which shuffles every
+    pass unless skip_shuffle (ProtoDataProvider.cpp:372-379) — the
+    checked-in mnist_bin_part is label-SORTED, so unshuffled training
+    oscillates exactly as single-class batches would."""
+    dc = parsed.train_data if train else parsed.test_data
+    if dc is None or getattr(dc, "kind", None) != "proto":
+        raise ValueError(
+            "make_data_reader supports TrainData(ProtoData(...)) configs; "
+            f"got {dc!r}"
+        )
+    files = _proto_data_files(dc, config_dir)
+    if not files:
+        raise FileNotFoundError(
+            f"proto data files {dc.files!r} not found under {config_dir}"
+        )
+    from paddle_tpu.io.protodata import make_reader
+
+    sequence = (getattr(dc, "type", None) or "").endswith("sequence")
+    rd = make_reader(files, sequence=sequence)
+    if shuffle and not train:
+        shuffle = False  # test data is read in order (reference skipShuffle)
+    if shuffle:
+        from paddle_tpu.reader.decorator import shuffle as _shuffle
+
+        # whole-dataset buffer: the reference loads all records into memory
+        # and permutes sequence ids (loadDataAll + shuffledSequenceIds_)
+        rd = _shuffle(rd, 65536)
+    return rd
+
+
+def _mark_unresolved_msg(parsed: ParsedConfig, reason: str) -> None:
+    for c in parsed.topology.data_layers().values():
+        if c.attrs.get("_v1_size_only"):
+            c.attrs["_v1_unresolved"] = f"slot types unknown: {reason}"
+
+
+def _slot_compatible(t, conf) -> bool:
+    """Does slot type ``t`` dim-check against data layer ``conf``?  Dense and
+    sparse slots must match the declared layer size exactly; index slots are
+    compatible with any size — reference providers routinely declare
+    ``integer_value(1)`` for a 1000-class label (benchmark provider.py
+    initHook), so the value range carries no binding signal."""
+    from paddle_tpu.core.data_types import SlotKind
+
+    if t is None:
+        return False
+    if t.kind == SlotKind.INDEX:
+        return True
+    return t.dim == conf.size
+
+
+def _bind_slots(itypes, data_confs, label: str):
+    """Bind positional provider slot types to data layers, validating dims.
+
+    Positional order is the contract (reference config_parser.py:205-222),
+    but providers written against the DFS input order break silently if the
+    orders ever diverge — so every binding is dim-checked, and when the
+    positional binding fails the check we search for the assignment that
+    does dim-check.  A unique consistent assignment is used (with a
+    warning); none or several → hard error, never a silent mis-feed.
+    Returns a list of types aligned with ``data_confs``."""
+    n = len(data_confs)
+    if len(itypes) != n:
+        raise ValueError(
+            f"{label}: provider declares {len(itypes)} slots but the config "
+            f"has {n} data layers "
+            f"({[c.name for c in data_confs]})"
+        )
+    if all(_slot_compatible(t, c) for t, c in zip(itypes, data_confs)):
+        return list(itypes)
+    # positional binding fails the dim check: search assignments over the
+    # slot×layer candidate matrix
+    cand = [
+        [t if _slot_compatible(t, c) else None for c in data_confs]
+        for t in itypes
+    ]
+    out = _unique_assignment(cand, n)
+    if out is not None:
+        warnings.warn(
+            f"{label}: provider slot types do not dim-check against the "
+            f"data layers in feeding order "
+            f"({[c.name for c in data_confs]}); using the unique "
+            "dim-consistent assignment instead",
+            stacklevel=2,
+        )
+        return out
+    raise ValueError(
+        f"{label}: cannot bind provider slot types {itypes} to data layers "
+        f"{[(c.name, c.size) for c in data_confs]}: no unique dim-consistent "
+        "assignment exists.  Declare input_types in feeding order "
+        "(Inputs(...) order if set, else DFS order from the outputs) or fix "
+        "the slot dims."
+    )
+
+
+def _unique_assignment(cand, n: int):
+    """Perfect matching over ``cand[slot][layer]`` (None = incompatible).
+    Returns the layer-aligned type list when exactly one DISTINCT
+    layer→type mapping exists (identical types swapping slots count as the
+    same mapping), else None.  The search dedups into distinct mappings as
+    it goes and stops only once TWO exist — capping raw solution count
+    instead would declare ambiguous bindings unique whenever the first
+    branch alone yields many permutations of equal types."""
+    distinct: set = set()
+    first_sol: list = []
+    budget = [200_000]  # node guard: factorial worst case bails to "no
+    # unique assignment" (the hard-error path), never to a wrong binding
+
+    def search(i: int, used: int, assign: list) -> None:
+        if len(distinct) > 1 or budget[0] <= 0:
+            return
+        budget[0] -= 1
+        if i == n:
+            key = tuple(
+                sorted((j, repr(cand[i2][j])) for i2, j in enumerate(assign))
+            )
+            if key not in distinct:
+                distinct.add(key)
+                if len(distinct) == 1:
+                    first_sol[:] = assign
+            return
+        for j in range(n):
+            if cand[i][j] is not None and not used & (1 << j):
+                assign.append(j)
+                search(i + 1, used | (1 << j), assign)
+                assign.pop()
+
+    search(0, 0, [])
+    if len(distinct) != 1 or budget[0] <= 0:  # exhausted => possibly ambiguous
+        return None
+    out = [None] * n
+    for i, j in enumerate(first_sol):
+        out[j] = cand[i][j]
+    return out
+
+
 def _first_sample(obj, ds, config_dir: str):
     """One sample from the provider, shuffle disabled (is_train=False keeps
     the pool from buffering 1024 samples before the first yield)."""
@@ -166,6 +400,8 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     input_types after init_hook), else first-batch introspection.  Slots
     still unresolved are marked so feeding raises instead of silently using
     a dense placeholder."""
+    if _resolve_proto_data_types(parsed, config_dir):
+        return
     ds = parsed.data_sources
     if ds is None or not ds.module:
         return
@@ -197,13 +433,11 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     itypes = getattr(obj, "input_types", None)
     names = getattr(obj, "slot_names", None)
     hook_error: Optional[BaseException] = None
-    cwd = os.getcwd()
     if itypes is None and hasattr(obj, "resolve_input_types"):
         # hook-declared types (reference initializer pattern); hooks open
         # data files relative to the config/run dir, so resolve from there
         try:
-            os.chdir(config_dir)
-            with _py2_shims():
+            with _in_dir(config_dir), _py2_shims():
                 itypes, names = obj.resolve_input_types(
                     file_list=_read_file_list(ds.train_list, config_dir),
                     **(ds.args or {}),
@@ -211,30 +445,34 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
         except Exception as e:
             hook_error = e
             itypes = None
-        finally:
-            os.chdir(cwd)
     data_confs = list(parsed.topology.data_layers().values())
     if itypes is None and obj is not None:
         # last resort: pull one real sample and infer each slot's type from
         # its value + the data layer's declared size
         try:
-            os.chdir(config_dir)
-            with _py2_shims():
+            with _in_dir(config_dir), _py2_shims():
                 sample = _first_sample(obj, ds, config_dir)
         except Exception as e:
             hook_error = hook_error or e
             sample = None
-        finally:
-            os.chdir(cwd)
-        if sample is not None:
-            items = sample if isinstance(sample, (list, tuple)) else (sample,)
-            inferred = [
-                _infer_slot_type(v, c.size) for v, c in zip(items, data_confs)
+        if sample is not None and not isinstance(sample, (list, tuple)):
+            sample = (sample,)
+        if sample is not None and len(sample) == len(data_confs):
+            # infer each value against each layer's size and take the
+            # unique dim-consistent assignment (positional when it checks;
+            # robust to provider-yield vs feeding-order divergence)
+            cand = [
+                [_infer_slot_type(v, c.size) for c in data_confs]
+                for v in sample
             ]
-            if len(items) == len(data_confs) and all(
-                t is not None for t in inferred
-            ):
-                itypes, names = inferred, None
+            n = len(data_confs)
+            positional = [cand[i][i] for i in range(n)]
+            if all(t is not None for t in positional):
+                aligned = positional
+            else:
+                aligned = _unique_assignment(cand, n)
+            if aligned is not None:
+                itypes, names = aligned, [c.name for c in data_confs]
     if itypes is None:
         _mark_unresolved(
             parsed,
@@ -244,15 +482,27 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
             else "provider declares no input_types",
         )
         return
-    # Declaration order, NOT graph-traversal order — positional provider
-    # types pair with data layers the way readers yield tuples.
-    by_name = dict(zip(names, itypes)) if names else None
+    label = f"{ds.module}.{ds.obj}"
+    if names:
+        by_name = dict(zip(names, itypes))
+        aligned = [by_name.get(c.name) for c in data_confs]
+        bad = [
+            (c.name, c.size, t)
+            for c, t in zip(data_confs, aligned)
+            if t is not None and not _slot_compatible(t, c)
+        ]
+        if bad:
+            raise ValueError(
+                f"{label}: named slot types do not dim-check against their "
+                f"data layers: {bad}"
+            )
+    else:
+        # Positional provider types pair with data layers in FEEDING order
+        # (Inputs()/DFS — see Topology.data_layers), validated against each
+        # layer's declared size; mismatch → unique re-assignment or error.
+        aligned = _bind_slots(list(itypes), data_confs, label)
     resolved = {}
-    for i, conf in enumerate(data_confs):
-        if by_name is not None:
-            t = by_name.get(conf.name)
-        else:
-            t = itypes[i] if i < len(itypes) else None
+    for conf, t in zip(data_confs, aligned):
         if t is not None and conf.attrs.get("_v1_size_only"):
             # LayerConf is frozen; parse-time resolution happens before any
             # compilation, so this is the one sanctioned mutation point.
@@ -275,6 +525,22 @@ def _mark_unresolved(parsed: ParsedConfig, ds, reason: str) -> None:
 
 
 import contextlib
+
+# os.chdir is process-global; the async feeder (reader/prefetch.py) resolves
+# relative paths on a background thread, so provider-side chdirs during a
+# config parse must be exclusive to avoid racing on the cwd.
+_chdir_lock = threading.RLock()
+
+
+@contextlib.contextmanager
+def _in_dir(d: str):
+    with _chdir_lock:
+        cwd = os.getcwd()
+        os.chdir(d)
+        try:
+            yield
+        finally:
+            os.chdir(cwd)
 
 
 @contextlib.contextmanager
@@ -382,6 +648,17 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
         )
     assert state.outputs, f"{label}: config declared no outputs()"
     topo = Topology(list(state.outputs))
+    # Explicit Inputs(...) / inputs(...) pins the feeding order (reference
+    # config_parser.py:205-222: "The data streams from DataProvider must
+    # have the same order").  Without it data_layers() uses DFS order, the
+    # same order the reference's outputs() computes via __dfs_travel__.
+    explicit_inputs = (
+        [l.name for l in state.inputs] if state.inputs else list(state.input_names)
+    )
+    if explicit_inputs and all(
+        n in topo.layers and topo.layers[n].type == "data" for n in explicit_inputs
+    ):
+        topo.input_order = tuple(explicit_inputs)
     parsed = ParsedConfig(
         topology=topo,
         settings=state.settings,

@@ -375,9 +375,11 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
 
 
 def Inputs(*names):
-    """Capital-I config_parser form: declares input LAYER NAMES (strings).
-    Feeding order already follows data-layer declaration order here; the
-    names are recorded for the parse result."""
+    """Capital-I config_parser form: declares input LAYER NAMES (strings)
+    and PINS the feeding order — "the data streams from DataProvider must
+    have the same order" (reference config_parser.py:205-222).  parse_config
+    copies this order onto Topology.input_order; without it feeding order is
+    DFS from the outputs."""
     st = _require_state()
     st.input_names = list(names)
 

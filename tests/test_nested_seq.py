@@ -298,3 +298,134 @@ def test_nested_group_output_is_sequence():
     np.testing.assert_array_equal(np.asarray(o.sub_lengths), np.asarray(x.sub_lengths))
     # padding subsequences are zeroed
     np.testing.assert_allclose(np.asarray(o.data[1, 1]), 0.0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sequence-valued memories (reference sequence-memory frames,
+# RecurrentGradientMachine.cpp:530-608; memory(is_seq=True) in layers.py)
+# ---------------------------------------------------------------------------
+
+
+def _masked_nested(seed=11, B=2, S=3, T=4, D=2):
+    """Nested batch whose padding positions are ZERO (as the feeder
+    produces), so running-sum goldens are exact."""
+    rng = np.random.RandomState(seed)
+    data = rng.randn(B, S, T, D).astype(np.float32)
+    n_sub = np.array([3, 2], np.int32)[:B]
+    sub_len = np.array([[3, 2, 4], [4, 1, 0]], np.int32)[:B, :S]
+    for b in range(B):
+        for s in range(S):
+            lim = sub_len[b, s] if s < n_sub[b] else 0
+            data[b, s, lim:] = 0.0
+    return nested_seq(data, n_sub, sub_len), data, n_sub, sub_len
+
+
+def test_sequence_memory_running_sum():
+    """memory(is_seq=True): each outer step sees the previous step's WHOLE
+    output sequence.  Step = addto(subsequence, prev) -> running elementwise
+    sum of subsequences, verifiable in numpy exactly."""
+    reset_auto_names()
+    x, data, n_sub, sub_len = _masked_nested()
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+
+    def step(sub):
+        prev = layers.memory(name="acc", size=2, is_seq=True)
+        return layers.addto([sub, prev], name="acc")
+
+    out = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    o = _run_layer(out, {"x": x})
+    assert o.is_nested and o.data.shape == (2, 3, 4, 2)
+
+    B, S = data.shape[:2]
+    want = np.zeros_like(data)
+    for b in range(B):
+        carry = np.zeros(data.shape[2:], np.float32)
+        for s in range(S):
+            if s < n_sub[b]:
+                carry = carry + data[b, s]
+                # the emitted step output is a sequence of the addto layer's
+                # declared length (= the subsequence's); padding is masked
+                w = carry.copy()
+                w[sub_len[b, s]:] = 0.0
+                want[b, s] = w
+    np.testing.assert_allclose(np.asarray(o.data), want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(o.lengths), n_sub)
+
+
+def test_sequence_memory_boot_from_sequence_layer():
+    """Booted seq memory: the t=0 carry is an OUTER sequence layer's value
+    (reference memory boot frames)."""
+    reset_auto_names()
+    x, data, n_sub, sub_len = _masked_nested(seed=12)
+    rng = np.random.RandomState(13)
+    boot_np = rng.randn(2, 4, 2).astype(np.float32)
+    boot_len = np.array([4, 2], np.int32)
+    boot_np[1, 2:] = 0.0
+    from paddle_tpu.core.data_types import dense_vector_sequence
+
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+    boot = layers.data("boot", dense_vector_sequence(2))
+
+    def step(sub):
+        prev = layers.memory(name="acc2", size=2, is_seq=True, boot_layer=boot)
+        return layers.addto([sub, prev], name="acc2")
+
+    out = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    outs, _ = net.apply(
+        params,
+        {"x": x, "boot": SeqTensor(jnp.asarray(boot_np), jnp.asarray(boot_len))},
+        state=state,
+        train=False,
+    )
+    o = outs[out.name]
+    B, S = data.shape[:2]
+    want = np.zeros_like(data)
+    for b in range(B):
+        carry = boot_np[b].copy()
+        for s in range(S):
+            if s < n_sub[b]:
+                carry = carry + data[b, s]
+                w = carry.copy()
+                w[sub_len[b, s]:] = 0.0
+                want[b, s] = w
+    np.testing.assert_allclose(np.asarray(o.data), want, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_memory_non_seq_link_raises():
+    """A seq memory whose link resolves to a NON-sequence layer must raise
+    (silent mis-training is the round-2/3 bug this replaces)."""
+    reset_auto_names()
+    x, *_ = _masked_nested(seed=14)
+    inp = layers.data("x", dense_vector_sub_sequence(2))
+
+    def step(sub):
+        prev = layers.memory(name="pooled", size=2, is_seq=True)
+        pooled = layers.pooling(
+            sub, pooling_type="sum", name="pooled"
+        )  # NOT a sequence
+        return pooled
+
+    out = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    net = CompiledNetwork(Topology([out]))
+    with pytest.raises(ValueError, match="not a sequence"):
+        params, state = net.init(jax.random.PRNGKey(0))
+        net.apply(params, {"x": x}, state=state, train=False)
+
+
+def test_sequence_memory_grad():
+    """Gradients flow through the sequence carry."""
+    reset_auto_names()
+    inp = layers.data("x", dense_vector_sub_sequence(3))
+
+    def step(sub):
+        prev = layers.memory(name="accg", size=4, is_seq=True)
+        h = layers.fc(sub, size=4, act=paddle.activation.Tanh())
+        return layers.addto([h, prev], name="accg")
+
+    grp = layers.recurrent_group(step=step, input=SubsequenceInput(inp))
+    out = layers.last_seq(layers.pooling(
+        grp, pooling_type="sum", agg_level=AggregateLevel.TO_SEQUENCE
+    ))
+    check_layer_grad(out, atol=8e-2, rtol=8e-2)

@@ -306,10 +306,13 @@ def test_inner_group_param_shares_with_outer_layer():
     assert "b" not in params.get("g", {}).get("g_unit", {})
 
 
-def test_gru_naive_math_differs_and_matches_reference_formula():
-    """gru_step(naive=True) = the reference's gru_step_naive_layer: reset
-    applied to the previous state BEFORE the candidate matmul, and the
-    update gate mixing inverted (h*(1-u) + c*u)."""
+def test_gru_fused_and_naive_share_reference_recurrence():
+    """GruStepLayer.cpp and gru_step_naive_layer lower to the SAME GruCompute
+    recurrence in the reference (hl_gru_ops.cuh gru_resetOutput/
+    gru_finalOutput, hl_cpu_gru.cuh:238-253): c = act(x_c + (r⊙h₋)·W_c),
+    h = (1-u)⊙h₋ + u⊙c.  With identical params both paths must produce
+    identical outputs, and both must match a numpy transcription of the
+    reference formula."""
     reset_auto_names()
     din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
     fused = networks.gru_group(din, size=H, name="fused")
@@ -322,12 +325,11 @@ def test_gru_naive_math_differs_and_matches_reference_formula():
     batch = {"x": _var_len_batch(3 * H, seed=3)}
     outs, _ = net.apply(params, batch, state=state, train=False)
 
-    # same params, different math
-    a = np.asarray(outs["fused"].data)
-    b = np.asarray(outs["naive"].data)
-    assert not np.allclose(a[:, :1], b[:, :1], rtol=1e-4)
+    # same params, SAME math (reference checkpoints produce identical
+    # outputs whichever layer type a config uses)
+    _assert_valid_close(outs["fused"].data, np.asarray(outs["naive"].data))
 
-    # numpy transcription of the reference naive formulas
+    # numpy transcription of the reference GruCompute formula
     p = jax.tree_util.tree_map(np.asarray, params["naive"]["naive_unit"])
     x = np.asarray(batch["x"].data)
     h_prev = np.zeros((B, H), np.float32)
@@ -344,6 +346,63 @@ def test_gru_naive_math_differs_and_matches_reference_formula():
         h_prev = np.where(alive, h_t, h_prev)
         want[:, t] = h_prev
     _assert_valid_close(outs["naive"].data, want)
+    _assert_valid_close(outs["fused"].data, want)
+
+
+def test_gru_naive_named_param_ties_three_blocks():
+    """Reference gru_step_naive_layer with a NAMED param_attr hands the same
+    name to all three full_matrix_projections — one shared H×H recurrent
+    matrix.  naive=True + ParamAttr(name=...) must build a single tied `w`
+    and match the formula with U_u = U_r = W_c = w."""
+    from paddle_tpu.layers.recurrent_group import memory, recurrent_group
+
+    reset_auto_names()
+    din = L.data("x", paddle.data_type.dense_vector_sequence(3 * H))
+
+    def step(ipt):
+        mem = memory(name="tied_out", size=H)
+        return L.gru_step(
+            input=ipt,
+            output_mem=mem,
+            size=H,
+            naive=True,
+            param_attr=paddle.attr.ParamAttr(name="shared_w"),
+            name="tied_out",
+        )
+
+    out = recurrent_group(step=step, input=din, name="tied_grp")
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(4))
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    # one H×H recurrent weight + one 3H bias — no w_h/w_c pair
+    shapes = sorted(tuple(l.shape) for l in leaves)
+    assert (H, H) in shapes and (H, 2 * H) not in shapes, shapes
+
+    batch = {"x": _var_len_batch(3 * H, seed=5)}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    flat = {
+        "/".join(map(str, path)): np.asarray(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=lambda x: hasattr(x, "shape")
+        )[0]
+    }
+    w = next(v for v in flat.values() if v.shape == (H, H))
+    b = next((v for v in flat.values() if v.shape == (3 * H,)), None)
+    x = np.asarray(batch["x"].data)
+    h_prev = np.zeros((B, H), np.float32)
+    want = np.zeros((B, T, H), np.float32)
+    for t in range(T):
+        xt = x[:, t] + (b if b is not None else 0.0)
+        x_u, x_r, x_c = np.split(xt, 3, axis=-1)
+        hw = h_prev @ w
+        u = 1.0 / (1.0 + np.exp(-(x_u + hw)))
+        r = 1.0 / (1.0 + np.exp(-(x_r + hw)))
+        c = np.tanh(x_c + (r * h_prev) @ w)
+        h_t = (1.0 - u) * h_prev + u * c
+        alive = (t < LENS)[:, None]
+        h_prev = np.where(alive, h_t, h_prev)
+        want[:, t] = h_prev
+    _assert_valid_close(outs["tied_grp"].data, want)
 
 
 def test_two_inner_declarers_chain_to_outer_owner():

@@ -3,6 +3,7 @@ beam/greedy generation — the test_recurrent_machine_generation.cpp equivalent
 (reference: paddle/trainer/tests/test_recurrent_machine_generation.cpp checks
 beam-search output against a golden model dir)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -12,6 +13,10 @@ from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
 
 VOCAB = 16
 BOS, EOS = 0, 1
+# Readers yield (src, trg, trg_next); the topology's DFS feeding order
+# visits trg_word first (the cost's decoder subtree) — map explicitly, the
+# reference v2 feeding= contract (v2/trainer.py:107 train(feeding=...))
+FEEDING = {"src_word": 0, "trg_word": 1, "trg_next": 2}
 
 
 def copy_task_reader(n=512, seed=0):
@@ -46,6 +51,7 @@ def trained():
         event_handler=lambda e: costs.append(e.cost)
         if isinstance(e, paddle.event.EndIteration)
         else None,
+        feeding=FEEDING,
     )
     return trainer, costs
 
@@ -56,7 +62,7 @@ def test_nmt_cost_decreases(trained):
 
 
 def _gen_batch(trainer, samples):
-    feeder = paddle.reader.DataFeeder(trainer.topology.data_types())
+    feeder = paddle.reader.DataFeeder(trainer.topology.data_types(), FEEDING)
     return feeder(samples)
 
 
@@ -105,3 +111,101 @@ def test_beam_search_generation(trained):
         lg = int(np.asarray(lens_g)[i])
         greedy_match += toks_g[i, :lg].tolist() == src
     assert top_match >= greedy_match - 1  # beam should not be much worse
+
+
+# ---------------------------------------------------------------------------
+# beam-search user hooks (reference BeamSearchControlCallbacks,
+# RecurrentGradientMachine.h:70-120 + diy_beam_search_prob_so .cpp:27):
+# candidate-adjust / drop / norm as restricted in-graph functions
+# ---------------------------------------------------------------------------
+
+
+def _toy_step_fn(vocab, eos_id):
+    """Deterministic toy LM: fixed preferences 1 > 2 > 3 > ... regardless of
+    state, eos least preferred."""
+    logits = np.full((vocab,), -10.0, np.float32)
+    for k in range(1, vocab - 1):
+        logits[k] = -0.5 * k
+    logits[eos_id] = -9.0
+    logp = np.log(np.exp(logits) / np.exp(logits).sum())
+
+    def step_fn(ids, carry):
+        return jnp.asarray(np.tile(logp, (ids.shape[0], 1))), carry
+
+    return step_fn
+
+
+def test_beam_candidate_adjust_hook_bans_token():
+    from paddle_tpu.ops.beam import beam_search
+
+    V, B, K, T, EOS_ = 6, 2, 3, 4, 5
+    step_fn = _toy_step_fn(V, EOS_)
+    seqs, _ = beam_search(step_fn, {}, B, K, V, bos_id=0, eos_id=EOS_, max_len=T)
+    assert (np.asarray(seqs)[:, 0] == 1).all()  # unconstrained: best token
+
+    def ban_1(logp, prefix, t):
+        return logp.at[:, 1].set(-1e9)
+
+    seqs2, _ = beam_search(
+        step_fn, {}, B, K, V, bos_id=0, eos_id=EOS_, max_len=T,
+        candidate_adjust_fn=ban_1,
+    )
+    s2 = np.asarray(seqs2)
+    assert (s2 != 1).all()
+    assert (s2[:, 0] == 2).all()  # next-best takes over
+
+
+def test_beam_drop_hook_prunes_paths():
+    from paddle_tpu.ops.beam import beam_search
+
+    V, B, K, T, EOS_ = 6, 2, 3, 4, 5
+    step_fn = _toy_step_fn(V, EOS_)
+
+    def drop_12(seqs, ids, scores, t):
+        return (ids == 1) | (ids == 2)  # drop any path extended with 1 or 2
+
+    seqs, scores = beam_search(
+        step_fn, {}, B, K, V, bos_id=0, eos_id=EOS_, max_len=T,
+        drop_fn=drop_12,
+    )
+    s = np.asarray(seqs)
+    # the surviving best path uses token 3 throughout
+    assert (s[:, 0] == 3).all()
+    assert (np.asarray(scores)[:, 0] > -1e8).all()
+
+
+def test_beam_norm_hook_rescores_final_ranking():
+    from paddle_tpu.ops.beam import beam_search
+
+    V, B, K, T, EOS_ = 6, 1, 3, 4, 5
+    step_fn = _toy_step_fn(V, EOS_)
+    seqs, scores = beam_search(
+        step_fn, {}, B, K, V, bos_id=0, eos_id=EOS_, max_len=T
+    )
+    base_top = np.asarray(seqs)[0, 0].copy()
+
+    def invert(scores, seqs, lengths):
+        return -scores  # pathological on purpose: rank inversion
+
+    seqs2, scores2 = beam_search(
+        step_fn, {}, B, K, V, bos_id=0, eos_id=EOS_, max_len=T,
+        norm_fn=invert,
+    )
+    # the former best is now ranked last; scores still reported sorted
+    assert (np.asarray(seqs2)[0, -1] == base_top).all()
+    assert (np.diff(np.asarray(scores2), axis=1) <= 1e-6).all()
+
+
+def test_beam_hooks_through_dsl_layer(trained):
+    """Hooks plumb through the layers.beam_search DSL face: banning token 1
+    via candidate_adjust_fn keeps it out of the generated ids entirely."""
+    trainer, _ = trained
+    gen = Seq2SeqGenerator(
+        trainer.parameters, VOCAB, VOCAB, word_dim=24, hidden_dim=32,
+        bos_id=BOS, eos_id=EOS, max_length=10, beam_size=3,
+        candidate_adjust_fn=lambda logp, prefix, t: logp.at[:, 1].set(-1e9),
+    )
+    samples = list(copy_task_reader(n=8, seed=21)())
+    batch = _gen_batch(trainer, samples)
+    seqs, _ = gen.generate(batch)
+    assert (np.asarray(seqs) != 1).all()

@@ -96,6 +96,11 @@ def test_mha_causal_masks_future():
     assert np.abs(a[0, 3] - b[0, 3]).max() > 1e-3  # last position did change
 
 
+# Readers yield (src, trg, trg_next); DFS feeding order visits the decoder
+# subtree (trg_word) first — map explicitly (reference v2 feeding= contract).
+_FEEDING = {"src_word": 0, "trg_word": 1, "trg_next": 2}
+
+
 def test_transformer_trains_on_copy_task():
     reset_auto_names()
     V, BOS, EOS = 14, 0, 1
@@ -120,6 +125,7 @@ def test_transformer_trains_on_copy_task():
         num_passes=10,
         event_handler=lambda e: costs.append(e.cost)
         if isinstance(e, paddle.event.EndIteration) else None,
+        feeding=_FEEDING,
     )
     assert np.mean(costs[-5:]) < 0.6 * np.mean(costs[:5]), (
         costs[:5], costs[-5:],
@@ -133,6 +139,8 @@ def test_transformer_infer():
     cost, logits = transformer_cost(V, V, d_model=16, n_heads=2, n_layers=1, d_ff=32)
     params = paddle.parameters.create(cost)
     samples = [([2, 3, 4], [0, 2, 3, 4], [2, 3, 4, 1]), ([5, 6], [0, 5, 6], [5, 6, 1])]
-    probs = paddle.infer(output_layer=logits, parameters=params, input=samples)
+    probs = paddle.infer(
+        output_layer=logits, parameters=params, input=samples, feeding=_FEEDING
+    )
     assert probs.shape == (7, V)  # 4 + 3 decoder timesteps
     np.testing.assert_allclose(probs.sum(1), 1.0, rtol=1e-3)

@@ -151,9 +151,10 @@ def test_quick_start_lr_trains_end_to_end(dict_dir):
 
 
 def test_positional_provider_types_pair_by_declaration_order(tmp_path):
-    """Positional provider input_types must map to data layers in DECLARATION
-    order even when graph-traversal order differs (label declared first but
-    the cost graph visits pixel's subtree first)."""
+    """Provider slot types that do not dim-check positionally against the
+    feeding order (DFS from outputs — here [pixel, label], though label is
+    declared first) are re-bound via the unique dim-consistent assignment:
+    dense(784) can only be the 784-wide pixel layer."""
     cfg = tmp_path / "conf.py"
     cfg.write_text(
         "from paddle.trainer_config_helpers import *\n"
@@ -178,6 +179,124 @@ def test_positional_provider_types_pair_by_declaration_order(tmp_path):
     assert p.provider_input_types["label"].kind == SlotKind.INDEX
     assert p.provider_input_types["pixel"].kind == SlotKind.DENSE
     assert p.provider_input_types["pixel"].dim == 784
+
+
+def test_label_first_config_feeds_in_dfs_order(tmp_path):
+    """The googlenet regression (BENCH_r03): config declares label BEFORE
+    input (benchmark/paddle/image/googlenet.py:146-147) while the provider's
+    init_hook yields (image, label) — reference feeding order is DFS from
+    the outputs (networks.py:1412 outputs() __dfs_travel__), so the dense
+    image slot must bind to the image layer and an end-to-end feed + train
+    step must run."""
+    import jax
+    import numpy as np
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_py_data_sources2(train_list='t', test_list=None,\n"
+        "                        module='prov_lf', obj='process')\n"
+        "settings(batch_size=4, learning_rate=1e-3,\n"
+        "         learning_method=MomentumOptimizer())\n"
+        "lbl = data_layer(name='label', size=10)\n"
+        "img = data_layer(name='input', size=48)\n"
+        "fc1 = fc_layer(input=img, size=10, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=fc1, label=lbl))\n"
+    )
+    # init_hook-declared slots, image first — the googlenet provider.py shape
+    (tmp_path / "prov_lf.py").write_text(
+        "from paddle.trainer.PyDataProvider2 import *\n"
+        "def hook(settings, **kw):\n"
+        "    settings.slots = [dense_vector(48), integer_value(10)]\n"
+        "@provider(init_hook=hook)\n"
+        "def process(settings, f):\n"
+        "    for i in range(8):\n"
+        "        yield [0.1] * 48, i % 10\n"
+    )
+    p = parse_config(str(cfg))
+    from paddle_tpu.core.data_types import SlotKind
+
+    order = list(p.topology.data_layers())
+    assert order == ["input", "label"], order
+    dtypes = p.topology.data_types()
+    assert dict(dtypes)["input"].kind == SlotKind.DENSE
+    assert dict(dtypes)["input"].dim == 48
+    assert dict(dtypes)["label"].kind == SlotKind.INDEX
+
+    # end-to-end: feed rows in feeding order through the real converter and
+    # take one train step (this is exactly what bench_googlenet does)
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.reader.feeder import DataFeeder
+    from paddle_tpu.trainer.step import make_train_step
+    from paddle_tpu.v1_compat import make_optimizer
+
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    opt = make_optimizer(p.settings)
+    opt_state = opt.init(params)
+    step = make_train_step(net, opt, mesh=None)
+    feeder = DataFeeder(dtypes)
+    rows = [(np.full(48, 0.1, np.float32), i % 10) for i in range(4)]
+    params, state, opt_state, m = step(
+        params, state, opt_state, feeder(rows), jax.random.PRNGKey(1)
+    )
+    assert np.isfinite(float(m["cost"]))
+
+
+def test_first_sample_inference_binds_by_dim(tmp_path):
+    """Introspection path (no declared types): a label-first config whose
+    provider yields (image, label) must still resolve via the unique
+    dim-consistent assignment, and int lists must infer as id sequences,
+    never dense (even when len(list) == size)."""
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_py_data_sources2(train_list='t', test_list=None,\n"
+        "                        module='prov_inf', obj='process')\n"
+        "settings(batch_size=4, learning_rate=1e-3)\n"
+        "lbl = data_layer(name='label', size=7)\n"
+        "img = data_layer(name='input', size=32)\n"
+        "emb = embedding_layer(input=data_layer(name='ids', size=32), size=8)\n"
+        "pooled = pooling_layer(input=emb, pooling_type=SumPooling())\n"
+        "fc1 = fc_layer(input=[img, pooled], size=7, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=fc1, label=lbl))\n"
+    )
+    # no input_types, no hook types: first-sample introspection.  The ids
+    # slot yields a 32-long int list — len == the ids layer size (32), the
+    # ambiguity ADVICE flagged — and must still infer as a sequence.
+    (tmp_path / "prov_inf.py").write_text(
+        "from paddle.trainer.PyDataProvider2 import *\n"
+        "@provider()\n"
+        "def process(settings, f):\n"
+        "    for i in range(8):\n"
+        "        yield [0.1] * 32, [3] * 32, i % 7\n"
+    )
+    p = parse_config(str(cfg))
+    from paddle_tpu.core.data_types import SeqLevel, SlotKind
+
+    t = dict(p.topology.data_types())
+    assert t["input"].kind == SlotKind.DENSE and t["input"].dim == 32
+    assert t["ids"].kind == SlotKind.INDEX and t["ids"].seq == SeqLevel.SEQ
+    assert t["label"].kind == SlotKind.INDEX and t["label"].seq == SeqLevel.NONE
+
+
+def test_explicit_inputs_pins_feeding_order(tmp_path):
+    """Capital-I Inputs(...) fixes the feeding order regardless of graph
+    shape (reference config_parser.py:205-222)."""
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=4, learning_rate=1e-3)\n"
+        "lbl = data_layer(name='label', size=10)\n"
+        "img = data_layer(name='pixel', size=16)\n"
+        "Inputs('label', 'pixel')\n"
+        "fc1 = fc_layer(input=img, size=10, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=fc1, label=lbl))\n"
+    )
+    p = parse_config(str(cfg))
+    assert list(p.topology.data_layers()) == ["label", "pixel"]
 
 
 @pytest.mark.parametrize("mode", ["generator_training", "discriminator_training", "generator"])
