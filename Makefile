@@ -1,0 +1,26 @@
+# Test/verify entry points (the reference's build-scripts plane,
+# paddle/scripts/travis/, as make targets).
+#
+#   make test    — fast tier: every test not marked `slow`; < 5 min on the
+#                  virtual 8-device CPU mesh.  This is the default CI gate.
+#   make verify  — the full suite, then a bench smoke (one metric) and the
+#                  8-device multichip dry-run compile.
+#   make bench   — the full benchmark set (one JSON line per metric).
+
+PY ?= python
+CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+
+.PHONY: test verify bench test-all
+
+test:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow"
+
+test-all:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+verify: test-all
+	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_allreduce_virtual8())"
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py

@@ -52,6 +52,56 @@ def _sync(metrics) -> float:
     return float(metrics["cost"])
 
 
+# bf16 peak TFLOP/s per chip by device kind (public specs) — for the MFU
+# fields (reference prints hierarchical timer tables per log period,
+# paddle/utils/Stat.h:230; here each metric carries achieved TFLOP/s and
+# %-of-peak so "14% MFU" is said out loud in the bench output itself)
+_PEAK_TFLOPS = (
+    ("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0), ("v6", 918.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 46.0),
+)
+
+
+def _peak_tflops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_TFLOPS:
+        if key in kind:
+            return peak
+    return 197.0  # assume v5e-class when unknown
+
+
+def _aot(jitted, *args):
+    """AOT-compile the step once and return (runner, flops-per-execution
+    from XLA's own cost analysis).  The runner IS the compiled executable —
+    benches must call it for their timed loop, otherwise the traced jit
+    path compiles the identical program a second time (measured: the
+    dispatch cache is not populated by lower().compile()).  Must run BEFORE
+    the first call: the step donates its buffers."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        return compiled, (f if f > 0 else None)
+    except Exception:
+        return jitted, None
+
+
+def _mfu_fields(flops, sec_per_iter: float) -> dict:
+    """{"tflops": achieved, "mfu": fraction-of-peak} — empty when XLA gave
+    no cost analysis."""
+    if not flops or sec_per_iter <= 0:
+        return {}
+    tflops = flops / sec_per_iter / 1e12
+    return {
+        "tflops": round(tflops, 2),
+        "mfu": round(tflops / _peak_tflops(), 4),
+    }
+
+
 def bench_resnet() -> dict:
     import jax
     import jax.numpy as jnp
@@ -88,6 +138,9 @@ def bench_resnet() -> dict:
         for _ in range(4)
     ]
 
+    step, flops = _aot(
+        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
     params, state, opt_state, m = step(
         params, state, opt_state, batches[0], jax.random.PRNGKey(1)
     )
@@ -108,6 +161,9 @@ def bench_resnet() -> dict:
         "value": round(img_per_sec, 2),
         "unit": "images/sec",
         "vs_baseline": round(img_per_sec / TARGET_IMG_S, 4),
+        "step_ms": round(dt / iters * 1e3, 2),
+        "feed": "pre-staged device batches (feed excluded by design)",
+        **_mfu_fields(flops, dt / iters),
     }
 
 
@@ -151,6 +207,9 @@ def bench_nmt() -> dict:
         }
 
     batches = [mk() for _ in range(4)]
+    step, flops = _aot(
+        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
     params, state, opt_state, m = step(
         params, state, opt_state, batches[0], jax.random.PRNGKey(1)
     )
@@ -171,6 +230,8 @@ def bench_nmt() -> dict:
         "value": round(tok_per_sec, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_sec / TARGET_NMT_TOK_S, 4),
+        "step_ms": round(dt / iters * 1e3, 2),
+        **_mfu_fields(flops, dt / iters),
     }
 
 
@@ -409,6 +470,9 @@ def bench_transformer() -> dict:
         }
 
     batches = [mk() for _ in range(4)]
+    step, flops = _aot(
+        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
     params, state, opt_state, m = step(
         params, state, opt_state, batches[0], jax.random.PRNGKey(1)
     )
@@ -429,6 +493,8 @@ def bench_transformer() -> dict:
         "value": round(tok_per_sec, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(tok_per_sec / TARGET_TRANSFORMER_TOK_S, 4),
+        "step_ms": round(dt / iters * 1e3, 2),
+        **_mfu_fields(flops, dt / iters),
     }
 
 
@@ -480,6 +546,9 @@ def bench_transformer_long_context() -> dict:
             }
 
         batches = [mk() for _ in range(2)]
+        step, flops = _aot(
+            step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+        )
         params, state, opt_state, m = step(
             params, state, opt_state, batches[0], jax.random.PRNGKey(1)
         )
@@ -504,6 +573,8 @@ def bench_transformer_long_context() -> dict:
         "unit": "tokens/sec",
         "seq_len": seq_len,
         "vs_baseline": round(tok_per_sec / TARGET_TRANSFORMER_TOK_S, 4),
+        "step_ms": round(dt / iters * 1e3, 2),
+        **_mfu_fields(flops, dt / iters),
     }
 
 
@@ -561,6 +632,9 @@ def bench_lstm_textcls() -> dict:
         }
         for _ in range(4)
     ]
+    step, flops = _aot(
+        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
     params, state, opt_state, m = step(
         params, state, opt_state, batches[0], jax.random.PRNGKey(1)
     )
@@ -579,6 +653,7 @@ def bench_lstm_textcls() -> dict:
         "value": round(ms_per_batch, 2),
         "unit": "ms/batch",
         "vs_baseline": round(ref_ms / ms_per_batch, 4),
+        **_mfu_fields(flops, ms_per_batch / 1e3),
     }
 
 
@@ -634,12 +709,17 @@ def _bench_reference_image_config(
                 out.append(int(rng.randint(num_class)))
         return tuple(out)
 
-    batches = [
-        jax.tree_util.tree_map(
-            jax.device_put, feeder([row() for _ in range(batch_size)])
-        )
-        for _ in range(4)
+    t_feed = time.perf_counter()
+    host_batches = [
+        feeder([row() for _ in range(batch_size)]) for _ in range(4)
     ]
+    feed_ms = (time.perf_counter() - t_feed) / 4 * 1e3  # host feed per batch
+    batches = [
+        jax.tree_util.tree_map(jax.device_put, hb) for hb in host_batches
+    ]
+    step, flops = _aot(
+        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
+    )
     params, state, opt_state, m = step(
         params, state, opt_state, batches[0], jax.random.PRNGKey(1)
     )
@@ -658,6 +738,8 @@ def _bench_reference_image_config(
         "value": round(ms, 2),
         "unit": "ms/batch",
         "vs_baseline": round(ref_ms / ms, 4),
+        "host_feed_ms_per_batch": round(feed_ms, 2),
+        **_mfu_fields(flops, ms / 1e3),
     }
 
 

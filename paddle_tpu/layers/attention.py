@@ -150,10 +150,11 @@ def mha_apply(conf, params, inputs, ctx):
             and jax.default_backend() == "tpu"
             and fa.supported(tq, dh)
         ):
+            bq, bk = fa.auto_blocks(tq)
             out = fa.flash_attention_diff(
                 q, k, v,
                 kv_in.lengths if kv_in.is_seq else None,
-                causal, 128, 128, False,
+                causal, bq, bk, False,
             ).reshape(b, tq, d)
 
     if out is None:  # dense path

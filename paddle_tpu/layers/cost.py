@@ -168,3 +168,17 @@ def ce_selfnorm_apply(conf, params, inputs, ctx):
     )
     cost = -jnp.log(jnp.maximum(p, _EPS)) + alpha * jnp.square(jnp.log(jnp.maximum(z, _EPS)))
     return _per_sample(cost, prob)
+
+
+@register_layer("multi_nn_cost", auto_activation=False, full_precision=True)
+def multi_nn_cost_apply(conf, params, inputs, ctx):
+    """Joint training objective of a model_type('multi_nn') ensemble: the
+    sum of every sub-network's mean cost — the reference trainer sums all
+    output Arguments of MultiNetwork::forward (Argument::sum over outArgs,
+    TrainerInternal.cpp), which concatenates the sub-networks' outputs
+    (MultiNetwork.cpp:67-95).  Gradients flow into every sub-network from
+    this single scalar."""
+    total = 0.0
+    for t in inputs:
+        total = total + jnp.mean(t.data)
+    return SeqTensor(jnp.broadcast_to(total, (1,)))

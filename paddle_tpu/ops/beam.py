@@ -101,7 +101,11 @@ def beam_search(
         new_seqs = jnp.take(seqs, parent, axis=0)  # reorder histories
         new_seqs = new_seqs.at[:, t].set(new_ids)
         if drop_fn is not None:
+            # never re-judge an already-finished hypothesis: its tail is
+            # forced eos padding the user hook should not see (the reference
+            # applies DropCallback to live expansion candidates only)
             drop = drop_fn(new_seqs, new_ids, new_scores, t)
+            drop &= ~jnp.take(finished, parent)
             new_scores = jnp.where(drop, NEG_INF, new_scores)
         return (new_ids, new_scores, new_finished, new_carry, new_seqs, t + 1), None
 

@@ -55,6 +55,22 @@ def supported(t: int, dh: int) -> bool:
     return t % min(128, t) == 0 and dh % 8 == 0 and t >= 128
 
 
+def auto_blocks(t: int) -> tuple:
+    """v5e-tuned (block_q, block_k) for sequence length t, from an on-chip
+    sweep of the fwd+bwd train path (bq in {128..2048} x bk in {128..1024},
+    B=8/T=1024 and B=4/T=2048, bf16): large query blocks win — fewer grid
+    steps and better MXU pipelining — with bk=512 the sweet spot:
+      T=1024: 128/128 6.03 ms -> 512/512 4.28 ms
+      T=2048: 128/128 8.51 ms -> 1024/512 3.66 ms"""
+    bq = min(max(t // 2, 128), 1024)
+    while t % bq:
+        bq //= 2
+    bk = min(512, t)
+    while t % bk:
+        bk //= 2
+    return bq, bk
+
+
 # ---------------------------------------------------------------------------
 # backward kernels — the standard two-pass flash backward:
 #   forward additionally emits LSE (log-sum-exp per query row) so p = exp(s -
@@ -67,7 +83,11 @@ def _fa_fwd_kernel(
     len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk, t, causal, scale, bq
 ):
     qi = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32) * scale
+    # Keep MXU operands in the INPUT dtype (bf16 on the bench path): the MXU
+    # is bf16-native, and f32 operands with Precision.HIGHEST cost multiple
+    # passes — accumulation stays f32 via preferred_element_type (the
+    # standard TPU flash recipe; softmax statistics are always f32).
+    q = q_ref[...]
     dh = q.shape[-1]
     q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
     valid_len = len_ref[pl.program_id(0)]
@@ -75,13 +95,12 @@ def _fa_fwd_kernel(
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * bk, bk), :]
+        v = v_ref[pl.ds(j * bk, bk), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        ) * scale
         k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
         mask = k_pos[None, :] < valid_len
         if causal:
@@ -92,8 +111,7 @@ def _fa_fwd_kernel(
         p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
         corr = jnp.exp(m - m_new)
         acc = acc * corr[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
         )
         l = l * corr + jnp.sum(p, axis=-1)
         return m_new, l, acc
@@ -117,8 +135,8 @@ def _fa_bwd_dq_kernel(
     *, bk, t, causal, scale, bq
 ):
     qi = pl.program_id(2)
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
+    q = q_ref[...]
+    do = do_ref[...]
     lse = lse_ref[pl.ds(qi * bq, bq), 0]
     delta = delta_ref[pl.ds(qi * bq, bq), 0]
     q_pos = qi * bq + jax.lax.iota(jnp.int32, bq)
@@ -126,12 +144,11 @@ def _fa_bwd_dq_kernel(
     nk = t // bk
 
     def body(j, dq):
-        k = k_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[pl.ds(j * bk, bk), :].astype(jnp.float32)
+        k = k_ref[pl.ds(j * bk, bk), :]
+        v = v_ref[pl.ds(j * bk, bk), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         ) * scale
         k_pos = j * bk + jax.lax.iota(jnp.int32, bk)
         mask = k_pos[None, :] < valid_len
@@ -141,15 +158,13 @@ def _fa_bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jnp.dot(
             ds, k, preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         )
 
-    dq0 = jnp.zeros_like(q)
+    dq0 = jnp.zeros(q.shape, jnp.float32)  # f32 accumulator (q may be bf16)
     upper = ((qi + 1) * bq + bk - 1) // bk if causal else nk
     dq = jax.lax.fori_loop(0, upper, body, dq0)
     dq_ref[...] = dq.astype(dq_ref.dtype)
@@ -160,48 +175,45 @@ def _fa_bwd_dkv_kernel(
     *, bq_loop, t, causal, scale, bk
 ):
     ki = pl.program_id(2)
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
+    k = k_ref[...]
+    v = v_ref[...]
     k_pos = ki * bk + jax.lax.iota(jnp.int32, bk)
     valid_len = len_ref[pl.program_id(0)]
     nq = t // bq_loop
 
     def body(j, carry):
         dk, dv = carry
-        q = q_ref[pl.ds(j * bq_loop, bq_loop), :].astype(jnp.float32)
-        do = do_ref[pl.ds(j * bq_loop, bq_loop), :].astype(jnp.float32)
+        q = q_ref[pl.ds(j * bq_loop, bq_loop), :]
+        do = do_ref[pl.ds(j * bq_loop, bq_loop), :]
         lse = lse_ref[pl.ds(j * bq_loop, bq_loop), 0]
         delta = delta_ref[pl.ds(j * bq_loop, bq_loop), 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         ) * scale  # [bq, bk]
         q_pos = j * bq_loop + jax.lax.iota(jnp.int32, bq_loop)
         mask = k_pos[None, :] < valid_len
         if causal:
             mask &= k_pos[None, :] <= q_pos[:, None]
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [bq, bk]
+        p_b = p.astype(do.dtype)
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_b, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         )  # p^T @ do: [bk, dh]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         )  # [bq, bk]
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
         )  # ds^T @ q: [bk, dh]
         return dk, dv
 
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
+    dk0 = jnp.zeros(k.shape, jnp.float32)  # f32 accumulators (k/v may be bf16)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
     # causal: query blocks strictly before this key block's diagonal see
     # none of these keys — start at the diagonal
     lower = (ki * bk) // bq_loop if causal else 0

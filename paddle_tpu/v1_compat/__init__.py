@@ -627,6 +627,10 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
         set_layer_sink(prev_sink)
 
     label = config_file or getattr(config, "__name__", "<callable config>")
+    if state.submodel_stack:
+        raise ValueError(f"{label}: SubModelBegin without matching SubModelEnd")
+    if state.model_type_name == "multi_nn" and state.submodels:
+        _assemble_multi_nn(state, label)
     if state.pending_output_names:  # capital-O Outputs(name, ...) form
         # reference alias: the beam-search generator registers its predict
         # layer as __beam_search_predict__ (config_parser) — map it to the
@@ -658,7 +662,21 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
     if explicit_inputs and all(
         n in topo.layers and topo.layers[n].type == "data" for n in explicit_inputs
     ):
-        topo.input_order = tuple(explicit_inputs)
+        # pin only a COMPLETE ordering: a partial Inputs() list must not
+        # shrink the feed contract (data_layers() returns input_order
+        # verbatim — a missing slot would silently vanish from feeding)
+        all_data = {
+            n for n, c in topo.layers.items() if c.type == "data"
+        }
+        if set(explicit_inputs) == all_data:
+            topo.input_order = tuple(explicit_inputs)
+        else:
+            warnings.warn(
+                f"{label}: Inputs({explicit_inputs}) does not cover every "
+                f"data layer ({sorted(all_data)}); falling back to DFS "
+                "feeding order",
+                stacklevel=2,
+            )
     parsed = ParsedConfig(
         topology=topo,
         settings=state.settings,
@@ -675,6 +693,46 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
     )
     _resolve_provider_types(parsed, config_dir)
     return parsed
+
+
+def _assemble_multi_nn(state, label: str) -> None:
+    """model_type('multi_nn') ensembles (reference MultiNetwork.cpp,
+    ModelConfig.proto:579): each SubModelBegin/End block declared its own
+    Inputs/Outputs; the whole ensemble compiles into ONE jitted program
+    whose training objective is the summed sub-network cost (multi_nn_cost
+    layer — the reference sums all of MultiNetwork::forward's concatenated
+    outArgs).  Feeding order = the sub-models' Inputs() concatenated in
+    declaration order (the reference splits inArgs by dataId per
+    sub-network, MultiNetwork.cpp:70)."""
+    from paddle_tpu.core.topology import LayerConf as _LC, LayerOutput as _LO
+
+    sub_outs: List[LayerOutput] = []
+    for sm in state.submodels:
+        if not sm["outputs"]:
+            raise ValueError(
+                f"{label}: multi_nn submodel {sm['name']!r} declares no Outputs"
+            )
+        for n in sm["outputs"]:
+            if n not in state.all_layers:
+                raise KeyError(
+                    f"{label}: submodel {sm['name']!r} output {n!r} was "
+                    "never built"
+                )
+            sub_outs.append(state.all_layers[n])
+    joint = _LO(
+        _LC(
+            name="__multi_nn_cost__",
+            type="multi_nn_cost",
+            size=1,
+            inputs=tuple(o.name for o in sub_outs),
+            bias=False,
+        ),
+        sub_outs,
+    )
+    state.outputs = [joint] + sub_outs
+    state.pending_output_names = []
+    if not state.input_names:
+        state.input_names = [n for sm in state.submodels for n in sm["inputs"]]
 
 
 def make_optimizer(settings: TrainerSettings):

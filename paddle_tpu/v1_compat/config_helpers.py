@@ -230,6 +230,12 @@ class _ParseState:
         self.input_names: List[str] = []
         self.pending_output_names: List[str] = []
         self.all_layers: Dict[str, LayerOutput] = {}
+        # model_type('multi_nn') ensembles (reference MultiNetwork.cpp,
+        # ModelConfig.proto:579 SubModelConfig): each SubModelBegin/End
+        # block records its own Inputs/Outputs
+        self.model_type_name: Optional[str] = None
+        self.submodels: List[dict] = []
+        self.submodel_stack: List[dict] = []
 
 
 _state: Optional[_ParseState] = None
@@ -379,16 +385,49 @@ def Inputs(*names):
     and PINS the feeding order — "the data streams from DataProvider must
     have the same order" (reference config_parser.py:205-222).  parse_config
     copies this order onto Topology.input_order; without it feeding order is
-    DFS from the outputs."""
+    DFS from the outputs.  Inside a SubModelBegin block the names belong to
+    that sub-model (multi_nn groups slots per sub-network the way the
+    reference splits inArgs by dataId, MultiNetwork.cpp:70)."""
     st = _require_state()
-    st.input_names = list(names)
+    if st.submodel_stack:
+        st.submodel_stack[-1]["inputs"].extend(names)
+    else:
+        # APPEND, like the reference (config_parser.py:212 appends each name
+        # to input_layer_names) — configs may declare Inputs incrementally
+        st.input_names.extend(names)
 
 
 def Outputs(*names):
     """Capital-O form: output layer NAMES (strings) — parse_config resolves
-    them against every layer built during the exec (LayerOutput sink)."""
+    them against every layer built during the exec (LayerOutput sink).
+    Inside a SubModelBegin block the names are that sub-model's outputs."""
     st = _require_state()
-    st.pending_output_names = list(names)
+    if st.submodel_stack:
+        st.submodel_stack[-1]["outputs"].extend(names)
+    else:
+        st.pending_output_names = list(names)
+
+
+def SubModelBegin(name):
+    """Open a sub-model block (reference config_parser.py:249; consumed by
+    MultiNetwork for model_type('multi_nn') ensembles).  Layers share one
+    global namespace and parameter table across sub-models, exactly as the
+    reference's MultiNetwork keeps all Parameters on the root network."""
+    st = _require_state()
+    if any(sm["name"] == name for sm in st.submodels):
+        raise ValueError(f"Duplicated submodel name: {name}")
+    sm = {"name": name, "inputs": [], "outputs": []}
+    st.submodels.append(sm)
+    st.submodel_stack.append(sm)
+
+
+def SubModelEnd(name=None):
+    """Close the current sub-model block (reference config_parser.py:265)."""
+    st = _require_state()
+    assert st.submodel_stack, "SubModelEnd without SubModelBegin"
+    sm = st.submodel_stack.pop()
+    if name is not None and sm["name"] != name:
+        raise ValueError(f"SubModelEnd({name!r}) closes submodel {sm['name']!r}")
 
 
 def inputs(*layers_):
