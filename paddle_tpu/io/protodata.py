@@ -277,8 +277,12 @@ def slot_input_types(defs: Sequence[SlotDef], sequence: bool = False):
                 if sequence
                 else dt.sparse_float_vector(d.dim)
             )
-        elif d.type in (INDEX, VAR_MDIM_INDEX):
+        elif d.type == INDEX:
             t = dt.integer_value_sequence(d.dim) if sequence else dt.integer_value(d.dim)
+        elif d.type == VAR_MDIM_INDEX:
+            # a var-length id LIST per sample — inherently a sequence slot
+            # even in non-sequence mode (its _slot_value is a list)
+            t = dt.integer_value_sequence(d.dim)
         else:
             raise ValueError(f"slot type {d.type} has no InputType mapping")
         out.append(t)

@@ -133,3 +133,40 @@ def get_layer_impl(type_name: str) -> LayerImpl:
 
 def registered_layer_types() -> List[str]:
     return sorted(_LAYERS)
+
+
+# ---------------------------------------------------------------------------
+# sparse-id batches (big-vocab sparse_binary slots)
+# ---------------------------------------------------------------------------
+
+
+def is_sparse_ids(t, declared_size: int) -> bool:
+    """True when a batch SeqTensor is the PADDED-ID form of a sparse_binary
+    slot: integer ids [..., max_nnz] with sentinel == vocab, produced by the
+    feeder for vocabularies too large to densify (reference sparse-row
+    regime, SparseRowMatrix.h — the TPU-native path is gather-of-touched-
+    rows, never a [B, vocab] multi-hot).
+
+    The id form always carries ONE more trailing axis (the nnz axis) than
+    an INDEX slot of the same sequence level: plain [B, nnz] vs [B];
+    sequence [B, T, nnz] vs [B, T]; nested [B, S, T, nnz] vs [B, S, T] —
+    anything else (e.g. a per-timestep id sequence [B, T]) is NOT sparse."""
+    import jax.numpy as _jnp
+
+    data = t.data
+    if not _jnp.issubdtype(data.dtype, _jnp.integer):
+        return False
+    want_ndim = 2 + (1 if t.is_seq else 0) + (1 if t.is_nested else 0)
+    return data.ndim == want_ndim and data.shape[-1] != declared_size
+
+
+def gather_sum_rows(w, ids):
+    """Bag-of-ids contraction: sum of w's rows per padded id list
+    ([..., nnz] int32 -> [..., w.shape[1]]); sentinel ids (== w.shape[0],
+    out of range) contribute zero via take's fill mode.  This IS the
+    sparse-row matmul of the reference (multi-hot @ W == sum of selected
+    rows), with only touched rows read."""
+    import jax.numpy as _jnp
+
+    g = _jnp.take(w, ids, axis=0, mode="fill", fill_value=0)
+    return _jnp.sum(g, axis=-2)

@@ -62,8 +62,19 @@ def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTen
     acc = None
     lengths = None
     sub_lengths = None
+    from paddle_tpu.layers.base import gather_sum_rows, is_sparse_ids
+
     for i, t in enumerate(inputs):
         x = t.data
+        w = params[f"w{i}"]
+        if is_sparse_ids(t, int(w.shape[0])):
+            # big-vocab sparse_binary slot in padded-id form: the multi-hot
+            # matmul is a gather-sum of touched rows
+            if t.is_seq:
+                lengths, sub_lengths = t.lengths, t.sub_lengths
+            y = gather_sum_rows(w, x)
+            acc = y if acc is None else acc + y
+            continue
         if t.is_nested:
             lengths, sub_lengths = t.lengths, t.sub_lengths  # [B,S,T,D] as-is
         elif t.is_seq:
@@ -72,7 +83,7 @@ def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTen
                 x = x.reshape(x.shape[0], x.shape[1], -1)
         else:
             x = _flat2d(x)
-        y = jnp.matmul(x, params[f"w{i}"])
+        y = jnp.matmul(x, w)
         acc = y if acc is None else acc + y
     if "b" in params:
         acc = acc + params["b"]

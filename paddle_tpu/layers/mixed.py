@@ -65,15 +65,24 @@ def mixed_init(conf: LayerConf, in_confs: List[LayerConf], rng) -> Dict[str, Any
 
 def _apply_proj(spec: Dict[str, Any], p: Dict[str, Any], t: SeqTensor,
                 out_size: int) -> jnp.ndarray:
+    from paddle_tpu.layers.base import gather_sum_rows, is_sparse_ids
+
     kind = spec["kind"]
     x = t.data
     if kind == "full_matrix":
+        if is_sparse_ids(t, int(p["w"].shape[0])):
+            return gather_sum_rows(p["w"], x)
         if not t.is_seq and x.ndim > 2:
             x = x.reshape(x.shape[0], -1)
         return jnp.matmul(x, p["w"])
     if kind == "trans_full_matrix":
         return jnp.matmul(x, p["w"].T)
     if kind == "table":
+        if is_sparse_ids(t, int(p["w"].shape[0])) and x.shape[-1] != 1:
+            # multi-id slot (sparse_binary): bag-of-rows sum, the reference
+            # TableProjection sparse-row path (TableProjection.cpp selected
+            # rows; SparseRowMatrix.h regime)
+            return gather_sum_rows(p["w"], x)
         idx = x.astype(jnp.int32)
         if idx.ndim >= 2 and idx.shape[-1] == 1:
             idx = idx[..., 0]

@@ -23,6 +23,21 @@ def _round_up(n: int, m: int) -> int:
     return ((n + m - 1) // m) * m
 
 
+# Above this vocabulary size a sparse_binary slot feeds as PADDED IDS
+# (sentinel = dim) instead of a dense multi-hot row: the multi-hot form is
+# O(B·T·vocab) memory — fatal for the reference's 1.45M-word LTR configs —
+# while the id form is O(B·T·max_nnz) and consumers gather-sum touched rows
+# (layers/base.py gather_sum_rows; the reference's SparseRowMatrix regime).
+SPARSE_IDS_THRESHOLD = 65536
+
+
+def _ids_form(itype: InputType) -> bool:
+    return (
+        itype.kind == SlotKind.SPARSE_BINARY
+        and itype.dim > SPARSE_IDS_THRESHOLD
+    )
+
+
 class DataFeeder:
     """feeding: [(slot_name, InputType)] in sample-tuple order, or a dict
     {slot_name: index_in_sample} combined with `data_types`."""
@@ -84,6 +99,14 @@ class DataFeeder:
             return SeqTensor(arr)
         if itype.kind == SlotKind.INDEX:
             return SeqTensor(np.asarray(col, dtype=np.int32).reshape(b))
+        if _ids_form(itype):
+            nnz = max(
+                _round_up(max((len(ids) for ids in col), default=1), 8), 8
+            )
+            arr = np.full((b, nnz), itype.dim, dtype=np.int32)  # sentinel pad
+            for i, ids in enumerate(col):
+                arr[i, : len(ids)] = np.asarray(ids, dtype=np.int32)
+            return SeqTensor(arr)
         # sparse -> dense multi-hot
         arr = np.zeros((b, itype.dim), dtype=self.dtype)
         for i, ids in enumerate(col):
@@ -108,6 +131,18 @@ class DataFeeder:
             for i, s in enumerate(col):
                 if len(s):
                     arr[i, : len(s)] = np.asarray(s, dtype=self.dtype)
+            return SeqTensor(arr, lengths)
+        if _ids_form(itype):
+            nnz = max(
+                _round_up(
+                    max((len(ids) for s in col for ids in s), default=1), 8
+                ),
+                8,
+            )
+            arr = np.full((b, t, nnz), itype.dim, dtype=np.int32)
+            for i, s in enumerate(col):
+                for j, ids in enumerate(s):
+                    arr[i, j, : len(ids)] = np.asarray(ids, dtype=np.int32)
             return SeqTensor(arr, lengths)
         # sparse sequence -> [B, T, dim] multi-hot
         arr = np.zeros((b, t, itype.dim), dtype=self.dtype)

@@ -526,9 +526,12 @@ def _mark_unresolved(parsed: ParsedConfig, ds, reason: str) -> None:
 
 import contextlib
 
-# os.chdir is process-global; the async feeder (reader/prefetch.py) resolves
-# relative paths on a background thread, so provider-side chdirs during a
-# config parse must be exclusive to avoid racing on the cwd.
+# os.chdir is process-global.  This lock serializes the PARSE-TIME chdirs
+# in this module against each other (concurrent parse_config calls); it
+# cannot protect arbitrary other threads that read the cwd (e.g. a
+# background feeder resolving relative paths mid-parse) — those windows are
+# only narrowed by keeping each chdir scope as short as possible.  Provider
+# code that must be robust should open paths relative to its own __file__.
 _chdir_lock = threading.RLock()
 
 

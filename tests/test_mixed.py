@@ -126,3 +126,34 @@ def test_conv_operator():
 def test_mixed_seq_input_grad():
     seq = L.data("s", paddle.data_type.dense_vector_sequence(5))
     check_layer_grad(L.mixed(size=4, input=L.full_matrix_projection(seq)))
+
+
+def test_table_projection_id_sequence_keeps_time_axis():
+    """A [B, T] integer id sequence through mixed/table_projection must
+    produce per-timestep embeddings [B, T, D] — the sparse-id bag-sum path
+    (big-vocab padded rows [B, T, nnz]) must NOT trigger on plain id
+    sequences whose T happens to differ from the vocab."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layers as L
+    from paddle_tpu.core.batch import seq
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+
+    reset_auto_names()
+    ids = L.data("ids", paddle.data_type.integer_value_sequence(100))
+    out = L.mixed(
+        size=8, input=[L.table_projection(ids)], bias_attr=False
+    )
+    net = CompiledNetwork(Topology([out]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {"ids": seq(np.array([[1, 2, 3, 0, 0], [4, 5, 0, 0, 0]], np.int32), [3, 2])}
+    o, _ = net.apply(params, batch, state=state, train=False)
+    assert o[out.name].data.shape == (2, 5, 8), o[out.name].data.shape
+    # row 0, t=0 must equal the table row of id 1
+    w = next(v for v in jax.tree_util.tree_leaves(params) if v.shape == (100, 8))
+    np.testing.assert_allclose(
+        np.asarray(o[out.name].data)[0, 0], np.asarray(w)[1], rtol=1e-5
+    )

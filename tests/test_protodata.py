@@ -193,3 +193,56 @@ def test_proto_index_before_vector_slots(tmp_path):
     np.testing.assert_allclose(got[0][1], rows[0][1])
     assert got[0][3] == [4, 9]
     assert got[1][0] == 1 and got[1][3] == []
+
+
+def test_compare_sparse_conf_mismatched_dims_is_a_hard_error():
+    """sample_trainer_config_compare_sparse.conf declares word_dim=999 but
+    data_bin_part's slots carry ids up to 1.45M — feeding that into a
+    999-row table would be out-of-bounds.  The binding must refuse loudly
+    at the feed boundary, never gather garbage rows."""
+    p = parse_config(f"{REF_TESTS}/sample_trainer_config_compare_sparse.conf")
+    with pytest.raises(ValueError, match="dim-consistent|slot types unknown"):
+        p.topology.data_types()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("conf", ["sample_trainer_config_rnn.conf"])
+def test_trainer_big_vocab_ltr_configs_train_on_data_bin_part(conf):
+    """The reference's learning-to-rank fixtures (test_CompareTwoNets /
+    test_CompareSparse data): raw-face recurrent groups over eight
+    1.45M-vocab sparse_binary sequence slots, fed from the checked-in
+    data_bin_part via proto_sequence.  Big-vocab sparse slots feed as
+    padded id lists (gather-sum of touched embedding rows — the
+    SparseRowMatrix regime), never as multi-hot."""
+    import jax
+
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.reader.feeder import DataFeeder
+    from paddle_tpu.trainer.step import make_train_step
+
+    p = parse_config(f"{REF_TESTS}/{conf}")
+    types = dict(p.topology.data_types())
+    assert sum(t.kind.name == "SPARSE_BINARY" for t in types.values()) == 8
+    r = make_data_reader(p, REF_TESTS)
+    it = iter(r())
+    rows = [next(it) for _ in range(8)]
+
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    opt = make_optimizer(p.settings)
+    opt_state = opt.init(params)
+    step = make_train_step(net, opt, mesh=None)
+    feeder = DataFeeder(p.topology.data_types())
+    batch = feeder(rows[:4])
+    # the id-form batch must be tiny compared to a multi-hot (4 samples x
+    # T x 1.45M floats would be gigabytes)
+    qb = batch[next(iter(types))]
+    assert qb.data.dtype == np.int32 and qb.data.shape[-1] <= 64
+    costs = []
+    for i in range(2):
+        params, state, opt_state, m = step(
+            params, state, opt_state, feeder(rows[i * 4:(i + 1) * 4]),
+            jax.random.PRNGKey(i),
+        )
+        costs.append(float(m["cost"]))
+    assert all(np.isfinite(costs)), costs
