@@ -33,19 +33,24 @@ class SeqTensor:
                  ordinary [B, T, ...] sequence.
     """
 
-    def __init__(self, data, lengths=None, sub_lengths=None):
+    def __init__(self, data, lengths=None, sub_lengths=None, sparse_ids=False):
         self.data = data
         self.lengths = lengths
         self.sub_lengths = sub_lengths
+        # True when `data` is the PADDED-ID form of a big-vocab sparse
+        # slot ([..., max_nnz] int32 ids, sentinel == vocab) — set by the
+        # feeder, consumed by fc/mixed projections via
+        # layers.base.is_sparse_ids (exact dispatch, no shape heuristics)
+        self.sparse_ids = sparse_ids
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
         children = (self.data, self.lengths, self.sub_lengths)
-        return children, None
+        return children, self.sparse_ids
 
     @classmethod
-    def tree_unflatten(cls, _aux, children):
-        return cls(*children)
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, sparse_ids=bool(aux))
 
     # -- helpers ------------------------------------------------------------
     @property

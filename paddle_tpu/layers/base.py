@@ -147,17 +147,10 @@ def is_sparse_ids(t, declared_size: int) -> bool:
     regime, SparseRowMatrix.h — the TPU-native path is gather-of-touched-
     rows, never a [B, vocab] multi-hot).
 
-    The id form always carries ONE more trailing axis (the nnz axis) than
-    an INDEX slot of the same sequence level: plain [B, nnz] vs [B];
-    sequence [B, T, nnz] vs [B, T]; nested [B, S, T, nnz] vs [B, S, T] —
-    anything else (e.g. a per-timestep id sequence [B, T]) is NOT sparse."""
-    import jax.numpy as _jnp
-
-    data = t.data
-    if not _jnp.issubdtype(data.dtype, _jnp.integer):
-        return False
-    want_ndim = 2 + (1 if t.is_seq else 0) + (1 if t.is_nested else 0)
-    return data.ndim == want_ndim and data.shape[-1] != declared_size
+    Dispatch is EXACT: the feeder sets SeqTensor.sparse_ids when it builds
+    the id form — no shape/dtype heuristics, so ordinary integer tensors
+    reaching a projection still fail loudly instead of being bag-summed."""
+    return bool(getattr(t, "sparse_ids", False))
 
 
 def gather_sum_rows(w, ids):

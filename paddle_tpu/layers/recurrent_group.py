@@ -580,6 +580,9 @@ def _seq_memory_widths(
 
 
 def mask_like(ys: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
+    """[B, T] validity mask broadcast-shaped to ys's rank (ys may carry any
+    number of trailing axes — features, beam × token for an in-group
+    generator, ...)."""
     t = jnp.arange(ys.shape[1], dtype=jnp.int32)
     m = (t[None, :] < lengths[:, None]).astype(ys.dtype)
-    return m[..., None] if ys.ndim == 3 else m
+    return m.reshape(m.shape + (1,) * (ys.ndim - 2))

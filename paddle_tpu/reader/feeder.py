@@ -106,7 +106,7 @@ class DataFeeder:
             arr = np.full((b, nnz), itype.dim, dtype=np.int32)  # sentinel pad
             for i, ids in enumerate(col):
                 arr[i, : len(ids)] = np.asarray(ids, dtype=np.int32)
-            return SeqTensor(arr)
+            return SeqTensor(arr, sparse_ids=True)
         # sparse -> dense multi-hot
         arr = np.zeros((b, itype.dim), dtype=self.dtype)
         for i, ids in enumerate(col):
@@ -143,7 +143,7 @@ class DataFeeder:
             for i, s in enumerate(col):
                 for j, ids in enumerate(s):
                     arr[i, j, : len(ids)] = np.asarray(ids, dtype=np.int32)
-            return SeqTensor(arr, lengths)
+            return SeqTensor(arr, lengths, sparse_ids=True)
         # sparse sequence -> [B, T, dim] multi-hot
         arr = np.zeros((b, t, itype.dim), dtype=self.dtype)
         for i, s in enumerate(col):
@@ -179,6 +179,23 @@ class DataFeeder:
                 for j, sub in enumerate(sample):
                     arr[i, j, : len(sub)] = np.asarray(sub, dtype=np.int32)
             return SeqTensor(arr, n_sub, sub_lengths)
+        if _ids_form(itype):
+            nnz = max(
+                _round_up(
+                    max(
+                        (len(ids) for s in col for sub in s for ids in sub),
+                        default=1,
+                    ),
+                    8,
+                ),
+                8,
+            )
+            arr = np.full((b, s_max, t, nnz), itype.dim, dtype=np.int32)
+            for i, sample in enumerate(col):
+                for j, sub in enumerate(sample):
+                    for k, ids in enumerate(sub):
+                        arr[i, j, k, : len(ids)] = np.asarray(ids, np.int32)
+            return SeqTensor(arr, n_sub, sub_lengths, sparse_ids=True)
         arr = np.zeros((b, s_max, t, itype.dim), dtype=self.dtype)
         for i, sample in enumerate(col):
             for j, sub in enumerate(sample):

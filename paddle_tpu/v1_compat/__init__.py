@@ -637,13 +637,26 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
     if state.pending_output_names:  # capital-O Outputs(name, ...) form
         # reference alias: the beam-search generator registers its predict
         # layer as __beam_search_predict__ (config_parser) — map it to the
-        # beam_search layer built during the exec
+        # beam_search layer built during the exec, or to the OUTER
+        # recurrent_group wrapping it (nested generation: the reference
+        # concatenates per-subsequence beam results through the group,
+        # sample_trainer_nest_rnn_gen.conf)
         if "__beam_search_predict__" in state.pending_output_names:
+            gen_groups = [
+                lo for lo in state.all_layers.values()
+                if lo.conf.type == "recurrent_group"
+                and any(
+                    c.type == "beam_search"
+                    for c in lo.conf.attrs["_sub_topology"].layers.values()
+                )
+            ]
             beams = [
                 lo for lo in state.all_layers.values()
                 if lo.conf.type == "beam_search"
             ]
-            if len(beams) == 1:
+            if len(gen_groups) == 1:
+                state.all_layers["__beam_search_predict__"] = gen_groups[0]
+            elif len(beams) == 1:
                 state.all_layers["__beam_search_predict__"] = beams[0]
         missing = [n for n in state.pending_output_names if n not in state.all_layers]
         if missing:

@@ -246,3 +246,32 @@ def test_trainer_big_vocab_ltr_configs_train_on_data_bin_part(conf):
         )
         costs.append(float(m["cost"]))
     assert all(np.isfinite(costs)), costs
+
+
+def test_sparse_ids_flag_and_nested_form():
+    """The feeder TAGS id-form batches (SeqTensor.sparse_ids) — consumers
+    dispatch on the tag, not shape heuristics — and the nested
+    (sub-sequence) variant also feeds as padded ids, never multi-hot."""
+    from paddle_tpu.core.data_types import (
+        sparse_binary_vector_sequence,
+        sparse_binary_vector_sub_sequence,
+    )
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    V = 1_000_000
+    f = DataFeeder([("s", sparse_binary_vector_sequence(V))])
+    b = f([([[1, 5], [7]],), ([[9]],)])["s"]
+    assert b.sparse_ids and b.data.dtype == np.int32
+    assert b.data.shape[-1] <= 64 and b.data.ndim == 3
+
+    f2 = DataFeeder([("n", sparse_binary_vector_sub_sequence(V))])
+    b2 = f2([([[[1], [2, 3]], [[4]]],)])["n"]
+    assert b2.sparse_ids and b2.is_nested
+    assert b2.data.ndim == 4 and b2.data.shape[-1] <= 64
+
+    # pytree round-trip preserves the tag
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(b)
+    again = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert again.sparse_ids
