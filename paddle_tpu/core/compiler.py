@@ -170,6 +170,11 @@ class CompiledNetwork:
         # group's own sub-CompiledNetwork running this same scan.
         self._param_owner: Dict[str, str] = {}
         self._shared_keys: Dict[str, Dict[tuple, tuple]] = {}
+        # global parameter table: reference parameters are NAMED objects
+        # (Parameter.h:46; v2 parameters.get("embedding.w0")) — map each
+        # declared global name to its owning storage path (top layer,
+        # relpath-into-its-param-subtree)
+        self._named_params: Dict[str, tuple] = {}
         owners: Dict[str, str] = {}
         key_owners: Dict[str, tuple] = {}
         inner_seen: set = set()  # (global name, top layer) with an inner decl
@@ -187,6 +192,7 @@ class CompiledNetwork:
                         self._param_owner[name] = owners[pname]
                     else:
                         owners[pname] = name
+                        self._named_params[pname] = (name, ())
                 else:
                     # legacy one-parameter layer inside a group: share its
                     # whole inner dict at `rel`
@@ -208,6 +214,8 @@ class CompiledNetwork:
                     )
                     if owner is not None:
                         self._shared_keys.setdefault(name, {})[rel] = owner
+                    else:
+                        self._named_params.setdefault(pname, (name, rel))
             for key, gname in pmap.items():
                 if not gname:
                     continue
@@ -227,6 +235,8 @@ class CompiledNetwork:
                 )
                 if owner is not None:
                     self._shared_keys.setdefault(name, {})[kp] = owner
+                else:
+                    self._named_params.setdefault(gname, (name, kp))
 
     @staticmethod
     def _inner_key_owner(key_owners, inner_seen, gname, top, relpath, inner,
@@ -336,6 +346,16 @@ class CompiledNetwork:
                 )
                 _set_path(p, relpath, src)
         return p
+
+    def named_parameters(self) -> Dict[str, str]:
+        """Global parameter table: {declared parameter name: dotted storage
+        path into the params tree} (reference Parameter.h:46 named buffers /
+        v2 parameters surface — the reference addresses every parameter by
+        its config-declared name)."""
+        return {
+            gname: ".".join((top,) + tuple(rel))
+            for gname, (top, rel) in self._named_params.items()
+        }
 
     def materialize_shared(self, params: Params) -> Params:
         """Params with every shared key grafted back in place, per top-level

@@ -1352,6 +1352,16 @@ def recurrent(
     name: Optional[str] = None,
 ) -> LayerOutput:
     drop, shard = _extra(layer_attr)
+    # per-key global names: the reference names the recurrent WEIGHT via
+    # Input(parameter_name=...) and the bias via Bias(parameter_name=...)
+    # separately (e.g. the LTR fixtures tie all slots' recurrences to one
+    # "rnn1.w0"/"rnn1.bias"), so w_h and b share under their own names
+    pnames = {}
+    pn = _param_name(param_attr)
+    if pn:
+        pnames["w_h"] = pn
+    if isinstance(bias_attr, ParamAttr) and bias_attr.name:
+        pnames["b"] = bias_attr.name
     conf = LayerConf(
         name=name or auto_name("recurrent"),
         type="recurrent",
@@ -1361,7 +1371,12 @@ def recurrent(
         bias=bool(bias_attr),
         drop_rate=drop,
         shard_axis=shard,
-        attrs={"reverse": reverse, **_param_attrs(param_attr)},
+        attrs={
+            "reverse": reverse,
+            "param_std": _param_std(param_attr),
+            "prune_sparsity": _prune_ratio(param_attr),
+            **({"param_names": pnames} if pnames else {}),
+        },
     )
     _set_error_clip(conf, layer_attr)
     return LayerOutput(conf, [input])

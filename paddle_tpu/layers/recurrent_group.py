@@ -113,6 +113,12 @@ def memory(
     sequence when unbooted); under the static-shape scan the linked layer's
     padded width must be step-invariant."""
     assert _current_build is not None, "memory() must be called inside a recurrent_group step"
+    if is_seq and boot_with_const_id is not None:
+        raise ValueError(
+            "memory(is_seq=True) cannot boot with a constant id — a "
+            "sequence memory boots from a sequence boot_layer or as an "
+            "empty sequence"
+        )
     conf = LayerConf(
         name=auto_name(f"memory_{name or memory_name or 'deferred'}"),
         type="memory",

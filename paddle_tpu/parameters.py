@@ -51,8 +51,31 @@ class Parameters:
     def _resolve(self, key: str):
         parts = key.split(".")
         node = self.params
-        for p in parts[:-1]:
-            node = node[p]
+        try:
+            for p in parts[:-1]:
+                node = node[p]
+            if parts[-1] not in node:
+                raise KeyError(parts[-1])
+        except (KeyError, TypeError):
+            # fall back to the GLOBAL parameter name table (reference
+            # parameters are named objects: parameters.get("embedding.w0"))
+            named = getattr(self.network, "named_parameters", None)
+            if named is not None and key in (table := named()):
+                node, leaf = self._resolve(table[key])
+                # legacy whole-layer names address the layer's param DICT;
+                # descend to its single leaf (reference one-parameter
+                # layers), never hand back a dict as if it were an array
+                while isinstance(node[leaf], dict):
+                    inner = node[leaf]
+                    if len(inner) != 1:
+                        raise KeyError(
+                            f"named parameter {key!r} maps to a multi-key "
+                            f"param dict ({sorted(inner)}); address a leaf "
+                            f"as {table[key]}.<key>"
+                        )
+                    node, leaf = inner, next(iter(inner))
+                return node, leaf
+            raise
         return node, parts[-1]
 
     def get(self, key: str) -> np.ndarray:

@@ -218,19 +218,18 @@ def Memory(name: str, size: int, boot_layer: Optional[str] = None,
            boot_with_const_id: Optional[int] = None,
            is_sequence: bool = False, **kw) -> str:
     """Declare a memory of in-group layer `name` (reference Memory config
-    func); returns the handle name projections can reference."""
+    func); returns the handle name projections can reference.
+    is_sequence=True carries the linked layer's WHOLE sequence between
+    steps (reference sequence-memory frames — see layers/recurrent_group.py
+    memory(is_seq=True))."""
     assert _current_raw_group is not None, "Memory() outside a layer group"
-    if is_sequence:
-        raise NotImplementedError(
-            "raw Memory(is_sequence=True) (sequence-valued memories) is not "
-            "supported — restructure as a nested recurrent_group"
-        )
     if kw:
         raise TypeError(f"raw Memory() got unsupported arguments {sorted(kw)}")
     boot = _resolve(boot_layer) if boot_layer is not None else None
     mem = _rg.memory(
         name=name, size=size, boot_layer=boot,
         boot_with_const_id=boot_with_const_id,
+        is_seq=bool(is_sequence),
     )
     handle = mem.conf.name
     _current_raw_group.namespace[handle] = mem

@@ -275,3 +275,34 @@ def test_sparse_ids_flag_and_nested_form():
     leaves, treedef = jax.tree_util.tree_flatten(b)
     again = jax.tree_util.tree_unflatten(treedef, leaves)
     assert again.sparse_ids
+
+
+@pytest.mark.slow
+def test_compare_two_nets_rnn_vs_qb_rnn():
+    """test_CompareTwoNets.cpp parity: sample_trainer_config_rnn.conf (raw
+    recurrent layer groups) and sample_trainer_config_qb_rnn.conf (fused
+    `recurrent` layers) describe the same network; with parameters tied
+    through the GLOBAL parameter name table (embedding.w0, rnn1.w0, ...)
+    both must produce the SAME cost on the same reference data."""
+    import jax
+
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    pa = parse_config(f"{REF_TESTS}/sample_trainer_config_rnn.conf")
+    pb = parse_config(f"{REF_TESTS}/sample_trainer_config_qb_rnn.conf")
+    na, nb = CompiledNetwork(pa.topology), CompiledNetwork(pb.topology)
+    pla = paddle.parameters.Parameters(na, *na.init(jax.random.PRNGKey(0)))
+    plb = paddle.parameters.Parameters(nb, *nb.init(jax.random.PRNGKey(1)))
+    common = sorted(set(na.named_parameters()) & set(nb.named_parameters()))
+    # the whole model is named-parameter-shared in both configs
+    assert {"embedding.w0", "rnn1.w0", "rnn1.bias"} <= set(common)
+    for n in common:
+        plb.set(n, pla.get(n))
+    r = make_data_reader(pa, REF_TESTS, shuffle=False)
+    rows = [x for _, x in zip(range(6), r())]
+    fa = DataFeeder(pa.topology.data_types())
+    fb = DataFeeder(pb.topology.data_types())
+    ca, _ = na.cost(pla.params, fa(rows), state=pla.state, train=False)
+    cb, _ = nb.cost(plb.params, fb(rows), state=plb.state, train=False)
+    np.testing.assert_allclose(float(ca), float(cb), rtol=1e-6)

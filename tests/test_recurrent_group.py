@@ -177,3 +177,50 @@ def test_group_static_input_attention():
     # masking: steps beyond trg length are zero
     assert np.allclose(out[2, 1:], 0.0)
     assert np.allclose(out[1, 3:], 0.0)
+
+
+def test_seq_memory_rejects_const_id_boot():
+    """memory(is_seq=True, boot_with_const_id=...) is contradictory (a
+    sequence cannot boot from a scalar id) and must raise, not silently
+    boot empty."""
+    import pytest as _pytest
+
+    from paddle_tpu import layers as L
+    from paddle_tpu.core.data_types import dense_vector_sub_sequence
+    from paddle_tpu.core.topology import reset_auto_names
+    from paddle_tpu.layers import SubsequenceInput
+
+    reset_auto_names()
+    inp = L.data("x", dense_vector_sub_sequence(3))
+
+    def step(sub):
+        with _pytest.raises(ValueError, match="constant id"):
+            L.memory(name="m", size=3, is_seq=True, boot_with_const_id=0)
+        m = L.memory(name="m", size=3, is_seq=True)
+        return L.addto([sub, m], name="m")
+
+    L.recurrent_group(step=step, input=SubsequenceInput(inp))
+
+
+def test_named_parameter_table_whole_layer_resolves_to_leaf():
+    """Legacy whole-layer parameter names (embedding param_attr name) must
+    resolve to the single array leaf through Parameters.get/set, never hand
+    back a dict."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layers as L
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+
+    reset_auto_names()
+    d = L.data("ids", paddle.data_type.integer_value_sequence(20))
+    e = L.embedding(d, size=8, param_attr=paddle.attr.ParamAttr(name="emb.w"))
+    out = L.fc(L.pooling(e, pooling_type="sum"), size=2)
+    net = CompiledNetwork(Topology([out]))
+    ps = paddle.parameters.Parameters(net, *net.init(jax.random.PRNGKey(0)))
+    v = ps.get("emb.w")
+    assert v.shape == (20, 8) and v.dtype == np.float32
+    ps.set("emb.w", np.zeros((20, 8), np.float32))
+    assert np.all(ps.get("emb.w") == 0)
