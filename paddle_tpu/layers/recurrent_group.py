@@ -70,6 +70,13 @@ class _GroupBuild:
 
 _current_build: Optional[_GroupBuild] = None
 
+# Unroll factor for the group scan.  The body is a whole traced
+# sub-network; measured on v5e (NMT attention decoder fwd+bwd) unroll=2
+# was SLOWER than 1 (33.0 vs 27.9 ms/step) — the body is large enough that
+# scan overhead is already amortized and unrolling only bloats the program.
+# (The small fused cells in ops/rnn.py are different: they unroll 4x.)
+_GROUP_UNROLL = 1
+
 
 @contextlib.contextmanager
 def _group_build():
@@ -487,7 +494,10 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
 
     # Memory/step placeholders ride the compiler's data path per step.
     (_, sub_state_out), ys = jax.lax.scan(
-        body, (init_carry, sub_state0), tuple(xs) + (mask_seq, t_iota)
+        body,
+        (init_carry, sub_state0),
+        tuple(xs) + (mask_seq, t_iota),
+        unroll=_GROUP_UNROLL,
     )
     if sub_state0:
         ctx.new_state[conf.name] = sub_state_out

@@ -21,6 +21,12 @@ from jax import lax
 
 from paddle_tpu.ops.activations import get_activation
 
+# Step-body unroll factor for the recurrence scans: amortizes per-iteration
+# scan overhead across MXU-bound small matmuls (measured on v5e, GRU
+# B=128/T=50/H=512 fwd+bwd: unroll 1 -> 5.6 ms, 4 -> 4.1 ms; 8 is no
+# better).  lax.scan handles non-divisible lengths itself.
+_UNROLL = 4
+
 
 def _time_major(x):
     """[B, T, D] -> [T, B, D] for scan."""
@@ -99,7 +105,9 @@ def lstm_scan(
         return (h_t, c_t), h_t
 
     inputs = xs if mask is None else (xs, mask)
-    (h_last, c_last), hs = lax.scan(step, (h_prev, c_prev), inputs)
+    (h_last, c_last), hs = lax.scan(
+        step, (h_prev, c_prev), inputs, unroll=_UNROLL
+    )
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return jnp.swapaxes(hs, 0, 1), (h_last, c_last)
@@ -153,7 +161,7 @@ def gru_scan(
         return h_t, h_t
 
     inputs = xs if mask is None else (xs, mask)
-    h_last, hs = lax.scan(step, h_prev, inputs)
+    h_last, hs = lax.scan(step, h_prev, inputs, unroll=_UNROLL)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return jnp.swapaxes(hs, 0, 1), h_last
@@ -192,7 +200,7 @@ def simple_rnn_scan(
         return h_t, h_t
 
     inputs = xs if mask is None else (xs, mask)
-    h_last, hs = lax.scan(step, h_prev, inputs)
+    h_last, hs = lax.scan(step, h_prev, inputs, unroll=_UNROLL)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return jnp.swapaxes(hs, 0, 1), h_last
