@@ -80,6 +80,22 @@ def _as_list(x: Inputish) -> list:
     return list(x)
 
 
+def _warn_dynamic_width(consumer: str, i: LayerOutput) -> None:
+    """Any SIZE-CONSUMING layer (fc, mixed matrix projections, tensor, ...)
+    stacked on a dynamic-width input — e.g. trans(height=None), whose true
+    width is the runtime batch size — builds weights for the STATIC declared
+    size and only runs when batch == that size (the reference has the same
+    latent constraint, TransLayer config_parser.py:2129)."""
+    if i.conf.attr("dynamic_size"):
+        _warnings.warn(
+            f"{consumer} input {i.name!r} has a dynamic "
+            f"(runtime-batch-dependent) width but weights are built for its "
+            f"static size {i.size}; this only runs when the batch size "
+            "equals that static size",
+            stacklevel=3,
+        )
+
+
 def _extra(layer_attr: Optional[ExtraAttr]):
     drop = layer_attr.drop_rate if layer_attr else 0.0
     shard = layer_attr.shard_axis if layer_attr else None
@@ -194,15 +210,7 @@ def fc(
 ) -> LayerOutput:
     ins = _as_list(input)
     for i in ins:
-        if i.conf.attr("dynamic_size"):
-            _warnings.warn(
-                f"fc input {i.name!r} has a dynamic (runtime-batch-dependent) "
-                f"width — e.g. trans(height=None) — but weights are built for "
-                f"its static size {i.size}; this only runs when the batch "
-                "size equals that static size (the reference has the same "
-                "latent constraint, TransLayer config_parser.py:2129)",
-                stacklevel=2,
-            )
+        _warn_dynamic_width("fc", i)
     drop, shard = _extra(layer_attr)
     if isinstance(param_attr, (list, tuple)):
         # per-input weight attrs (reference fc_layer param_attr list): each
@@ -1960,6 +1968,7 @@ class Projection:
 def full_matrix_projection(
     input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
 ) -> Projection:
+    _warn_dynamic_width("full_matrix_projection", input)
     return Projection(
         "full_matrix", input, size=size,
         param_std=_param_std(param_attr), param_name=_param_name(param_attr),
@@ -1969,6 +1978,7 @@ def full_matrix_projection(
 def trans_full_matrix_projection(
     input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
 ) -> Projection:
+    _warn_dynamic_width("trans_full_matrix_projection", input)
     return Projection(
         "trans_full_matrix", input, size=size,
         param_std=_param_std(param_attr), param_name=_param_name(param_attr),

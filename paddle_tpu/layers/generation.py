@@ -72,8 +72,10 @@ def beam_search(
     + diy_beam_search_prob_so .cpp:27) as restricted in-graph functions —
     see ops/beam.py's module docstring for signatures.
 
-    Output: int32 ids [B, K, T] sorted best-first; beam scores are exposed as
-    the auxiliary output ``<name>@scores`` ([B, K]).
+    Output: int32 ids [B, N, T] sorted best-first, where N =
+    num_results_per_sample if set (trimmed from the K=beam_size searched
+    beams) else K; beam scores are exposed as the auxiliary output
+    ``<name>@scores`` ([B, N]).
     """
     if beam_size is None:
         from paddle_tpu.utils.flags import get_flag
@@ -135,6 +137,11 @@ def beam_search(
             "eos_id": eos_id,
             "beam_size": beam_size,
             "max_length": max_length,
+            **(
+                {"num_results": int(num_results_per_sample)}
+                if num_results_per_sample
+                else {}
+            ),
             **(
                 {"_candidate_adjust_fn": candidate_adjust_fn}
                 if candidate_adjust_fn
@@ -219,5 +226,11 @@ def beam_search_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         drop_fn=a.get("_drop_fn"),
         norm_fn=a.get("_norm_fn"),
     )
+    # num_results_per_sample (reference beam_search arg): keep only the
+    # best N of the K beams in the layer output
+    n_res = a.get("num_results")
+    if n_res is not None and n_res < seqs.shape[1]:
+        seqs = seqs[:, :n_res]
+        scores = scores[:, :n_res]
     ctx.outputs[conf.name + "@scores"] = SeqTensor(scores)
     return SeqTensor(seqs)

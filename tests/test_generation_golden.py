@@ -157,3 +157,47 @@ def test_generation_matches_golden_nested():
     assert len(golden) == 15
     for s in range(15):
         assert _trim(seqs[0, s, 0]) == golden[s], (s, seqs[0, s, 0])
+
+
+def test_num_results_per_sample_limits_output():
+    """num_results_per_sample keeps only the best N of K beams in the
+    layer output (reference beam_search arg) — built with beam_size=2 and
+    num_results_per_sample=1 so the trim path actually executes."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import layers as L
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+
+    reset_auto_names()
+    dummy = L.data("d", paddle.data_type.dense_vector(2))
+
+    def step(static_in, prev_word):
+        return L.fc(prev_word, size=6, act=paddle.activation.Softmax())
+
+    beam = L.beam_search(
+        step=step,
+        input=[
+            L.StaticInput(input=dummy, size=2),
+            L.GeneratedInput(size=6, embedding_size=4),
+        ],
+        bos_id=0,
+        eos_id=5,
+        beam_size=2,
+        num_results_per_sample=1,
+        max_length=5,
+        name="trimmed",
+    )
+    net = CompiledNetwork(Topology([beam]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    outs, _ = net.apply(
+        params,
+        {"d": SeqTensor(np.zeros((4, 2), np.float32))},
+        state=state,
+        train=False,
+    )
+    # searched with K=2, reports only the best 1
+    assert np.asarray(outs["trimmed"].data).shape == (4, 1, 5)
+    assert np.asarray(outs["trimmed@scores"].data).shape == (4, 1)
