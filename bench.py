@@ -1,15 +1,22 @@
 """Benchmark driver — emits the BASELINE.json metric set, one JSON line per
 metric (the first line is the headline ResNet-50 number the driver parses):
 
-  1. resnet50_train_images_per_sec_per_chip  — bf16 mixed-precision training
-  2. nmt_tokens_per_sec                      — seq2seq-NMT attention GRU fwd+bwd
-  3. allreduce_bw_gbps                       — psum bandwidth over the mesh
-  4. transformer_base_tokens_per_sec         — Transformer-base MT train step
-  5. lstm_textcls_ms_per_batch               — 2xLSTM text cls (benchmark/paddle/rnn)
-  6. alexnet_ms_per_batch                    — reference alexnet.py config, unmodified
-  7. googlenet_ms_per_batch                  — reference googlenet.py config, unmodified
-  8. smallnet_ms_per_batch                   — reference smallnet_mnist_cifar.py config
-  9. resnet50_pipeline_images_per_sec        — ResNet-50 through the real data plane
+   1. resnet50_train_images_per_sec_per_chip — bf16 mixed-precision training
+   2. nmt_tokens_per_sec                     — seq2seq-NMT attention GRU fwd+bwd
+   3. allreduce_bw_gbps                      — psum bandwidth over the mesh
+   4. allreduce_psum_8dev_gbps               — value-verified 8-dev virtual-mesh psum
+   5. transformer_base_tokens_per_sec        — Transformer-base MT train step
+   6. transformer_long_ctx_tokens_per_sec    — seq 1024, Pallas flash attention
+   7. transformer_xl_ctx_tokens_per_sec      — seq 4096 (dense attention cannot)
+   8. lstm_textcls_ms_per_batch              — 2xLSTM text cls (benchmark/paddle/rnn)
+   9. alexnet_ms_per_batch                   — reference alexnet.py config, unmodified
+  10. googlenet_ms_per_batch                 — reference googlenet.py config, unmodified
+  11. smallnet_ms_per_batch                  — reference smallnet_mnist_cifar.py config
+  12. resnet50_pipeline_images_per_sec       — ResNet-50 through the real data plane
+                                               (inline vs async feed A/B)
+
+Training metrics carry step_ms + achieved TFLOP/s + MFU (fraction of the
+chip's bf16 peak) from XLA's own cost analysis.
 
 Methodology: every step consumes a different pre-staged device batch (cycled)
 and a fresh PRNG key, and timing syncs via a host fetch of the cost scalar —
@@ -430,80 +437,14 @@ def _bench_resnet_pipeline_body(tmp: str) -> dict:
     }
 
 
-def bench_transformer() -> dict:
-    """Transformer-base MT training step (BASELINE configs #5, stretch
-    metric): fwd+bwd+momentum over padded batches, bf16 mixed precision."""
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as paddle
-    from paddle_tpu.core.batch import SeqTensor
-    from paddle_tpu.core.compiler import CompiledNetwork
-    from paddle_tpu.core.topology import Topology, reset_auto_names
-    from paddle_tpu.models.transformer import transformer_cost
-    from paddle_tpu.trainer.step import make_train_step
-
-    reset_auto_names()
-    batch_size, seq_len = 64, 64
-    vocab = 32000
-
-    cost, _ = transformer_cost(vocab, vocab, 512, 8, 6, 2048)
-    net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
-    params, state = net.init(jax.random.PRNGKey(0))
-    opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
-    opt_state = opt.init(params)
-    step = make_train_step(net, opt, mesh=None)
-
-    rng = np.random.RandomState(0)
-    lens = jnp.full((batch_size,), seq_len, jnp.int32)
-
-    def mk():
-        def ids():
-            return jax.device_put(
-                rng.randint(1, vocab, size=(batch_size, seq_len)).astype(np.int32)
-            )
-
-        return {
-            "src_word": SeqTensor(ids(), lens),
-            "trg_word": SeqTensor(ids(), lens),
-            "trg_next": SeqTensor(ids(), lens),
-        }
-
-    batches = [mk() for _ in range(4)]
-    step, flops = _aot(
-        step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
-    )
-    params, state, opt_state, m = step(
-        params, state, opt_state, batches[0], jax.random.PRNGKey(1)
-    )
-    _sync(m)
-
-    iters = 20
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, state, opt_state, m = step(
-            params, state, opt_state, batches[i % len(batches)], jax.random.PRNGKey(i)
-        )
-    _sync(m)
-    dt = time.perf_counter() - t0
-
-    tok_per_sec = batch_size * seq_len * iters / dt
-    return {
-        "metric": "transformer_base_tokens_per_sec",
-        "value": round(tok_per_sec, 2),
-        "unit": "tokens/sec",
-        "vs_baseline": round(tok_per_sec / TARGET_TRANSFORMER_TOK_S, 4),
-        "step_ms": round(dt / iters * 1e3, 2),
-        **_mfu_fields(flops, dt / iters),
-    }
-
-
-def bench_transformer_long_context() -> dict:
-    """Long-context Transformer-base training (seq 1024) with the Pallas
-    flash-attention kernel on — the memory-bound regime where the fused
-    online-softmax kernel avoids materializing [T, T] score matrices.
-    vs_baseline reuses the Transformer-base tokens/s target (long context
-    should stay at or above the short-seq class target on TPU)."""
+def _bench_transformer_ctx(
+    metric: str, batch_size: int, seq_len: int, iters: int,
+    use_pallas: bool, extra: dict | None = None,
+) -> dict:
+    """Shared Transformer-base training harness: one jitted step over
+    padded [B, seq_len] batches, optionally through the Pallas flash
+    attention kernel (the long-context path); AOT-compiled once, timed via
+    host-fetch sync, MFU from XLA cost analysis."""
     import jax
     import jax.numpy as jnp
 
@@ -516,10 +457,9 @@ def bench_transformer_long_context() -> dict:
     from paddle_tpu.utils.flags import set_flag
 
     reset_auto_names()
-    batch_size, seq_len = 8, 1024
     vocab = 32000
 
-    set_flag("use_pallas_attention", True)
+    set_flag("use_pallas_attention", use_pallas)
     try:
         cost, _ = transformer_cost(vocab, vocab, 512, 8, 6, 2048)
         net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
@@ -545,7 +485,7 @@ def bench_transformer_long_context() -> dict:
                 "trg_next": SeqTensor(ids(), lens),
             }
 
-        batches = [mk() for _ in range(2)]
+        batches = [mk() for _ in range(2 if seq_len >= 1024 else 4)]
         step, flops = _aot(
             step, params, state, opt_state, batches[0], jax.random.PRNGKey(1)
         )
@@ -554,7 +494,6 @@ def bench_transformer_long_context() -> dict:
         )
         _sync(m)
 
-        iters = 10
         t0 = time.perf_counter()
         for i in range(iters):
             params, state, opt_state, m = step(
@@ -568,14 +507,45 @@ def bench_transformer_long_context() -> dict:
 
     tok_per_sec = batch_size * seq_len * iters / dt
     return {
-        "metric": "transformer_long_ctx_tokens_per_sec",
+        "metric": metric,
         "value": round(tok_per_sec, 2),
         "unit": "tokens/sec",
-        "seq_len": seq_len,
+        # all context lengths share the short-seq class target: long context
+        # should stay at or above it on TPU, not get a discount
         "vs_baseline": round(tok_per_sec / TARGET_TRANSFORMER_TOK_S, 4),
         "step_ms": round(dt / iters * 1e3, 2),
+        **(extra or {}),
         **_mfu_fields(flops, dt / iters),
     }
+
+
+def bench_transformer() -> dict:
+    """Transformer-base MT train step (BASELINE configs #5), seq 64."""
+    return _bench_transformer_ctx(
+        "transformer_base_tokens_per_sec", batch_size=64, seq_len=64,
+        iters=20, use_pallas=False,
+    )
+
+
+def bench_transformer_long_context() -> dict:
+    """Long-context training (seq 1024) with the Pallas flash-attention
+    kernel on — the memory-bound regime where the fused online-softmax
+    kernel avoids materializing [T, T] score matrices."""
+    return _bench_transformer_ctx(
+        "transformer_long_ctx_tokens_per_sec", batch_size=8, seq_len=1024,
+        iters=10, use_pallas=True, extra={"seq_len": 1024},
+    )
+
+
+def bench_transformer_xl_context() -> dict:
+    """Sequence 4096 training — the regime the Pallas flash kernel EXISTS
+    for: a dense [T, T] score matrix at T=4096 is 128 MB per head per
+    direction (f32) and the dense path OOMs/thrashes, while the streaming
+    kernel holds O(T*dh)."""
+    return _bench_transformer_ctx(
+        "transformer_xl_ctx_tokens_per_sec", batch_size=2, seq_len=4096,
+        iters=6, use_pallas=True, extra={"seq_len": 4096},
+    )
 
 
 def bench_lstm_textcls() -> dict:
@@ -856,7 +826,8 @@ def bench_allreduce_virtual8() -> dict:
 def main() -> None:
     for fn in (bench_resnet, bench_nmt, bench_allreduce,
                bench_allreduce_virtual8, bench_transformer,
-               bench_transformer_long_context, bench_lstm_textcls,
+               bench_transformer_long_context, bench_transformer_xl_context,
+               bench_lstm_textcls,
                bench_alexnet, bench_googlenet, bench_smallnet,
                bench_resnet_pipeline):
         try:

@@ -15,9 +15,13 @@ accepted, as protobuf parsers must.
 
 from __future__ import annotations
 
+import ctypes
 import dataclasses
 import gzip
+import os
 import struct
+import subprocess
+import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -184,6 +188,148 @@ def _parse_sample(buf: bytes) -> DataSample:
                     _collect_uint32(ss.lens, wt2, v2)
             s.subseq_slots.append(ss)
     return s
+
+
+# ---------------------------------------------------------------------------
+# native fast path (paddle_tpu/native/protodata.cc): one-pass C++ decode of
+# DENSE+INDEX files (the mnist_bin_part shape) into contiguous numpy
+# buffers; anything else (sparse, sequences, gzip) falls back to the
+# pure-Python decoder below.
+# ---------------------------------------------------------------------------
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_SRC = os.path.join(_PKG_ROOT, "native", "protodata.cc")
+_NATIVE_SO = os.path.join(_PKG_ROOT, "native", "build", "libpaddle_tpu_protodata.so")
+_native_lib = None
+_native_tried = False
+_native_lock = threading.Lock()
+
+
+def _load_native():
+    global _native_lib, _native_tried
+    with _native_lock:
+        if _native_tried:
+            return _native_lib
+        _native_tried = True
+        try:
+            have_so = os.path.exists(_NATIVE_SO)
+            have_src = os.path.exists(_NATIVE_SRC)
+            stale = (
+                have_so and have_src
+                and os.path.getmtime(_NATIVE_SO) < os.path.getmtime(_NATIVE_SRC)
+            )
+            if (not have_so or stale) and have_src:
+                os.makedirs(os.path.dirname(_NATIVE_SO), exist_ok=True)
+                # build to a per-pid temp and rename: concurrent processes
+                # (pytest workers, multi-process launch) must never CDLL a
+                # half-written .so
+                tmp = f"{_NATIVE_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     _NATIVE_SRC, "-o", tmp],
+                    check=True, capture_output=True,
+                )
+                os.replace(tmp, _NATIVE_SO)
+            elif not have_so:
+                return None
+            lib = ctypes.CDLL(_NATIVE_SO)
+            lib.pdx_scan.restype = ctypes.c_int
+            lib.pdx_scan.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_uint),
+                ctypes.c_int,
+            ]
+            lib.pdx_decode_dense_index.restype = ctypes.c_int
+            lib.pdx_decode_dense_index.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.c_longlong,
+            ]
+            _native_lib = lib
+        except Exception:
+            _native_lib = None
+        return _native_lib
+
+
+# (path, size, mtime_ns) -> (defs, count) or None: skips the full scan walk
+# on later epochs, and remembers which files can NEVER take the fast path so
+# they don't pay a C++ parse before every Python fallback.
+_scan_cache: dict = {}
+
+
+def _native_scan(lib, path: str):
+    key = None
+    try:
+        st = os.stat(path)
+        key = (str(path), st.st_size, st.st_mtime_ns)
+        if key in _scan_cache:
+            return _scan_cache[key]
+    except OSError:
+        pass
+    max_slots = 64
+    n = ctypes.c_longlong(0)
+    ns = ctypes.c_int(0)
+    types = (ctypes.c_int * max_slots)()
+    dims = (ctypes.c_uint * max_slots)()
+    rc = lib.pdx_scan(
+        str(path).encode(), ctypes.byref(n), ctypes.byref(ns), types, dims,
+        max_slots,
+    )
+    out = (
+        ([SlotDef(types[i], int(dims[i])) for i in range(ns.value)], int(n.value))
+        if rc == 0
+        else None
+    )
+    if key is not None:
+        if len(_scan_cache) > 1024:
+            _scan_cache.clear()
+        _scan_cache[key] = out
+    return out
+
+
+def native_decode_dense_index(path: str):
+    """(defs, arrays-aligned-to-defs) via the C++ decoder, or None when the
+    file is not the dense/index fast path (or the native lib is absent)."""
+    if str(path).endswith(".gz"):
+        return None
+    lib = _load_native()
+    if lib is None:
+        return None
+    scanned = _native_scan(lib, path)
+    if scanned is None:
+        return None
+    defs, count = scanned
+    dense_arrays = [
+        np.empty((count, d.dim), np.float32) for d in defs if d.type == VECTOR_DENSE
+    ]
+    index_arrays = [
+        np.empty((count,), np.int32) for d in defs if d.type == INDEX
+    ]
+    dense_ptrs = (ctypes.c_void_p * max(len(dense_arrays), 1))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in dense_arrays]
+    )
+    index_ptrs = (ctypes.c_void_p * max(len(index_arrays), 1))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in index_arrays]
+    )
+    rc = lib.pdx_decode_dense_index(
+        str(path).encode(), dense_ptrs, index_ptrs, ctypes.c_longlong(count)
+    )
+    if rc != 0:
+        return None
+    out = []
+    di = ii = 0
+    for d in defs:
+        if d.type == VECTOR_DENSE:
+            out.append(dense_arrays[di])
+            di += 1
+        else:
+            out.append(index_arrays[ii])
+            ii += 1
+    return defs, out
 
 
 # ---------------------------------------------------------------------------
@@ -390,6 +536,23 @@ def make_reader(
         expect: Optional[List[SlotDef]] = None
         seq_acc: Optional[List[list]] = None
         for path in paths:
+            if not sequence:
+                nat = native_decode_dense_index(path)
+                if nat is not None:
+                    defs, arrays = nat
+                    if expect is None:
+                        expect = defs
+                    elif defs != expect:
+                        raise ValueError(
+                            f"{path}: slot defs {defs} differ from first "
+                            f"file's {expect}"
+                        )
+                    count = arrays[0].shape[0] if arrays else 0
+                    for i in range(count):
+                        yield tuple(
+                            a[i] if a.ndim == 2 else int(a[i]) for a in arrays
+                        )
+                    continue
             defs, samples = read_proto_data(path)
             if expect is None:
                 expect = defs

@@ -54,11 +54,15 @@ def _load_native():
             )
             if (not have_so or stale) and have_src:
                 os.makedirs(_BUILD_DIR, exist_ok=True)
+                # per-pid temp + rename: concurrent processes must never
+                # CDLL a half-written .so
+                tmp = f"{_SO}.{os.getpid()}.tmp"
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", _SRC, "-o", _SO],
+                     "-pthread", _SRC, "-o", tmp],
                     check=True, capture_output=True,
                 )
+                os.replace(tmp, _SO)
             elif not have_so:
                 return None  # neither a prebuilt .so nor source to build
             lib = ctypes.CDLL(_SO)

@@ -306,3 +306,29 @@ def test_compare_two_nets_rnn_vs_qb_rnn():
     ca, _ = na.cost(pla.params, fa(rows), state=pla.state, train=False)
     cb, _ = nb.cost(plb.params, fb(rows), state=plb.state, train=False)
     np.testing.assert_allclose(float(ca), float(cb), rtol=1e-6)
+
+
+def test_native_decoder_matches_python():
+    """The C++ fast-path decoder (native/protodata.cc) must agree with the
+    pure-Python wire decoder byte for byte on the dense/index mnist file,
+    and decline (None) on the sparse chunking file so the Python path
+    serves it."""
+    from paddle_tpu.io.protodata import native_decode_dense_index
+
+    nat = native_decode_dense_index(f"{REF_TESTS}/mnist_bin_part")
+    if nat is None:
+        pytest.skip("native toolchain unavailable")
+    defs, arrs = nat
+    assert [d.type for d in defs] == [VECTOR_DENSE, INDEX]
+    d2, samples = read_proto_data(f"{REF_TESTS}/mnist_bin_part")
+    assert d2 == defs and arrs[0].shape == (len(samples), 784)
+    for i in (0, 1, 613, len(samples) - 1):
+        np.testing.assert_array_equal(
+            arrs[0][i], np.asarray(samples[i].vector_slots[0].values, np.float32)
+        )
+        assert int(arrs[1][i]) == samples[i].id_slots[0]
+    # sparse slots are NOT the fast path
+    assert native_decode_dense_index(f"{REF_TESTS}/data_bin_part") is None
+    # the reader uses the fast path transparently
+    rows = list(make_reader([f"{REF_TESTS}/mnist_bin_part"])())
+    assert len(rows) == len(samples) and rows[0][0].shape == (784,)
