@@ -1,0 +1,9 @@
+"""``python -m paddle_tpu <command>`` — same face as the ``paddle-tpu``
+console script (the reference's ``paddle`` wrapper, submit_local.sh.in)."""
+
+import sys
+
+from paddle_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

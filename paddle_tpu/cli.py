@@ -1,0 +1,469 @@
+"""The ``paddle train`` command-line face.
+
+The reference's primary entry point is a command line
+(``paddle/trainer/TrainerMain.cpp:32-65``): ``paddle_trainer --config=...
+--save_dir=... --num_passes=...`` wrapped by the ``paddle`` shell script
+(``paddle/scripts/submit_local.sh.in``), with ``--job`` selecting
+train / test / time / checkgrad (TrainerBenchmark.cpp:71 for ``time``).
+This module is that face over the TPU-native stack: ``paddle-tpu train
+--config=conf.py`` (or ``python -m paddle_tpu train ...``) runs any v1
+config file unmodified — parse → compile → jitted-step pass loop, with
+``pass-%05d/`` checkpoint dirs exactly like the reference trainer writes.
+
+Flags mirror the reference gflags (Flags.cpp) in ``--name=value`` form;
+argparse also accepts ``--name value``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def _build_train_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu train",
+        description="Train/test/time a v1 config file "
+        "(reference paddle_trainer, TrainerMain.cpp).",
+    )
+    ap.add_argument("--config", required=True, help="v1 config file (.py/.conf)")
+    ap.add_argument(
+        "--config_args", default="",
+        help="comma-separated key=value pairs passed to the config "
+        "(get_config_arg)",
+    )
+    ap.add_argument(
+        "--job", default="train",
+        choices=["train", "test", "time", "checkgrad"],
+        help="one of (train, test, time, checkgrad) — TrainerMain.cpp:51-62",
+    )
+    ap.add_argument("--save_dir", default=None, help="write pass-%%05d/ checkpoints here")
+    ap.add_argument("--num_passes", type=int, default=1)
+    ap.add_argument("--start_pass", type=int, default=0)
+    ap.add_argument(
+        "--init_model_path", default=None,
+        help="load initial parameters from this pass dir (ParamUtil.cpp)",
+    )
+    ap.add_argument("--saving_period", type=int, default=1)
+    ap.add_argument("--saving_period_by_batches", type=int, default=0)
+    ap.add_argument("--batch_size", type=int, default=0,
+                    help="override the config's settings(batch_size=...)")
+    ap.add_argument("--log_period", type=int, default=None)
+    ap.add_argument("--dot_period", type=int, default=1,
+                    help="print a '.' every N batches (reference TrainerInternal)")
+    ap.add_argument("--show_parameter_stats_period", type=int, default=None)
+    ap.add_argument("--test_period", type=int, default=50,
+                    help="--job=time: number of timed batches "
+                    "(TrainerBenchmark.cpp:79)")
+    ap.add_argument("--feed_data", action="store_true",
+                    help="--job=time: refetch a fresh batch every timed step "
+                    "instead of reusing one (TrainerBenchmark.cpp:80-83)")
+    ap.add_argument("--seed", type=int, default=None)
+    # accepted for surface compatibility; the platform comes from jax
+    ap.add_argument("--use_tpu", type=_flag_bool, default=True, nargs="?", const=True)
+    ap.add_argument("--use_gpu", type=_flag_bool, default=False, nargs="?", const=True)
+    ap.add_argument("--trainer_count", type=int, default=1)
+    ap.add_argument("--async_load_data", type=_flag_bool, default=True)
+    return ap
+
+
+def _flag_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("1", "true", "yes")
+
+
+def _echo(msg: str) -> None:
+    print(msg, flush=True)
+
+
+def _load_init_model(trainer, path: str) -> None:
+    """--init_model_path: a pass dir (params.tar and/or v1 per-parameter
+    binaries) or a merged-model bundle."""
+    from paddle_tpu import checkpoint as ckpt
+
+    if os.path.isdir(path):
+        ckpt.load_parameter_dir(trainer.parameters, path)
+    else:
+        with open(path, "rb") as f:
+            trainer.parameters.from_tar(f)
+    trainer._reshard_after_restore()
+
+
+def _make_trainer(parsed, seed: int):
+    from paddle_tpu import parameters as v2_params
+    from paddle_tpu import trainer as v2_trainer
+    from paddle_tpu.v1_compat import make_optimizer
+
+    params = v2_params.create(parsed.topology, seed=seed)
+    return v2_trainer.SGD(
+        cost=parsed.topology,
+        parameters=params,
+        update_equation=make_optimizer(parsed.settings),
+        evaluators=list(parsed.evaluators),
+        seed=seed,
+    )
+
+
+def cmd_train(argv: List[str]) -> int:
+    args = _build_train_parser().parse_args(argv)
+    from paddle_tpu import event as v2_event
+    from paddle_tpu import minibatch
+    from paddle_tpu.utils import flags as _flags
+    from paddle_tpu.v1_compat import make_config_reader, parse_config
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    if args.log_period is not None:
+        _flags.set_flag("log_period", args.log_period)
+    if args.show_parameter_stats_period is not None:
+        _flags.set_flag(
+            "show_parameter_stats_period", args.show_parameter_stats_period
+        )
+    if args.seed is not None:
+        _flags.set_flag("seed", args.seed)
+    _flags.set_flag("trainer_count", args.trainer_count)
+    seed = _flags.get_flag("seed")
+
+    config_path = os.path.abspath(args.config)
+    config_dir = os.path.dirname(config_path)
+    parsed = parse_config(config_path, args.config_args)
+    batch_size = args.batch_size or parsed.settings.batch_size
+    trainer = _make_trainer(parsed, seed)
+
+    if args.init_model_path:
+        _load_init_model(trainer, args.init_model_path)
+    elif args.start_pass > 0 and args.save_dir:
+        # resume from the last completed pass (reference ParamUtil
+        # loadParametersWithPath from save_dir/pass-%05d)
+        trainer.load_pass(args.save_dir, args.start_pass - 1)
+
+    if args.job == "train":
+        return _job_train(args, parsed, trainer, batch_size, config_dir, v2_event, minibatch, make_config_reader)
+    if args.job == "test":
+        return _job_test(args, parsed, trainer, batch_size, config_dir, minibatch, make_config_reader)
+    if args.job == "time":
+        return _job_time(args, parsed, trainer, batch_size, config_dir, minibatch, make_config_reader)
+    if args.job == "checkgrad":
+        return _job_checkgrad(args, parsed, trainer, batch_size, config_dir, minibatch, make_config_reader)
+    raise AssertionError(args.job)
+
+
+def _job_train(args, parsed, trainer, batch_size, config_dir,
+               v2_event, minibatch, make_config_reader) -> int:
+    reader = make_config_reader(parsed, config_dir, train=True)
+    test_reader = None
+    has_test = (
+        parsed.test_data is not None
+        or (parsed.data_sources is not None and parsed.data_sources.test_list)
+    )
+    if has_test:
+        try:
+            test_reader = make_config_reader(parsed, config_dir, train=False)
+        except (ValueError, FileNotFoundError) as e:
+            _echo(f"test data declared but unavailable ({e}); skipping eval")
+
+    dot = max(args.dot_period, 0)
+    t0 = time.time()
+
+    def handler(ev) -> None:
+        if isinstance(ev, v2_event.EndIteration):
+            if dot and (ev.batch_id + 1) % dot == 0:
+                sys.stdout.write(".")
+                sys.stdout.flush()
+        elif isinstance(ev, v2_event.EndPass):
+            sys.stdout.write("\n")
+            _echo(
+                f"Pass {ev.pass_id}: mean cost "
+                f"{ev.evaluator.get('mean_cost', float('nan')):.6f} "
+                f"({time.time() - t0:.1f}s elapsed)"
+            )
+            for k, v in sorted(ev.evaluator.items()):
+                if k != "mean_cost":
+                    _echo(f"  {k} = {v}")
+            if test_reader is not None:
+                res = trainer.test(
+                    reader=minibatch.batch(test_reader, batch_size),
+                    feeding=parsed.feeding,
+                )
+                _echo(f"Test with Pass {ev.pass_id}: cost {res.cost:.6f}")
+                for k, v in sorted(res.metrics.items()):
+                    _echo(f"  {k} = {v}")
+
+    trainer.train(
+        reader=minibatch.batch(reader, batch_size),
+        num_passes=args.num_passes,
+        event_handler=handler,
+        feeding=parsed.feeding,
+        save_dir=args.save_dir,
+        saving_period=args.saving_period,
+        saving_period_by_batches=args.saving_period_by_batches or None,
+        start_pass=args.start_pass,
+        async_load_data=args.async_load_data,
+    )
+    return 0
+
+
+def _job_test(args, parsed, trainer, batch_size, config_dir,
+              minibatch, make_config_reader) -> int:
+    """--job=test (reference Tester.cpp): evaluate the loaded model on the
+    config's test stream (train stream when no test stream is declared)."""
+    try:
+        reader = make_config_reader(parsed, config_dir, train=False)
+    except (ValueError, FileNotFoundError):
+        _echo("no test data declared; evaluating on the train stream")
+        reader = make_config_reader(parsed, config_dir, train=True)
+    res = trainer.test(
+        reader=minibatch.batch(reader, batch_size), feeding=parsed.feeding
+    )
+    _echo(f"Test cost {res.cost:.6f}")
+    for k, v in sorted(res.metrics.items()):
+        _echo(f"  {k} = {v}")
+    return 0
+
+
+def _job_time(args, parsed, trainer, batch_size, config_dir,
+              minibatch, make_config_reader) -> int:
+    """--job=time (TrainerBenchmark.cpp:30-90): 10 burn-in steps on one
+    batch, then ``--test_period`` timed steps; prints the StatSet table the
+    reference prints via globalStat.printSegTimerStatus()."""
+    import jax
+
+    from paddle_tpu.parallel.mesh import shard_batch
+    from paddle_tpu.utils.timers import global_stats, stat_timer
+
+    reader = make_config_reader(parsed, config_dir, train=True)
+    batches = minibatch.batch(reader, batch_size)()
+    feeder = trainer._make_feeder(parsed.feeding)
+
+    def next_batch():
+        nonlocal batches
+        with stat_timer("GetData"):
+            try:
+                raw = next(batches)
+            except StopIteration:
+                batches = minibatch.batch(reader, batch_size)()
+                raw = next(batches)
+            return shard_batch(feeder(raw), trainer.mesh)
+
+    batch = next_batch()
+    params, state = trainer.parameters.params, trainer.parameters.state
+    opt_state = trainer._opt_state
+    rng = jax.random.PRNGKey(0)
+
+    def one_step(params, state, opt_state, batch, rng):
+        rng, step_rng = jax.random.split(rng)
+        params, state, opt_state, metrics = trainer._train_step(
+            params, state, opt_state, batch, step_rng
+        )
+        return params, state, opt_state, metrics, rng
+
+    _echo("Burning time...")
+    for _ in range(10):
+        params, state, opt_state, metrics, rng = one_step(
+            params, state, opt_state, batch, rng
+        )
+    # host sync before the clock starts (axon returns early from
+    # block_until_ready; a host fetch is the reliable barrier)
+    float(np.asarray(metrics["cost"]))
+    _echo("Burning time end.")
+
+    n = 0
+    t0 = time.time()
+    for _ in range(max(args.test_period, 1)):
+        if args.feed_data:
+            batch = next_batch()
+        with stat_timer("FwdBwd"):
+            params, state, opt_state, metrics, rng = one_step(
+                params, state, opt_state, batch, rng
+            )
+        n += 1
+    float(np.asarray(metrics["cost"]))
+    dt = time.time() - t0
+    global_stats.print_all_status()  # prints the StatSet table itself
+    _echo(
+        f"{n} batches of {batch_size}: {dt * 1000 / n:.3f} ms/batch, "
+        f"{n * batch_size / dt:.1f} samples/sec"
+    )
+    global_stats.reset()
+    return 0
+
+
+def _job_checkgrad(args, parsed, trainer, batch_size, config_dir,
+                   minibatch, make_config_reader) -> int:
+    """--job=checkgrad (Trainer::checkGradient, Trainer.cpp): compare the
+    VJP gradient of the total cost against a central finite difference of
+    the directional derivative, per parameter tensor.  Runs the graph in
+    float64 — the reference gets its fd accuracy from the double-precision
+    build (WITH_DOUBLE); in f32 the forward noise (~1e-4 relative for an
+    800-wide MLP) swamps any usable eps."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from paddle_tpu.parallel.mesh import shard_batch
+
+    reader = make_config_reader(parsed, config_dir, train=True)
+    raw = next(minibatch.batch(reader, min(batch_size, 8))())
+    feeder = trainer._make_feeder(parsed.feeding)
+    batch = shard_batch(feeder(raw), trainer.mesh)
+
+    def _f64(x):
+        arr = np.asarray(x)
+        return arr.astype(np.float64) if np.issubdtype(arr.dtype, np.floating) else arr
+
+    batch = jax.tree.map(_f64, batch)
+    net = trainer.network
+    state = trainer.parameters.state
+    rng = jax.random.PRNGKey(0)
+    out_names = list(net.topology.output_names)
+
+    def total_cost(params):
+        outs, _ = net.apply(params, batch, state=state, train=True, rng=rng)
+        total = 0.0
+        for name in out_names:
+            v = outs[name]
+            arr = v.data if hasattr(v, "data") else v
+            total = total + arr.astype("float64").mean()
+        return total
+
+    def loss(params) -> float:
+        return float(np.asarray(total_cost(params)))
+
+    base = jax.tree.map(_f64, trainer.parameters.params)
+    grads = jax.grad(total_cost)(base)
+
+    # Directional derivative per parameter tensor, the reference's scheme
+    # (perturb the whole parameter by a random delta, compare the cost
+    # change against <grad, delta>).
+    rng_np = np.random.RandomState(0)
+    worst = 0.0
+    failed = []
+    eps = 1e-5
+    for pname, g in sorted(grads.items()):
+        for wname, gval in sorted(g.items()):
+            gval = np.asarray(gval, np.float64)
+            w0 = np.asarray(base[pname][wname], np.float64)
+            d = rng_np.standard_normal(w0.shape)
+            d /= max(np.linalg.norm(d), 1e-12)
+            pert = dict(base)
+            pert[pname] = dict(base[pname])
+            pert[pname][wname] = w0 + eps * d
+            lp = loss(pert)
+            pert[pname][wname] = w0 - eps * d
+            lm = loss(pert)
+            fd = (lp - lm) / (2 * eps)
+            an = float((gval * d).sum())
+            denom = max(abs(fd), abs(an), 1e-8)
+            rel = abs(fd - an) / denom
+            worst = max(worst, rel)
+            if rel > 1e-3:
+                failed.append((f"{pname}.{wname}", an, fd, rel))
+    if failed:
+        for name, an, fd, rel in failed:
+            _echo(f"FAIL {name}: analytic {an:.6g} vs fd {fd:.6g} (rel {rel:.3g})")
+        return 1
+    _echo(f"checkgrad PASSED ({len(grads)} parameters, worst rel err {worst:.3g})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# non-train subcommands (submit_local.sh.in:114-135)
+# ---------------------------------------------------------------------------
+
+def cmd_version(argv: List[str]) -> int:
+    import jax
+
+    import paddle_tpu
+
+    print(f"paddle-tpu {paddle_tpu.__version__}, running on")
+    print(f"    jax: {jax.__version__}")
+    try:
+        devs = jax.devices()
+        print(f"    devices: {[str(d) for d in devs]}")
+    except RuntimeError as e:
+        print(f"    devices: unavailable ({e})")
+    return 0
+
+
+def cmd_dump_config(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(prog="paddle-tpu dump_config")
+    ap.add_argument("config")
+    ap.add_argument("--config_args", default="")
+    args = ap.parse_args(argv)
+    from paddle_tpu.utils.model_tools import dump_config
+
+    print(dump_config(args.config, args.config_args))
+    return 0
+
+
+def cmd_make_diagram(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(prog="paddle-tpu make_diagram")
+    ap.add_argument("config")
+    ap.add_argument("dot_file")
+    ap.add_argument("--config_args", default="")
+    args = ap.parse_args(argv)
+    from paddle_tpu.utils.model_tools import make_diagram
+    from paddle_tpu.v1_compat import parse_config
+
+    parsed = parse_config(os.path.abspath(args.config), args.config_args)
+    make_diagram(parsed.topology, args.dot_file)
+    print(f"wrote {args.dot_file}")
+    return 0
+
+
+def cmd_merge_model(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(prog="paddle-tpu merge_model")
+    ap.add_argument("--model_dir", required=True, help="a pass-%%05d dir")
+    ap.add_argument("--config_file", required=True)
+    ap.add_argument("--model_file", required=True, help="output bundle path")
+    ap.add_argument("--config_args", default="")
+    args = ap.parse_args(argv)
+    from paddle_tpu import checkpoint as ckpt
+    from paddle_tpu import parameters as v2_params
+    from paddle_tpu.utils.model_tools import merge_model
+    from paddle_tpu.v1_compat import parse_config
+
+    parsed = parse_config(os.path.abspath(args.config_file), args.config_args)
+    params = v2_params.create(parsed.topology)
+    ckpt.load_parameter_dir(params, args.model_dir)
+    merge_model(params, args.model_file)
+    print(f"wrote {args.model_file}")
+    return 0
+
+
+_COMMANDS = {
+    "train": cmd_train,
+    "version": cmd_version,
+    "dump_config": cmd_dump_config,
+    "make_diagram": cmd_make_diagram,
+    "merge_model": cmd_merge_model,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: paddle-tpu <command> [<flags>]")
+        print("commands:")
+        print("    train             train/test/time a v1 config (--job=...)")
+        print("    version           print version + device info")
+        print("    dump_config       print the resolved topology of a config")
+        print("    make_diagram      write a Graphviz diagram of a config")
+        print("    merge_model       bundle config + parameters into one file")
+        return 0 if argv else 1
+    cmd, rest = argv[0], argv[1:]
+    if cmd not in _COMMANDS:
+        print(f"unknown command {cmd!r}; try 'paddle-tpu --help'", file=sys.stderr)
+        return 1
+    return _COMMANDS[cmd](rest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,3 +71,9 @@ def train100():
 
 def test100():
     return _reader("cifar-100-python.tar.gz", "test", 100, SYNTH_TEST, 9)
+def convert(path):
+    """Export to recordio shards for the master (reference cifar.py:132)."""
+    common.convert(path, train100(), 1000, "cifar_train100")
+    common.convert(path, test100(), 1000, "cifar_test100")
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

@@ -63,3 +63,47 @@ def build_word_dict(docs, cutoff: int = 0):
         for w in words:
             freq[w] = freq.get(w, 0) + 1
     return dict_from_freq(freq, cutoff)
+
+
+def convert(output_path, reader, line_count, name_prefix, seed: int = 0):
+    """Export any reader to recordio shards the elastic master serves
+    (reference python/paddle/v2/dataset/common.py:187 ``convert``; every
+    dataset module exposes a ``convert(path)`` built on it).
+
+    Samples are pickled one-per-record into ``<output_path>/<name_prefix>-
+    %05d`` shard files, ``line_count`` samples per shard, each shard
+    shuffled before writing (the reference's max_lines_to_shuffle).  Feed
+    the shards to ``master.Service.set_dataset([pattern])`` and read them
+    back through ``reader.creator.cloud_reader`` (or ``recordio_local``
+    without a master).
+
+    Returns the list of shard paths written."""
+    import pickle
+    import random
+
+    from paddle_tpu.io import recordio
+
+    if line_count < 1:
+        raise ValueError(f"line_count must be >= 1, got {line_count}")
+    os.makedirs(output_path, exist_ok=True)
+    rng = random.Random(seed)
+    paths = []
+
+    def write_shard(samples):
+        path = os.path.join(output_path, f"{name_prefix}-{len(paths):05d}")
+        rng.shuffle(samples)
+        recordio.write_records(
+            path, (pickle.dumps(s, protocol=pickle.HIGHEST_PROTOCOL)
+                   for s in samples),
+        )
+        paths.append(path)
+
+    buf = []
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) >= line_count:
+            write_shard(buf)
+            buf = []
+    if buf or not paths:  # an empty reader still writes one (empty) shard
+        write_shard(buf)
+    return paths

@@ -198,3 +198,8 @@ def test():
             )
         )
     return reader_creator()
+def convert(path):
+    """Export to recordio shards for the master (reference conll05.py; only
+    the test split is publicly redistributable, so it stands in for both)."""
+    common.convert(path, test(), 1000, "conl105_train")
+    common.convert(path, test(), 1000, "conl105_test")

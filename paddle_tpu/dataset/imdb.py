@@ -88,3 +88,8 @@ def train(word_idx):
 
 def test(word_idx):
     return _reader(word_idx, False, _SYNTH_TEST, seed=23)
+def convert(path):
+    """Export to recordio shards for the master (reference imdb.py)."""
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

@@ -120,3 +120,9 @@ def train(word_idx, n, data_type=DataType.NGRAM):
 
 def test(word_idx, n, data_type=DataType.NGRAM):
     return _reader(word_idx, n, data_type, train_split=False)
+def convert(path):
+    """Export to recordio shards for the master (reference imikolov.py)."""
+    n = 5
+    word_idx = build_dict()
+    common.convert(path, train(word_idx, n), 1000, "imikolov_train")
+    common.convert(path, test(word_idx, n), 1000, "imikolov_test")

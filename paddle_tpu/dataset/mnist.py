@@ -75,3 +75,7 @@ def test():
     if files:
         return _reader(_load_idx_images(files[0]), _load_idx_labels(files[1]))
     return _reader(*_synthetic(SYNTH_TEST, seed=11))
+def convert(path):
+    """Export to recordio shards for the master (reference mnist.py:118)."""
+    common.convert(path, train(), 1000, "mnist_train")
+    common.convert(path, test(), 1000, "mnist_test")

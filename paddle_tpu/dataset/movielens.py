@@ -212,3 +212,7 @@ def user_info():
 
 def movie_info():
     return _get_meta()[1]
+def convert(path):
+    """Export to recordio shards for the master (reference movielens.py)."""
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

@@ -80,3 +80,7 @@ def test():
             yield sample
 
     return reader
+def convert(path):
+    """Export to recordio shards for the master (reference sentiment.py)."""
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

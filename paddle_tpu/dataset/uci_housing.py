@@ -53,3 +53,7 @@ def test():
     d = _load_real() or _synthetic()
     n = int(d[0].shape[0] * 0.8)
     return _reader(d[0], d[1], n, d[0].shape[0])
+def convert(path):
+    """Export to recordio shards for the master (reference uci_housing.py)."""
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_housing_test")

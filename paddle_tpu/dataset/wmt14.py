@@ -122,3 +122,8 @@ def get_dict(dict_size: int, reverse: bool = True):
         src_dict = {v: k for k, v in src_dict.items()}
         trg_dict = {v: k for k, v in trg_dict.items()}
     return src_dict, trg_dict
+def convert(path):
+    """Export to recordio shards for the master (reference wmt14.py)."""
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")

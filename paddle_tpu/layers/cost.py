@@ -48,7 +48,14 @@ def cross_entropy_apply(conf, params, inputs, ctx):
     ids = _label_ids(label)
     logits = ctx.outputs.get(conf.inputs[0] + "@logits")
     if logits is not None:
-        logp = jax.nn.log_softmax(logits.data.astype(jnp.float32), axis=-1)
+        # promote (never truncate): f32 under bf16 mixed precision, but keep
+        # f64 when the checkgrad job runs the graph in double precision
+        logp = jax.nn.log_softmax(
+            logits.data.astype(
+                jnp.promote_types(logits.data.dtype, jnp.float32)
+            ),
+            axis=-1,
+        )
         cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
         return _per_sample(cost, prob)
     p = jnp.take_along_axis(prob.data, ids[..., None], axis=-1)[..., 0]

@@ -21,6 +21,7 @@ No per-timestep re-batching, no frame cloning — static shapes end to end.
 from __future__ import annotations
 
 import contextlib
+import warnings
 from typing import Dict, List, Optional, Sequence, Union
 
 import jax
@@ -414,6 +415,23 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             if boot is not None:
                 bt = ctx.outputs[boot]
                 if bt.is_seq:
+                    if bt.data.shape[1] > w:
+                        # the boot layer's PADDED width exceeds the link's
+                        # converged fixed-point width: any boot sequence
+                        # longer than w loses its tail here.  Lengths are
+                        # traced values, so whether real timesteps (vs mere
+                        # padding, e.g. bucketed feeder pads) are dropped
+                        # is unknowable at trace time — warn with both
+                        # widths instead of clipping silently (the lengths
+                        # clamp below keeps ≤w boots exactly correct).
+                        warnings.warn(
+                            f"seq memory '{m.name}': boot layer '{boot}' is "
+                            f"padded to {bt.data.shape[1]} steps but the "
+                            f"linked layer's fixed-point width is {w}; boot "
+                            f"sequences longer than {w} steps will be "
+                            "truncated before the first outer step",
+                            stacklevel=2,
+                        )
                     d = bt.data[:, :w]
                     if d.shape[1] < w:
                         pad = [(0, 0), (0, w - d.shape[1])] + [(0, 0)] * (

@@ -105,6 +105,17 @@ bool walk_sample(const uint8_t* msg, size_t len,
   Buf b{msg, len};
   size_t vec_i = 0;   // which dense slot (in slot order of kind)
   size_t idx_i = 0;   // which index value
+  // Declared slot counts, computed up front: every write below must be
+  // bounded by these.  The caller allocates exactly want_vec/want_idx
+  // pointers, and the file content is re-read after pdx_scan (whose result
+  // may come from a cache), so a sample with more slots than declared must
+  // fail cleanly here rather than index past the pointer arrays.
+  size_t want_vec = 0, want_idx = 0;
+  for (const auto& d : defs) {
+    if (d.type == kDense) ++want_vec;
+    else if (d.type == kIndex) ++want_idx;
+    else return false;
+  }
   while (b.pos < b.n && b.ok) {
     uint64_t key = b.varint();
     int field = static_cast<int>(key >> 3), wt = static_cast<int>(key & 7);
@@ -121,6 +132,7 @@ bool walk_sample(const uint8_t* msg, size_t len,
         if (f2 == 1 && w2 == 2) {  // packed float values
           uint64_t bytes = s.varint();
           if (!s.ok || bytes > s.n - s.pos || bytes % 4) return false;
+          if (vec_i >= want_vec) return false;
           if (dense_fill) {
             // find the vec_i-th DENSE slot's dim for bounds checking
             size_t seen = 0;
@@ -153,6 +165,7 @@ bool walk_sample(const uint8_t* msg, size_t len,
         Buf s{b.p + b.pos, static_cast<size_t>(bytes)};
         while (s.pos < s.n && s.ok) {
           uint64_t v = s.varint();
+          if (idx_i >= want_idx) return false;
           if (index_fill) index_fill[idx_i][sample_idx] = static_cast<int32_t>(v);
           ++idx_i;
         }
@@ -160,6 +173,7 @@ bool walk_sample(const uint8_t* msg, size_t len,
         b.pos += bytes;
       } else {
         uint64_t v = b.varint();
+        if (idx_i >= want_idx) return false;
         if (index_fill) index_fill[idx_i][sample_idx] = static_cast<int32_t>(v);
         ++idx_i;
       }
@@ -168,13 +182,7 @@ bool walk_sample(const uint8_t* msg, size_t len,
     }
   }
   if (!b.ok) return false;
-  // every declared slot must have appeared
-  size_t want_vec = 0, want_idx = 0;
-  for (const auto& d : defs) {
-    if (d.type == kDense) ++want_vec;
-    else if (d.type == kIndex) ++want_idx;
-    else return false;
-  }
+  // every declared slot must have appeared (exactly once / exactly dim ids)
   return vec_i == want_vec && idx_i == want_idx;
 }
 

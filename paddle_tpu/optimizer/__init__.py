@@ -56,18 +56,54 @@ def linear_schedule(a: float, b: float) -> Schedule:
 
 
 def manual_schedule(boundaries, multipliers) -> Schedule:
-    """Piecewise-constant by step (reference "manual"/"pass_manual")."""
+    """Piecewise-constant (reference "manual"/"pass_manual", ManualLRS):
+    the first segment with counter <= boundaries[i] selects multipliers[i];
+    past the last boundary the last multiplier holds
+    (LearningRateScheduler.cpp ManualLRS::calc)."""
     bs = jnp.asarray(boundaries, jnp.float32)
     ms = jnp.asarray(multipliers, jnp.float32)
 
     def fn(step):
-        idx = jnp.sum((step >= bs).astype(jnp.int32))
+        # count of boundaries strictly below: num <= segments_[i] keeps
+        # segment i, matching the reference's closed upper bound
+        idx = jnp.sum((step > bs).astype(jnp.int32))
         return ms[jnp.minimum(idx, ms.shape[0] - 1)]
 
     return fn
 
 
-def make_schedule(name: str, a: float = 0.0, b: float = 0.0, max_steps: float = 0.0) -> Schedule:
+def parse_lr_args(args: str):
+    """The reference's ``learning_rate_args`` boundary string
+    ``'seg0:rate0,seg1:rate1,...'`` (LearningRateScheduler.cpp ManualLRS
+    ctor) -> (segments, rates)."""
+    segments, rates = [], []
+    for piece in (args or "").split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        seg, sep, rate = piece.partition(":")
+        if not sep:
+            raise ValueError(
+                f"wrong format for learning_rate_args {args!r}: expected "
+                "'seg0:rate0,seg1:rate1,...'"
+            )
+        segments.append(float(seg))
+        rates.append(float(rate))
+    if not segments:
+        raise ValueError(
+            f"learning_rate_schedule 'manual'/'pass_manual' needs a "
+            f"non-empty learning_rate_args; got {args!r}"
+        )
+    return segments, rates
+
+
+def make_schedule(
+    name: str,
+    a: float = 0.0,
+    b: float = 0.0,
+    max_steps: float = 0.0,
+    args: str = "",
+) -> Schedule:
     if name in ("constant", "fixed", ""):
         return constant_schedule()
     if name == "poly":
@@ -80,7 +116,22 @@ def make_schedule(name: str, a: float = 0.0, b: float = 0.0, max_steps: float = 
         return discexp_schedule(a, b)
     if name == "linear":
         return linear_schedule(a, b)
+    if name in ("manual", "pass_manual"):
+        return manual_schedule(*parse_lr_args(args))
     raise ValueError(f"unknown learning_rate_schedule {name!r}")
+
+
+def schedule_counter_unit(name: str) -> str:
+    """What counter the reference feeds this schedule: "pass" for
+    pass_manual (calcLearningRate uses the pass index), "samples" for
+    manual (numSamplesProcessed), "step" otherwise (this framework's
+    schedules are expressed in update steps; v1 configs convert their
+    sample-based decay args via batch_size in make_optimizer)."""
+    if name == "pass_manual":
+        return "pass"
+    if name == "manual":
+        return "samples"
+    return "step"
 
 
 # ---------------------------------------------------------------------------
@@ -122,9 +173,11 @@ class Optimizer:
         learning_rate_decay_a: float = 0.0,
         learning_rate_decay_b: float = 0.0,
         learning_rate_max_steps: float = 1.0,
+        learning_rate_args: str = "",
         regularization: Optional[Any] = None,
         gradient_clipping_threshold: float = 0.0,
         model_average: Optional["ModelAverage"] = None,
+        samples_per_step: float = 1.0,
     ):
         self.learning_rate = learning_rate
         self.schedule = make_schedule(
@@ -132,7 +185,14 @@ class Optimizer:
             learning_rate_decay_a,
             learning_rate_decay_b,
             learning_rate_max_steps,
+            learning_rate_args,
         )
+        # "manual" boundaries count SAMPLES (reference numSamplesProcessed);
+        # samples_per_step (the batch size, set by v1_compat.make_optimizer)
+        # converts the step counter.  "pass_manual" counts passes: the
+        # trainer publishes the pass index into opt_state["pass"].
+        self.schedule_unit = schedule_counter_unit(learning_rate_schedule)
+        self.samples_per_step = float(samples_per_step)
         self.regularization = regularization
         self.clip = gradient_clipping_threshold
         self.model_average = model_average
@@ -143,6 +203,8 @@ class Optimizer:
 
     def init(self, params) -> OptState:
         state: OptState = {"step": jnp.zeros((), jnp.int32)}
+        if self.schedule_unit == "pass":
+            state["pass"] = jnp.zeros((), jnp.int32)
         state.update(self.init_slots(params))
         if self.model_average is not None:
             state["avg"] = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
@@ -159,7 +221,16 @@ class Optimizer:
 
     def update(self, grads, state: OptState, params) -> Tuple[Any, OptState]:
         step = state["step"]
-        lr = self.learning_rate * self.schedule(step.astype(jnp.float32))
+        if self.schedule_unit == "pass":
+            counter = state["pass"].astype(jnp.float32)
+        elif self.schedule_unit == "samples":
+            # the reference bumps numSamplesProcessed BEFORE computing the
+            # rate (ParameterUpdater.h startBatch/finishBatch order), so the
+            # first update already sees num = batchSize
+            counter = (step.astype(jnp.float32) + 1.0) * self.samples_per_step
+        else:
+            counter = step.astype(jnp.float32)
+        lr = self.learning_rate * self.schedule(counter)
 
         # global gradient clipping by value threshold (reference
         # gradient_clipping_threshold clips elementwise per parameter).

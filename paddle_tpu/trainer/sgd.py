@@ -218,6 +218,15 @@ class SGD:
         opt_state = self._opt_state
         for pass_id in range(start_pass, start_pass + num_passes):
             event_handler(v2_event.BeginPass(pass_id))
+            if "pass" in opt_state:
+                # pass_manual schedule: the optimizer reads the pass index
+                # (reference PassManualLRS calcLearningRate(_, pass)); the
+                # value is a traced scalar so updating it never recompiles
+                import jax.numpy as jnp
+
+                opt_state = {
+                    **opt_state, "pass": jnp.asarray(pass_id, jnp.int32)
+                }
             pass_costs: List[float] = []
             pass_accums: Dict[str, np.ndarray] = {}
             batches = (

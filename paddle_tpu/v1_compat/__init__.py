@@ -40,6 +40,8 @@ __all__ = [
     "ParsedConfig",
     "make_optimizer",
     "make_data_reader",
+    "make_provider_reader",
+    "make_config_reader",
 ]
 
 
@@ -77,6 +79,12 @@ class ParsedConfig:
     output_layers: List[str]
     evaluators: List = dataclasses.field(default_factory=list)
     provider_input_types: Optional[dict] = None  # name -> InputType (if resolved)
+    # Default feeding map {layer_name: index_in_sample_tuple}.  Non-None only
+    # when slot binding had to PERMUTE provider slots onto data layers (the
+    # unique-assignment path in _bind_slots): provider tuples stay in slot
+    # order, so the trainer must pair them through this map — pass it as
+    # DataFeeder's ``feeding``.  None ⇒ identity (positional) feeding.
+    feeding: Optional[dict] = None
     # old-face TrainData/TestData declarations (config_parser.py:1115)
     train_data: Optional[object] = None
     test_data: Optional[object] = None
@@ -220,7 +228,11 @@ def _resolve_proto_data_types(parsed: ParsedConfig, config_dir: str) -> bool:
     try:
         itypes = slot_input_types(defs, sequence=sequence)
         data_confs = list(parsed.topology.data_layers().values())
-        aligned = _bind_slots(itypes, data_confs, f"ProtoData({td.files})")
+        aligned, feeding = _bind_slots(
+            itypes, data_confs, f"ProtoData({td.files})"
+        )
+        if feeding is not None:
+            parsed.feeding = feeding
     except ValueError as e:
         # building/inspecting the topology must survive a data mismatch
         # (e.g. a fixture config whose slots feed raw-face groups we map
@@ -278,6 +290,110 @@ def make_data_reader(
     return rd
 
 
+def _load_provider_module(module_name: str, config_dir: str):
+    """Import a data-provider module for a config.  Loads by file path under
+    a config-dir-unique module name: different demo dirs reuse the same
+    provider module name (e.g. "dataprovider"), and importlib.import_module
+    would hand the second config the first one's cached module — wrong input
+    types, silently."""
+    mod_path = os.path.join(config_dir, module_name + ".py")
+    sys.path.insert(0, config_dir)  # provider's own sibling imports
+    try:
+        with _py2_shims():
+            if os.path.exists(mod_path):
+                uniq = (
+                    f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}"
+                    f"_{module_name}"
+                )
+                spec = importlib.util.spec_from_file_location(uniq, mod_path)
+                mod = importlib.util.module_from_spec(spec)
+                # py2-era provider files (reference demos predate python 3)
+                mod.xrange = range
+                mod.unicode = str
+                # re-executed on every call (parse-time + reader-build) so a
+                # failed or since-edited provider never serves stale; the
+                # sys.modules entry only exists for the provider's own
+                # relative imports during exec and is dropped on failure
+                sys.modules[uniq] = mod
+                try:
+                    spec.loader.exec_module(mod)
+                except BaseException:
+                    sys.modules.pop(uniq, None)
+                    raise
+                _py2_patch_siblings(config_dir)
+                return mod
+            mod = importlib.import_module(module_name)
+            _py2_patch_siblings(config_dir)
+            return mod
+    finally:
+        sys.path.pop(0)
+
+
+def _py2_patch_siblings(config_dir: str) -> None:
+    """Give py2-era helper modules the provider pulled in from the config
+    dir (e.g. v1_api_demo/mnist/mnist_util.py: `for i in xrange(n)`) the
+    same xrange/unicode aliases the provider module itself gets — their
+    generator bodies run at ITERATION time, long after the _py2_shims
+    context has exited."""
+    prefix = os.path.abspath(config_dir) + os.sep
+    for mod in list(sys.modules.values()):
+        f = getattr(mod, "__file__", None)
+        if f and os.path.abspath(f).startswith(prefix):
+            if not hasattr(mod, "xrange"):
+                mod.xrange = range
+            if not hasattr(mod, "unicode"):
+                mod.unicode = str
+
+
+def make_provider_reader(
+    parsed: ParsedConfig, config_dir: str, train: bool = True
+):
+    """Reader over a config's ``define_py_data_sources2`` declaration: import
+    the provider module and call its @provider factory with the train/test
+    file list + declared args — what the reference trainer does through
+    PyDataProvider2.cpp:665 (embed CPython, call the decorated object).
+    Returns a v2-style reader callable yielding sample tuples."""
+    ds = parsed.data_sources
+    if ds is None or not ds.module:
+        raise ValueError(
+            "config declares no define_py_data_sources2 provider"
+        )
+    module = ds.module if train else (ds.test_module or ds.module)
+    obj_name = ds.obj if train else (ds.test_obj or ds.obj)
+    mod = _load_provider_module(module, config_dir)
+    obj = getattr(mod, obj_name, None)
+    if obj is None:
+        raise ValueError(
+            f"provider module {module!r} has no object {obj_name!r}"
+        )
+    list_path = ds.train_list if train else ds.test_list
+    files = _read_file_list(list_path, config_dir)
+    # list entries are run-dir-relative in the reference; resolve against the
+    # config dir when the cwd doesn't have them so configs run from anywhere
+    files = [
+        f
+        if os.path.isabs(f) or os.path.exists(f)
+        else os.path.join(config_dir, f)
+        for f in files
+    ]
+    with _in_dir(config_dir), _py2_shims():
+        rd = obj(*files, is_train=train, **(ds.args or {}))
+    return rd
+
+
+def make_config_reader(
+    parsed: ParsedConfig, config_dir: str, train: bool = True
+):
+    """One entry point over both data planes: old-face
+    ``TrainData(ProtoData(...))`` binary files and
+    ``define_py_data_sources2`` python providers.  The CLI trainer feeds
+    from this."""
+    dc = parsed.train_data if train else parsed.test_data
+    if dc is not None and getattr(dc, "kind", None) == "proto":
+        return make_data_reader(parsed, config_dir, train=train)
+    return make_provider_reader(parsed, config_dir, train=train)
+
+
 def _mark_unresolved_msg(parsed: ParsedConfig, reason: str) -> None:
     for c in parsed.topology.data_layers().values():
         if c.attrs.get("_v1_size_only"):
@@ -308,7 +424,13 @@ def _bind_slots(itypes, data_confs, label: str):
     positional binding fails the check we search for the assignment that
     does dim-check.  A unique consistent assignment is used (with a
     warning); none or several → hard error, never a silent mis-feed.
-    Returns a list of types aligned with ``data_confs``."""
+    Returns ``(aligned, feeding)``: a list of types aligned with
+    ``data_confs`` plus a ``{layer_name: sample_index}`` feeding map —
+    ``None`` for the identity (positional) binding.  The feeding map is NOT
+    optional information when present: sample tuples stay in provider slot
+    order, so a permuted binding that is not also fed through this map would
+    deliver every value to the wrong layer (the types were re-aligned, the
+    data wasn't)."""
     n = len(data_confs)
     if len(itypes) != n:
         raise ValueError(
@@ -317,23 +439,31 @@ def _bind_slots(itypes, data_confs, label: str):
             f"({[c.name for c in data_confs]})"
         )
     if all(_slot_compatible(t, c) for t, c in zip(itypes, data_confs)):
-        return list(itypes)
+        return list(itypes), None
     # positional binding fails the dim check: search assignments over the
     # slot×layer candidate matrix
     cand = [
         [t if _slot_compatible(t, c) else None for c in data_confs]
         for t in itypes
     ]
-    out = _unique_assignment(cand, n)
-    if out is not None:
+    res = _unique_assignment(cand, n)
+    if res is not None:
+        out, assign = res
+        # assign[slot_i] = layer_j  ⇒  layer_j reads sample index slot_i
+        feeding = {
+            data_confs[j].name: i for i, j in enumerate(assign)
+        }
+        if all(i == j for i, j in enumerate(assign)):
+            feeding = None  # distinct mapping happens to be positional
         warnings.warn(
             f"{label}: provider slot types do not dim-check against the "
             f"data layers in feeding order "
             f"({[c.name for c in data_confs]}); using the unique "
-            "dim-consistent assignment instead",
+            "dim-consistent assignment instead"
+            + (f" with feeding map {feeding}" if feeding else ""),
             stacklevel=2,
         )
-        return out
+        return out, feeding
     raise ValueError(
         f"{label}: cannot bind provider slot types {itypes} to data layers "
         f"{[(c.name, c.size) for c in data_confs]}: no unique dim-consistent "
@@ -381,7 +511,7 @@ def _unique_assignment(cand, n: int):
     out = [None] * n
     for i, j in enumerate(first_sol):
         out[j] = cand[i][j]
-    return out
+    return out, list(first_sol)
 
 
 def _first_sample(obj, ds, config_dir: str):
@@ -405,30 +535,11 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     ds = parsed.data_sources
     if ds is None or not ds.module:
         return
-    # Load by file path under a config-dir-unique module name: different
-    # demo dirs reuse the same provider module name (e.g. "dataprovider"),
-    # and importlib.import_module would hand the second config the first
-    # one's cached module — wrong input types, silently.
-    mod_path = os.path.join(config_dir, ds.module + ".py")
-    sys.path.insert(0, config_dir)  # provider's own sibling imports
     try:
-        with _py2_shims():
-            if os.path.exists(mod_path):
-                uniq = f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}_{ds.module}"
-                spec = importlib.util.spec_from_file_location(uniq, mod_path)
-                mod = importlib.util.module_from_spec(spec)
-                # py2-era provider files (reference demos predate python 3)
-                mod.xrange = range
-                mod.unicode = str
-                sys.modules[uniq] = mod
-                spec.loader.exec_module(mod)
-            else:
-                mod = importlib.import_module(ds.module)
+        mod = _load_provider_module(ds.module, config_dir)
     except ImportError as e:
         _mark_unresolved(parsed, ds, f"provider module import failed: {e!r}")
         return
-    finally:
-        sys.path.pop(0)
     obj = getattr(mod, ds.obj, None)
     itypes = getattr(obj, "input_types", None)
     names = getattr(obj, "slot_names", None)
@@ -470,7 +581,17 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
             if all(t is not None for t in positional):
                 aligned = positional
             else:
-                aligned = _unique_assignment(cand, n)
+                res = _unique_assignment(cand, n)
+                if res is None:
+                    aligned = None
+                else:
+                    aligned, assign = res
+                    if any(i != j for i, j in enumerate(assign)):
+                        # permuted binding: feed tuples through the map
+                        parsed.feeding = {
+                            data_confs[j].name: i
+                            for i, j in enumerate(assign)
+                        }
             if aligned is not None:
                 itypes, names = aligned, [c.name for c in data_confs]
     if itypes is None:
@@ -496,11 +617,24 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
                 f"{label}: named slot types do not dim-check against their "
                 f"data layers: {bad}"
             )
+        # Sample tuples arrive in the provider's slot-NAME order; when that
+        # differs from feeding order the tuples must be re-paired by name.
+        name_pos = {nm: i for i, nm in enumerate(names)}
+        if any(
+            name_pos.get(c.name, j) != j for j, c in enumerate(data_confs)
+        ):
+            parsed.feeding = {
+                c.name: name_pos[c.name]
+                for c in data_confs
+                if c.name in name_pos
+            }
     else:
         # Positional provider types pair with data layers in FEEDING order
         # (Inputs()/DFS — see Topology.data_layers), validated against each
         # layer's declared size; mismatch → unique re-assignment or error.
-        aligned = _bind_slots(list(itypes), data_confs, label)
+        aligned, feeding = _bind_slots(list(itypes), data_confs, label)
+        if feeding is not None:
+            parsed.feeding = feeding
     resolved = {}
     for conf, t in zip(data_confs, aligned):
         if t is not None and conf.attrs.get("_v1_size_only"):
@@ -773,9 +907,13 @@ def make_optimizer(settings: TrainerSettings):
         learning_rate_schedule=settings.learning_rate_schedule,
         learning_rate_decay_a=settings.learning_rate_decay_a,
         learning_rate_decay_b=settings.learning_rate_decay_b,
+        learning_rate_args=getattr(settings, "learning_rate_args", ""),
         regularization=reg,
         gradient_clipping_threshold=settings.gradient_clipping_threshold or 0.0,
         model_average=avg,
+        # 'manual' boundaries are numSamplesProcessed in the reference;
+        # the step counter converts through the config's batch size
+        samples_per_step=float(settings.batch_size or 1),
     )
     extra = dict(getattr(method, "extra", {}))
     cls = {

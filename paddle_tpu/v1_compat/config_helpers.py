@@ -196,6 +196,9 @@ class TrainerSettings:
     learning_rate_decay_a: float = 0.0
     learning_rate_decay_b: float = 0.0
     learning_rate_schedule: str = "constant"
+    # 'seg0:rate0,seg1:rate1,...' for manual/pass_manual
+    # (LearningRateScheduler.cpp ManualLRS)
+    learning_rate_args: str = ""
     learning_method: Optional[BaseSGDOptimizer] = None
     regularization: Optional[BaseRegularization] = None
     model_average: Optional[ModelAverage] = None

@@ -24,8 +24,13 @@ setup(
     packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
     # native recordio source ships inside the package; compiled lazily at
     # first use (paddle_tpu/io/recordio.py), with a pure-python fallback
-    package_data={"paddle_tpu": ["native/recordio.cc"]},
+    package_data={"paddle_tpu": ["native/recordio.cc", "native/protodata.cc"]},
     include_package_data=True,
+    # the reference's `paddle` shell wrapper (submit_local.sh.in) — here a
+    # console script: `paddle-tpu train --config=... --save_dir=...`
+    entry_points={
+        "console_scripts": ["paddle-tpu=paddle_tpu.cli:main"],
+    },
     python_requires=">=3.11",  # BaseException.add_note in the error path
     install_requires=[
         "jax",

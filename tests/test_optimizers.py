@@ -190,3 +190,121 @@ def test_lr_schedules_scale_plain_sgd(schedule, a, b, expect):
     for t, (g, got) in enumerate(zip(grads, traj)):
         p = p - lr * expect(t) * g
         np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-6)
+
+
+def _manual_golden(num, segments, rates):
+    """ManualLRS::calc transcription (LearningRateScheduler.cpp): first
+    segment with num <= segments[i] selects rates[i]; past the last
+    boundary the last rate holds."""
+    for seg, rate in zip(segments, rates):
+        if num <= seg:
+            return rate
+    return rates[-1]
+
+
+def test_manual_schedule_matches_reference_formula():
+    """'manual': learning_rate_args boundaries count SAMPLES processed
+    (numSamplesProcessed = step * batch); samples_per_step converts."""
+    p0, grads = _data(11)
+    lr, batch = 0.1, 100
+    opt = O.Momentum(
+        learning_rate=lr,
+        learning_rate_schedule="manual",
+        learning_rate_args="150:1.0,250:0.5,300:0.25",
+        samples_per_step=batch,
+    )
+    traj = _run(opt, grads, p0)
+    p = p0.copy()
+    segs, rates = [150, 250, 300], [1.0, 0.5, 0.25]
+    for t, (g, got) in enumerate(zip(grads, traj)):
+        # the reference bumps numSamplesProcessed before the rate lookup, so
+        # update t sees (t+1)*batch samples (ParameterUpdater.h)
+        mult = _manual_golden((t + 1) * batch, segs, rates)
+        p = p - lr * mult * g
+        np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-6)
+    # boundary semantics: num == segment stays in that segment (ManualLRS
+    # uses num <= segments_[i])
+    assert _manual_golden(150, segs, rates) == 1.0
+    assert _manual_golden(151, segs, rates) == 0.5
+
+
+def test_pass_manual_schedule_reads_pass_counter():
+    """'pass_manual': boundaries count PASSES (PassManualLRS::calc(pass));
+    the trainer publishes the pass index into opt_state['pass']."""
+    import jax.numpy as jnp
+
+    p0, grads = _data(12)
+    lr = 0.1
+    opt = O.Momentum(
+        learning_rate=lr,
+        learning_rate_schedule="pass_manual",
+        learning_rate_args="1:1.0,3:0.1",
+    )
+    params = {"layer": {"w": jnp.asarray(p0)}}
+    state = opt.init(params)
+    assert "pass" in state  # the trainer's publication point
+    p = p0.copy()
+    for pass_id, g in enumerate(grads):
+        state = {**state, "pass": jnp.asarray(pass_id, jnp.int32)}
+        params, state = opt.update(
+            {"layer": {"w": jnp.asarray(g)}}, state, params
+        )
+        mult = _manual_golden(pass_id, [1, 3], [1.0, 0.1])
+        p = p - lr * mult * g
+        np.testing.assert_allclose(
+            np.asarray(params["layer"]["w"]), p, rtol=1e-5, atol=1e-6
+        )
+
+
+def test_v1_config_with_manual_schedules_trains(tmp_path):
+    """A v1 config file using learning_rate_schedule='pass_manual' parses
+    and trains through the v2 trainer, with the LR actually dropping at the
+    declared pass boundary."""
+    import paddle_tpu as paddle
+    from paddle_tpu.v1_compat import make_optimizer, parse_config
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=4, learning_rate=0.5,\n"
+        "         learning_rate_schedule='pass_manual',\n"
+        "         learning_rate_args='0:1.0,1:0.01',\n"
+        "         learning_method=MomentumOptimizer(momentum=0.0))\n"
+        "x = data_layer(name='x', size=4)\n"
+        "y = fc_layer(input=x, size=1, act=LinearActivation())\n"
+        "lbl = data_layer(name='lbl', size=1)\n"
+        "outputs(regression_cost(input=y, label=lbl))\n"
+    )
+    p = parse_config(str(cfg))
+    assert p.settings.learning_rate_schedule == "pass_manual"
+    opt = make_optimizer(p.settings)
+    assert opt.schedule_unit == "pass"
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = (xs @ np.array([1.0, -1.0, 0.5, 0.0], np.float32))[:, None]
+    reader = lambda: iter([(x, y) for x, y in zip(xs, ys)])
+
+    params = paddle.parameters.create(p.topology)
+    trainer = paddle.trainer.SGD(
+        cost=p.topology, parameters=params, update_equation=opt
+    )
+    before = {}
+    deltas = {}
+
+    def handler(e):
+        if isinstance(e, paddle.event.BeginPass):
+            before[e.pass_id] = np.array(
+                trainer.parameters.params["__fc_layer_0__"]["w0"]
+            )
+        elif isinstance(e, paddle.event.EndPass):
+            after = np.array(trainer.parameters.params["__fc_layer_0__"]["w0"])
+            deltas[e.pass_id] = float(np.abs(after - before[e.pass_id]).max())
+
+    trainer.train(
+        reader=paddle.batch(reader, 4), num_passes=3, event_handler=handler,
+        async_load_data=False,
+    )
+    # passes 0 and 1 run at multiplier 1.0; pass 2 is past the last boundary
+    # (pass_manual '0:1.0,1:0.01' => pass>=2 uses 0.01): updates shrink ~100x
+    assert deltas[2] < 0.2 * deltas[0], deltas

@@ -179,6 +179,20 @@ def test_positional_provider_types_pair_by_declaration_order(tmp_path):
     assert p.provider_input_types["label"].kind == SlotKind.INDEX
     assert p.provider_input_types["pixel"].kind == SlotKind.DENSE
     assert p.provider_input_types["pixel"].dim == 784
+    # The permuted binding must come with the matching feeding map: provider
+    # tuples stay in SLOT order (label first), so positional pairing against
+    # the feeding order [pixel, label] would send the int label into the
+    # pixel layer.  parse_config surfaces the permutation for the trainer.
+    assert p.feeding == {"label": 0, "pixel": 1}
+    import numpy as np
+
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    feeder = DataFeeder(p.topology.data_types(), p.feeding)
+    batch = feeder([(3, np.full(784, 0.5, np.float32))])
+    assert batch["pixel"].data.shape == (1, 784)
+    assert float(batch["pixel"].data[0, 0]) == 0.5
+    assert int(batch["label"].data[0]) == 3
 
 
 def test_label_first_config_feeds_in_dfs_order(tmp_path):
