@@ -85,14 +85,32 @@ def _echo(msg: str) -> None:
 
 def _load_init_model(trainer, path: str) -> None:
     """--init_model_path: a pass dir (params.tar and/or v1 per-parameter
-    binaries) or a merged-model bundle."""
+    binaries), a merged-model bundle (merge_model output), or a bare
+    params.tar."""
+    import tarfile
+
     from paddle_tpu import checkpoint as ckpt
 
     if os.path.isdir(path):
         ckpt.load_parameter_dir(trainer.parameters, path)
     else:
-        with open(path, "rb") as f:
-            trainer.parameters.from_tar(f)
+        # a merge_model bundle is a tar with a manifest + nested params.tar;
+        # a bare params.tar has no manifest
+        is_bundle = False
+        try:
+            with tarfile.open(path, "r:*") as tf:
+                is_bundle = any(
+                    m.name.endswith("manifest.json") for m in tf.getmembers()
+                )
+        except tarfile.ReadError:
+            pass
+        if is_bundle:
+            from paddle_tpu.utils.model_tools import load_merged_model
+
+            load_merged_model(path, trainer.parameters)
+        else:
+            with open(path, "rb") as f:
+                trainer.parameters.from_tar(f)
     trainer._reshard_after_restore()
 
 
@@ -135,7 +153,13 @@ def cmd_train(argv: List[str]) -> int:
     config_path = os.path.abspath(args.config)
     config_dir = os.path.dirname(config_path)
     parsed = parse_config(config_path, args.config_args)
-    batch_size = args.batch_size or parsed.settings.batch_size
+    if args.batch_size:
+        # write the override back BEFORE building the optimizer: the
+        # 'manual' LR schedule converts its sample boundaries through
+        # settings.batch_size (reference numSamplesProcessed counts real
+        # samples)
+        parsed.settings.batch_size = args.batch_size
+    batch_size = parsed.settings.batch_size
     trainer = _make_trainer(parsed, seed)
 
     if args.init_model_path:

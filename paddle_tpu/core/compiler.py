@@ -76,6 +76,35 @@ def _cast_floats(tree, dtype):
     return jax.tree_util.tree_map(cast, tree)
 
 
+def _feed_transform(conf, t):
+    """On-device narrow-dtype feed (DataProvider.h double-buffer parity —
+    the reference never ships float32 pixels either; mnist_bin_part stores
+    uint8).  A DENSE slot arriving at an integer dtype (the DataFeeder's
+    ``feed_dtypes`` wire form) is cast to float32 here, INSIDE the jitted
+    step, and normalized with the data layer's feed_scale/feed_shift attrs
+    — XLA fuses the cast+scale into the first consumer, so the host->device
+    transfer is 1/4 the bytes with zero extra kernels."""
+    from paddle_tpu.core.data_types import SlotKind
+
+    it = conf.input_type
+    data = t.data if hasattr(t, "data") else t
+    if (
+        it is None
+        or it.kind != SlotKind.DENSE
+        or not jnp.issubdtype(data.dtype, jnp.integer)
+    ):
+        return t
+    x = data.astype(jnp.float32)
+    scale = conf.attr("feed_scale") or 0.0
+    shift = conf.attr("feed_shift") or 0.0
+    if scale:
+        x = x * scale
+    if shift:
+        x = x + shift
+    return SeqTensor(x, getattr(t, "lengths", None),
+                     getattr(t, "sub_lengths", None))
+
+
 def _walk_layers(topology, prefix=()):
     """(path, conf) over a topology INCLUDING recurrent_group sub-topologies
     (path = (top_layer, inner..., layer)) — the traversal behind the global
@@ -300,6 +329,83 @@ class CompiledNetwork:
                 params[name] = p
         return params
 
+    # ------------------------------------------------------------------
+    @property
+    def has_dynamic_widths(self) -> bool:
+        """Any fc / matrix projection stacked on a dynamic-width input
+        (whole-minibatch trans, TransLayer.cpp) — their true weight height
+        is the runtime batch size."""
+        for conf in self.topology.layers.values():
+            if conf.attr("dynamic_width_in"):
+                return True
+            for s in conf.attrs.get("projections", ()):
+                if s.get("dynamic_width"):
+                    return True
+        return False
+
+    def resolve_dynamic_widths(
+        self, params: Params, batch: Batch, seed: int = 0
+    ) -> Tuple[Params, bool]:
+        """Re-initialize weights whose height depends on the runtime batch
+        size, now that a batch exists.
+
+        A whole-minibatch ``trans`` (reference TransLayer.cpp) outputs
+        [D, B]: a consuming fc/matrix-projection weight must be [B, size],
+        but B is unknowable at init, so init builds the declared static
+        size (matching the reference's parameter dims — which can then only
+        RUN at batch == size, protostr test_fc dims 100x100).  The trainer
+        calls this with its first batch; weights whose height mismatches
+        the actual B are re-drawn (deterministically from ``seed``) at the
+        right shape and the optimizer state must be rebuilt by the caller
+        when ``changed`` comes back True.  Note the inherent semantics of
+        batch-wide transpose: weights trained at one batch size cannot be
+        reused at another (true of the op, not this implementation) — feed
+        with drop_last=True so a ragged final batch doesn't change B."""
+        import dataclasses
+
+        b = 0
+        for t in batch.values():
+            data = t.data if hasattr(t, "data") else t
+            b = int(data.shape[0])
+            break
+        if not b:
+            return params, False
+        rng = jax.random.PRNGKey(seed)
+        out = dict(params)
+        changed = False
+        for name in self.topology.order:
+            conf = self.topology.layers[name]
+            dyn_fc = conf.attr("dynamic_width_in") or ()
+            dyn_proj = [
+                j for j, s in enumerate(conf.attrs.get("projections", ()))
+                if s.get("dynamic_width")
+            ]
+            if not dyn_fc and not dyn_proj:
+                continue
+            in_confs = [self.topology.layers[i] for i in conf.inputs]
+            patched = list(in_confs)
+            targets = set(dyn_fc) | {
+                conf.attrs["projections"][j]["in"] for j in dyn_proj
+            }
+            for i in targets:
+                # the dynamic input's runtime width is the batch size B
+                # (trans swaps [B, D] -> [D, B]); width-preserving unaries
+                # in between keep it
+                patched[i] = dataclasses.replace(in_confs[i], size=b)
+            impl = self._impls[name]
+            layer_rng = jax.random.fold_in(rng, stable_hash(name))
+            fresh = impl.init(conf, patched, layer_rng)
+            cur = dict(out.get(name, {}))
+            layer_changed = False
+            for k, v in fresh.items():
+                if k in cur and jnp.shape(cur[k]) != jnp.shape(v):
+                    cur[k] = v
+                    layer_changed = True
+            if layer_changed:
+                out[name] = cur
+                changed = True
+        return out, changed
+
     def init_state(self) -> NetState:
         state: NetState = {}
         for name in self.topology.order:
@@ -411,7 +517,7 @@ class CompiledNetwork:
                 # enclosing recurrent_group's scan body.
                 if name not in batch:
                     raise KeyError(f"batch is missing data slot {name!r}")
-                ctx.outputs[name] = batch[name]
+                ctx.outputs[name] = _feed_transform(conf, batch[name])
                 continue
             ins = [ctx.outputs[i] for i in conf.inputs]
             pre_keys = set(ctx.outputs) if mixed else ()

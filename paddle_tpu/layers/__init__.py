@@ -11,8 +11,9 @@ arithmetic, implicit flatten) mirrors config_parser.py cnn_output_size.
 from __future__ import annotations
 
 import math
-import warnings as _warnings
 from typing import Optional, Sequence, Union
+
+import numpy as np
 
 from paddle_tpu import activation as _act_mod
 from paddle_tpu.activation import act_name
@@ -80,20 +81,16 @@ def _as_list(x: Inputish) -> list:
     return list(x)
 
 
-def _warn_dynamic_width(consumer: str, i: LayerOutput) -> None:
-    """Any SIZE-CONSUMING layer (fc, mixed matrix projections, tensor, ...)
-    stacked on a dynamic-width input — e.g. trans(height=None), whose true
-    width is the runtime batch size — builds weights for the STATIC declared
-    size and only runs when batch == that size (the reference has the same
-    latent constraint, TransLayer config_parser.py:2129)."""
-    if i.conf.attr("dynamic_size"):
-        _warnings.warn(
-            f"{consumer} input {i.name!r} has a dynamic "
-            f"(runtime-batch-dependent) width but weights are built for its "
-            f"static size {i.size}; this only runs when the batch size "
-            "equals that static size",
-            stacklevel=3,
-        )
+def _dynamic_width(i: LayerOutput) -> bool:
+    """A SIZE-CONSUMING layer (fc, mixed matrix projections) stacked on a
+    dynamic-width input — e.g. trans(height=None), whose true width is the
+    runtime batch size — cannot know its weight height at build time.  The
+    conf gets tagged instead of warned: weights init at the declared static
+    size for config parity (the reference keeps the static size too,
+    TransLayer config_parser.py:2129, protostr dims 100x100 — and then can
+    only RUN at batch == size), and the trainer resolves the true width from
+    the first batch via CompiledNetwork.resolve_dynamic_widths."""
+    return bool(i.conf.attr("dynamic_size"))
 
 
 def _extra(layer_attr: Optional[ExtraAttr]):
@@ -178,13 +175,29 @@ def cnn_output_size(
 # ---------------------------------------------------------------------------
 
 
-def data(name: str, type: InputType, height: int = 0, width: int = 0) -> LayerOutput:
+def data(name: str, type: InputType, height: int = 0, width: int = 0,
+         feed_dtype=None, feed_scale: float = 0.0,
+         feed_shift: float = 0.0) -> LayerOutput:
     """Declare an input slot (reference data_layer, layers.py).  Feeding
     order is DFS from the outputs, or explicit Inputs(...) — see
-    Topology.data_layers."""
+    Topology.data_layers.
+
+    feed_dtype (e.g. "uint8"): narrow ON-WIRE dtype for a dense slot — the
+    DataFeeder packs raw values at this dtype (4x fewer host->device bytes
+    for uint8 pixels) and the jitted step casts to the compute float on
+    device, applying ``x * feed_scale + feed_shift`` (fused into the first
+    consumer by XLA).  feed_scale=0 means "just cast".  The reference's
+    providers ship bytes the same way (mnist_bin_part stores uint8;
+    DataProvider.h double-buffers raw batches)."""
     attrs = {}
     if height and width:
         attrs.update(in_h=height, in_w=width, in_c=max(type.dim // (height * width), 1))
+    if feed_dtype is not None:
+        attrs["feed_dtype"] = str(np.dtype(feed_dtype))
+    if feed_scale:
+        attrs["feed_scale"] = float(feed_scale)
+    if feed_shift:
+        attrs["feed_shift"] = float(feed_shift)
     conf = LayerConf(
         name=name, type="data", size=type.dim, input_type=type, attrs=attrs, bias=False
     )
@@ -209,8 +222,7 @@ def fc(
     name: Optional[str] = None,
 ) -> LayerOutput:
     ins = _as_list(input)
-    for i in ins:
-        _warn_dynamic_width("fc", i)
+    dyn_in = tuple(idx for idx, i in enumerate(ins) if _dynamic_width(i))
     drop, shard = _extra(layer_attr)
     if isinstance(param_attr, (list, tuple)):
         # per-input weight attrs (reference fc_layer param_attr list): each
@@ -240,6 +252,8 @@ def fc(
         pnames["b"] = bias_attr.name
     if pnames:
         attrs["param_names"] = pnames
+    if dyn_in:
+        attrs["dynamic_width_in"] = dyn_in
     conf = LayerConf(
         name=name or auto_name("fc_layer"),
         type="fc",
@@ -877,6 +891,26 @@ def repeat(input, num_repeats: int, as_row_vector: bool = True, act=None,
 
 
 repeat_layer = repeat
+
+
+def featmap_expand(input, num_filters: int, as_row_vector: bool = True,
+                   name=None):
+    """reference featmap_expand_layer (FeatureMapExpandLayer.cpp): tile a
+    feature map across num_filters channels, row- or column-vector order."""
+    ins = _as_list(input)
+    conf = LayerConf(
+        name=name or auto_name("featmap_expand"),
+        type="featmap_expand",
+        size=ins[0].size * num_filters,
+        inputs=(ins[0].name,),
+        act="identity",
+        bias=False,
+        attrs={"num_filters": num_filters, "as_row_vector": as_row_vector},
+    )
+    return LayerOutput(conf, ins)
+
+
+featmap_expand_layer = featmap_expand
 
 
 def resize(input, size: int, name=None):
@@ -1968,20 +2002,20 @@ class Projection:
 def full_matrix_projection(
     input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
 ) -> Projection:
-    _warn_dynamic_width("full_matrix_projection", input)
     return Projection(
         "full_matrix", input, size=size,
         param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+        **({"dynamic_width": True} if _dynamic_width(input) else {}),
     )
 
 
 def trans_full_matrix_projection(
     input: LayerOutput, size: int = 0, param_attr: Optional[ParamAttr] = None
 ) -> Projection:
-    _warn_dynamic_width("trans_full_matrix_projection", input)
     return Projection(
         "trans_full_matrix", input, size=size,
         param_std=_param_std(param_attr), param_name=_param_name(param_attr),
+        **({"dynamic_width": True} if _dynamic_width(input) else {}),
     )
 
 

@@ -49,8 +49,15 @@ class DataFeeder:
         seq_multiple: int = 8,
         min_seq_len: int = 8,
         dtype=np.float32,
+        feed_dtypes: Optional[Dict[str, Any]] = None,
     ):
+        """feed_dtypes: per-slot WIRE dtype override for dense slots (e.g.
+        {"image": np.uint8}) — the batch crosses host->device at 1/4 the
+        bytes and the jitted step casts + normalizes on device (the data
+        layer's feed_scale/feed_shift attrs; reference DataProvider ships
+        bytes the same way, mnist_bin_part is uint8 on disk)."""
         self.data_types = list(data_types)
+        self.feed_dtypes = dict(feed_dtypes or {})
         if feeding is None:
             self.index = {name: i for i, (name, _) in enumerate(self.data_types)}
         elif isinstance(feeding, dict):
@@ -78,24 +85,30 @@ class DataFeeder:
         out: Dict[str, SeqTensor] = {}
         for name, itype in self.data_types:
             col = [sample[self.index[name]] for sample in batch_data]
-            out[name] = self._convert_slot(col, itype)
+            out[name] = self._convert_slot(
+                col, itype, self.feed_dtypes.get(name, self.dtype)
+            )
         return out
 
     # ------------------------------------------------------------------
     def _bucket_len(self, max_len: int) -> int:
         return max(_round_up(max_len, self.seq_multiple), self.min_seq_len)
 
-    def _convert_slot(self, col: List[Any], itype: InputType) -> SeqTensor:
+    def _convert_slot(
+        self, col: List[Any], itype: InputType, dtype=None
+    ) -> SeqTensor:
+        dtype = self.dtype if dtype is None else dtype
         if itype.seq == SeqLevel.NONE:
-            return self._convert_plain(col, itype)
+            return self._convert_plain(col, itype, dtype)
         if itype.seq == SeqLevel.SEQ:
-            return self._convert_seq(col, itype)
-        return self._convert_sub_seq(col, itype)
+            return self._convert_seq(col, itype, dtype)
+        return self._convert_sub_seq(col, itype, dtype)
 
-    def _convert_plain(self, col, itype: InputType) -> SeqTensor:
+    def _convert_plain(self, col, itype: InputType, dtype=None) -> SeqTensor:
+        dtype = self.dtype if dtype is None else dtype
         b = len(col)
         if itype.kind == SlotKind.DENSE:
-            arr = np.asarray(col, dtype=self.dtype).reshape(b, itype.dim)
+            arr = np.asarray(col, dtype=dtype).reshape(b, itype.dim)
             return SeqTensor(arr)
         if itype.kind == SlotKind.INDEX:
             return SeqTensor(np.asarray(col, dtype=np.int32).reshape(b))
@@ -117,7 +130,8 @@ class DataFeeder:
                 arr[i, np.asarray(idx, dtype=np.int64)] = np.asarray(vals, self.dtype)
         return SeqTensor(arr)
 
-    def _convert_seq(self, col, itype: InputType) -> SeqTensor:
+    def _convert_seq(self, col, itype: InputType, dtype=None) -> SeqTensor:
+        dtype = self.dtype if dtype is None else dtype
         b = len(col)
         lengths = np.asarray([len(s) for s in col], dtype=np.int32)
         t = self._bucket_len(int(lengths.max()) if b else 1)
@@ -127,10 +141,10 @@ class DataFeeder:
                 arr[i, : len(s)] = np.asarray(s, dtype=np.int32)
             return SeqTensor(arr, lengths)
         if itype.kind == SlotKind.DENSE:
-            arr = np.zeros((b, t, itype.dim), dtype=self.dtype)
+            arr = np.zeros((b, t, itype.dim), dtype=dtype)
             for i, s in enumerate(col):
                 if len(s):
-                    arr[i, : len(s)] = np.asarray(s, dtype=self.dtype)
+                    arr[i, : len(s)] = np.asarray(s, dtype=dtype)
             return SeqTensor(arr, lengths)
         if _ids_form(itype):
             nnz = max(
@@ -157,12 +171,13 @@ class DataFeeder:
                     )
         return SeqTensor(arr, lengths)
 
-    def _convert_sub_seq(self, col, itype: InputType) -> SeqTensor:
+    def _convert_sub_seq(self, col, itype: InputType, dtype=None) -> SeqTensor:
         """Nested sequences: each sample is a list of subsequences.  Reference
         packs these as two-level CSR (Argument.h:84-93,
         subSequenceStartPositions); TPU-native form is a doubly padded
         [B, S, T, ...] block plus n_sub[B] and sub_lengths[B, S] so nested
         recurrence stays static-shape under jit."""
+        dtype = self.dtype if dtype is None else dtype
         b = len(col)
         n_sub = np.asarray([len(s) for s in col], dtype=np.int32)
         s_max = max(_round_up(int(n_sub.max()) if b else 1, 4), 4)
@@ -196,12 +211,12 @@ class DataFeeder:
                     for k, ids in enumerate(sub):
                         arr[i, j, k, : len(ids)] = np.asarray(ids, np.int32)
             return SeqTensor(arr, n_sub, sub_lengths, sparse_ids=True)
-        arr = np.zeros((b, s_max, t, itype.dim), dtype=self.dtype)
+        arr = np.zeros((b, s_max, t, itype.dim), dtype=dtype)
         for i, sample in enumerate(col):
             for j, sub in enumerate(sample):
                 if itype.kind == SlotKind.DENSE:
                     if len(sub):
-                        arr[i, j, : len(sub)] = np.asarray(sub, dtype=self.dtype)
+                        arr[i, j, : len(sub)] = np.asarray(sub, dtype=dtype)
                 else:
                     for k, ids in enumerate(sub):
                         if itype.kind == SlotKind.SPARSE_BINARY:

@@ -135,6 +135,10 @@ class SGD:
         self._opt_state = self.optimizer.init(self.parameters.params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
+        # dynamic-width (batch-wide trans) weights resolve exactly ONCE, at
+        # the first batch this trainer ever sees; a later batch-size change
+        # must fail loudly, never silently re-draw trained weights
+        self._width_resolved = not self.network.has_dynamic_widths
 
     # ------------------------------------------------------------------
     def _build_metrics_fn(self):
@@ -169,7 +173,16 @@ class SGD:
 
     # ------------------------------------------------------------------
     def _make_feeder(self, feeding) -> DataFeeder:
-        return DataFeeder(self.topology.data_types(), feeding)
+        # data layers declaring a narrow wire dtype (data_layer(feed_dtype=
+        # "uint8")) feed raw and cast+normalize on device (_feed_transform)
+        feed_dtypes = {
+            name: conf.attr("feed_dtype")
+            for name, conf in self.topology.data_layers().items()
+            if conf.attr("feed_dtype")
+        }
+        return DataFeeder(
+            self.topology.data_types(), feeding, feed_dtypes=feed_dtypes
+        )
 
     def train(
         self,
@@ -235,6 +248,18 @@ class SGD:
                 else map(_stage, reader())
             )
             for batch_id, batch in enumerate(batches):
+                if not self._width_resolved:
+                    # fc/matrix-projection weights over a whole-minibatch
+                    # trans have a batch-dependent height; the FIRST batch
+                    # this trainer sees pins it (resolve_dynamic_widths) —
+                    # any later batch-size change hits an XLA shape error
+                    # rather than silently re-drawing trained weights
+                    self._width_resolved = True
+                    params, chg = self.network.resolve_dynamic_widths(
+                        params, batch
+                    )
+                    if chg:  # weight shapes moved: optimizer slots follow
+                        opt_state = self.optimizer.init(params)
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
                 with stat_timer("train_step"):
                     self._rng, step_rng = jax.random.split(self._rng)
@@ -309,6 +334,17 @@ class SGD:
             else map(stage, reader())
         )
         for batch in batches:
+            if not self._width_resolved:
+                # never trained yet: the eval batch pins the dynamic widths
+                # (a post-training batch-size change raises a shape error in
+                # the step instead — see train())
+                self._width_resolved = True
+                p2, chg = self.network.resolve_dynamic_widths(
+                    self.parameters.params, batch
+                )
+                if chg:
+                    self.parameters.params = p2
+                    self._opt_state = self.optimizer.init(p2)
             metrics = self._eval_step(
                 self.parameters.params, self.parameters.state, batch
             )

@@ -301,19 +301,24 @@ def _load_provider_module(module_name: str, config_dir: str):
     try:
         with _py2_shims():
             if os.path.exists(mod_path):
+                # cache key: path + mtime — one CLI run touches the module
+                # three times (type resolution + train reader + test reader)
+                # and real providers do heavy module-level work (dict loads);
+                # an edited file gets a new mtime, so staleness is bounded
+                # to one exec per file version, and a FAILED exec is never
+                # cached (the entry is dropped on the way out)
+                mtime = int(os.stat(mod_path).st_mtime_ns)
                 uniq = (
                     f"_v1_provider_{abs(hash(os.path.abspath(mod_path)))}"
-                    f"_{module_name}"
+                    f"_{mtime}_{module_name}"
                 )
+                if uniq in sys.modules:
+                    return sys.modules[uniq]
                 spec = importlib.util.spec_from_file_location(uniq, mod_path)
                 mod = importlib.util.module_from_spec(spec)
                 # py2-era provider files (reference demos predate python 3)
                 mod.xrange = range
                 mod.unicode = str
-                # re-executed on every call (parse-time + reader-build) so a
-                # failed or since-edited provider never serves stale; the
-                # sys.modules entry only exists for the provider's own
-                # relative imports during exec and is dropped on failure
                 sys.modules[uniq] = mod
                 try:
                     spec.loader.exec_module(mod)

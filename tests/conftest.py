@@ -41,6 +41,28 @@ def pytest_configure(config):
 # by `make verify`.  Regenerate after large suite changes with
 #   pytest --durations=0 | awk '$1+0>=4' ...
 _SLOW_TESTS = {
+    # registry-sweep grad checks >= ~2s each (the sweep's completeness GATE,
+    # test_every_registered_type_is_swept, always runs in the fast tier)
+    "test_registry_grad[multibox_loss]",
+    "test_registry_grad[lstmemory]",
+    "test_registry_grad[gru]",
+    "test_registry_grad[moe]",
+    "test_registry_grad[mdlstmemory]",
+    "test_registry_grad[multi_head_attention]",
+    "test_registry_grad[crf]",
+    "test_registry_grad[ctc]",
+    "test_registry_grad[recurrent]",
+    "test_registry_grad[nce]",
+    "test_registry_grad[recurrent_group]",
+    "test_registry_grad[lstm_step]",
+    "test_registry_grad[multi_nn_cost]",
+    "test_registry_grad[lambda_cost]",
+    "test_registry_grad[hsigmoid]",
+    "test_registry_grad[gru_step]",
+    "test_registry_grad[seqconcat]",
+    "test_registry_grad[selective_fc]",
+    "test_registry_grad[cross_entropy]",
+    "test_registry_grad[norm]",
     "test_beam_hooks_through_dsl_layer",
     "test_beam_search_generation",
     "test_beam_search_layer_through_infer",
@@ -111,5 +133,7 @@ def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
     for item in items:
-        if item.name.split("[")[0] in _SLOW_TESTS:
+        # match the base name (marks every param case) or one exact
+        # parametrized id like "test_registry_grad[moe]"
+        if item.name.split("[")[0] in _SLOW_TESTS or item.name in _SLOW_TESTS:
             item.add_marker(_pytest.mark.slow)

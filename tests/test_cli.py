@@ -227,3 +227,11 @@ def test_merge_model_roundtrip(tmp_path):
     ])
     assert r.returncode == 0, r.stderr[-2000:]
     assert bundle.exists() and bundle.stat().st_size > 1000
+    # the bundle round-trips through --init_model_path (detected as a
+    # merged bundle, not a bare params.tar)
+    r = run_cli([
+        "train", f"--config={OPT_A}", "--job=test",
+        f"--init_model_path={bundle}", "--batch_size=400",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Test cost" in r.stdout

@@ -140,3 +140,58 @@ def test_prefetch_terminal_states_are_sticky():
         with pytest.raises(RuntimeError, match="boom"):
             next(pf2)
     pf2.close()
+
+
+def test_narrow_dtype_feed_trains():
+    """data_layer(feed_dtype="uint8"): the wire batch stays uint8 (4x fewer
+    host->device bytes) and the jitted step casts + normalizes on device
+    (feed_scale/feed_shift) — reference DataProvider ships bytes the same
+    way (mnist_bin_part is uint8 on disk).  Training must still converge."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+
+    reset_auto_names()
+    x = paddle.layer.data(
+        "img", paddle.data_type.dense_vector(12),
+        feed_dtype="uint8", feed_scale=1 / 255.0, feed_shift=-0.5,
+    )
+    lbl = paddle.layer.data("lbl", paddle.data_type.integer_value(3))
+    fc = paddle.layer.fc(input=x, size=3, act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(input=fc, label=lbl)
+    params = paddle.parameters.create(cost)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.5, momentum=0.9
+        ),
+    )
+    feeder = tr._make_feeder(None)
+    rng = np.random.RandomState(0)
+    pix = rng.randint(0, 256, (24, 12), dtype=np.uint8)
+    rows = [(pix[i], int(pix[i, 0]) % 3) for i in range(24)]  # learnable
+    batch = feeder(rows)
+    assert batch["img"].data.dtype == np.uint8  # narrow on the wire
+    assert batch["lbl"].data.dtype == np.int32
+
+    costs = []
+    tr.train(
+        reader=paddle.batch(lambda: iter(rows), 8), num_passes=30,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        async_load_data=False,
+    )
+    assert costs[-1] < 0.9 * costs[0], (costs[0], costs[-1])
+
+    # the device-side values are the normalized floats, not raw bytes
+    import jax
+
+    net = tr.network
+    outs, _ = net.apply(
+        tr.parameters.params, batch, state=tr.parameters.state, train=False,
+        rng=jax.random.PRNGKey(0),
+    )
+    got = np.asarray(outs["img"].data)
+    want = np.asarray(batch["img"].data, np.float32) / 255.0 - 0.5
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
