@@ -360,7 +360,11 @@ class CompiledNetwork:
         when ``changed`` comes back True.  Note the inherent semantics of
         batch-wide transpose: weights trained at one batch size cannot be
         reused at another (true of the op, not this implementation) — feed
-        with drop_last=True so a ragged final batch doesn't change B."""
+        with drop_last=True so a ragged final batch doesn't change B.
+        Weights restored at a shape matching neither the static init nor
+        this batch raise (they trained at another B); the one blind spot
+        is a checkpoint trained at exactly B == the declared static size,
+        which is indistinguishable from a fresh init by shape."""
         import dataclasses
 
         b = 0
@@ -395,12 +399,28 @@ class CompiledNetwork:
             impl = self._impls[name]
             layer_rng = jax.random.fold_in(rng, stable_hash(name))
             fresh = impl.init(conf, patched, layer_rng)
+            # what a FRESH (untrained) init looks like at the declared
+            # static sizes — only weights still in that state may be
+            # re-drawn; anything else was trained/restored at some other
+            # batch size and re-drawing it would silently destroy it
+            static_init = impl.init(conf, in_confs, layer_rng)
             cur = dict(out.get(name, {}))
             layer_changed = False
             for k, v in fresh.items():
-                if k in cur and jnp.shape(cur[k]) != jnp.shape(v):
-                    cur[k] = v
-                    layer_changed = True
+                if k not in cur or jnp.shape(cur[k]) == jnp.shape(v):
+                    continue
+                if jnp.shape(cur[k]) != jnp.shape(static_init.get(k)):
+                    raise ValueError(
+                        f"layer {name!r} parameter {k!r} has shape "
+                        f"{jnp.shape(cur[k])} — neither the declared static "
+                        f"shape {jnp.shape(static_init.get(k))} nor this "
+                        f"batch's resolved shape {jnp.shape(v)}.  It was "
+                        "trained/restored at a different batch size; "
+                        "batch-wide-trans weights are only usable at the "
+                        "batch size they trained at."
+                    )
+                cur[k] = v
+                layer_changed = True
             if layer_changed:
                 out[name] = cur
                 changed = True

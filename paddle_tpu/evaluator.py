@@ -63,7 +63,13 @@ def classification_error_evaluator(
     nm = name or auto_name("classification_error")
 
     def update(outs):
-        p, ids, w = _flat_valid(outs[input.name], outs[label.name])
+        # argmax(softmax(x)) == argmax(x): prefer the producer's
+        # pre-activation aux so the error never forces a big softmax to
+        # materialize (the fused CE path reads logits directly)
+        pred = outs.get(input.name + "@logits")
+        if pred is None:
+            pred = outs[input.name]
+        p, ids, w = _flat_valid(pred, outs[label.name])
         err = (jnp.argmax(p, axis=-1) != ids).astype(jnp.float32)
         return {"err": jnp.sum(err * w), "total": jnp.sum(w)}
 

@@ -96,11 +96,17 @@ class Inference:
         feeding=None,
         batch_size: Optional[int] = None,
     ):
-        from paddle_tpu.reader.feeder import DataFeeder
+        from paddle_tpu.reader.feeder import DataFeeder, feed_dtypes_of
 
         if not len(input):
             raise ValueError("infer() needs at least one input sample")
-        feeder = DataFeeder(self.topology.data_types(), feeding)
+        # same wire dtypes as training (narrow uint8 feeds normalize on
+        # device via the data layer's feed_scale/feed_shift) — a float-fed
+        # batch would skip the on-device normalize and skew inference
+        feeder = DataFeeder(
+            self.topology.data_types(), feeding,
+            feed_dtypes=feed_dtypes_of(self.topology),
+        )
         bs = batch_size or len(input)
         for lo in range(0, len(input), bs):
             batch = feeder(list(input[lo : lo + bs]))

@@ -19,6 +19,26 @@ from paddle_tpu.layers.base import register_layer
 _EPS = 1e-10
 
 
+def _fused_ce_from_logits(x: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """-log softmax(x)[ids] WITHOUT materializing the [N, V] log-prob
+    matrix: cost = logsumexp(x) - x[ids].
+
+    jax.nn.log_softmax writes a full f32 [N, V] block (524 MB for 4096x32k)
+    just so take_along_axis can read ONE element per row — at big vocab the
+    HBM traffic of that round trip dominates the whole cost layer (~5 ms of
+    a 24 ms transformer-base step).  The two-reduction form reads the bf16
+    logits once, accumulates in f32 (promoted per-element inside the fused
+    reduction — XLA never materializes the cast), and writes [N] scalars.
+    The backward autodiffs to softmax(x)·g − one_hot·g, recomputed inside
+    one bwd fusion at the logits dtype."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(acc)  # fuses into each reduction below; never stored whole
+    m = jax.lax.stop_gradient(jnp.max(xf, axis=-1, keepdims=True))
+    lse = m[..., 0] + jnp.log(jnp.sum(jnp.exp(xf - m), axis=-1))
+    picked = jnp.take_along_axis(x, ids[..., None], axis=-1)[..., 0]
+    return lse - picked.astype(acc)
+
+
 def _per_sample(cost: jnp.ndarray, tensor: SeqTensor) -> SeqTensor:
     """Reduce a per-timestep cost [B, T] to per-*token-summed* [B, 1] with
     masking, or pass through [B] -> [B, 1]."""
@@ -48,16 +68,7 @@ def cross_entropy_apply(conf, params, inputs, ctx):
     ids = _label_ids(label)
     logits = ctx.outputs.get(conf.inputs[0] + "@logits")
     if logits is not None:
-        # promote (never truncate): f32 under bf16 mixed precision, but keep
-        # f64 when the checkgrad job runs the graph in double precision
-        logp = jax.nn.log_softmax(
-            logits.data.astype(
-                jnp.promote_types(logits.data.dtype, jnp.float32)
-            ),
-            axis=-1,
-        )
-        cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
-        return _per_sample(cost, prob)
+        return _per_sample(_fused_ce_from_logits(logits.data, ids), prob)
     p = jnp.take_along_axis(prob.data, ids[..., None], axis=-1)[..., 0]
     cost = -jnp.log(jnp.maximum(p, _EPS))
     return _per_sample(cost, prob)
@@ -71,9 +82,7 @@ def softmax_with_cost_apply(conf, params, inputs, ctx):
     pair into one lax reduction)."""
     logits, label = inputs[0], inputs[1]
     ids = _label_ids(label)
-    logp = jax.nn.log_softmax(logits.data, axis=-1)
-    cost = -jnp.take_along_axis(logp, ids[..., None], axis=-1)[..., 0]
-    return _per_sample(cost, logits)
+    return _per_sample(_fused_ce_from_logits(logits.data, ids), logits)
 
 
 @register_layer("soft_binary_class_cross_entropy", auto_activation=False, full_precision=True)

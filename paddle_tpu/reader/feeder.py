@@ -38,6 +38,17 @@ def _ids_form(itype: InputType) -> bool:
     )
 
 
+def feed_dtypes_of(topology) -> Dict[str, str]:
+    """{slot: wire dtype} for data layers declaring a narrow feed dtype
+    (``data_layer(feed_dtype="uint8")``) — shared by the trainer and the
+    inference face so train and infer see identical device-side values."""
+    return {
+        name: conf.attr("feed_dtype")
+        for name, conf in topology.data_layers().items()
+        if conf.attr("feed_dtype")
+    }
+
+
 class DataFeeder:
     """feeding: [(slot_name, InputType)] in sample-tuple order, or a dict
     {slot_name: index_in_sample} combined with `data_types`."""

@@ -38,7 +38,14 @@ def default_metrics_fn(topology: Topology) -> Optional[Callable]:
             ids = label.data.astype(jnp.int32)
             if ids.ndim >= 2 and ids.shape[-1] == 1:
                 ids = ids[..., 0]
-            err = (jnp.argmax(pred.data, axis=-1) != ids).astype(jnp.float32)
+            # argmax(softmax(x)) == argmax(x): read the pre-activation aux
+            # when the producer exposed one, so the error metric never
+            # forces the [N, V] softmax to materialize (at a 32k MT vocab
+            # that softmax is ~1 GB per step and exists ONLY for this
+            # metric — the fused CE reads logits)
+            lg = outs.get(pred_name + "@logits")
+            scores = lg.data if lg is not None else pred.data
+            err = (jnp.argmax(scores, axis=-1) != ids).astype(jnp.float32)
             if pred.is_seq and err.ndim == 2:
                 mask = pred.mask()
                 err = jnp.sum(err * mask) / jnp.maximum(jnp.sum(mask), 1.0)

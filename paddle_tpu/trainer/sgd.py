@@ -175,13 +175,11 @@ class SGD:
     def _make_feeder(self, feeding) -> DataFeeder:
         # data layers declaring a narrow wire dtype (data_layer(feed_dtype=
         # "uint8")) feed raw and cast+normalize on device (_feed_transform)
-        feed_dtypes = {
-            name: conf.attr("feed_dtype")
-            for name, conf in self.topology.data_layers().items()
-            if conf.attr("feed_dtype")
-        }
+        from paddle_tpu.reader.feeder import feed_dtypes_of
+
         return DataFeeder(
-            self.topology.data_types(), feeding, feed_dtypes=feed_dtypes
+            self.topology.data_types(), feeding,
+            feed_dtypes=feed_dtypes_of(self.topology),
         )
 
     def train(

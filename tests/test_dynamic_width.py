@@ -114,3 +114,19 @@ def test_static_batch_still_uses_init_weights():
     p2, changed = net.resolve_dynamic_widths(params, batch)
     assert not changed
     assert p2["f"]["w0"] is params["f"]["w0"]
+
+
+def test_restored_other_batch_weights_raise_not_redraw():
+    """Weights trained/restored at a different batch size must raise, not
+    be silently replaced with fresh random values (r5 review finding)."""
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    h = L.fc(L.trans(x), size=2, act=A.Identity(), name="f")
+    net = CompiledNetwork(Topology([h]))
+    params, _ = net.init(jax.random.PRNGKey(0))
+    # simulate a checkpoint trained at batch 20 (static size is 8)
+    params["f"]["w0"] = np.zeros((20, 2), np.float32)
+    from paddle_tpu.core.batch import SeqTensor
+
+    batch = {"x": SeqTensor(np.zeros((6, 8), np.float32))}
+    with pytest.raises(ValueError, match="different batch size"):
+        net.resolve_dynamic_widths(params, batch)

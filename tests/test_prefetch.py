@@ -195,3 +195,31 @@ def test_narrow_dtype_feed_trains():
     got = np.asarray(outs["img"].data)
     want = np.asarray(batch["img"].data, np.float32) / 255.0 - 0.5
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_narrow_dtype_infer_matches_train_path():
+    """paddle.infer must feed the same wire dtype + on-device normalize as
+    training (r5 review finding: a float-fed infer batch skipped the
+    normalize and skewed predictions)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+
+    reset_auto_names()
+    x = paddle.layer.data(
+        "img", paddle.data_type.dense_vector(8),
+        feed_dtype="uint8", feed_scale=1 / 255.0, feed_shift=-0.5,
+    )
+    out = paddle.layer.fc(x, size=2, act=paddle.activation.Softmax())
+    params = paddle.parameters.create(out)
+    rows = [(np.arange(8, dtype=np.uint8) * 30,)]
+    probs = paddle.infer(output_layer=out, parameters=params, input=rows)
+    # manual reference through the train-path math
+    xf = (np.arange(8) * 30).astype(np.float32) / 255.0 - 0.5
+    w = np.asarray(params.params["__fc_layer_0__"]["w0"])
+    b = np.asarray(params.params["__fc_layer_0__"]["b"])
+    logits = xf @ w + b
+    want = np.exp(logits - logits.max())
+    want /= want.sum()
+    np.testing.assert_allclose(np.asarray(probs)[0], want, rtol=2e-3, atol=2e-3)
