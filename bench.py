@@ -178,7 +178,6 @@ def bench_resnet() -> dict:
     from paddle_tpu.core.compiler import CompiledNetwork
     from paddle_tpu.core.topology import Topology, reset_auto_names
     from paddle_tpu.models.resnet import resnet_cost
-    from paddle_tpu.trainer.step import make_train_step
 
     reset_auto_names()
     batch_size, img_size = 128, 224
@@ -231,7 +230,6 @@ def bench_nmt() -> dict:
     from paddle_tpu.core.compiler import CompiledNetwork
     from paddle_tpu.core.topology import Topology, reset_auto_names
     from paddle_tpu.models.seq2seq import seq2seq_cost
-    from paddle_tpu.trainer.step import make_train_step
 
     reset_auto_names()
     batch_size, seq_len = 128, 50
@@ -489,15 +487,17 @@ def _bench_transformer_ctx(
     from paddle_tpu.core.compiler import CompiledNetwork
     from paddle_tpu.core.topology import Topology, reset_auto_names
     from paddle_tpu.models.transformer import transformer_cost
-    from paddle_tpu.trainer.step import make_train_step
     from paddle_tpu.utils.flags import set_flag
 
     reset_auto_names()
     vocab = 32000
+    d_model, n_heads, n_layers, d_ff = 512, 8, 6, 2048
 
     set_flag("use_pallas_attention", use_pallas)
     try:
-        cost, _ = transformer_cost(vocab, vocab, 512, 8, 6, 2048)
+        cost, _ = transformer_cost(
+            vocab, vocab, d_model, n_heads, n_layers, d_ff
+        )
         net = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
         params, state = net.init(jax.random.PRNGKey(0))
         opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9)
@@ -541,8 +541,13 @@ def _bench_transformer_ctx(
         # matmuls + s recompute); causal self-attention skips half the
         # blocks.  Layers: 6 encoder self (full) + 6 decoder self (causal)
         # + 6 cross (full).
-        unit = 14.0 * batch_size * 8 * (512 // 8) * seq_len * seq_len
-        flops = flops + unit * (6 + 6 * 0.5 + 6)
+        unit = (
+            14.0 * batch_size * n_heads * (d_model // n_heads)
+            * seq_len * seq_len
+        )
+        # n_layers encoder self (full) + n_layers decoder self (causal,
+        # half the blocks) + n_layers cross (full)
+        flops = flops + unit * (n_layers + n_layers * 0.5 + n_layers)
         flops_src = "xla+analytic_flash"
     return {
         "metric": metric,
@@ -609,7 +614,6 @@ def bench_lstm_textcls() -> dict:
     from paddle_tpu.core.compiler import CompiledNetwork
     from paddle_tpu.core.topology import Topology, reset_auto_names
     from paddle_tpu.layers import networks
-    from paddle_tpu.trainer.step import make_train_step
 
     reset_auto_names()
     L = paddle.layer
@@ -677,7 +681,6 @@ def _bench_reference_image_config(
 
     from paddle_tpu.core.batch import SeqTensor
     from paddle_tpu.core.compiler import CompiledNetwork
-    from paddle_tpu.trainer.step import make_train_step
     from paddle_tpu.v1_compat import make_optimizer, parse_config
 
     p = parse_config(

@@ -34,6 +34,7 @@ from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
 from paddle_tpu.core.topology import Topology  # noqa: F401
 from paddle_tpu.minibatch import batch  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import model  # noqa: F401
 from paddle_tpu.inference import Inference, infer  # noqa: F401
 from paddle_tpu import v1_compat  # noqa: F401
 from paddle_tpu import plot  # noqa: F401

@@ -1,0 +1,55 @@
+"""Distributed model save/load — the ``paddle.v2.model`` surface
+(reference: python/paddle/v2/model.py).
+
+``save_model`` coordinates with the elastic master so exactly ONE trainer of
+a data-parallel fleet writes the checkpoint (reference: the Go master's
+save-model arbitration over etcd, go/master/service.go RequestSaveModel;
+here ``master.Service.request_save_model`` over the lease RPC plane).
+Without a master it degrades to a plain parameter tar — the single-trainer
+path.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Optional
+
+__all__ = ["save_model", "load_model"]
+
+# one id per process, like the reference's module-level uuid trainer_id
+trainer_id = str(uuid.uuid4())
+
+
+def save_model(
+    parameters, path: str, master=None, block_secs: float = 60.0
+) -> Optional[str]:
+    """Write ``parameters`` as a tar at ``path``.
+
+    ``master`` (a ``paddle_tpu.master.Service``, ``Client``, or a
+    ``(host, port)`` Server address) enables the distributed arbitration:
+    the master grants the save to one trainer per window and the rest skip
+    (returns None).  Returns the path written, or None when another trainer
+    holds the grant."""
+    if master is not None:
+        from paddle_tpu.master import Client, Service
+
+        client = (
+            master
+            if isinstance(master, Client)
+            else Client(master, trainer_id=trainer_id)
+        )
+        if not client.request_save_model(block_secs):
+            return None  # another trainer saves this window
+        # per-trainer subdir exactly like the reference's etcd path shape —
+        # keyed by the identity that WON the grant, not this module's id
+        path = os.path.join(path, client.trainer_id, "model.tar")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        parameters.to_tar(f)
+    return path
+
+
+def load_model(parameters, path: str) -> None:
+    with open(path, "rb") as f:
+        parameters.from_tar(f)

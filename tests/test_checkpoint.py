@@ -174,3 +174,65 @@ def test_meta_json(tmp_path):
     meta = mgr.meta(7)
     assert meta["step"] == 7 and meta["extra"]["pass_id"] == 2
     assert "crc32" in meta and meta["n_leaves"] == 1
+
+
+def test_v2_model_save_load_roundtrip(tmp_path):
+    """paddle.model.save_model/load_model (reference v2/model.py): plain tar
+    without a master; master arbitration grants exactly one trainer."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+
+    reset_auto_names()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(4))
+    fc = paddle.layer.fc(x, size=3, act=paddle.activation.Softmax())
+    params = paddle.parameters.create(fc)
+    p = str(tmp_path / "model.tar")
+    out = paddle.model.save_model(params, p)
+    assert out == p
+
+    params2 = paddle.parameters.create(fc, seed=99)  # different init
+    before = np.asarray(params2.params["__fc_layer_0__"]["w0"]).copy()
+    paddle.model.load_model(params2, p)
+    after = np.asarray(params2.params["__fc_layer_0__"]["w0"])
+    want = np.asarray(params.params["__fc_layer_0__"]["w0"])
+    np.testing.assert_allclose(after, want)
+    assert not np.allclose(before, want)  # it actually changed something
+
+    # master arbitration: only one of two "trainers" gets the grant
+    from paddle_tpu.master import Client, Service
+
+    svc = Service()
+    a = Client(svc, trainer_id="a")
+    b = Client(svc, trainer_id="b")
+    got_a = paddle.model.save_model(params, str(tmp_path / "dist"), master=a)
+    got_b = paddle.model.save_model(params, str(tmp_path / "dist"), master=b)
+    assert (got_a is None) != (got_b is None)  # exactly one saved
+    saved = got_a or got_b
+    assert saved.endswith("model.tar")
+    import os
+
+    assert os.path.exists(saved)
+
+
+def test_plotcurve_parses_cli_and_reference_logs(tmp_path):
+    """utils.plotcurve reads both this CLI's 'Pass N: mean cost X' lines and
+    reference-style 'AvgCost=X' lines (reference utils/plotcurve.py)."""
+    from paddle_tpu.utils.plotcurve import main, parse_log
+
+    log = tmp_path / "train.log"
+    log.write_text(
+        "Pass 0: mean cost 2.500000 (1.0s elapsed)\n"
+        "I some noise\n"
+        "Pass 1: mean cost 1.250000 (2.0s elapsed)\n"
+        ".....\n"
+        "Batch=200 samples=25600 AvgCost=0.625 Eval: err=0.2\n"
+    )
+    curves = parse_log(log.read_text().splitlines())
+    assert curves["cost"] == [2.5, 1.25]
+    assert curves["AvgCost"] == [0.625]
+
+    out = tmp_path / "plot.png"
+    rc = main(["-i", str(log), "-o", str(out)])
+    assert rc == 0
