@@ -153,6 +153,22 @@ def is_sparse_ids(t, declared_size: int) -> bool:
     return bool(getattr(t, "sparse_ids", False))
 
 
+def take_rows_or_zero(w, idx):
+    """Row lookup where ids outside [0, rows) contribute a ZERO row —
+    the reference's table-kernel contract (hl_table_apply.cu
+    KeMatrixAddRows skips out-of-bounds ids; providers emit
+    0xffffffff == -1 for OOV-ignored tokens).  Explicit mask on purpose:
+    jnp.take's clamp mode reads the edge row, and its fill mode WRAPS
+    negative ids to real rows (measured on this jax: take([-1], mode="fill")
+    returns the last row) — both silently wrong.  Backward scatters nothing
+    for masked positions (the multiply-by-zero kills the cotangent)."""
+    import jax.numpy as _jnp
+
+    valid = (idx >= 0) & (idx < w.shape[0])
+    out = _jnp.take(w, _jnp.where(valid, idx, 0), axis=0)
+    return out * valid[..., None].astype(out.dtype)
+
+
 def gather_sum_rows(w, ids):
     """Bag-of-ids contraction: sum of w's rows per padded id list
     ([..., nnz] int32 -> [..., w.shape[1]]); sentinel ids (== w.shape[0],

@@ -110,7 +110,11 @@ def embedding_apply(conf, params, inputs, ctx):
     # padded to 1), so no squeeze there.
     if idx.ndim >= 2 and idx.shape[-1] == 1 and not ids.is_nested:
         idx = idx[..., 0]
-    out = jnp.take(params["w"], idx, axis=0)
+    from paddle_tpu.layers.base import take_rows_or_zero
+
+    # out-of-range ids (e.g. the providers' 0xffffffff OOV sentinel)
+    # contribute a zero row, reference KeMatrixAddRows semantics
+    out = take_rows_or_zero(params["w"], idx)
     return SeqTensor(out, ids.lengths, ids.sub_lengths)
 
 

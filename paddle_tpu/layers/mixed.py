@@ -86,7 +86,10 @@ def _apply_proj(spec: Dict[str, Any], p: Dict[str, Any], t: SeqTensor,
         idx = x.astype(jnp.int32)
         if idx.ndim >= 2 and idx.shape[-1] == 1:
             idx = idx[..., 0]
-        return jnp.take(p["w"], idx, axis=0)
+        # out-of-range ids -> zero row (reference KeMatrixAddRows)
+        from paddle_tpu.layers.base import take_rows_or_zero
+
+        return take_rows_or_zero(p["w"], idx)
     if kind == "identity":
         return x
     if kind == "identity_offset":

@@ -369,3 +369,45 @@ def test_stride_pooling_rejects_nested():
     )
     with pytest.raises(AssertionError, match="nested"):
         net.apply(params, {"seq": nested}, state=state, train=False)
+
+
+def test_embedding_out_of_range_ids_contribute_zero():
+    """Reference table kernels SKIP ids outside [0, tableSize)
+    (hl_table_apply.cu KeMatrixAddRows bounds check): providers emit
+    0xffffffff == -1 for OOV-ignored tokens (sequence_tagging
+    dataprovider.py OOV_POLICY_IGNORE).  The lookup must yield a zero row
+    — jnp's default clamp would silently read the edge row — and the
+    backward must scatter nothing into the table for those positions."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology
+
+    reset_auto_names()
+    vocab, dim = 5, 3
+    ids = layers.data("ids", paddle.data_type.integer_value_sequence(vocab))
+    emb = layers.embedding(ids, size=dim, name="emb")
+    net = CompiledNetwork(Topology([emb]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    w = np.asarray(params["emb"]["w"])
+
+    idx = np.array([[0, vocab - 1, -1, vocab]], np.int32)  # last two invalid
+    batch = {"ids": SeqTensor(jnp.asarray(idx), jnp.asarray([4], jnp.int32))}
+    outs, _ = net.apply(params, batch, state=state, train=False)
+    got = np.asarray(outs["emb"].data)[0]
+    np.testing.assert_allclose(got[0], w[0], rtol=1e-6)
+    np.testing.assert_allclose(got[1], w[vocab - 1], rtol=1e-6)
+    np.testing.assert_allclose(got[2], 0.0, atol=0)
+    np.testing.assert_allclose(got[3], 0.0, atol=0)
+
+    # backward: only valid rows receive gradient
+    def loss(p):
+        o, _ = net.apply(p, batch, state=state, train=False)
+        return o["emb"].data.sum()
+
+    g = np.asarray(jax.grad(loss)(params)["emb"]["w"])
+    assert g[0].sum() != 0 and g[vocab - 1].sum() != 0
+    rows_touched = {i for i in range(vocab) if np.abs(g[i]).sum() > 0}
+    assert rows_touched == {0, vocab - 1}, rows_touched
