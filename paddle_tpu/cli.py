@@ -129,8 +129,44 @@ def _make_trainer(parsed, seed: int):
     )
 
 
+# The reference trainer's registered gflags this CLI doesn't implement
+# (paddle/utils/Flags.cpp + paddle/trainer/*.cpp DEFINE_*): a train.sh line
+# that works against paddle_trainer must not die here — these specific names
+# are accepted-and-ignored with a note.  Anything NOT in this set (typos,
+# stray tokens) stays a hard error.
+_IGNORED_REFERENCE_FLAGS = {
+    "average_test_period", "beam_size", "checkgrad_eps", "comment",
+    "distribute_test", "enable_parallel_vector", "feed_data", "gpu_id",
+    "load_missing_parameter_strategy", "loadsave_parameters_in_pserver",
+    "local", "log_period_server", "nics", "num_gradient_servers",
+    "parallel_nn", "port", "ports_num", "ports_num_for_sparse",
+    "prev_batch_state", "rdma_tcp", "save_only_one", "show_layer_stat",
+    "start_pserver", "test_all_data_in_one_period", "test_pass",
+    "test_wait", "trainer_id", "use_old_updater", "with_cost",
+}
+
+
 def cmd_train(argv: List[str]) -> int:
-    args = _build_train_parser().parse_args(argv)
+    args, unknown = _build_train_parser().parse_known_args(argv)
+    ignored, fatal = [], []
+    for u in unknown:
+        name = u.lstrip("-").split("=", 1)[0]
+        if u.startswith("-") and name in _IGNORED_REFERENCE_FLAGS:
+            ignored.append(u)
+        else:
+            fatal.append(u)
+    if ignored:
+        print(
+            f"note: ignoring reference trainer flags {ignored}",
+            file=sys.stderr,
+        )
+    if fatal:
+        print(
+            f"error: unrecognized arguments {fatal} (not reference trainer "
+            "flags; see `paddle-tpu train --help`)",
+            file=sys.stderr,
+        )
+        return 2
     from paddle_tpu import event as v2_event
     from paddle_tpu import minibatch
     from paddle_tpu.utils import flags as _flags

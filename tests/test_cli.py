@@ -235,3 +235,45 @@ def test_merge_model_roundtrip(tmp_path):
     ])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "Test cost" in r.stdout
+
+
+def test_reference_train_sh_flag_lines_accepted():
+    """A reference train.sh command line (mnist/train.sh passes
+    --test_all_data_in_one_period and friends) must run — unknown gflags
+    are warned about, never fatal."""
+    r = run_cli([
+        "train", f"--config={OPT_A}", "--num_passes=0", "--batch_size=400",
+        "--test_all_data_in_one_period=1", "--num_gradient_servers=1",
+        "--nics=eth0", "--ports_num=1",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ignoring reference trainer flags" in r.stderr
+
+    # typos of SUPPORTED flags and stray tokens stay fatal — a multi-hour
+    # run must not silently drop --save_dir because of a typo
+    r = run_cli([
+        "train", f"--config={OPT_A}", "--num_passes=0", "--save_dri=/tmp/x",
+    ])
+    assert r.returncode == 2
+    assert "unrecognized arguments" in r.stderr
+    r = run_cli(["train", f"--config={OPT_A}", "num_passes=5"])
+    assert r.returncode == 2
+
+
+@pytest.mark.slow
+def test_start_pass_resumes_from_save_dir(tmp_path):
+    """--start_pass=N without --init_model_path resumes from
+    save_dir/pass-%05d (reference ParamUtil loadParametersWithPath)."""
+    save = tmp_path / "model"
+    r = run_cli([
+        "train", f"--config={OPT_A}", f"--save_dir={save}",
+        "--num_passes=1", "--batch_size=400",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    r = run_cli([
+        "train", f"--config={OPT_A}", f"--save_dir={save}",
+        "--num_passes=1", "--start_pass=1", "--batch_size=400",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Pass 1" in r.stdout
+    assert (save / "pass-00001" / "params.tar").exists()
