@@ -136,7 +136,7 @@ def _make_trainer(parsed, seed: int):
 # stray tokens) stays a hard error.
 _IGNORED_REFERENCE_FLAGS = {
     "average_test_period", "beam_size", "checkgrad_eps", "comment",
-    "distribute_test", "enable_parallel_vector", "feed_data", "gpu_id",
+    "distribute_test", "enable_parallel_vector", "gpu_id",
     "load_missing_parameter_strategy", "loadsave_parameters_in_pserver",
     "local", "log_period_server", "nics", "num_gradient_servers",
     "parallel_nn", "port", "ports_num", "ports_num_for_sparse",
@@ -146,15 +146,36 @@ _IGNORED_REFERENCE_FLAGS = {
 }
 
 
+def _is_ignored_reference_flag(token: str) -> bool:
+    if not token.startswith("-"):
+        return False
+    name = token.lstrip("-").split("=", 1)[0]
+    # gflags boolean negation: --nolocal == --local=false
+    return name in _IGNORED_REFERENCE_FLAGS or (
+        name.startswith("no") and name[2:] in _IGNORED_REFERENCE_FLAGS
+    )
+
+
 def cmd_train(argv: List[str]) -> int:
     args, unknown = _build_train_parser().parse_known_args(argv)
     ignored, fatal = [], []
-    for u in unknown:
-        name = u.lstrip("-").split("=", 1)[0]
-        if u.startswith("-") and name in _IGNORED_REFERENCE_FLAGS:
+    i = 0
+    while i < len(unknown):
+        u = unknown[i]
+        if _is_ignored_reference_flag(u):
             ignored.append(u)
+            # gflags separate-value form: `--nics eth0` leaves the value as
+            # its own token — swallow it with the flag
+            if (
+                "=" not in u
+                and i + 1 < len(unknown)
+                and not unknown[i + 1].startswith("-")
+            ):
+                ignored.append(unknown[i + 1])
+                i += 1
         else:
             fatal.append(u)
+        i += 1
     if ignored:
         print(
             f"note: ignoring reference trainer flags {ignored}",

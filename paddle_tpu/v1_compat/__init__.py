@@ -207,6 +207,95 @@ def _proto_data_files(dc, config_dir: str) -> list:
     return [lst]
 
 
+def _bind_and_assign_slot_types(
+    parsed: ParsedConfig, itypes, label: str
+) -> bool:
+    """Shared tail of every old-face type resolver: positional/unique-bind
+    the slot types to the data layers (recording a feeding permutation when
+    one fires), assign them onto the frozen confs, and populate
+    provider_input_types.  A bind failure marks the slots unresolved (the
+    topology must stay buildable; the error surfaces at feed time) and
+    still returns True — the declaration WAS handled."""
+    data_confs = list(parsed.topology.data_layers().values())
+    try:
+        aligned, feeding = _bind_slots(itypes, data_confs, label)
+        if feeding is not None:
+            parsed.feeding = feeding
+    except ValueError as e:
+        _mark_unresolved_msg(parsed, str(e))
+        return True
+    resolved = {}
+    for conf, t in zip(data_confs, aligned):
+        if t is not None and conf.attrs.get("_v1_size_only"):
+            object.__setattr__(conf, "input_type", t)
+            conf.attrs.pop("_v1_size_only", None)
+            resolved[conf.name] = t
+    parsed.provider_input_types = resolved
+    return True
+
+
+def _simple_sample_dim(dc) -> int:
+    """SimpleData's per-sample feature width: feat_dim * (2*context_len + 1)
+    (SimpleDataProviderBase ctor, DataProvider.cpp:223)."""
+    return int(dc.feat_dim) * (2 * int(dc.context_len or 0) + 1)
+
+
+def _resolve_simple_data_types(parsed: ParsedConfig, config_dir: str) -> bool:
+    """Old-face ``TrainData(SimpleData(files=...))`` (the reference's
+    text-format provider, DataProvider.cpp SimpleDataProvider::loadDataFile:
+    each line is ``label feat_1 .. feat_sampleDim``): one dense slot of
+    sample_dim plus an integer label slot."""
+    td = parsed.train_data
+    if td is None or getattr(td, "kind", None) != "simple":
+        return False
+    if td.feat_dim is None:
+        _mark_unresolved_msg(parsed, "SimpleData declares no feat_dim")
+        return True
+    from paddle_tpu.core.data_types import dense_vector, integer_value
+
+    dim = _simple_sample_dim(td)
+    return _bind_and_assign_slot_types(
+        parsed, [dense_vector(dim), integer_value(1)],
+        f"SimpleData({td.files})",
+    )
+
+
+def make_simple_data_reader(
+    parsed: ParsedConfig, config_dir: str, train: bool = True
+):
+    """Reader over a SimpleData text declaration: yields
+    ``(feats float32[sample_dim], int label)`` rows exactly as
+    SimpleDataProvider::loadDataFile parses them."""
+    import numpy as _np
+
+    dc = parsed.train_data if train else (parsed.test_data or parsed.train_data)
+    files = _proto_data_files(dc, config_dir)  # same .list/.txt expansion
+    if not files:
+        raise FileNotFoundError(
+            f"SimpleData files {dc.files!r} not found under {config_dir}"
+        )
+    dim = _simple_sample_dim(dc)
+
+    def reader():
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    if len(parts) != dim + 1:
+                        raise ValueError(
+                            f"{path}: expected label + {dim} feats per "
+                            f"line, got {len(parts)} fields"
+                        )
+                    yield (
+                        _np.asarray(parts[1:], _np.float32),
+                        int(parts[0]),
+                    )
+
+    return reader
+
+
 def _resolve_proto_data_types(parsed: ParsedConfig, config_dir: str) -> bool:
     """Old-face ``TrainData(ProtoData(files=...))``: the binary data's OWN
     DataHeader is the authoritative slot-type source
@@ -227,26 +316,12 @@ def _resolve_proto_data_types(parsed: ParsedConfig, config_dir: str) -> bool:
     sequence = (getattr(td, "type", None) or "").endswith("sequence")
     try:
         itypes = slot_input_types(defs, sequence=sequence)
-        data_confs = list(parsed.topology.data_layers().values())
-        aligned, feeding = _bind_slots(
-            itypes, data_confs, f"ProtoData({td.files})"
-        )
-        if feeding is not None:
-            parsed.feeding = feeding
     except ValueError as e:
-        # building/inspecting the topology must survive a data mismatch
-        # (e.g. a fixture config whose slots feed raw-face groups we map
-        # differently); the error surfaces at FEED time instead
         _mark_unresolved_msg(parsed, str(e))
         return True
-    resolved = {}
-    for conf, t in zip(data_confs, aligned):
-        if t is not None and conf.attrs.get("_v1_size_only"):
-            object.__setattr__(conf, "input_type", t)
-            conf.attrs.pop("_v1_size_only", None)
-            resolved[conf.name] = t
-    parsed.provider_input_types = resolved
-    return True
+    return _bind_and_assign_slot_types(
+        parsed, itypes, f"ProtoData({td.files})"
+    )
 
 
 def make_data_reader(
@@ -396,6 +471,8 @@ def make_config_reader(
     dc = parsed.train_data if train else parsed.test_data
     if dc is not None and getattr(dc, "kind", None) == "proto":
         return make_data_reader(parsed, config_dir, train=train)
+    if dc is not None and getattr(dc, "kind", None) == "simple":
+        return make_simple_data_reader(parsed, config_dir, train=train)
     return make_provider_reader(parsed, config_dir, train=train)
 
 
@@ -536,6 +613,8 @@ def _resolve_provider_types(parsed: ParsedConfig, config_dir: str) -> None:
     still unresolved are marked so feeding raises instead of silently using
     a dense placeholder."""
     if _resolve_proto_data_types(parsed, config_dir):
+        return
+    if _resolve_simple_data_types(parsed, config_dir):
         return
     ds = parsed.data_sources
     if ds is None or not ds.module:

@@ -259,6 +259,15 @@ def test_reference_train_sh_flag_lines_accepted():
     r = run_cli(["train", f"--config={OPT_A}", "num_passes=5"])
     assert r.returncode == 2
 
+    # gflags separate-value and --no<flag> boolean-negation spellings of
+    # ignored reference flags must also pass
+    r = run_cli([
+        "train", f"--config={OPT_A}", "--num_passes=0",
+        "--nics", "eth0", "--nolocal", "--notest_wait",
+    ])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ignoring reference trainer flags" in r.stderr
+
 
 @pytest.mark.slow
 def test_start_pass_resumes_from_save_dir(tmp_path):
