@@ -146,14 +146,28 @@ _IGNORED_REFERENCE_FLAGS = {
 }
 
 
-def _is_ignored_reference_flag(token: str) -> bool:
+# the subset of ignored flags that take a VALUE (gflags string/int/double
+# definitions) — only these may consume a separate following token; the
+# boolean remainder (--local, --test_wait, ...) never do
+_VALUE_REFERENCE_FLAGS = {
+    "average_test_period", "beam_size", "checkgrad_eps", "comment",
+    "gpu_id", "load_missing_parameter_strategy", "log_period_server",
+    "nics", "num_gradient_servers", "port", "ports_num",
+    "ports_num_for_sparse", "rdma_tcp", "test_pass", "trainer_id",
+}
+
+
+def _ignored_flag_name(token: str):
+    """The _IGNORED_REFERENCE_FLAGS entry this token spells, or None.
+    Accepts --name, --name=value, and the gflags --no<bool> negation."""
     if not token.startswith("-"):
-        return False
+        return None
     name = token.lstrip("-").split("=", 1)[0]
-    # gflags boolean negation: --nolocal == --local=false
-    return name in _IGNORED_REFERENCE_FLAGS or (
-        name.startswith("no") and name[2:] in _IGNORED_REFERENCE_FLAGS
-    )
+    if name in _IGNORED_REFERENCE_FLAGS:
+        return name
+    if name.startswith("no") and name[2:] in _IGNORED_REFERENCE_FLAGS:
+        return name[2:]
+    return None
 
 
 def cmd_train(argv: List[str]) -> int:
@@ -162,14 +176,20 @@ def cmd_train(argv: List[str]) -> int:
     i = 0
     while i < len(unknown):
         u = unknown[i]
-        if _is_ignored_reference_flag(u):
+        name = _ignored_flag_name(u)
+        if name is not None:
             ignored.append(u)
-            # gflags separate-value form: `--nics eth0` leaves the value as
-            # its own token — swallow it with the flag
+            # gflags separate-value form (`--gpu_id -1`, `--nics eth0`):
+            # only VALUE-taking flags consume the next token, and only when
+            # the value wasn't already attached with '='; the token must
+            # not itself be a key=value (a stray `batch_size=32` after a
+            # boolean stays a hard error)
             if (
                 "=" not in u
+                and not u.lstrip("-").startswith("no")
+                and name in _VALUE_REFERENCE_FLAGS
                 and i + 1 < len(unknown)
-                and not unknown[i + 1].startswith("-")
+                and "=" not in unknown[i + 1]
             ):
                 ignored.append(unknown[i + 1])
                 i += 1

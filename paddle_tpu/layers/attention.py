@@ -42,6 +42,10 @@ def layer_norm_apply(conf, params, inputs, ctx):
     x = inputs[0]
     eps = conf.attr("epsilon", 1e-6)
     x32 = x.data.astype(jnp.float32)
+    # two-pass (subtract-mean-first) variance on purpose: rows are only
+    # 512 wide so the second pass is cheap, and the one-pass E[x^2]-E[x]^2
+    # form cancels catastrophically for offset-heavy rows (measured zero
+    # speedup here, unlike batch_norm's megasample reductions)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.var(x32, axis=-1, keepdims=True)
     y = (x32 - mu) * jax.lax.rsqrt(var + eps)
@@ -86,9 +90,17 @@ def mha_apply(conf, params, inputs, ctx):
     dh = d // h
     assert d % h == 0, f"{conf.name}: size {d} not divisible by n_heads {h}"
 
-    q = q_in.data @ params["wq"]  # [B, Tq, D]
-    k = kv_in.data @ params["wk"]  # [B, Tk, D]
-    v = kv_in.data @ params["wv"]
+    if kv_in is q_in:
+        # self-attention: one [D, 3D] GEMM instead of three [D, D] — wider
+        # N keeps the MXU fuller and the param concat is trace-time cheap
+        qkv = q_in.data @ jnp.concatenate(
+            [params["wq"], params["wk"], params["wv"]], axis=1
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    else:
+        q = q_in.data @ params["wq"]  # [B, Tq, D]
+        k = kv_in.data @ params["wk"]  # [B, Tk, D]
+        v = kv_in.data @ params["wv"]
     b, tq = q.shape[0], q.shape[1]
     tk = k.shape[1]
     q = q.reshape(b, tq, h, dh)

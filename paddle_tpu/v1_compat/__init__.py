@@ -293,6 +293,13 @@ def make_simple_data_reader(
                         int(parts[0]),
                     )
 
+    if train:
+        # SimpleDataProviderBase::reset shuffles every pass
+        # (DataProvider.cpp fillBuffer -> shuffle); a label-sorted text
+        # file must not train in single-class batches
+        from paddle_tpu.reader.decorator import shuffle as _shuffle
+
+        return _shuffle(reader, 65536)
     return reader
 
 

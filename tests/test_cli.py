@@ -260,13 +260,22 @@ def test_reference_train_sh_flag_lines_accepted():
     assert r.returncode == 2
 
     # gflags separate-value and --no<flag> boolean-negation spellings of
-    # ignored reference flags must also pass
+    # ignored reference flags must also pass, including negative values
     r = run_cli([
         "train", f"--config={OPT_A}", "--num_passes=0",
-        "--nics", "eth0", "--nolocal", "--notest_wait",
+        "--nics", "eth0", "--gpu_id", "-1", "--nolocal", "--notest_wait",
     ])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "ignoring reference trainer flags" in r.stderr
+
+    # a stray key=value token after a BOOLEAN ignored flag is NOT its
+    # value — it stays a hard error (would otherwise silently drop a
+    # mistyped option)
+    r = run_cli([
+        "train", f"--config={OPT_A}", "--nolocal", "batch_size=32",
+    ])
+    assert r.returncode == 2
+    assert "unrecognized arguments" in r.stderr
 
 
 @pytest.mark.slow

@@ -390,3 +390,27 @@ def test_trainer_one_pass_hsigmoid_simple_data():
     assert all(np.isfinite(costs))
     # the conf's own hyperparams are conservative; demand a real decrease
     assert costs[-1] < 0.8 * costs[0], (costs[0], costs[-1])
+
+
+def test_trainer_one_pass_parallel_conf_simple_data():
+    """sample_trainer_config_parallel.conf (the reference's parallel_nn
+    OnePass fixture — per-layer device attrs are placement hints the XLA
+    plane absorbs) trains on the same SimpleData text file."""
+    from paddle_tpu.v1_compat import make_config_reader
+
+    p = parse_config(f"{REF_TESTS}/sample_trainer_config_parallel.conf")
+    reader = make_config_reader(p, REF_TESTS)
+    params = paddle.parameters.create(p.topology)
+    trainer = paddle.trainer.SGD(
+        cost=p.topology, parameters=params,
+        update_equation=make_optimizer(p.settings),
+    )
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 10), num_passes=40,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        async_load_data=False,
+    )
+    assert all(np.isfinite(costs))
+    assert costs[-1] < 0.98 * costs[0], (costs[0], costs[-1])
