@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import re
 import os
 import sys
 import time
@@ -147,13 +148,14 @@ _IGNORED_REFERENCE_FLAGS = {
 
 
 # the subset of ignored flags that take a VALUE (gflags string/int/double
-# definitions) — only these may consume a separate following token; the
-# boolean remainder (--local, --test_wait, ...) never do
+# definitions per the reference Flags.cpp/trainer flags) — only these may
+# consume a separate following token; the boolean remainder never does
 _VALUE_REFERENCE_FLAGS = {
     "average_test_period", "beam_size", "checkgrad_eps", "comment",
-    "gpu_id", "load_missing_parameter_strategy", "log_period_server",
-    "nics", "num_gradient_servers", "port", "ports_num",
-    "ports_num_for_sparse", "rdma_tcp", "test_pass", "trainer_id",
+    "enable_parallel_vector", "gpu_id", "load_missing_parameter_strategy",
+    "log_period_server", "nics", "num_gradient_servers", "port",
+    "ports_num", "ports_num_for_sparse", "rdma_tcp", "test_pass",
+    "test_wait", "trainer_id",
 }
 
 
@@ -181,17 +183,24 @@ def cmd_train(argv: List[str]) -> int:
             ignored.append(u)
             # gflags separate-value form (`--gpu_id -1`, `--nics eth0`):
             # only VALUE-taking flags consume the next token, and only when
-            # the value wasn't already attached with '='; the token must
-            # not itself be a key=value (a stray `batch_size=32` after a
-            # boolean stays a hard error)
+            # the value wasn't already attached with '='.  The token must
+            # neither be a key=value (a stray `batch_size=32` after a
+            # boolean stays fatal) nor LOOK like a flag itself (`--nics
+            # --nolocall` must not eat the typo) — negative numbers like
+            # `-1` are values, dash-then-letter is a flag.
+            nxt = unknown[i + 1] if i + 1 < len(unknown) else None
+            looks_like_flag = bool(
+                nxt and re.match(r"--?[A-Za-z]", nxt)
+            )
             if (
                 "=" not in u
                 and not u.lstrip("-").startswith("no")
                 and name in _VALUE_REFERENCE_FLAGS
-                and i + 1 < len(unknown)
-                and "=" not in unknown[i + 1]
+                and nxt is not None
+                and "=" not in nxt
+                and not looks_like_flag
             ):
-                ignored.append(unknown[i + 1])
+                ignored.append(nxt)
                 i += 1
         else:
             fatal.append(u)
@@ -539,12 +548,19 @@ def cmd_merge_model(argv: List[str]) -> int:
     return 0
 
 
+def cmd_plotcurve(argv: List[str]) -> int:
+    from paddle_tpu.utils.plotcurve import main as plot_main
+
+    return plot_main(argv)
+
+
 _COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
     "dump_config": cmd_dump_config,
     "make_diagram": cmd_make_diagram,
     "merge_model": cmd_merge_model,
+    "plotcurve": cmd_plotcurve,
 }
 
 
@@ -558,6 +574,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    dump_config       print the resolved topology of a config")
         print("    make_diagram      write a Graphviz diagram of a config")
         print("    merge_model       bundle config + parameters into one file")
+        print("    plotcurve         plot training curves from a log")
         return 0 if argv else 1
     cmd, rest = argv[0], argv[1:]
     if cmd not in _COMMANDS:

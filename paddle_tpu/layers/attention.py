@@ -90,7 +90,11 @@ def mha_apply(conf, params, inputs, ctx):
     dh = d // h
     assert d % h == 0, f"{conf.name}: size {d} not divisible by n_heads {h}"
 
-    if kv_in is q_in:
+    # self-attention detection by TOPOLOGY, not object identity: the
+    # mixed-precision cast rebuilds each input SeqTensor, so `kv_in is
+    # q_in` is False in every bf16 step even when both are the same layer
+    same_input = len(conf.inputs) == 1 or conf.inputs[0] == conf.inputs[1]
+    if same_input:
         # self-attention: one [D, 3D] GEMM instead of three [D, D] — wider
         # N keeps the MXU fuller and the param concat is trace-time cheap
         qkv = q_in.data @ jnp.concatenate(

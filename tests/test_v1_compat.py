@@ -173,7 +173,8 @@ def test_positional_provider_types_pair_by_declaration_order(tmp_path):
         "def process(settings, f):\n"
         "    yield 0, [0.0] * 784\n"
     )
-    p = parse_config(str(cfg))
+    with pytest.warns(UserWarning, match="unique dim-consistent assignment"):
+        p = parse_config(str(cfg))
     from paddle_tpu.core.data_types import SlotKind
 
     assert p.provider_input_types["label"].kind == SlotKind.INDEX
