@@ -149,7 +149,9 @@ _IGNORED_REFERENCE_FLAGS = {
 
 # the subset of ignored flags that take a VALUE (gflags string/int/double
 # definitions per the reference Flags.cpp/trainer flags) — only these may
-# consume a separate following token; the boolean remainder never does
+# consume a separate following token; the boolean remainder never does.
+# NB test_wait and enable_parallel_vector LOOK boolean but are DEFINE_int32
+# (Trainer.cpp:70, Flags.cpp:62).
 _VALUE_REFERENCE_FLAGS = {
     "average_test_period", "beam_size", "checkgrad_eps", "comment",
     "enable_parallel_vector", "gpu_id", "load_missing_parameter_strategy",

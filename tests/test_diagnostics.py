@@ -87,3 +87,43 @@ def test_show_parameter_stats_period_logs(caplog):
         )
     text = caplog.text
     assert "parameter stats" in text and "hidden.w0" in text
+
+
+def test_duplicate_layer_name_rejected():
+    """Two structurally different layers under one name fail at Topology
+    build (the reference's config_parser duplicate-name config_assert), not
+    deep inside a traced matmul."""
+    reset_auto_names()
+    x = layers.data("dx", paddle.data_type.dense_vector(4))
+    a = layers.fc(x, size=3, name="same")
+    b = layers.fc(x, size=5, name="same")
+    with pytest.raises(ValueError, match="share the name"):
+        Topology([layers.addto([a, b])])
+
+
+def test_unknown_activation_fails_fast():
+    """A bad activation name dies at DSL build with the known names listed
+    (reference ActivationFunction::create fatal), not at apply time."""
+    reset_auto_names()
+    x = layers.data("ax", paddle.data_type.dense_vector(4))
+    with pytest.raises(KeyError, match="unknown activation"):
+        layers.fc(x, size=3, act="frobnicate")
+
+
+def test_lstmemory_wrong_input_size_fails_fast():
+    """lstmemory demands a 4H pre-projection (reference LstmLayer::init
+    CHECK_EQ on input size) and says so at build."""
+    reset_auto_names()
+    x = layers.data("lx", paddle.data_type.dense_vector_sequence(10))
+    with pytest.raises(AssertionError, match="must be 4"):
+        layers.lstmemory(x)
+
+
+def test_wrong_dense_dim_fails_at_feed():
+    """A sample narrower than the declared dense slot dies in the feeder's
+    reshape, before any device work."""
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    feeder = DataFeeder([("d", paddle.data_type.dense_vector(8))])
+    with pytest.raises(ValueError, match="reshape"):
+        feeder([(np.zeros(5, np.float32),)])
