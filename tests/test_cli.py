@@ -295,3 +295,14 @@ def test_start_pass_resumes_from_save_dir(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     assert "Pass 1" in r.stdout
     assert (save / "pass-00001" / "params.tar").exists()
+
+
+def test_make_diagram_writes_dot(tmp_path):
+    """make_diagram renders a config to Graphviz dot
+    (submit_local.sh.in make_diagram -> python -m paddle.utils.make_model_diagram)."""
+    out = tmp_path / "net.dot"
+    r = run_cli(["make_diagram", OPT_A, str(out)])
+    assert r.returncode == 0, r.stderr[-2000:]
+    text = out.read_text()
+    assert text.startswith("digraph")
+    assert "__fc_layer_0__" in text
