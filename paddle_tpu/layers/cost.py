@@ -17,6 +17,10 @@ from paddle_tpu.core.batch import SeqTensor
 from paddle_tpu.layers.base import register_layer
 
 _EPS = 1e-10
+# two-sided probability clip for the BCE family: must be representable in
+# float32 — 1.0 - 1e-10 rounds to exactly 1.0 (f32 has ~7 digits), which
+# made log(1-p) = -inf for saturated probabilities
+_BCE_EPS = 1e-6
 
 
 def _fused_ce_from_logits(x: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
@@ -89,7 +93,7 @@ def softmax_with_cost_apply(conf, params, inputs, ctx):
 def soft_bce_apply(conf, params, inputs, ctx):
     """Per-dim BCE with soft targets (SoftBinaryClassCrossEntropy)."""
     prob, label = inputs[0], inputs[1]
-    p = jnp.clip(prob.data, _EPS, 1.0 - _EPS)
+    p = jnp.clip(prob.data, _BCE_EPS, 1.0 - _BCE_EPS)
     t = label.data
     cost = -jnp.sum(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p), axis=-1)
     return _per_sample(cost, prob)
@@ -98,19 +102,33 @@ def soft_bce_apply(conf, params, inputs, ctx):
 @register_layer("multi_binary_label_cross_entropy", auto_activation=False, full_precision=True)
 def multi_binary_label_ce_apply(conf, params, inputs, ctx):
     """BCE where the label is a multi-hot vector (MultiBinaryLabelCrossEntropy).
-    The label slot arrives densified to multi-hot [B, D] by the feeder."""
+    The label slot arrives densified to multi-hot [B, D] by the feeder; an
+    integer ID label one-hots (the reference's sparse id-matrix form)."""
     prob, label = inputs[0], inputs[1]
-    p = jnp.clip(prob.data, _EPS, 1.0 - _EPS)
-    t = label.data
+    p = jnp.clip(prob.data, _BCE_EPS, 1.0 - _BCE_EPS)
+    t = _label_as_dense(label, prob.data.shape[-1])
     cost = -jnp.sum(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p), axis=-1)
     return _per_sample(cost, prob)
 
 
+def _label_as_dense(label: SeqTensor, width: int) -> jnp.ndarray:
+    """A cost's label operand as a dense [.., width] block: already-dense
+    labels pass through; integer ID labels one-hot against the prediction
+    width — the reference's sparse-label support in these costs
+    (SumOfSquaresCostLayer / MultiBinaryLabelCrossEntropy accept a sparse
+    id matrix, CostLayer.cpp)."""
+    t = label.data
+    if jnp.issubdtype(t.dtype, jnp.integer):
+        return jax.nn.one_hot(_label_ids(label), width, dtype=jnp.float32)
+    return t
+
+
 @register_layer("square_error", auto_activation=False, full_precision=True)
 def square_error_apply(conf, params, inputs, ctx):
-    """0.5 * sum((x - y)^2) per sample (SumOfSquaresCostLayer)."""
+    """0.5 * sum((x - y)^2) per sample (SumOfSquaresCostLayer; an integer
+    label acts as the one-hot row, the reference's sparse-label form)."""
     x, y = inputs[0], inputs[1]
-    d = x.data - y.data
+    d = x.data - _label_as_dense(y, x.data.shape[-1])
     cost = 0.5 * jnp.sum(jnp.square(d), axis=-1)
     return _per_sample(cost, x)
 

@@ -55,10 +55,21 @@ def nce_apply(conf, params, inputs, ctx):
     k = conf.attrs["num_neg_samples"]
     c = conf.attrs["num_classes"]
 
-    x = jnp.concatenate(
-        [t.data.reshape(t.data.shape[0], -1) for t in inputs[:nfeat]], axis=-1
-    )
-    label = inputs[nfeat].data.astype(jnp.int32).reshape(-1)  # [B]
+    # sequence inputs run FRAME-WISE (each timestep one NCE sample) — the
+    # reference NCELayer checks label rows == input frame rows, so a seq
+    # feature pairs with a seq label position by position
+    seq_in = inputs[0].is_seq and inputs[0].data.ndim == 3
+    if seq_in:
+        x = jnp.concatenate(
+            [t.data.reshape(-1, t.data.shape[-1]) for t in inputs[:nfeat]],
+            axis=-1,
+        )  # [B*T, D]
+    else:
+        x = jnp.concatenate(
+            [t.data.reshape(t.data.shape[0], -1) for t in inputs[:nfeat]],
+            axis=-1,
+        )
+    label = inputs[nfeat].data.astype(jnp.int32).reshape(-1)  # [B] / [B*T]
     b_ = x.shape[0]
 
     dist = conf.attrs.get("noise_dist")
@@ -88,6 +99,11 @@ def nce_apply(conf, params, inputs, ctx):
         + jnp.log1p(jnp.exp(-jnp.abs(logits))),
         axis=1,
     )
+    if seq_in:
+        t0 = inputs[0]
+        frames = loss.reshape(t0.data.shape[0], t0.data.shape[1])  # [B, T]
+        frames = frames * t0.mask(frames.dtype)
+        return SeqTensor(jnp.sum(frames, axis=1)[:, None])
     return SeqTensor(loss[:, None])
 
 

@@ -41,6 +41,12 @@ def pytest_configure(config):
 # by `make verify`.  Regenerate after large suite changes with
 #   pytest --durations=0 | awk '$1+0>=4' ...
 _SLOW_TESTS = {
+    # DSL run-sweep heavyweights (conv-stack configs compile ~30s each)
+    "test_dsl_config_executes[img_trans_layers]",
+    "test_dsl_config_executes[img_layers]",
+    "test_dsl_config_executes[test_cost_layers]",
+    "test_dsl_config_executes[test_cost_layers_with_weight]",
+    "test_dsl_config_executes[simple_rnn_layers]",
     # registry-sweep grad checks >= ~2s each (the sweep's completeness GATE,
     # test_every_registered_type_is_swept, always runs in the fast tier)
     "test_registry_grad[multibox_loss]",
