@@ -119,6 +119,17 @@ def _label_as_dense(label: SeqTensor, width: int) -> jnp.ndarray:
     id matrix, CostLayer.cpp)."""
     t = label.data
     if jnp.issubdtype(t.dtype, jnp.integer):
+        if t.ndim >= 2 and t.shape[-1] != 1:
+            # padded multi-id rows (the feeder's big-vocab sparse_ids form,
+            # [.., nnz] with sentinel == width): multi-hot by summing the
+            # one-hots — sentinels one-hot to all-zero rows, duplicates
+            # clamp to 1 (NO_VALUE sparse labels are binary)
+            return jnp.minimum(
+                jnp.sum(
+                    jax.nn.one_hot(t, width, dtype=jnp.float32), axis=-2
+                ),
+                1.0,
+            )
         return jax.nn.one_hot(_label_ids(label), width, dtype=jnp.float32)
     return t
 

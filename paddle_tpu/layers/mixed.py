@@ -119,7 +119,17 @@ def mixed_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> Seq
         y = _apply_proj(spec, p, t, conf.size)
         acc = y if acc is None else acc + y
     if "b" in params:
-        acc = acc + params["b"]
+        b = params["b"]
+        if acc.ndim == 4 and b.ndim == 1 and b.shape[0] == (
+            acc.shape[1] * acc.shape[2] * acc.shape[3]
+        ):
+            # conv-projection output stays 4D NHWC; the v1 full-width mixed
+            # bias is stored flat CHW (img_conv_b.conf: mixed_layer(
+            # bias_attr=True) over conv_projection) — place it accordingly
+            b = b.reshape(
+                acc.shape[3], acc.shape[1], acc.shape[2]
+            ).transpose(1, 2, 0)
+        acc = acc + b
     return SeqTensor(acc, lengths)
 
 

@@ -450,19 +450,24 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
                     )
             else:  # unbooted: EMPTY sequence (zero lengths), not zeros-as-data
                 init_carry[m.name] = SeqTensor(
-                    jnp.zeros((b, w, m.size), scanned[0].data.dtype),
+                    jnp.zeros((b, w, m.size), ctx.dtype),
                     jnp.zeros((b,), jnp.int32),
                 )
         elif boot is not None:
             init_carry[m.name] = ctx.outputs[boot].data
         elif boot_const is not None:
             # id-type memory booted with a constant id (reference
-            # boot_with_const_id — used for generated-input memories)
+            # boot_with_const_id — used for generated-input memories);
+            # these DO follow the scanned ids' integer dtype
             init_carry[m.name] = jnp.full(
                 (b, m.size), boot_const, scanned[0].data.dtype
             )
         else:
-            init_carry[m.name] = jnp.zeros((b, m.size), scanned[0].data.dtype)
+            # memories carry float layer state: zeros at the COMPUTE dtype,
+            # never the first scanned input's (an id sequence scanned first
+            # made the carry int32 while the linked fc emits floats —
+            # sequence_nest_rnn_multi_input.conf)
+            init_carry[m.name] = jnp.zeros((b, m.size), ctx.dtype)
 
     step_rng = ctx.layer_rng(conf.name)
     t_iota = jnp.arange(t_max, dtype=jnp.uint32)

@@ -102,8 +102,15 @@ def nce_apply(conf, params, inputs, ctx):
     if seq_in:
         t0 = inputs[0]
         frames = loss.reshape(t0.data.shape[0], t0.data.shape[1])  # [B, T]
-        frames = frames * t0.mask(frames.dtype)
-        return SeqTensor(jnp.sum(frames, axis=1)[:, None])
+        m = t0.mask(frames.dtype)
+        lab_t = inputs[nfeat]
+        if lab_t.is_seq:
+            # the reference CHECKs label rows == feature rows; lengths are
+            # traced here, so the defensible equivalent is counting only
+            # frames BOTH sides declare valid (a frame past the label's
+            # end must not train against padding ids)
+            m = m * lab_t.mask(frames.dtype)
+        return SeqTensor(jnp.sum(frames * m, axis=1)[:, None])
     return SeqTensor(loss[:, None])
 
 

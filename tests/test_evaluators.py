@@ -177,3 +177,20 @@ def test_trainer_with_evaluator():
     trainer.train(paddle.batch(lambda: iter(data), 8), num_passes=1,
                   event_handler=handler)
     assert "clserr" in seen and 0.0 <= seen["clserr"] <= 1.0
+
+
+def test_multi_binary_ce_multi_id_labels_multi_hot():
+    """_label_as_dense with padded multi-id rows (the feeder's sparse_ids
+    form): multi-hot with sentinel rows contributing nothing and duplicates
+    clamped — never a silently mis-shaped [B, nnz, width] broadcast."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.layers.cost import _label_as_dense
+
+    ids = jnp.asarray([[1, 3, 3, 5], [0, 5, 5, 5]], jnp.int32)  # 5 = sentinel
+    t = np.asarray(_label_as_dense(SeqTensor(ids, sparse_ids=True), 5))
+    assert t.shape == (2, 5)
+    np.testing.assert_allclose(t[0], [0, 1, 0, 1, 0])
+    np.testing.assert_allclose(t[1], [1, 0, 0, 0, 0])
