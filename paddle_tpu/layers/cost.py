@@ -119,11 +119,13 @@ def _label_as_dense(label: SeqTensor, width: int) -> jnp.ndarray:
     id matrix, CostLayer.cpp)."""
     t = label.data
     if jnp.issubdtype(t.dtype, jnp.integer):
-        if t.ndim >= 2 and t.shape[-1] != 1:
+        if getattr(label, "sparse_ids", False):
             # padded multi-id rows (the feeder's big-vocab sparse_ids form,
             # [.., nnz] with sentinel == width): multi-hot by summing the
             # one-hots — sentinels one-hot to all-zero rows, duplicates
-            # clamp to 1 (NO_VALUE sparse labels are binary)
+            # clamp to 1 (NO_VALUE sparse labels are binary).  Dispatch is
+            # on the EXACT sparse_ids flag (base.is_sparse_ids contract) —
+            # a plain [B, T] id-sequence label must keep per-frame one-hots
             return jnp.minimum(
                 jnp.sum(
                     jax.nn.one_hot(t, width, dtype=jnp.float32), axis=-2

@@ -41,6 +41,13 @@ def pytest_configure(config):
 # by `make verify`.  Regenerate after large suite changes with
 #   pytest --durations=0 | awk '$1+0>=4' ...
 _SLOW_TESTS = {
+    # demo run-sweep heavyweights
+    "test_quick_start_configs_execute[db-lstm]",
+    "test_quick_start_configs_execute[lstm]",
+    "test_quick_start_configs_execute[bidi-lstm]",
+    "test_quick_start_configs_execute[resnet-lstm]",
+    "test_sequence_tagging_configs_execute[rnn_crf]",
+    "test_sequence_tagging_configs_execute[linear_crf]",
     # DSL run-sweep heavyweights (conv-stack configs compile ~30s each)
     "test_dsl_config_executes[img_trans_layers]",
     "test_dsl_config_executes[img_layers]",

@@ -861,3 +861,73 @@ def test_multi_nn_ensemble_builds_and_trains(tmp_path):
     assert np.mean(costs[-5:]) < 0.5 * np.mean(costs[:5]), (
         costs[:5], costs[-5:],
     )
+
+
+# ---------------------------------------------------------------------------
+# demo configs EXECUTE (the run-sweep discipline of test_dsl_run_sweep.py
+# applied to v1_api_demo): build + one jitted forward with hinted random
+# batches.  quick_start-lr / gan / vae / mnist already TRAIN in other tests.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "cfg", ["lr", "emb", "cnn", "lstm", "bidi-lstm", "db-lstm", "resnet-lstm"]
+)
+def test_quick_start_configs_execute(dict_dir, cfg):
+    import jax
+
+    import paddle_tpu.core.data_types as dt
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    from layer_grad_util import rand_batch_for
+
+    p = parse_config(f"{REF}/quick_start/trainer_config.{cfg}.py")
+    for name, conf in list(p.topology.data_layers().items()):
+        if conf.input_type is None or conf.attrs.get("_v1_size_only"):
+            itype = (
+                dt.integer_value(2) if name == "label"
+                else dt.integer_value_sequence(max(conf.size, 2))
+            )
+            object.__setattr__(conf, "input_type", itype)
+            conf.attrs.pop("_v1_size_only", None)
+            conf.attrs.pop("_v1_unresolved", None)
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = rand_batch_for(p.topology, batch_size=2, max_len=4)
+    outs, _ = net.apply(
+        params, batch, state=state, train=True, rng=jax.random.PRNGKey(1)
+    )
+    for oname in p.topology.output_names:
+        arr = outs[oname].data
+        assert np.all(np.isfinite(np.asarray(arr, np.float32))), (cfg, oname)
+
+
+@pytest.mark.parametrize("cfg", ["linear_crf", "rnn_crf"])
+def test_sequence_tagging_configs_execute(cfg):
+    import jax
+
+    import paddle_tpu.core.data_types as dt
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    from layer_grad_util import rand_batch_for
+
+    p = parse_config(f"{REF}/sequence_tagging/{cfg}.py")
+    hints = {
+        "features": dt.sparse_binary_vector_sequence(76328),
+        "word": dt.integer_value_sequence(6778),
+        "pos": dt.integer_value_sequence(44),
+        "chunk": dt.integer_value_sequence(24),
+    }
+    for name, conf in list(p.topology.data_layers().items()):
+        if name in hints:
+            object.__setattr__(conf, "input_type", hints[name])
+            conf.attrs.pop("_v1_size_only", None)
+            conf.attrs.pop("_v1_unresolved", None)
+    net = CompiledNetwork(p.topology)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = rand_batch_for(p.topology, batch_size=2, max_len=4)
+    outs, _ = net.apply(
+        params, batch, state=state, train=True, rng=jax.random.PRNGKey(1)
+    )
+    for oname in p.topology.output_names:
+        arr = outs[oname].data
+        assert np.all(np.isfinite(np.asarray(arr, np.float32))), (cfg, oname)
