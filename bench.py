@@ -664,6 +664,8 @@ def bench_lstm_textcls() -> dict:
         **_mfu_fields(flops, ms / 1e3),
         "binds": "scan-sequential recurrent GEMMs ([128,512]x[512,2048] per "
         "step, 200 dependent steps) — MXU-latency-bound, not HBM; "
+        "custom-VJP cells (ops/rnn.py _lstm_core) keep backward to one "
+        "GEMM/step with the weight grad as one post-scan einsum; "
         "single-dispatch adds ~6 ms tunnel cost",
     }
 

@@ -68,6 +68,14 @@ class Inference:
         self.network = CompiledNetwork(
             self.topology, compute_dtype=get_default_compute_dtype()
         )
+        if not hasattr(parameters, "network"):
+            # topology-free bag from the static Parameters.from_tar(f):
+            # build parameters for this inference topology, merge by name
+            from paddle_tpu.parameters import create_from_network
+
+            detached = parameters
+            parameters = create_from_network(self.network, seed=0)
+            detached.merge_into(parameters)
         # inherit the training network's mesh so mesh-aware layers (ring
         # attention) keep their parallelism at inference time
         self.network.mesh = getattr(parameters.network, "mesh", None)

@@ -119,7 +119,9 @@ def _label_as_dense(label: SeqTensor, width: int) -> jnp.ndarray:
     id matrix, CostLayer.cpp)."""
     t = label.data
     if jnp.issubdtype(t.dtype, jnp.integer):
-        if getattr(label, "sparse_ids", False):
+        from paddle_tpu.layers.base import is_sparse_ids
+
+        if is_sparse_ids(label, width):
             # padded multi-id rows (the feeder's big-vocab sparse_ids form,
             # [.., nnz] with sentinel == width): multi-hot by summing the
             # one-hots — sentinels one-hot to all-zero rows, duplicates

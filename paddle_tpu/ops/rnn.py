@@ -13,18 +13,26 @@ the MXU.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from paddle_tpu.ops.activations import get_activation
 
-# Step-body unroll factor for the recurrence scans: amortizes per-iteration
-# scan overhead across MXU-bound small matmuls (measured on v5e, GRU
-# B=128/T=50/H=512 fwd+bwd: unroll 1 -> 5.6 ms, 4 -> 4.1 ms; 8 is no
-# better).  lax.scan handles non-divisible lengths itself.
+# Step-body unroll factors.  The custom-VJP LSTM core (one GEMM per step
+# in BOTH directions, weight grads deferred to a single post-scan GEMM) is
+# latency-bound on the chained [B,H]x[H,4H] matmul and unroll=1 measures
+# fastest on v5e (LSTM text-cls B=128/T=100/H=512 fwd+bwd: unroll 1 ->
+# 5.9 ms, 4 -> 6.9 ms; a bare 200-GEMM chain microbench shows the same
+# 13.4 vs 25.5 us/link shape).  The GRU/simple-RNN scans still use naive
+# autodiff whose heavier backward bodies (per-step weight-grad GEMM +
+# accumulator) amortize best at the previously measured unroll=4 (GRU
+# B=128/T=50/H=512 fwd+bwd: unroll 1 -> 5.6 ms, 4 -> 4.1 ms).
+_UNROLL_FUSED = 1
 _UNROLL = 4
 
 
@@ -44,6 +52,120 @@ def _mask_seq(lengths: Optional[jnp.ndarray], max_len: int, reverse: bool):
     else:
         valid = t < lengths[None, :]
     return valid[..., None]
+
+
+def _lstm_elem(acts, a, c_p, h_p, m, w_ci, w_cf, w_co):
+    """The per-step ELEMENTWISE LSTM cell math (everything except the
+    recurrent GEMM): a = x_t + h₋W (+bias) already combined.  Shared by the
+    forward scan and the backward pass (which re-derives its local VJP from
+    this closure, so peepholes/masking/activation choices stay exact)."""
+    f_gate = get_activation(acts[0])
+    f_act = get_activation(acts[1])
+    f_state = get_activation(acts[2])
+    a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)
+    a_i = a_i + w_ci * c_p
+    a_f = a_f + w_cf * c_p
+    i_t = f_gate(a_i)
+    f_t = f_gate(a_f)
+    c_t = f_t * c_p + i_t * f_act(a_g)
+    o_t = f_gate(a_o + w_co * c_t)
+    h_t = o_t * f_state(c_t)
+    h_t = jnp.where(m, h_t, h_p)
+    c_t = jnp.where(m, c_t, c_p)
+    return h_t, c_t
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _lstm_core(acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask):
+    """Time-major LSTM recurrence with a hand-written VJP.
+
+    Autodiff of the naive scan accumulates dW_h with an extra [H,4H]
+    carry + a second [H,B]x[B,4H] GEMM in EVERY backward step — for
+    B=128/T=100/H=512 that is ~100 extra chained GEMMs and ~800 MB of f32
+    accumulator traffic.  Here the backward scan computes only the gate
+    cotangents (one [B,4H]x[4H,H] GEMM per step) and the weight gradient
+    is ONE batched einsum over the saved sequences afterwards — the same
+    restructuring the reference's fused CUDA kernels do by hand
+    (hl_cuda_lstm.cu backwardOneSequence vs its weight-grad GEMM pass).
+
+    xs: [T,B,4H] input projections (+bias), mask: [T,B,1] bool.
+    Returns (hs [T,B,H], h_last, c_last)."""
+    hs, _as, _cs, h_last, c_last = _lstm_fwd_scan(
+        acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask
+    )
+    return hs, h_last, c_last
+
+
+def _lstm_fwd_scan(acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask):
+    def step(carry, inp):
+        h_p, c_p = carry
+        x_t, m = inp
+        a = x_t + h_p @ w_h
+        h_t, c_t = _lstm_elem(acts, a, c_p, h_p, m, w_ci, w_cf, w_co)
+        return (h_t, c_t), (h_t, a, c_t)
+
+    (h_last, c_last), (hs, a_seq, c_seq) = lax.scan(
+        step, (h0, c0), (xs, mask), unroll=_UNROLL_FUSED
+    )
+    return hs, a_seq, c_seq, h_last, c_last
+
+
+def _lstm_core_fwd(acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask):
+    hs, a_seq, c_seq, h_last, c_last = _lstm_fwd_scan(
+        acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask
+    )
+    res = (a_seq, c_seq, hs, w_h, w_ci, w_cf, w_co, h0, c0, mask)
+    return (hs, h_last, c_last), res
+
+
+def _lstm_core_bwd(acts, res, cts):
+    a_seq, c_seq, hs, w_h, w_ci, w_cf, w_co, h0, c0, mask = res
+    dhs, dh_last, dc_last = cts
+    t = a_seq.shape[0]
+    # previous-step state sequences aligned with step t
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prev_seq = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
+    w_h_t = w_h.T
+    zeros_w = (
+        jnp.zeros_like(w_ci),
+        jnp.zeros_like(w_cf),
+        jnp.zeros_like(w_co),
+    )
+
+    def step(carry, inp):
+        dh, dc, dwci, dwcf, dwco = carry
+        a_t, c_p, h_p, m, dh_out = inp
+        dh = dh + dh_out
+        _, vjp_fn = jax.vjp(
+            lambda a, cp, hp, wci, wcf, wco: _lstm_elem(
+                acts, a, cp, hp, m, wci, wcf, wco
+            ),
+            a_t, c_p, h_p, w_ci, w_cf, w_co,
+        )
+        da, dc_p, dh_p_elem, dwci_t, dwcf_t, dwco_t = vjp_fn((dh, dc))
+        dh_p = da @ w_h_t + dh_p_elem  # the ONE backward-chain GEMM
+        return (
+            (dh_p, dc_p, dwci + dwci_t, dwcf + dwcf_t, dwco + dwco_t),
+            da,
+        )
+
+    (dh0, dc0, dwci, dwcf, dwco), da_seq = lax.scan(
+        step,
+        (dh_last, dc_last, *zeros_w),
+        (a_seq, c_prev_seq, h_prev_seq, mask, dhs),
+        reverse=True,
+        unroll=_UNROLL_FUSED,
+    )
+    # weight grad as ONE big GEMM over the whole sequence (f32 accumulate)
+    dw_h = jnp.einsum(
+        "tbh,tbg->hg", h_prev_seq, da_seq,
+        preferred_element_type=jnp.float32,
+    ).astype(w_h.dtype)
+    d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return (da_seq, dw_h, dwci, dwcf, dwco, dh0, dc0, d_mask)
+
+
+_lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
 
 
 def lstm_scan(
@@ -68,45 +190,29 @@ def lstm_scan(
     Returns ([B, T, H] hidden sequence, (h_last, c_last))."""
     b, t, g4 = gates.shape
     h = g4 // 4
-    f_gate = get_activation(gate_act)
-    f_act = get_activation(act)
-    f_state = get_activation(state_act)
 
     xs = _time_major(gates)
+    if bias is not None:
+        xs = xs + bias  # folds into the producing projection GEMM's epilogue
     if reverse:
         xs = jnp.flip(xs, axis=0)
     mask = _mask_seq(lengths, t, reverse)
+    if mask is None:
+        mask = jnp.ones((t, b, 1), bool)
 
     h_prev = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
     c_prev = c0 if c0 is not None else jnp.zeros((b, h), gates.dtype)
-
-    def step(carry, inp):
-        h_p, c_p = carry
-        if mask is None:
-            x_t, m = inp, None
-        else:
-            x_t, m = inp
-        a = x_t + h_p @ w_h
-        if bias is not None:
-            a = a + bias
-        a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)
-        if w_ci is not None:
-            a_i = a_i + w_ci * c_p
-            a_f = a_f + w_cf * c_p
-        i_t = f_gate(a_i)
-        f_t = f_gate(a_f)
-        c_t = f_t * c_p + i_t * f_act(a_g)
-        a_o = a_o + (w_co * c_t if w_co is not None else 0.0)
-        o_t = f_gate(a_o)
-        h_t = o_t * f_state(c_t)
-        if m is not None:
-            h_t = jnp.where(m, h_t, h_p)
-            c_t = jnp.where(m, c_t, c_p)
-        return (h_t, c_t), h_t
-
-    inputs = xs if mask is None else (xs, mask)
-    (h_last, c_last), hs = lax.scan(
-        step, (h_prev, c_prev), inputs, unroll=_UNROLL
+    zeros_h = jnp.zeros((h,), gates.dtype)
+    hs, h_last, c_last = _lstm_core(
+        (gate_act, act, state_act),
+        xs,
+        w_h,
+        w_ci if w_ci is not None else zeros_h,
+        w_cf if w_cf is not None else zeros_h,
+        w_co if w_co is not None else zeros_h,
+        h_prev,
+        c_prev,
+        mask,
     )
     if reverse:
         hs = jnp.flip(hs, axis=0)

@@ -102,6 +102,12 @@ class Parameters:
 
     # -- tar checkpoints ------------------------------------------------
     def to_tar(self, f) -> None:
+        """Reference v2 tar layout (python/paddle/v2/parameters.py:266):
+        per parameter a data member (v1 binary header + raw float32) AND a
+        ``<name>.protobuf`` ParameterConfig member carrying name/size/dims
+        (hand-rolled proto2 wire bytes — fields 1, 2, 9 of
+        proto/ParameterConfig.proto) so the static ``from_tar`` can
+        restore shapes, and the reference itself can parse the file."""
         with tarfile.open(fileobj=f, mode="w") as tar:
             for name in self.names():
                 arr = self.get(name).astype(np.float32)
@@ -111,25 +117,193 @@ class Parameters:
                 info = tarfile.TarInfo(name=name)
                 info.size = len(payload)
                 tar.addfile(info, io.BytesIO(payload))
+                conf = _encode_param_conf(name, arr.shape)
+                cinfo = tarfile.TarInfo(name=f"{name}.protobuf")
+                cinfo.size = len(conf)
+                tar.addfile(cinfo, io.BytesIO(conf))
 
-    def from_tar(self, f) -> None:
+    def init_from_tar(self, f) -> None:
+        """Merge a parameter tar into THIS instance, ignoring names the
+        topology doesn't have (reference Parameters.init_from_tar,
+        python/paddle/v2/parameters.py:314)."""
         known = set(self.names())
-        with tarfile.open(fileobj=f, mode="r") as tar:
-            for member in tar.getmembers():
-                buf = tar.extractfile(member).read()
-                version, value_size, size = struct.unpack("<iIQ", buf[:16])
-                assert value_size == 4, "only float32 checkpoints supported"
-                arr = np.frombuffer(buf[16 : 16 + 4 * size], dtype=np.float32)
-                if member.name in known:
-                    self.set(member.name, arr)
+        for name, arr in _read_tar_members(f):
+            if name in known:
+                self.set(name, arr)
+
+    class _FromTar:
+        """``Parameters.from_tar(f)`` on the CLASS is the reference's
+        static constructor (python/paddle/v2/parameters.py:286) and
+        returns a topology-free :class:`DetachedParameters`; on an
+        INSTANCE it merges into the existing parameters (kept as an
+        alias of :meth:`init_from_tar` for the library's own callers)."""
+
+        def __get__(self, obj, objtype=None):
+            if obj is None:
+                return DetachedParameters.from_tar
+            return obj.init_from_tar
+
+    from_tar = _FromTar()
 
     @staticmethod
     def from_tar_new(network: CompiledNetwork, f) -> "Parameters":
-        import jax
-
         p = create_from_network(network, seed=0)
-        p.from_tar(f)
+        p.init_from_tar(f)
         return p
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _encode_param_conf(name: str, shape) -> bytes:
+    """Minimal proto2 ParameterConfig wire bytes: name (field 1, string),
+    size (field 2, uint64), dims (field 9, repeated uint64)."""
+    nb = name.encode("utf-8")
+    out = b"\x0a" + _varint(len(nb)) + nb  # field 1, wire type 2
+    size = 1
+    for d in shape:
+        size *= int(d)
+    out += b"\x10" + _varint(size)  # field 2, wire type 0
+    for d in shape:
+        out += b"\x48" + _varint(int(d))  # field 9, wire type 0
+    return out
+
+
+def _parse_param_conf(buf: bytes):
+    """Parse the fields we wrote (skipping any others a reference-written
+    tar may carry).  Returns (name, dims)."""
+    name, dims = None, []
+    i, n = 0, len(buf)
+
+    def read_varint(i):
+        v, shift = 0, 0
+        while True:
+            b = buf[i]
+            v |= (b & 0x7F) << shift
+            i += 1
+            if not b & 0x80:
+                return v, i
+            shift += 7
+
+    while i < n:
+        tag, i = read_varint(i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, i = read_varint(i)
+            if field == 9:
+                dims.append(v)
+        elif wire == 1:
+            i += 8
+        elif wire == 2:
+            ln, i = read_varint(i)
+            if field == 1:
+                name = buf[i : i + ln].decode("utf-8")
+            i += ln
+        elif wire == 5:
+            i += 4
+        else:
+            break  # unknown wire type: stop rather than misparse
+    return name, dims
+
+
+def _read_tar_members(f):
+    """Yield (name, float32 array) for each data member of a
+    reference-format parameter tar, with shapes restored from any
+    ``<name>.protobuf`` ParameterConfig members present."""
+    with tarfile.open(fileobj=f, mode="r") as tar:
+        members = tar.getmembers()
+        dims = {}
+        for member in members:
+            if member.name.endswith(".protobuf"):
+                nm, dd = _parse_param_conf(tar.extractfile(member).read())
+                dims[nm if nm else member.name[: -len(".protobuf")]] = dd
+        for member in members:
+            if member.name.endswith(".protobuf"):
+                continue
+            buf = tar.extractfile(member).read()
+            version, value_size, size = struct.unpack("<iIQ", buf[:16])
+            assert value_size == 4, "only float32 checkpoints supported"
+            arr = np.frombuffer(buf[16 : 16 + 4 * size], dtype=np.float32)
+            dd = dims.get(member.name)
+            if dd and int(np.prod(dd)) == arr.size:
+                arr = arr.reshape([int(d) for d in dd])
+            yield member.name, arr
+
+
+class DetachedParameters:
+    """Topology-free parameter bag — what the reference's static
+    ``Parameters.from_tar(f)`` returns: names + float32 values with no
+    network attached.  Accepted anywhere a Parameters is (SGD, Inference,
+    infer): the consumer builds its own parameters from the topology and
+    merges these values in by name."""
+
+    def __init__(self, values: Dict[str, np.ndarray]):
+        self._values = dict(values)
+
+    @staticmethod
+    def from_tar(f) -> "DetachedParameters":
+        return DetachedParameters(dict(_read_tar_members(f)))
+
+    def names(self):
+        return list(self._values)
+
+    keys = names
+
+    def get(self, key: str) -> np.ndarray:
+        return self._values[key]
+
+    __getitem__ = get
+
+    def set(self, key: str, value: np.ndarray) -> None:
+        self._values[key] = np.asarray(value)
+
+    __setitem__ = set
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    def to_tar(self, f) -> None:
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name, arr in self._values.items():
+                arr = np.asarray(arr, np.float32)
+                payload = struct.pack("<iIQ", 0, 4, arr.size) + arr.tobytes()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+
+    def merge_into(self, parameters: Parameters) -> Parameters:
+        """Copy every name the target topology knows into `parameters`.
+        Warns when NOTHING matches — that means the tar came from a
+        different/renamed topology and the consumer would otherwise run on
+        silently random weights."""
+        known = set(parameters.names())
+        hit = [n for n in self._values if n in known]
+        if self._values and not hit:
+            import warnings
+
+            warnings.warn(
+                "parameter tar matched no parameter names of the target "
+                f"topology (tar has {sorted(self._values)[:5]}..., topology "
+                f"has {sorted(known)[:5]}...); the model keeps its random "
+                "initialization",
+                stacklevel=2,
+            )
+        for name in hit:
+            parameters.set(name, self._values[name])
+        return parameters
 
 
 def create(cost_or_topology, seed: int = 0, dtype=None) -> Parameters:

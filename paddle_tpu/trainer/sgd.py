@@ -62,6 +62,15 @@ class SGD:
             for ev in self.evaluators:
                 outputs += list(ev.layers)
             self.topology = Topology(outputs)
+        if parameters is not None and not hasattr(parameters, "network"):
+            # the reference's static Parameters.from_tar(f) returns a
+            # topology-free bag (DetachedParameters); build real params
+            # for THIS topology and merge the values in by name
+            detached = parameters
+            parameters = create_from_network(
+                CompiledNetwork(self.topology), seed
+            )
+            detached.merge_into(parameters)
         # Structural comparison (serialize covers types/sizes/attrs) — name
         # tuples alone would wrongly reuse a different network whose layers
         # happen to share auto-names.
