@@ -180,10 +180,13 @@ def mha_apply(conf, params, inputs, ctx):
         # layout assignment handles in place.  With h trapped at dim 2
         # ("bqhd,bkhd->bhqk") the backward materialized layout-change
         # copies of every [B,h,T,T]/[B,T,h,dh] grad — measured 9.1 ms of
-        # a 36 ms transformer-base step (25% in pure copies).  (A single
-        # packed [B,T,3,h,dh]->[3,B,h,T,dh] relayout of the fused QKV was
-        # tried and measured SLOWER — the 5-D transpose tiles worse than
-        # three separate [B,T,h,dh] transposes.)
+        # a 36 ms transformer-base step (25% in pure copies).  (Two
+        # alternatives measured SLOWER on v5e: a single packed
+        # [B,T,3,h,dh]->[3,B,h,T,dh] relayout of the fused QKV — the 5-D
+        # transpose tiles worse than three separate ones — and a
+        # whole-[T,T]-in-VMEM Pallas kernel with grid (B,) + in-core
+        # batched-over-heads dots, which lost ~35% to tiny per-program
+        # work at T=64.)
         qh = q.transpose(0, 2, 1, 3)
         kh = k.transpose(0, 2, 1, 3)
         vh = v.transpose(0, 2, 1, 3)

@@ -23,17 +23,13 @@ from jax import lax
 
 from paddle_tpu.ops.activations import get_activation
 
-# Step-body unroll factors.  The custom-VJP LSTM core (one GEMM per step
-# in BOTH directions, weight grads deferred to a single post-scan GEMM) is
-# latency-bound on the chained [B,H]x[H,4H] matmul and unroll=1 measures
-# fastest on v5e (LSTM text-cls B=128/T=100/H=512 fwd+bwd: unroll 1 ->
-# 5.9 ms, 4 -> 6.9 ms; a bare 200-GEMM chain microbench shows the same
-# 13.4 vs 25.5 us/link shape).  The GRU/simple-RNN scans still use naive
-# autodiff whose heavier backward bodies (per-step weight-grad GEMM +
-# accumulator) amortize best at the previously measured unroll=4 (GRU
-# B=128/T=50/H=512 fwd+bwd: unroll 1 -> 5.6 ms, 4 -> 4.1 ms).
+# Step-body unroll factor.  All three cells use custom-VJP cores (chain
+# GEMMs only inside the scans, weight grads deferred to post-scan einsums),
+# whose light bodies are latency-bound on the chained [B,H]x[H,*] matmul:
+# unroll=1 measures fastest on v5e (LSTM text-cls B=128/T=100/H=512
+# fwd+bwd: unroll 1 -> 5.9 ms, 4 -> 6.9 ms; a bare 200-GEMM chain
+# microbench shows the same 13.4 vs 25.5 us/link shape).
 _UNROLL_FUSED = 1
-_UNROLL = 4
 
 
 def _time_major(x):
@@ -121,7 +117,6 @@ def _lstm_core_fwd(acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask):
 def _lstm_core_bwd(acts, res, cts):
     a_seq, c_seq, hs, w_h, w_ci, w_cf, w_co, h0, c0, mask = res
     dhs, dh_last, dc_last = cts
-    t = a_seq.shape[0]
     # previous-step state sequences aligned with step t
     h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     c_prev_seq = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
@@ -239,38 +234,119 @@ def gru_scan(
     Returns ([B, T, H], h_last)."""
     b, t, g3 = gates.shape
     h = g3 // 3
-    f_gate = get_activation(gate_act)
-    f_act = get_activation(act)
 
     xs = _time_major(gates)
+    if bias is not None:
+        xs = xs + bias
     if reverse:
         xs = jnp.flip(xs, axis=0)
     mask = _mask_seq(lengths, t, reverse)
+    if mask is None:
+        mask = jnp.ones((t, b, 1), bool)
 
     h_prev = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
-
-    def step(h_p, inp):
-        if mask is None:
-            x_t, m = inp, None
-        else:
-            x_t, m = inp
-        if bias is not None:
-            x_t = x_t + bias
-        x_u, x_r, x_c = jnp.split(x_t, 3, axis=-1)
-        ur = h_p @ w_h
-        u_t = f_gate(x_u + ur[:, :h])
-        r_t = f_gate(x_r + ur[:, h:])
-        c_t = f_act(x_c + (r_t * h_p) @ w_c)
-        h_t = (1.0 - u_t) * h_p + u_t * c_t
-        if m is not None:
-            h_t = jnp.where(m, h_t, h_p)
-        return h_t, h_t
-
-    inputs = xs if mask is None else (xs, mask)
-    h_last, hs = lax.scan(step, h_prev, inputs, unroll=_UNROLL)
+    hs, h_last = _gru_core((gate_act, act), xs, w_h, w_c, h_prev, mask)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return jnp.swapaxes(hs, 0, 1), h_last
+
+
+def _gru_reset(acts, p_r, h_p):
+    """rh = σ(p_r) ∘ h₋ — the reference's gru_resetOutput (hl_gru_ops.cuh),
+    separated out because the candidate GEMM consumes its result."""
+    return get_activation(acts[0])(p_r) * h_p
+
+
+def _gru_final(acts, p_u, p_c, h_p, m):
+    """h = (1-u)∘h₋ + u∘c with carry-through masking (gru_finalOutput)."""
+    u = get_activation(acts[0])(p_u)
+    c = get_activation(acts[1])(p_c)
+    h_t = (1.0 - u) * h_p + u * c
+    return jnp.where(m, h_t, h_p)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gru_core(acts, xs, w_h, w_c, h0, mask):
+    """Time-major GRU recurrence with a hand-written VJP (same deferment
+    as _lstm_core: the backward scan runs only the two transposed chain
+    GEMMs per step; dW_h / dW_c become two post-scan einsums over the
+    saved sequences instead of per-step accumulator carries).
+
+    xs: [T,B,3H] input projections (+bias) in (u, r, c) slot order.
+    Returns (hs [T,B,H], h_last)."""
+    hs, _p, _q, h_last = _gru_fwd_scan(acts, xs, w_h, w_c, h0, mask)
+    return hs, h_last
+
+
+def _gru_fwd_scan(acts, xs, w_h, w_c, h0, mask):
+    h = h0.shape[-1]
+
+    def step(h_p, inp):
+        x_t, m = inp
+        ur = h_p @ w_h
+        p_ur = x_t[:, : 2 * h] + ur
+        rh = _gru_reset(acts, p_ur[:, h:], h_p)
+        p_c = x_t[:, 2 * h :] + rh @ w_c
+        h_t = _gru_final(acts, p_ur[:, :h], p_c, h_p, m)
+        return h_t, (h_t, p_ur, p_c)
+
+    h_last, (hs, p_ur_seq, p_c_seq) = lax.scan(
+        step, h0, (xs, mask), unroll=_UNROLL_FUSED
+    )
+    return hs, p_ur_seq, p_c_seq, h_last
+
+
+def _gru_core_fwd(acts, xs, w_h, w_c, h0, mask):
+    hs, p_ur_seq, p_c_seq, h_last = _gru_fwd_scan(acts, xs, w_h, w_c, h0, mask)
+    return (hs, h_last), (p_ur_seq, p_c_seq, hs, w_h, w_c, h0, mask)
+
+
+def _gru_core_bwd(acts, res, cts):
+    p_ur_seq, p_c_seq, hs, w_h, w_c, h0, mask = res
+    dhs, dh_last = cts
+    h = h0.shape[-1]
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    w_h_t = w_h.T
+    w_c_t = w_c.T
+
+    def step(dh, inp):
+        p_ur, p_c, h_p, m, dh_out = inp
+        dh = dh + dh_out
+        _, vjp_final = jax.vjp(
+            lambda pu, pc, hp: _gru_final(acts, pu, pc, hp, m),
+            p_ur[:, :h], p_c, h_p,
+        )
+        dp_u, dp_c, dh_p = vjp_final(dh)
+        drh = dp_c @ w_c_t
+        rh, vjp_reset = jax.vjp(
+            lambda pr, hp: _gru_reset(acts, pr, hp), p_ur[:, h:], h_p
+        )
+        dp_r, dh_p_r = vjp_reset(drh)
+        dp_ur = jnp.concatenate([dp_u, dp_r], axis=-1)
+        dh_p = dh_p + dh_p_r + dp_ur @ w_h_t
+        return dh_p, (dp_ur, dp_c, rh)
+
+    dh0, (dp_ur_seq, dp_c_seq, rh_seq) = lax.scan(
+        step,
+        dh_last,
+        (p_ur_seq, p_c_seq, h_prev_seq, mask, dhs),
+        reverse=True,
+        unroll=_UNROLL_FUSED,
+    )
+    dxs = jnp.concatenate([dp_ur_seq, dp_c_seq], axis=-1)
+    dw_h = jnp.einsum(
+        "tbh,tbg->hg", h_prev_seq, dp_ur_seq,
+        preferred_element_type=jnp.float32,
+    ).astype(w_h.dtype)
+    dw_c = jnp.einsum(
+        "tbh,tbg->hg", rh_seq, dp_c_seq,
+        preferred_element_type=jnp.float32,
+    ).astype(w_c.dtype)
+    d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return (dxs, dw_h, dw_c, dh0, d_mask)
+
+
+_gru_core.defvjp(_gru_core_fwd, _gru_core_bwd)
 
 
 def simple_rnn_scan(
@@ -285,28 +361,75 @@ def simple_rnn_scan(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Plain recurrence h_t = act(x_t + h₋ W) (RecurrentLayer.cpp)."""
     b, t, h = x.shape
-    f_act = get_activation(act)
     xs = _time_major(x)
+    if bias is not None:
+        xs = xs + bias
     if reverse:
         xs = jnp.flip(xs, axis=0)
     mask = _mask_seq(lengths, t, reverse)
+    if mask is None:
+        mask = jnp.ones((t, b, 1), bool)
     h_prev = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
-
-    def step(h_p, inp):
-        if mask is None:
-            x_t, m = inp, None
-        else:
-            x_t, m = inp
-        a = x_t + h_p @ w_h
-        if bias is not None:
-            a = a + bias
-        h_t = f_act(a)
-        if m is not None:
-            h_t = jnp.where(m, h_t, h_p)
-        return h_t, h_t
-
-    inputs = xs if mask is None else (xs, mask)
-    h_last, hs = lax.scan(step, h_prev, inputs, unroll=_UNROLL)
+    hs, h_last = _rnn_core((act,), xs, w_h, h_prev, mask)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return jnp.swapaxes(hs, 0, 1), h_last
+
+
+def _rnn_act(acts, a, h_p, m):
+    return jnp.where(m, get_activation(acts[0])(a), h_p)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _rnn_core(acts, xs, w_h, h0, mask):
+    """Plain recurrence with the same deferred-weight-grad VJP as
+    _lstm_core / _gru_core."""
+    hs, _a, h_last = _rnn_fwd_scan(acts, xs, w_h, h0, mask)
+    return hs, h_last
+
+
+def _rnn_fwd_scan(acts, xs, w_h, h0, mask):
+    def step(h_p, inp):
+        x_t, m = inp
+        a = x_t + h_p @ w_h
+        h_t = _rnn_act(acts, a, h_p, m)
+        return h_t, (h_t, a)
+
+    h_last, (hs, a_seq) = lax.scan(step, h0, (xs, mask), unroll=_UNROLL_FUSED)
+    return hs, a_seq, h_last
+
+
+def _rnn_core_fwd(acts, xs, w_h, h0, mask):
+    hs, a_seq, h_last = _rnn_fwd_scan(acts, xs, w_h, h0, mask)
+    return (hs, h_last), (a_seq, hs, w_h, h0, mask)
+
+
+def _rnn_core_bwd(acts, res, cts):
+    a_seq, hs, w_h, h0, mask = res
+    dhs, dh_last = cts
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    w_h_t = w_h.T
+
+    def step(dh, inp):
+        a_t, h_p, m, dh_out = inp
+        dh = dh + dh_out
+        _, vjp_fn = jax.vjp(lambda a, hp: _rnn_act(acts, a, hp, m), a_t, h_p)
+        da, dh_p_elem = vjp_fn(dh)
+        return da @ w_h_t + dh_p_elem, da
+
+    dh0, da_seq = lax.scan(
+        step,
+        dh_last,
+        (a_seq, h_prev_seq, mask, dhs),
+        reverse=True,
+        unroll=_UNROLL_FUSED,
+    )
+    dw_h = jnp.einsum(
+        "tbh,tbg->hg", h_prev_seq, da_seq,
+        preferred_element_type=jnp.float32,
+    ).astype(w_h.dtype)
+    d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return (da_seq, dw_h, dh0, d_mask)
+
+
+_rnn_core.defvjp(_rnn_core_fwd, _rnn_core_bwd)

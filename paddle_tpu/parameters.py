@@ -110,17 +110,7 @@ class Parameters:
         restore shapes, and the reference itself can parse the file."""
         with tarfile.open(fileobj=f, mode="w") as tar:
             for name in self.names():
-                arr = self.get(name).astype(np.float32)
-                payload = (
-                    struct.pack("<iIQ", 0, 4, arr.size) + arr.tobytes()
-                )
-                info = tarfile.TarInfo(name=name)
-                info.size = len(payload)
-                tar.addfile(info, io.BytesIO(payload))
-                conf = _encode_param_conf(name, arr.shape)
-                cinfo = tarfile.TarInfo(name=f"{name}.protobuf")
-                cinfo.size = len(conf)
-                tar.addfile(cinfo, io.BytesIO(conf))
+                _write_tar_member(tar, name, self.get(name))
 
     def init_from_tar(self, f) -> None:
         """Merge a parameter tar into THIS instance, ignoring names the
@@ -152,6 +142,20 @@ class Parameters:
         return p
 
 
+def _write_tar_member(tar, name: str, arr: np.ndarray) -> None:
+    """One parameter as the reference pair of members: v1-binary data +
+    ParameterConfig shape record."""
+    arr = np.asarray(arr, np.float32)
+    payload = struct.pack("<iIQ", 0, 4, arr.size) + arr.tobytes()
+    info = tarfile.TarInfo(name=name)
+    info.size = len(payload)
+    tar.addfile(info, io.BytesIO(payload))
+    conf = _encode_param_conf(name, arr.shape)
+    cinfo = tarfile.TarInfo(name=f"{name}.protobuf")
+    cinfo.size = len(conf)
+    tar.addfile(cinfo, io.BytesIO(conf))
+
+
 def _varint(n: int) -> bytes:
     out = bytearray()
     while True:
@@ -176,7 +180,7 @@ def _encode_param_conf(name: str, shape) -> bytes:
     return out
 
 
-def _parse_param_conf(buf: bytes):
+def _parse_param_conf(buf: bytes, member: str = "?"):
     """Parse the fields we wrote (skipping any others a reference-written
     tar may carry).  Returns (name, dims)."""
     name, dims = None, []
@@ -185,6 +189,11 @@ def _parse_param_conf(buf: bytes):
     def read_varint(i):
         v, shift = 0, 0
         while True:
+            if i >= n:
+                raise ValueError(
+                    f"corrupt ParameterConfig member {member!r}: varint "
+                    f"runs past the end of the {n}-byte record"
+                )
             b = buf[i]
             v |= (b & 0x7F) << shift
             i += 1
@@ -222,7 +231,9 @@ def _read_tar_members(f):
         dims = {}
         for member in members:
             if member.name.endswith(".protobuf"):
-                nm, dd = _parse_param_conf(tar.extractfile(member).read())
+                nm, dd = _parse_param_conf(
+                    tar.extractfile(member).read(), member.name
+                )
                 dims[nm if nm else member.name[: -len(".protobuf")]] = dd
         for member in members:
             if member.name.endswith(".protobuf"):
@@ -278,11 +289,7 @@ class DetachedParameters:
     def to_tar(self, f) -> None:
         with tarfile.open(fileobj=f, mode="w") as tar:
             for name, arr in self._values.items():
-                arr = np.asarray(arr, np.float32)
-                payload = struct.pack("<iIQ", 0, 4, arr.size) + arr.tobytes()
-                info = tarfile.TarInfo(name=name)
-                info.size = len(payload)
-                tar.addfile(info, io.BytesIO(payload))
+                _write_tar_member(tar, name, arr)
 
     def merge_into(self, parameters: Parameters) -> Parameters:
         """Copy every name the target topology knows into `parameters`.
@@ -299,6 +306,16 @@ class DetachedParameters:
                 f"topology (tar has {sorted(self._values)[:5]}..., topology "
                 f"has {sorted(known)[:5]}...); the model keeps its random "
                 "initialization",
+                stacklevel=2,
+            )
+        elif (uncovered := sorted(known - set(self._values))):
+            import warnings
+
+            warnings.warn(
+                f"parameter tar covers {len(hit)} of {len(known)} topology "
+                f"parameters; {uncovered[:8]} keep their random "
+                "initialization (use init_from_tar directly for intentional "
+                "partial loads)",
                 stacklevel=2,
             )
         for name in hit:

@@ -116,3 +116,36 @@ def test_reference_tar_without_protobuf_members_still_loads():
     buf.seek(0)
     bag = paddle.parameters.Parameters.from_tar(buf)
     np.testing.assert_allclose(bag.get("w"), arr)
+
+
+def test_detached_bag_tar_roundtrip_keeps_shapes():
+    cost, _ = _small_net()
+    params = paddle.parameters.create(cost)
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    bag = paddle.parameters.Parameters.from_tar(buf)
+    buf2 = io.BytesIO()
+    bag.to_tar(buf2)
+    buf2.seek(0)
+    bag2 = paddle.parameters.Parameters.from_tar(buf2)
+    for name in params.names():
+        assert bag2.get(name).shape == params.get(name).shape, name
+
+
+def test_partial_merge_warns():
+    cost, y = _small_net()
+    params = paddle.parameters.create(cost)
+    # a tar holding only ONE of the parameters
+    full = io.BytesIO()
+    params.to_tar(full)
+    full.seek(0)
+    bag = paddle.parameters.Parameters.from_tar(full)
+    one = DetachedParameters({params.names()[0]: params.get(params.names()[0])})
+    with pytest.warns(UserWarning, match="keep their random"):
+        one.merge_into(paddle.parameters.create(cost, seed=5))
+    # corrupt protobuf member fails with a named error, not IndexError
+    from paddle_tpu.parameters import _parse_param_conf
+
+    with pytest.raises(ValueError, match="corrupt ParameterConfig"):
+        _parse_param_conf(b"\x0a\xff", "h.w0.protobuf")
