@@ -609,58 +609,64 @@ def bench_transformer_xl_context() -> dict:
 
 
 def bench_lstm_textcls() -> dict:
-    """LSTM text classification (reference benchmark/paddle/rnn/rnn.py:
-    embedding 128 -> 2x simple_lstm(512) -> last_seq -> fc softmax, IMDB
-    class shapes: vocab 30k, seq 100, batch 128).  Reference K40m:
+    """Train the reference's OWN rnn benchmark config unmodified
+    (benchmark/paddle/rnn/rnn.py: embedding 128 -> lstm_num x
+    simple_lstm(hidden_size) -> last_seq -> fc softmax, via v1_compat +
+    the config's provider.py).  Data: imdb.train.pkl synthesized in the
+    provider's exact pickle schema (zero-egress stand-in for the IMDB
+    download; vocab 30k, seq 100 padded, batch 128).  Reference K40m:
     261 ms/batch (benchmark/README.md:121-127, hidden 512 / bs 128);
     vs_baseline = reference_ms / our_ms."""
+    import shutil
+    import tempfile
+
     import jax
     import jax.numpy as jnp
 
-    import paddle_tpu as paddle
-    from paddle_tpu.core.batch import SeqTensor
     from paddle_tpu.core.compiler import CompiledNetwork
-    from paddle_tpu.core.topology import Topology, reset_auto_names
-    from paddle_tpu.layers import networks
+    from paddle_tpu.v1_compat import (
+        make_optimizer,
+        make_provider_reader,
+        parse_config,
+    )
 
-    reset_auto_names()
-    L = paddle.layer
-    batch_size, seq_len, vocab, hidden = 128, 100, 30000, 512
-    ref_ms = 261.0
+    batch_size, seq_len, ref_ms = 128, 100, 261.0
+    from paddle_tpu.testing import stage_reference_rnn_benchmark
 
-    net = L.data("data", paddle.data_type.integer_value_sequence(vocab))
-    net = L.embedding(net, size=128)
-    for _ in range(2):
-        net = networks.simple_lstm(net, size=hidden)
-    net = L.last_seq(input=net)
-    net = L.fc(net, size=2, act=paddle.activation.Softmax())
-    lab = L.data("label", paddle.data_type.integer_value(2))
-    cost = L.classification_cost(input=net, label=lab)
+    d = tempfile.mkdtemp(prefix="rnn_bench_")
+    try:
+        stage_reference_rnn_benchmark(d, n=512, seq_len=seq_len)
 
-    cnet = CompiledNetwork(Topology([cost]), compute_dtype=jnp.bfloat16)
-    params, state = cnet.init(jax.random.PRNGKey(0))
-    opt = paddle.optimizer.Adam(learning_rate=2e-3)
+        cwd = os.getcwd()
+        os.chdir(d)  # rnn.py probes imdb.train.pkl relative to cwd
+        try:
+            p = parse_config(
+                os.path.join(d, "rnn.py"),
+                f"hidden_size=512,lstm_num=2,batch_size={batch_size}",
+            )
+        finally:
+            os.chdir(cwd)
+        net = CompiledNetwork(p.topology, compute_dtype=jnp.bfloat16)
+        params, state = net.init(jax.random.PRNGKey(0))
+        opt = make_optimizer(p.settings)
 
-    rng = np.random.RandomState(0)
-    lens = jnp.full((batch_size,), seq_len, jnp.int32)
-    batches = [
-        {
-            "data": SeqTensor(
-                jax.device_put(
-                    rng.randint(0, vocab, size=(batch_size, seq_len)).astype(
-                        np.int32
-                    )
-                ),
-                lens,
-            ),
-            "label": SeqTensor(
-                jax.device_put(rng.randint(0, 2, size=batch_size).astype(np.int32))
-            ),
-        }
-        for _ in range(4)
-    ]
+        from paddle_tpu.reader.feeder import DataFeeder
+
+        reader = make_provider_reader(p, d, train=True)
+        feeder = DataFeeder(p.topology.data_types())
+        it = reader()
+        rows = [next(it) for _ in range(batch_size * 4)]
+        batches = [
+            jax.tree_util.tree_map(
+                jax.device_put,
+                feeder(rows[i * batch_size : (i + 1) * batch_size]),
+            )
+            for i in range(4)
+        ]
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     ms, ms_single, flops = _measure_steps(
-        cnet, opt, params, state, opt.init(params), batches, k=8,
+        net, opt, params, state, opt.init(params), batches, k=8,
     )
     return {
         "metric": "lstm_textcls_ms_per_batch",

@@ -30,8 +30,10 @@ from paddle_tpu import pooling  # noqa: F401
 from paddle_tpu import reader  # noqa: F401
 from paddle_tpu import trainer  # noqa: F401
 from paddle_tpu.core import data_types as data_type  # noqa: F401
+from paddle_tpu.core import topology  # noqa: F401
 from paddle_tpu.core.compiler import CompiledNetwork  # noqa: F401
 from paddle_tpu.core.topology import Topology  # noqa: F401
+from paddle_tpu import master  # noqa: F401
 from paddle_tpu.minibatch import batch  # noqa: F401
 from paddle_tpu import inference  # noqa: F401
 from paddle_tpu import model  # noqa: F401

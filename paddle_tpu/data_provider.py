@@ -187,6 +187,20 @@ def provider(
                                     "input_types was not a dict"
                                 )
                             sample = tuple(sample[n] for n in eff_names)
+                        elif isinstance(sample, (map, filter, zip)):
+                            # py2-era providers yield `map(int, row), label`
+                            # style fields — under py3 those are one-shot
+                            # iterators (reference benchmark/paddle/rnn/
+                            # provider.py:72); materialize so the feeder
+                            # can len()/index them
+                            sample = tuple(sample)
+                        if isinstance(sample, tuple):
+                            sample = tuple(
+                                list(fld)
+                                if isinstance(fld, (map, filter, zip))
+                                else fld
+                                for fld in sample
+                            )
                         if check and eff_types:
                             try:
                                 _check_sample(sample, eff_types)

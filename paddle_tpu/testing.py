@@ -36,3 +36,35 @@ def ensure_cpu_mesh(argv: Optional[List[str]] = None, device_count: int = 8) -> 
     env[REEXEC_SENTINEL] = "1"
     cmd = [sys.executable] + (argv if argv is not None else sys.argv)
     os.execve(sys.executable, cmd, env)
+
+
+def stage_reference_rnn_benchmark(
+    dest: str, n: int = 64, seq_len: int = 100, vocab: int = 30000,
+    seed: int = 0,
+) -> None:
+    """Stage the reference's rnn benchmark (benchmark/paddle/rnn) into
+    ``dest`` with a synthesized ``imdb.train.pkl`` in the provider's exact
+    pickle schema — ``(list_of_token_lists, labels)`` consumed by
+    provider.py:process — plus a ``train.list`` of absolute paths.  Used
+    by bench.py (full size) and the v1_compat test (tiny) so the schema
+    lives in one place; zero-egress stand-in for the IMDB download that
+    imdb.create_data would otherwise attempt."""
+    import pickle
+    import shutil
+
+    import numpy as np
+
+    src = "/root/reference/benchmark/paddle/rnn"
+    for fn in ("rnn.py", "provider.py", "imdb.py"):
+        shutil.copy(os.path.join(src, fn), dest)
+    rng = np.random.RandomState(seed)
+    x = [
+        [int(t) for t in rng.randint(2, vocab, size=seq_len)]
+        for _ in range(n)
+    ]
+    y = [int(v) for v in rng.randint(0, 2, size=n)]
+    pkl = os.path.join(dest, "imdb.train.pkl")
+    with open(pkl, "wb") as f:
+        pickle.dump((x, y), f, protocol=2)
+    with open(os.path.join(dest, "train.list"), "w") as f:
+        f.write(pkl + "\n")

@@ -217,3 +217,4 @@ def test_ctc_grad():
         "lab": SeqTensor(jnp.asarray(labels), jnp.asarray(lab_len)),
     }
     check_layer_grad(cost, batch=batch)
+
