@@ -121,10 +121,14 @@ def _lstm_core_bwd(acts, res, cts):
     h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     c_prev_seq = jnp.concatenate([c0[None], c_seq[:-1]], axis=0)
     w_h_t = w_h.T
+    # peephole-grad carries accumulate across all T steps: keep them at
+    # >= f32 like the deferred weight einsums (bf16 += bf16 over 100 steps
+    # loses low bits)
+    acc_w = jnp.promote_types(w_ci.dtype, jnp.float32)
     zeros_w = (
-        jnp.zeros_like(w_ci),
-        jnp.zeros_like(w_cf),
-        jnp.zeros_like(w_co),
+        jnp.zeros(w_ci.shape, acc_w),
+        jnp.zeros(w_cf.shape, acc_w),
+        jnp.zeros(w_co.shape, acc_w),
     )
 
     def step(carry, inp):
@@ -140,7 +144,13 @@ def _lstm_core_bwd(acts, res, cts):
         da, dc_p, dh_p_elem, dwci_t, dwcf_t, dwco_t = vjp_fn((dh, dc))
         dh_p = da @ w_h_t + dh_p_elem  # the ONE backward-chain GEMM
         return (
-            (dh_p, dc_p, dwci + dwci_t, dwcf + dwcf_t, dwco + dwco_t),
+            (
+                dh_p,
+                dc_p,
+                dwci + dwci_t.astype(dwci.dtype),
+                dwcf + dwcf_t.astype(dwcf.dtype),
+                dwco + dwco_t.astype(dwco.dtype),
+            ),
             da,
         )
 
@@ -151,13 +161,24 @@ def _lstm_core_bwd(acts, res, cts):
         reverse=True,
         unroll=_UNROLL_FUSED,
     )
-    # weight grad as ONE big GEMM over the whole sequence (f32 accumulate)
+    # weight grad as ONE big GEMM over the whole sequence, accumulated at
+    # >= f32 (bf16 inputs accumulate f32; f64 tests stay f64)
+    acc = jnp.promote_types(w_h.dtype, jnp.float32)
     dw_h = jnp.einsum(
         "tbh,tbg->hg", h_prev_seq, da_seq,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=acc,
     ).astype(w_h.dtype)
     d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
-    return (da_seq, dw_h, dwci, dwcf, dwco, dh0, dc0, d_mask)
+    return (
+        da_seq,
+        dw_h,
+        dwci.astype(w_ci.dtype),
+        dwcf.astype(w_cf.dtype),
+        dwco.astype(w_co.dtype),
+        dh0,
+        dc0,
+        d_mask,
+    )
 
 
 _lstm_core.defvjp(_lstm_core_fwd, _lstm_core_bwd)
@@ -334,13 +355,14 @@ def _gru_core_bwd(acts, res, cts):
         unroll=_UNROLL_FUSED,
     )
     dxs = jnp.concatenate([dp_ur_seq, dp_c_seq], axis=-1)
+    acc = jnp.promote_types(w_h.dtype, jnp.float32)
     dw_h = jnp.einsum(
         "tbh,tbg->hg", h_prev_seq, dp_ur_seq,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=acc,
     ).astype(w_h.dtype)
     dw_c = jnp.einsum(
         "tbh,tbg->hg", rh_seq, dp_c_seq,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=acc,
     ).astype(w_c.dtype)
     d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
     return (dxs, dw_h, dw_c, dh0, d_mask)
@@ -426,7 +448,7 @@ def _rnn_core_bwd(acts, res, cts):
     )
     dw_h = jnp.einsum(
         "tbh,tbg->hg", h_prev_seq, da_seq,
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.promote_types(w_h.dtype, jnp.float32),
     ).astype(w_h.dtype)
     d_mask = np.zeros(mask.shape, dtype=jax.dtypes.float0)
     return (da_seq, dw_h, dh0, d_mask)

@@ -1,0 +1,226 @@
+"""The custom-VJP recurrence cores (ops/rnn.py _lstm_core/_gru_core/
+_rnn_core) against naive autodiff scans, in float64: the hand-written
+backwards (one chain GEMM per step, weight grads deferred to post-scan
+einsums) must reproduce plain jax.grad-through-lax.scan to summation-order
+noise.  Finite-diff checks exist in test_layer_grad; this pins the VJP
+math itself across peepholes / bias / masking / reverse / boot states.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.rnn import gru_scan, lstm_scan, simple_rnn_scan
+
+
+@pytest.fixture(autouse=True)
+def _x64():
+    """f64 for these comparisons only — restore the session default so
+    other test modules keep f32 (the flag is process-global)."""
+    old = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", old)
+
+
+def _naive_lstm(gates, w_h, bias, w_ci, w_cf, w_co, lengths, reverse, h0, c0):
+    b, t, g4 = gates.shape
+    h = g4 // 4
+    xs = jnp.swapaxes(gates, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    tt = jnp.arange(t)[:, None]
+    if lengths is None:
+        mask = jnp.ones((t, b, 1), bool)
+    elif reverse:
+        mask = (tt >= t - lengths[None, :])[..., None]
+    else:
+        mask = (tt < lengths[None, :])[..., None]
+    h_p = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
+    c_p = c0 if c0 is not None else jnp.zeros((b, h), gates.dtype)
+
+    def step(carry, inp):
+        h_p, c_p = carry
+        x, m = inp
+        a = x + h_p @ w_h
+        if bias is not None:
+            a = a + bias
+        a_i, a_f, a_g, a_o = jnp.split(a, 4, -1)
+        if w_ci is not None:
+            a_i = a_i + w_ci * c_p
+            a_f = a_f + w_cf * c_p
+        i = jax.nn.sigmoid(a_i)
+        f = jax.nn.sigmoid(a_f)
+        c = f * c_p + i * jnp.tanh(a_g)
+        o = jax.nn.sigmoid(a_o + (w_co * c if w_co is not None else 0.0))
+        hh = o * jnp.tanh(c)
+        hh = jnp.where(m, hh, h_p)
+        c = jnp.where(m, c, c_p)
+        return (hh, c), hh
+
+    (hl, cl), hs = jax.lax.scan(step, (h_p, c_p), (xs, mask))
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    return jnp.swapaxes(hs, 0, 1), (hl, cl)
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_lstm_core_matches_autodiff(peephole, reverse, ragged):
+    rng = np.random.RandomState(0)
+    b, t, h = 3, 7, 5
+    gates = jnp.asarray(rng.randn(b, t, 4 * h))
+    w_h = jnp.asarray(rng.randn(h, 4 * h) * 0.3)
+    bias = jnp.asarray(rng.randn(4 * h) * 0.1)
+    peep = (
+        tuple(jnp.asarray(rng.randn(h) * 0.2) for _ in range(3))
+        if peephole
+        else (None, None, None)
+    )
+    lengths = jnp.asarray([7, 4, 2]) if ragged else None
+    h0 = jnp.asarray(rng.randn(b, h) * 0.5)
+    c0 = jnp.asarray(rng.randn(b, h) * 0.5)
+
+    def loss(fn, gates, w_h, bias, h0, c0):
+        hs, (hl, cl) = fn(
+            gates, w_h, bias, *peep, lengths,
+            reverse=reverse, h0=h0, c0=c0,
+        ) if fn is lstm_scan else fn(
+            gates, w_h, bias, peep[0], peep[1], peep[2], lengths, reverse, h0, c0
+        )
+        return (
+            jnp.sum(hs * jnp.cos(jnp.arange(hs.size).reshape(hs.shape)))
+            + jnp.sum(hl * 1.7)
+            + jnp.sum(cl * 0.6)
+        )
+
+    args = (gates, w_h, bias, h0, c0)
+    v1, g1 = jax.value_and_grad(
+        lambda *a: loss(lstm_scan, *a), argnums=(0, 1, 2, 3, 4)
+    )(*args)
+    v2, g2 = jax.value_and_grad(
+        lambda *a: loss(_naive_lstm, *a), argnums=(0, 1, 2, 3, 4)
+    )(*args)
+    np.testing.assert_allclose(v1, v2, rtol=1e-10)
+    for a, b_, name in zip(g1, g2, ("gates", "w_h", "bias", "h0", "c0")):
+        np.testing.assert_allclose(a, b_, rtol=1e-8, atol=1e-10, err_msg=name)
+
+
+def _naive_gru(gates, w_h, w_c, bias, lengths, reverse, h0):
+    b, t, g3 = gates.shape
+    h = g3 // 3
+    xs = jnp.swapaxes(gates, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    tt = jnp.arange(t)[:, None]
+    if lengths is None:
+        mask = jnp.ones((t, b, 1), bool)
+    elif reverse:
+        mask = (tt >= t - lengths[None, :])[..., None]
+    else:
+        mask = (tt < lengths[None, :])[..., None]
+    h_p = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
+
+    def step(h_p, inp):
+        x, m = inp
+        if bias is not None:
+            x = x + bias
+        x_u, x_r, x_c = jnp.split(x, 3, -1)
+        ur = h_p @ w_h
+        u = jax.nn.sigmoid(x_u + ur[:, :h])
+        r = jax.nn.sigmoid(x_r + ur[:, h:])
+        c = jnp.tanh(x_c + (r * h_p) @ w_c)
+        hh = (1.0 - u) * h_p + u * c
+        hh = jnp.where(m, hh, h_p)
+        return hh, hh
+
+    hl, hs = jax.lax.scan(step, h_p, (xs, mask))
+    if reverse:
+        hs = jnp.flip(hs, 0)
+    return jnp.swapaxes(hs, 0, 1), hl
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+@pytest.mark.parametrize("ragged", [False, True])
+def test_gru_core_matches_autodiff(reverse, ragged):
+    rng = np.random.RandomState(1)
+    b, t, h = 3, 6, 4
+    gates = jnp.asarray(rng.randn(b, t, 3 * h))
+    w_h = jnp.asarray(rng.randn(h, 2 * h) * 0.3)
+    w_c = jnp.asarray(rng.randn(h, h) * 0.3)
+    bias = jnp.asarray(rng.randn(3 * h) * 0.1)
+    lengths = jnp.asarray([6, 3, 1]) if ragged else None
+    h0 = jnp.asarray(rng.randn(b, h) * 0.5)
+
+    def loss(fn, gates, w_h, w_c, bias, h0):
+        if fn is gru_scan:
+            hs, hl = fn(gates, w_h, w_c, bias, lengths,
+                        reverse=reverse, h0=h0)
+        else:
+            hs, hl = fn(gates, w_h, w_c, bias, lengths, reverse, h0)
+        return (
+            jnp.sum(hs * jnp.sin(jnp.arange(hs.size).reshape(hs.shape)))
+            + jnp.sum(hl * 1.3)
+        )
+
+    args = (gates, w_h, w_c, bias, h0)
+    v1, g1 = jax.value_and_grad(
+        lambda *a: loss(gru_scan, *a), argnums=(0, 1, 2, 3, 4)
+    )(*args)
+    v2, g2 = jax.value_and_grad(
+        lambda *a: loss(_naive_gru, *a), argnums=(0, 1, 2, 3, 4)
+    )(*args)
+    np.testing.assert_allclose(v1, v2, rtol=1e-10)
+    for a, b_, name in zip(g1, g2, ("gates", "w_h", "w_c", "bias", "h0")):
+        np.testing.assert_allclose(a, b_, rtol=1e-8, atol=1e-10, err_msg=name)
+
+
+@pytest.mark.parametrize("reverse", [False, True])
+def test_simple_rnn_core_matches_autodiff(reverse):
+    rng = np.random.RandomState(2)
+    b, t, h = 2, 5, 4
+    x = jnp.asarray(rng.randn(b, t, h))
+    w_h = jnp.asarray(rng.randn(h, h) * 0.4)
+    bias = jnp.asarray(rng.randn(h) * 0.1)
+    lengths = jnp.asarray([5, 3])
+    h0 = jnp.asarray(rng.randn(b, h) * 0.5)
+
+    def naive(x, w_h, bias, h0):
+        xs = jnp.swapaxes(x, 0, 1)
+        if reverse:
+            xs = jnp.flip(xs, 0)
+        tt = jnp.arange(t)[:, None]
+        if reverse:
+            mask = (tt >= t - lengths[None, :])[..., None]
+        else:
+            mask = (tt < lengths[None, :])[..., None]
+
+        def step(h_p, inp):
+            xt, m = inp
+            hh = jnp.tanh(xt + h_p @ w_h + bias)
+            hh = jnp.where(m, hh, h_p)
+            return hh, hh
+
+        hl, hs = jax.lax.scan(step, h0, (xs, mask))
+        if reverse:
+            hs = jnp.flip(hs, 0)
+        return jnp.swapaxes(hs, 0, 1), hl
+
+    def loss(fn, x, w_h, bias, h0):
+        if fn is simple_rnn_scan:
+            hs, hl = fn(x, w_h, bias, lengths, reverse=reverse, h0=h0)
+        else:
+            hs, hl = fn(x, w_h, bias, h0)
+        return jnp.sum(hs**2) + jnp.sum(hl * 0.7)
+
+    args = (x, w_h, bias, h0)
+    v1, g1 = jax.value_and_grad(
+        lambda *a: loss(simple_rnn_scan, *a), argnums=(0, 1, 2, 3)
+    )(*args)
+    v2, g2 = jax.value_and_grad(
+        lambda *a: loss(naive, *a), argnums=(0, 1, 2, 3)
+    )(*args)
+    np.testing.assert_allclose(v1, v2, rtol=1e-10)
+    for a, b_, name in zip(g1, g2, ("x", "w_h", "bias", "h0")):
+        np.testing.assert_allclose(a, b_, rtol=1e-8, atol=1e-10, err_msg=name)
