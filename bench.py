@@ -275,8 +275,10 @@ def bench_nmt() -> dict:
         "step_ms": round(ms, 2),
         "steps_per_dispatch": 8,
         "single_dispatch_ms": round(ms_single, 2),
-        "binds": "GRU scan recurrence (sequential per-step GEMMs) + "
-        "per-step attention; see lstm_textcls for the latency analysis",
+        "binds": "decoder recurrent_group scan (per-step attention + GRU "
+        "chain GEMMs); the vocab head + softmax-CE are epilogue-HOISTED "
+        "out of the scan (layers/recurrent_group.py _split_epilogue) into "
+        "one [B*T,512]x[512,30k] GEMM with fused log-softmax CE",
         **_mfu_fields(flops, ms / 1e3),
     }
 

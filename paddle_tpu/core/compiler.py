@@ -519,9 +519,18 @@ class CompiledNetwork:
         state: Optional[NetState] = None,
         train: bool = True,
         rng: Optional[jax.Array] = None,
+        only: Optional[set] = None,
+        preset: Optional[Dict[str, SeqTensor]] = None,
     ) -> Tuple[Dict[str, SeqTensor], NetState]:
         """Run the whole graph; returns every layer's output by name plus the
-        functionally-updated state."""
+        functionally-updated state.
+
+        `only` restricts execution to the named layers (everything else is
+        skipped — its output must then come from `preset` if a survivor
+        needs it); `preset` seeds layer outputs directly.  Both exist for
+        recurrent_group's epilogue hoisting: the scan body executes the
+        loop partition, the stacked epilogue partition runs once outside
+        with the loop's outputs preset."""
         mixed = self.compute_dtype != jnp.dtype(jnp.float32)
         # Mixed precision: master params and the raw batch stay f32; each
         # non-full_precision layer casts its own params/inputs to the compute
@@ -529,7 +538,13 @@ class CompiledNetwork:
         # regression targets / soft labels before the full_precision cost
         # layers ever see them.
         ctx = self.make_context(train=train, rng=rng, state=state)
+        if preset:
+            ctx.outputs.update(preset)
         for name in self.topology.order:
+            if preset and name in preset:
+                continue
+            if only is not None and name not in only:
+                continue
             conf = self.topology.layers[name]
             impl = self._impls[name]
             if conf.type in ("data", "step_input", "memory"):

@@ -472,6 +472,63 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     step_rng = ctx.layer_rng(conf.name)
     t_iota = jnp.arange(t_max, dtype=jnp.uint32)
 
+    # Epilogue hoisting: the maximal rowwise SUFFIX of the step graph that
+    # no memory depends on runs ONCE on the stacked [T*B] sequence instead
+    # of per scan step.  The canonical win is a per-step vocab projection
+    # (seq2seq dec_out: 50 latency-bound [B,512]x[512,30000] GEMMs + a
+    # [512,30000] grad accumulator carried through every backward step
+    # become one batched GEMM) — the generalization of keeping input
+    # projections outside the cell scans, and the TPU analogue of the
+    # reference evaluating output frames via SequenceToBatch re-batching.
+    # Disabled for nested inputs and sequence-valued memories, whose step
+    # outputs are not plain [B, D] rows.
+    epilogue = None
+    frontier = (out_name,)
+    if not any(sub_scanned) and not any(
+        m.attrs.get("is_seq") for m in memories
+    ):
+        static_seq = {p for (p, is_seq) in static_info if is_seq}
+        epilogue, frontier = _split_epilogue(
+            sub_topo, memories, out_name, static_seq
+        )
+    static_names = {p for (p, _s) in static_info}
+    if epilogue is not None:
+        # validate by a ONE-step abstract eval (shapes only) that every
+        # frontier value really is a plain [B, D] row — a loop layer can
+        # emit a sequence (expand over a static, sub-seq transforms) whose
+        # stacked form must not be time-flattened
+        probe = dict(static_batch)
+        for pname, x in zip(scan_names, xs):
+            probe[pname] = jax.tree_util.tree_map(lambda v: v[0], x)
+        for m in memories:
+            # mirror the real carries (dtype matters: id memories are int)
+            probe[m.name] = SeqTensor(init_carry[m.name])
+        outs_shape = jax.eval_shape(
+            lambda p, pb: subnet.apply(
+                p, pb, state=sub_state0, train=ctx.train, rng=None,
+                only=set(sub_topo.order) - epilogue,
+            )[0],
+            params,
+            probe,
+        )
+        for n in frontier:
+            if n in static_names:
+                continue  # preset straight from static_batch below
+            st = outs_shape[n]
+            if (
+                st.lengths is not None
+                or st.sub_lengths is not None
+                or st.data.ndim != 2
+            ):
+                epilogue, frontier = None, (out_name,)
+                break
+    loop_only = None if epilogue is None else set(sub_topo.order) - epilogue
+    # static frontier inputs are step-invariant: preset them by tiling the
+    # outer value instead of having the scan stack T identical copies
+    frontier_scan = tuple(
+        n for n in frontier if epilogue is None or n not in static_names
+    )
+
     def body(carry_all, scan_in):
         carry, sub_state = carry_all
         xt = scan_in[:-2]
@@ -488,7 +545,8 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         # fold the timestep in so dropout/sampling decorrelate across steps
         rng_t = None if step_rng is None else jax.random.fold_in(step_rng, t_idx)
         outs, new_sub_state = subnet.apply(
-            params, sub_batch, state=sub_state, train=ctx.train, rng=rng_t
+            params, sub_batch, state=sub_state, train=ctx.train, rng=rng_t,
+            only=loop_only,
         )
         new_carry = {}
         for m in memories:
@@ -513,10 +571,12 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
                 )
         # Return the whole SeqTensor so a seq-valued step output stacks its
         # per-step lengths too (the nested-output case).
-        return (new_carry, new_sub_state), outs[out_name]
+        return (new_carry, new_sub_state), tuple(
+            outs[n] for n in frontier_scan
+        )
 
     # Memory/step placeholders ride the compiler's data path per step.
-    (_, sub_state_out), ys = jax.lax.scan(
+    (_, sub_state_out), ys_stacked = jax.lax.scan(
         body,
         (init_carry, sub_state0),
         tuple(xs) + (mask_seq, t_iota),
@@ -524,6 +584,37 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     )
     if sub_state0:
         ctx.new_state[conf.name] = sub_state_out
+
+    group_logits = None
+    if epilogue is not None:
+        # run the hoisted suffix once over the whole stacked sequence,
+        # time flattened into the batch (rowwise layers only, so [T*B]
+        # rows are independent)
+        preset = {}
+        for n, st in zip(frontier_scan, ys_stacked):
+            d = st.data  # [T, B, ...]
+            preset[n] = SeqTensor(d.reshape((t_max * b,) + d.shape[2:]))
+        for n in frontier:
+            if n not in preset:  # step-invariant static: tile, don't stack
+                d = static_batch[n].data
+                preset[n] = SeqTensor(
+                    jnp.tile(d, (t_max,) + (1,) * (d.ndim - 1))
+                )
+        epi_outs, _ = subnet.apply(
+            params, {}, state=sub_state0, train=ctx.train, rng=None,
+            only=epilogue, preset=preset,
+        )
+        eo = epi_outs[out_name]
+        ys = SeqTensor(
+            eo.data.reshape((t_max, b) + eo.data.shape[1:])
+        )
+        lg = epi_outs.get(out_name + "@logits")
+        if lg is not None:
+            group_logits = lg.data.reshape(
+                (t_max, b) + lg.data.shape[1:]
+            )
+    else:
+        ys = ys_stacked[0]
     if ys.lengths is not None:
         # step emitted sequences -> nested [B, S, T, ...] output
         data, sub_len = ys.data, ys.lengths
@@ -538,7 +629,94 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         ys = jnp.flip(ys, axis=0)
     ys = jnp.swapaxes(ys, 0, 1)  # [B, T, D]
     ys = ys * mask_like(ys, lengths)
+    if group_logits is not None:
+        # expose the hoisted softmax's pre-activation at the GROUP level so
+        # a downstream cross_entropy fuses into log-softmax CE and the
+        # [B, T, vocab] probabilities dead-code-eliminate entirely
+        if reverse:
+            group_logits = jnp.flip(group_logits, axis=0)
+        ctx.outputs[conf.name + "@logits"] = SeqTensor(
+            jnp.swapaxes(group_logits, 0, 1), lengths
+        )
     return SeqTensor(ys, lengths)
+
+
+_EPILOGUE_ROWWISE = frozenset({"fc", "addto", "slope_intercept"})
+
+
+def _split_epilogue(sub_topo, memories, out_name, static_seq):
+    """Partition the step graph for epilogue hoisting.
+
+    Returns (epilogue_names, frontier_names): `epilogue` is the maximal
+    suffix reaching `out_name` whose layers are rowwise (independent per
+    [B] row, so time can fold into batch), stateless, dropout-free, and
+    not ancestors of any memory link; `frontier` is every non-epilogue
+    name the epilogue reads (loop layers, memory/step placeholders) —
+    the scan body emits exactly these.  (None, (out_name,)) when nothing
+    hoists."""
+    from paddle_tpu.layers.base import get_layer_impl
+
+    layers = sub_topo.layers
+    # names may be SIDE outputs ("unit@cell" from lstm_step) — resolve to
+    # the producing layer for graph walks; the raw name stays the frontier
+    # key (the body's outs dict carries side outputs under the raw name)
+    base = lambda n: n.split("@")[0]
+    loop_needed = set()
+    stack = [base(m.attrs["link"]) for m in memories]
+    while stack:
+        n = stack.pop()
+        if n in loop_needed:
+            continue
+        loop_needed.add(n)
+        if n in layers:  # memory placeholders live outside the sub topology
+            stack.extend(base(i) for i in layers[n].inputs)
+
+    consumers: Dict[str, set] = {}
+    for n in sub_topo.order:
+        for i in layers[n].inputs:
+            consumers.setdefault(base(i), set()).add(n)
+
+    epilogue = set()
+    for name in reversed(sub_topo.order):
+        cons = consumers.get(name, set())
+        wanted = name == out_name or bool(cons)
+        if not wanted or name in loop_needed:
+            continue
+        if not all(c in epilogue for c in cons):
+            # SOME consumer stays in the loop (or is off the out cone), so
+            # this output must be computed there; hoisting it too would
+            # leave the loop-resident consumer reading a value the scan
+            # body never produced (diamond graphs)
+            continue
+        c = layers[name]
+        if c.type in ("data", "step_input", "memory"):
+            continue  # placeholder: becomes frontier
+        impl = get_layer_impl(c.type)
+        if (
+            c.type not in _EPILOGUE_ROWWISE
+            or c.drop_rate > 0.0
+            or impl.init_state is not None
+            or c.act == "sequence_softmax"
+            or c.attr("error_clip", 0.0)
+        ):
+            # ineligible: stays in the loop; consumers already in the
+            # epilogue read it from the frontier
+            loop_needed.add(name)
+            continue
+        epilogue.add(name)
+    if out_name not in epilogue:
+        return None, (out_name,)
+    order_ix = {n: i for i, n in enumerate(sub_topo.order)}
+    frontier = []
+    for e in sorted(epilogue, key=order_ix.__getitem__):
+        for i in layers[e].inputs:
+            if base(i) not in epilogue and i not in frontier:
+                if i in static_seq:
+                    # a sequence-valued static feeding the suffix: its
+                    # per-step value is not a plain [B, D] row — bail
+                    return None, (out_name,)
+                frontier.append(i)
+    return epilogue, tuple(frontier)
 
 
 def _seq_memory_widths(

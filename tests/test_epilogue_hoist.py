@@ -1,0 +1,202 @@
+"""recurrent_group epilogue hoisting (layers/recurrent_group.py
+_split_epilogue): the rowwise suffix of a step graph runs once on the
+stacked sequence instead of per scan step.  These tests pin (a) the
+partition itself, (b) exact numerics vs the unhoisted path, and (c) the
+group-level @logits exposure that lets cross_entropy fuse."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.compiler import CompiledNetwork
+from paddle_tpu.core.topology import Topology, reset_auto_names
+import importlib
+
+rg = importlib.import_module("paddle_tpu.layers.recurrent_group")
+
+L = paddle.layer
+A = paddle.activation
+
+
+def _group_cost(vocab=37):
+    """A decoder-shaped group: GRU-ish recurrence + per-step vocab fc."""
+    paddle.init(seed=5)
+    x = L.data("x", paddle.data_type.integer_value_sequence(vocab))
+    emb = L.embedding(x, size=12)
+
+    def step(e_t):
+        state = L.memory("st", 10)
+        h = L.fc([e_t, state], size=10, act=A.Tanh(), name="st")
+        return L.fc(h, size=vocab, act=A.Softmax(), name="head")
+
+    dec = L.recurrent_group(step, input=[emb], name="dec_group")
+    lab = L.data("y", paddle.data_type.integer_value_sequence(vocab))
+    return L.classification_cost(input=dec, label=lab)
+
+
+def _batch(vocab=37, b=3, t=6):
+    rng = np.random.RandomState(0)
+    lens = jnp.asarray([6, 4, 2], jnp.int32)
+    return {
+        "x": SeqTensor(
+            jnp.asarray(rng.randint(0, vocab, size=(b, t)), jnp.int32), lens
+        ),
+        "y": SeqTensor(
+            jnp.asarray(rng.randint(0, vocab, size=(b, t)), jnp.int32), lens
+        ),
+    }
+
+
+def test_partition_hoists_head_only():
+    reset_auto_names()
+    cost = _group_cost()
+    topo = Topology([cost])
+    gconf = next(
+        c for c in topo.layers.values() if c.type == "recurrent_group"
+    )
+    sub = gconf.attrs["_sub_topology"]
+    epi, frontier = rg._split_epilogue(
+        sub, gconf.attrs["_memories"], gconf.attrs["_output"], set()
+    )
+    assert epi == {"head"}
+    # the head reads exactly the recurrent state from the loop
+    assert frontier == ("st",)
+
+
+def test_hoisted_numerics_match_unhoisted(monkeypatch):
+    reset_auto_names()
+    cost = _group_cost()
+    net = CompiledNetwork(Topology([cost]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = _batch()
+
+    def cost_and_grads():
+        def loss(p):
+            # net.cost returns (cost, aux); take the scalar
+            return net.cost(p, batch, state=state, rng=None, train=True)[0]
+
+        return jax.value_and_grad(loss)(params)
+
+    v_hoisted, g_hoisted = cost_and_grads()
+    monkeypatch.setattr(
+        rg, "_split_epilogue", lambda *a, **k: (None, (a[2],))
+    )
+    v_plain, g_plain = cost_and_grads()
+    np.testing.assert_allclose(v_hoisted, v_plain, rtol=1e-5)
+    flat_h = jax.tree_util.tree_leaves(g_hoisted)
+    flat_p = jax.tree_util.tree_leaves(g_plain)
+    for a, b in zip(flat_h, flat_p):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_group_exposes_fused_ce_logits():
+    reset_auto_names()
+    cost = _group_cost()
+    net = CompiledNetwork(Topology([cost]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    outs, _ = net.apply(params, _batch(), state=state, train=True)
+    lg = outs.get("dec_group@logits")
+    assert lg is not None, "hoisted softmax must expose group-level logits"
+    assert lg.data.shape == outs["dec_group"].data.shape
+    # logits really are the pre-softmax values of the group output
+    np.testing.assert_allclose(
+        np.asarray(jax.nn.softmax(lg.data[..., :], axis=-1))[0, 0],
+        np.asarray(outs["dec_group"].data)[0, 0],
+        atol=1e-5,
+    )
+
+
+def test_memory_dependent_head_stays_in_loop():
+    """A suffix that feeds a memory cannot hoist."""
+    reset_auto_names()
+    paddle.init(seed=6)
+    x = L.data("x", paddle.data_type.integer_value_sequence(11))
+    emb = L.embedding(x, size=8)
+
+    def step(e_t):
+        state = L.memory("looped", 11)
+        h = L.fc([e_t, state], size=8, act=A.Tanh(), name="h")
+        out = L.fc(h, size=11, act=A.Softmax(), name="looped")
+        return out
+
+    dec = L.recurrent_group(step, input=[emb], name="g2")
+    topo = Topology([dec])
+    gconf = next(
+        c for c in topo.layers.values() if c.type == "recurrent_group"
+    )
+    epi, frontier = rg._split_epilogue(
+        gconf.attrs["_sub_topology"], gconf.attrs["_memories"],
+        gconf.attrs["_output"], set(),
+    )
+    assert epi is None and frontier == (gconf.attrs["_output"],)
+
+
+def test_diamond_with_loop_resident_consumer():
+    """p feeds both a hoistable suffix AND a loop-resident (dropout)
+    layer: p must stay in the loop — a hoisted p would leave the loop
+    consumer reading a never-computed output."""
+    reset_auto_names()
+    paddle.init(seed=7)
+    x = L.data("x", paddle.data_type.integer_value_sequence(13))
+    emb = L.embedding(x, size=8)
+
+    def step(e_t):
+        state = L.memory("s", 6)
+        h = L.fc([e_t, state], size=6, act=A.Tanh(), name="s")
+        p = L.fc(h, size=6, act=A.Tanh(), name="p")
+        q = L.fc(p, size=6, act=A.Tanh(), name="q",
+                 layer_attr=paddle.attr.ExtraAttr(drop_rate=0.5))
+        return L.addto([p, q], act=A.Identity(), name="out",
+                       bias_attr=False)
+
+    dec = L.recurrent_group(step, input=[emb], name="g3")
+    net = CompiledNetwork(Topology([dec]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": SeqTensor(
+            jnp.asarray(rng.randint(0, 13, size=(2, 4)), jnp.int32),
+            jnp.asarray([4, 2], jnp.int32),
+        )
+    }
+    outs, _ = net.apply(
+        params, batch, state=state, train=True, rng=jax.random.PRNGKey(1)
+    )
+    assert outs["g3"].data.shape == (2, 4, 6)
+
+
+def test_seq_valued_frontier_disables_hoisting():
+    """A loop layer emitting a per-step SEQUENCE (expand over a static
+    seq) cannot be time-flattened: the abstract probe must disable
+    hoisting and the nested output must match the unhoisted semantics."""
+    reset_auto_names()
+    paddle.init(seed=8)
+    x = L.data("x", paddle.data_type.integer_value_sequence(13))
+    emb = L.embedding(x, size=8)
+    static = L.fc(emb, size=5, act=A.Tanh(), name="stat")
+
+    from paddle_tpu.layers.recurrent_group import StaticInput
+
+    def step(e_t, stat_seq):
+        state = L.memory("s2", 5)
+        h = L.fc([e_t, state], size=5, act=A.Tanh(), name="s2")
+        ex = L.expand(h, stat_seq, name="ex")
+        return L.fc(ex, size=5, act=A.Tanh(), name="head2")
+
+    dec = L.recurrent_group(
+        step, input=[emb, StaticInput(static, is_seq=True)], name="g4"
+    )
+    net = CompiledNetwork(Topology([dec]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": SeqTensor(
+            jnp.asarray(rng.randint(0, 13, size=(2, 4)), jnp.int32),
+            jnp.asarray([4, 3], jnp.int32),
+        )
+    }
+    outs, _ = net.apply(params, batch, state=state, train=True)
+    # nested [B, S, T, D] output, exactly as without hoisting
+    assert outs["g4"].data.ndim == 4
