@@ -482,11 +482,13 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     # reference evaluating output frames via SequenceToBatch re-batching.
     # Disabled for nested inputs and sequence-valued memories, whose step
     # outputs are not plain [B, D] rows.
+    # both hoists assume plain [B, D] per-step rows and non-seq carries
+    rows_hoistable = not any(sub_scanned) and not any(
+        m.attrs.get("is_seq") for m in memories
+    )
     epilogue = None
     frontier = (out_name,)
-    if not any(sub_scanned) and not any(
-        m.attrs.get("is_seq") for m in memories
-    ):
+    if rows_hoistable:
         static_seq = {p for (p, is_seq) in static_info if is_seq}
         epilogue, frontier = _split_epilogue(
             sub_topo, memories, out_name, static_seq
@@ -511,9 +513,10 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             params,
             probe,
         )
+        scan_name_set = set(scan_names)
         for n in frontier:
-            if n in static_names:
-                continue  # preset straight from static_batch below
+            if n in static_names or n in scan_name_set:
+                continue  # preset straight from the outer values below
             st = outs_shape[n]
             if (
                 st.lengths is not None
@@ -522,16 +525,67 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             ):
                 epilogue, frontier = None, (out_name,)
                 break
-    loop_only = None if epilogue is None else set(sub_topo.order) - epilogue
-    # static frontier inputs are step-invariant: preset them by tiling the
-    # outer value instead of having the scan stack T identical copies
+    # Prologue hoisting (the prefix complement): rowwise layers fed only by
+    # scanned/static placeholders — in-step input projections like
+    # gru_unit/lstmemory_group's mixed 3H/4H GEMMs — compute once on the
+    # time-flattened inputs; the body reads their per-step slices.
+    _pro_producer = _producer_resolver(sub_topo.layers)
+    prologue = set()
+    if rows_hoistable:
+        prologue = _split_prologue(
+            sub_topo, scan_names, static_info, epilogue or set()
+        )
+    pro_outs = {}
+    pro_sliced = ()
+    if prologue:
+        pre_preset = {}
+        for pname, x in zip(scan_names, xs):
+            d = x.data  # [T, B, ...] (already flipped for reverse groups)
+            pre_preset[pname] = SeqTensor(
+                d.reshape((t_max * b,) + d.shape[2:])
+            )
+        for (pname, is_seq) in static_info:
+            if not is_seq:
+                d = static_batch[pname].data
+                pre_preset[pname] = SeqTensor(
+                    jnp.tile(d, (t_max,) + (1,) * (d.ndim - 1))
+                )
+        pro_outs, _ = subnet.apply(
+            params, {}, state=sub_state0, train=ctx.train, rng=None,
+            only=prologue, preset=pre_preset,
+        )
+        # every computed output (incl. "@side" keys) whose base layer was
+        # hoisted becomes a per-step scan input for the body
+        pro_sliced = tuple(
+            n for n in pro_outs if _pro_producer(n) in prologue
+        )
+
+    body_only = set(sub_topo.order) - (epilogue or set()) - prologue
+    loop_only = (
+        None if epilogue is None and not prologue else body_only
+    )
+    # static frontier inputs are step-invariant (tiled into the epilogue
+    # preset directly); prologue-produced frontier values are already
+    # available time-flattened — the scan emits neither
     frontier_scan = tuple(
-        n for n in frontier if epilogue is None or n not in static_names
+        n for n in frontier
+        if epilogue is None
+        or (
+            n not in static_names
+            and n not in scan_names
+            and _pro_producer(n) not in prologue
+        )
+    )
+    pro_stacked = tuple(
+        pro_outs[n].data.reshape((t_max, b) + pro_outs[n].data.shape[1:])
+        for n in pro_sliced
     )
 
     def body(carry_all, scan_in):
         carry, sub_state = carry_all
-        xt = scan_in[:-2]
+        n_x = len(xs)
+        xt = scan_in[:n_x]
+        pro_t = scan_in[n_x:-2]
         m_t = scan_in[-2]
         t_idx = scan_in[-1]
         sub_batch = dict(static_batch)
@@ -547,6 +601,9 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         outs, new_sub_state = subnet.apply(
             params, sub_batch, state=sub_state, train=ctx.train, rng=rng_t,
             only=loop_only,
+            preset={
+                n: SeqTensor(p) for n, p in zip(pro_sliced, pro_t)
+            } or None,
         )
         new_carry = {}
         for m in memories:
@@ -579,7 +636,7 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     (_, sub_state_out), ys_stacked = jax.lax.scan(
         body,
         (init_carry, sub_state0),
-        tuple(xs) + (mask_seq, t_iota),
+        tuple(xs) + pro_stacked + (mask_seq, t_iota),
         unroll=_GROUP_UNROLL,
     )
     if sub_state0:
@@ -595,7 +652,17 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             d = st.data  # [T, B, ...]
             preset[n] = SeqTensor(d.reshape((t_max * b,) + d.shape[2:]))
         for n in frontier:
-            if n not in preset:  # step-invariant static: tile, don't stack
+            if n in preset:
+                continue
+            if _pro_producer(n) in prologue:
+                preset[n] = pro_outs[n]  # already time-flattened
+            elif n in scan_names:
+                # the scan input itself: already held time-major in xs
+                d = xs[scan_names.index(n)].data
+                preset[n] = SeqTensor(
+                    d.reshape((t_max * b,) + d.shape[2:])
+                )
+            else:  # step-invariant static: tile, don't stack
                 d = static_batch[n].data
                 preset[n] = SeqTensor(
                     jnp.tile(d, (t_max,) + (1,) * (d.ndim - 1))
@@ -641,7 +708,73 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     return SeqTensor(ys, lengths)
 
 
-_EPILOGUE_ROWWISE = frozenset({"fc", "addto", "slope_intercept"})
+# Layer types whose rows are independent (time can fold into batch): every
+# mixed projection kind is per-row (full_matrix/trans_full_matrix/table/
+# identity/identity_offset/slice/scaling/dotmul — layers/mixed.py), and
+# conv/context projections enter mixed as identity terms of ordinary
+# layers, which would simply not hoist.
+_HOIST_ROWWISE = frozenset(
+    {"fc", "addto", "slope_intercept", "mixed", "embedding"}
+)
+
+
+def _producer_resolver(layers):
+    """Map an input reference to its producing layer name: raw names pass
+    through; "layer@side" side-output keys (lstm_step's "unit@cell")
+    resolve to the base layer — but ONLY when the base actually names a
+    layer, because scan/static placeholders legitimately contain '@'
+    ("group@in0") and must not be mangled."""
+
+    def producer(i):
+        if i in layers:
+            return i
+        b = i.split("@")[0]
+        return b if b in layers else i
+
+    return producer
+
+
+def _hoist_eligible(c, impl):
+    return (
+        c.type in _HOIST_ROWWISE
+        and c.drop_rate == 0.0
+        and impl.init_state is None
+        and c.act != "sequence_softmax"
+        and not c.attr("error_clip", 0.0)
+    )
+
+
+def _split_prologue(sub_topo, scan_names, static_info, epilogue):
+    """The PREFIX complement of epilogue hoisting: rowwise layers whose
+    transitive inputs are only scanned/static placeholders (never a
+    memory) compute identically at every scan step offset — the classic
+    in-step input projection (gru_unit/lstmemory_group's mixed 3H/4H
+    projections; reference SequenceToBatch feeds pre-projected frames).
+    They run ONCE on the time-flattened inputs before the scan; the body
+    receives their per-step slices as extra scan inputs.  Returns the set
+    of hoisted names (possibly empty)."""
+    from paddle_tpu.layers.base import get_layer_impl
+
+    layers = sub_topo.layers
+    producer = _producer_resolver(layers)
+    scanned = set(scan_names)
+    static_ok = {p for (p, is_seq) in static_info if not is_seq}
+    prologue = set()
+    for name in sub_topo.order:
+        c = layers[name]
+        if c.type in ("data", "step_input", "memory") or name in epilogue:
+            continue
+        if not _hoist_eligible(c, get_layer_impl(c.type)):
+            continue
+        deps = [producer(i) for i in c.inputs]
+        if not all(
+            d in scanned or d in static_ok or d in prologue for d in deps
+        ):
+            continue
+        if not any(d in scanned or d in prologue for d in deps):
+            continue  # step-invariant (static-only): nothing to batch over
+        prologue.add(name)
+    return prologue
 
 
 def _split_epilogue(sub_topo, memories, out_name, static_seq):
@@ -657,24 +790,21 @@ def _split_epilogue(sub_topo, memories, out_name, static_seq):
     from paddle_tpu.layers.base import get_layer_impl
 
     layers = sub_topo.layers
-    # names may be SIDE outputs ("unit@cell" from lstm_step) — resolve to
-    # the producing layer for graph walks; the raw name stays the frontier
-    # key (the body's outs dict carries side outputs under the raw name)
-    base = lambda n: n.split("@")[0]
+    producer = _producer_resolver(layers)
     loop_needed = set()
-    stack = [base(m.attrs["link"]) for m in memories]
+    stack = [producer(m.attrs["link"]) for m in memories]
     while stack:
         n = stack.pop()
         if n in loop_needed:
             continue
         loop_needed.add(n)
         if n in layers:  # memory placeholders live outside the sub topology
-            stack.extend(base(i) for i in layers[n].inputs)
+            stack.extend(producer(i) for i in layers[n].inputs)
 
     consumers: Dict[str, set] = {}
     for n in sub_topo.order:
         for i in layers[n].inputs:
-            consumers.setdefault(base(i), set()).add(n)
+            consumers.setdefault(producer(i), set()).add(n)
 
     epilogue = set()
     for name in reversed(sub_topo.order):
@@ -691,14 +821,7 @@ def _split_epilogue(sub_topo, memories, out_name, static_seq):
         c = layers[name]
         if c.type in ("data", "step_input", "memory"):
             continue  # placeholder: becomes frontier
-        impl = get_layer_impl(c.type)
-        if (
-            c.type not in _EPILOGUE_ROWWISE
-            or c.drop_rate > 0.0
-            or impl.init_state is not None
-            or c.act == "sequence_softmax"
-            or c.attr("error_clip", 0.0)
-        ):
+        if not _hoist_eligible(c, get_layer_impl(c.type)):
             # ineligible: stays in the loop; consumers already in the
             # epilogue read it from the frontier
             loop_needed.add(name)
@@ -710,7 +833,7 @@ def _split_epilogue(sub_topo, memories, out_name, static_seq):
     frontier = []
     for e in sorted(epilogue, key=order_ix.__getitem__):
         for i in layers[e].inputs:
-            if base(i) not in epilogue and i not in frontier:
+            if producer(i) not in epilogue and i not in frontier:
                 if i in static_seq:
                     # a sequence-valued static feeding the suffix: its
                     # per-step value is not a plain [B, D] row — bail

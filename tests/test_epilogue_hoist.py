@@ -200,3 +200,105 @@ def test_seq_valued_frontier_disables_hoisting():
     outs, _ = net.apply(params, batch, state=state, train=True)
     # nested [B, S, T, D] output, exactly as without hoisting
     assert outs["g4"].data.ndim == 4
+
+
+def test_prologue_hoists_input_projection():
+    """An in-step projection fed only by the scanned input (the
+    sequence_layer_group.conf pattern: Layer(fc) over the step input
+    before the recurrence) must land in the prologue set; the recurrent
+    fc must not."""
+    reset_auto_names()
+    paddle.init(seed=9)
+    x = L.data("x", paddle.data_type.integer_value_sequence(17))
+    emb = L.embedding(x, size=9)
+
+    def step(e_t):
+        proj = L.fc(e_t, size=6, act=A.Identity(), name="in_proj")
+        state = L.memory("rec", 6)
+        return L.fc([proj, state], size=6, act=A.Tanh(), name="rec")
+
+    g = L.recurrent_group(step, input=[emb], name="gg")
+    topo = Topology([g])
+    gconf = next(
+        c for c in topo.layers.values() if c.type == "recurrent_group"
+    )
+    sub = gconf.attrs["_sub_topology"]
+    pro = rg._split_prologue(
+        sub, gconf.attrs["_scan_placeholders"],
+        gconf.attrs["_static_placeholders"], set(),
+    )
+    assert any(sub.layers[n].name == "in_proj" for n in pro), pro
+    assert all(sub.layers[n].name != "rec" for n in pro), pro
+
+
+def test_prologue_numerics_match_unhoisted(monkeypatch):
+    reset_auto_names()
+    paddle.init(seed=10)
+    x = L.data("x", paddle.data_type.integer_value_sequence(17))
+    emb = L.embedding(x, size=12)
+    g = paddle.networks.gru_group(emb, size=4, name="gg2")
+    pool = L.last_seq(input=g)
+    out = L.fc(pool, size=3, act=A.Softmax())
+    lab = L.data("y", paddle.data_type.integer_value(3))
+    cost = L.classification_cost(input=out, label=lab)
+    net = CompiledNetwork(Topology([cost]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": SeqTensor(
+            jnp.asarray(rng.randint(0, 17, size=(3, 5)), jnp.int32),
+            jnp.asarray([5, 3, 1], jnp.int32),
+        ),
+        "y": SeqTensor(jnp.asarray(rng.randint(0, 3, size=3), jnp.int32)),
+    }
+
+    def cg():
+        def loss(p):
+            return net.cost(p, batch, state=state, rng=None, train=True)[0]
+
+        return jax.value_and_grad(loss)(params)
+
+    v_h, g_h = cg()
+    monkeypatch.setattr(rg, "_split_prologue", lambda *a, **k: set())
+    v_p, g_p = cg()
+    np.testing.assert_allclose(v_h, v_p, rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(g_h), jax.tree_util.tree_leaves(g_p)
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_epilogue_reads_step_input_directly():
+    """A readout consuming the scanned input alongside the recurrent
+    state: the placeholder is preset from the already-flattened xs (never
+    re-stacked by the scan) and numerics hold."""
+    reset_auto_names()
+    paddle.init(seed=13)
+    x = L.data("x", paddle.data_type.integer_value_sequence(19))
+    emb = L.embedding(x, size=7)
+
+    def step(e_t):
+        state = L.memory("r5", 7)
+        h = L.fc([e_t, state], size=7, act=A.Tanh(), name="r5")
+        return L.fc([h, e_t], size=5, act=A.Softmax(), name="head5")
+
+    g = L.recurrent_group(step, input=[emb], name="g5")
+    net = CompiledNetwork(Topology([g]))
+    params, state = net.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    batch = {
+        "x": SeqTensor(
+            jnp.asarray(rng.randint(0, 19, size=(2, 4)), jnp.int32),
+            jnp.asarray([4, 2], jnp.int32),
+        )
+    }
+    outs, _ = net.apply(params, batch, state=state, train=True)
+    assert outs["g5"].data.shape == (2, 4, 5)
+    # hoisting actually engaged (head5 in the epilogue)
+    gconf = net.topology.layers["g5"]
+    epi, frontier = rg._split_epilogue(
+        gconf.attrs["_sub_topology"], gconf.attrs["_memories"],
+        gconf.attrs["_output"], set(),
+    )
+    assert epi == {"head5"}
+    assert "g5@in0" in frontier
