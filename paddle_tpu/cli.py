@@ -270,7 +270,10 @@ def cmd_train(argv: List[str]) -> int:
 
 def _job_train(args, parsed, trainer, batch_size, config_dir,
                v2_event, minibatch, make_config_reader) -> int:
-    reader = make_config_reader(parsed, config_dir, train=True)
+    # batching honors the bucketing flags (use_bucketing /
+    # bucketing_token_budget): reference configs get length-bucketed
+    # token-budget feeding with zero config edits
+    from paddle_tpu.v1_compat import make_batched_reader
     test_reader = None
     has_test = (
         parsed.test_data is not None
@@ -310,7 +313,7 @@ def _job_train(args, parsed, trainer, batch_size, config_dir,
                     _echo(f"  {k} = {v}")
 
     trainer.train(
-        reader=minibatch.batch(reader, batch_size),
+        reader=make_batched_reader(parsed, config_dir, batch_size, train=True),
         num_passes=args.num_passes,
         event_handler=handler,
         feeding=parsed.feeding,
@@ -351,8 +354,13 @@ def _job_time(args, parsed, trainer, batch_size, config_dir,
     from paddle_tpu.parallel.mesh import shard_batch
     from paddle_tpu.utils.timers import global_stats, stat_timer
 
-    reader = make_config_reader(parsed, config_dir, train=True)
-    batches = minibatch.batch(reader, batch_size)()
+    from paddle_tpu.v1_compat import make_batched_reader
+
+    # honors use_bucketing: --job=time measures the bucketed feed when the
+    # flag is on (the per-bucket dispatch counters land in the StatSet table
+    # this job prints)
+    batch_reader = make_batched_reader(parsed, config_dir, batch_size, train=True)
+    batches = batch_reader()
     feeder = trainer._make_feeder(parsed.feeding)
 
     def next_batch():
@@ -361,7 +369,7 @@ def _job_time(args, parsed, trainer, batch_size, config_dir,
             try:
                 raw = next(batches)
             except StopIteration:
-                batches = minibatch.batch(reader, batch_size)()
+                batches = batch_reader()
                 raw = next(batches)
             return shard_batch(feeder(raw), trainer.mesh)
 

@@ -10,7 +10,7 @@ All layer implementations consume and produce SeqTensors.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +107,105 @@ class SeqTensor:
 
 
 Batch = Dict[str, SeqTensor]  # slot name -> value, the feeder's output
+
+
+# ---------------------------------------------------------------------------
+# Bucket-shape canonicalization — the feed→compile→scan shape-ladder contract
+# ---------------------------------------------------------------------------
+# Variable-length workloads recompile the jitted step once per distinct batch
+# shape.  The contract threaded through reader.bucketing → DataFeeder →
+# trainer.step → layers.recurrent_group is: every padded sequence extent is a
+# rung of one small geometric ladder (16·2^k), so the jit cache sees a
+# bounded shape set no matter how lengths are distributed, and the token-
+# budget batcher (reader/bucketing.py) keeps tokens/step ~constant by scaling
+# batch size inversely with the rung.
+
+DEFAULT_LADDER: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# Nested sequences' S axis (subsequence count) is typically small (2-8);
+# rounding it on the 16-based time ladder would pad the common case 4-8x.
+# A 4-based ladder bounds the shape set just as well without the blowup.
+DEFAULT_SUB_LADDER: Tuple[int, ...] = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def shape_ladder(base: int = 16, rungs: int = 9) -> Tuple[int, ...]:
+    """Geometric shape ladder base·2^k, k in [0, rungs)."""
+    return tuple(base << k for k in range(rungs))
+
+
+def ladder_len(n: int, ladder: Sequence[int] = DEFAULT_LADDER) -> int:
+    """Smallest ladder rung >= n; past the top rung, the next multiple of it
+    (so absurdly long outliers still get a canonical — if coarse — shape)."""
+    n = max(int(n), 1)
+    for r in ladder:
+        if n <= r:
+            return r
+    top = ladder[-1]
+    return ((n + top - 1) // top) * top
+
+
+def batch_shape_key(batch: Batch) -> tuple:
+    """Hashable shape signature of a feeder batch — exactly the part of the
+    jit cache key the feed controls (slot names, data shapes, dtypes).  Two
+    batches with equal keys dispatch to the same compiled executable."""
+    key = []
+    for name in sorted(batch):
+        t = batch[name]
+        data = t.data if hasattr(t, "data") else t
+        key.append((name, tuple(int(d) for d in data.shape), str(data.dtype)))
+    return tuple(key)
+
+
+def _pad_axis(data, axis: int, to: int):
+    """Zero-pad one axis of a host/device array up to `to` (no-op if equal).
+    Works on numpy and jax arrays alike (the feeder hands numpy; bench and
+    tests may hand staged device arrays)."""
+    cur = data.shape[axis]
+    if cur >= to:
+        return data
+    import numpy as np
+
+    pad = [(0, 0)] * data.ndim
+    pad[axis] = (0, to - cur)
+    mod = jnp if isinstance(data, jax.Array) else np
+    return mod.pad(data, pad)
+
+
+def canonicalize_batch(
+    batch: Batch, ladder: Sequence[int] = DEFAULT_LADDER
+) -> Batch:
+    """Round every sequence slot's padded extents up to the shape ladder.
+
+    Plain sequences pad T (axis 1) to ``ladder_len(T)``; nested sequences pad
+    both S (axis 1) and T (axis 2).  Lengths are untouched — the new
+    positions are beyond every sample's valid range, so masks, cost sums and
+    the scan early-exit (layers/recurrent_group.py) all treat them as dead
+    padding.  Zero-pad is correct for every slot kind here: the added
+    positions are whole masked-out timesteps, not intra-step nnz slots (the
+    feeder's sparse-ids sentinel concern)."""
+    out: Batch = {}
+    for name, t in batch.items():
+        if not hasattr(t, "data") or not t.is_seq:
+            out[name] = t
+            continue
+        sub_lengths = t.sub_lengths
+        if t.is_nested:
+            # S axis (outer, axis 1) rounds on the shallow sub-ladder; T
+            # (axis 2) on the time ladder
+            data = _pad_axis(
+                t.data, 1, ladder_len(t.data.shape[1], DEFAULT_SUB_LADDER)
+            )
+            data = _pad_axis(data, 2, ladder_len(data.shape[2], ladder))
+            # sub_lengths must track the padded S axis (new subsequences
+            # are empty: zero valid timesteps) or mask consumers see an
+            # internally inconsistent SeqTensor
+            sub_lengths = _pad_axis(sub_lengths, 1, data.shape[1])
+        else:
+            data = _pad_axis(t.data, 1, ladder_len(t.data.shape[1], ladder))
+        out[name] = SeqTensor(
+            data, t.lengths, sub_lengths, sparse_ids=t.sparse_ids
+        )
+    return out
 
 
 def non_seq(data) -> SeqTensor:

@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.batch import Batch, SeqTensor
+from paddle_tpu.core.batch import Batch, SeqTensor, batch_shape_key
 from paddle_tpu.core.topology import Topology
 from paddle_tpu.layers.base import ApplyContext, get_layer_impl, stable_hash
 from paddle_tpu.ops.activations import apply_activation
@@ -159,6 +159,66 @@ def _del_path(d, path):
     for parent, k in reversed(stack):
         if not parent[k]:
             del parent[k]
+
+
+class CompileShapeCache:
+    """Host-side mirror of the jit executable cache, keyed per bucket shape.
+
+    jax.jit keys its cache by abstract argument shapes; the feed layer
+    controls exactly one slice of that key — the batch's slot shapes
+    (core.batch.batch_shape_key).  Observing every batch here makes the
+    compile behaviour of a variable-length feed visible and testable:
+
+    * hit/miss counters export through the StatSet plane (``<name>/
+      compile_hit`` / ``compile_miss`` in utils.timers.global_stats — the
+      same table REGISTER_TIMER stats print in), so a feed that recompiles
+      per batch shows up in the stats instead of as mystery latency;
+    * ``n_shapes`` asserts the shape-ladder contract: with a laddered feed
+      (reader.bucketing + DataFeeder(ladder=...)), every padded extent is a
+      ladder rung, so distinct shapes across an epoch are bounded by the
+      combinations of slot rungs the data actually realizes — one per rung
+      when slot lengths correlate, and never a shape per batch, instead of
+      growing with the length distribution.  (Multiple sequence slots with
+      UNcorrelated lengths multiply rung combinations; pass the batcher a
+      ``key``/``slots`` tied to the dominant slot if that bites.)
+    """
+
+    def __init__(self, name: str = "train_step", stats=None):
+        from paddle_tpu.utils.timers import global_stats
+
+        self.name = name
+        self._stats = stats if stats is not None else global_stats
+        self.shapes: Dict[tuple, int] = {}  # shape key -> dispatch count
+
+    def observe(self, batch: Batch) -> bool:
+        """Record one dispatch; True when this shape is new (a compile)."""
+        key = batch_shape_key(batch)
+        miss = key not in self.shapes
+        self.shapes[key] = self.shapes.get(key, 0) + 1
+        self._stats.incr(
+            f"{self.name}/compile_{'miss' if miss else 'hit'}"
+        )
+        return miss
+
+    @property
+    def n_shapes(self) -> int:
+        return len(self.shapes)
+
+    @property
+    def misses(self) -> int:
+        # by construction every distinct shape missed exactly once
+        return self.n_shapes
+
+    @property
+    def hits(self) -> int:
+        return sum(self.shapes.values()) - len(self.shapes)
+
+    def summary(self) -> Dict[str, int]:
+        return {
+            "shapes": self.n_shapes,
+            "hits": self.hits,
+            "misses": self.misses,
+        }
 
 
 class CompiledNetwork:
@@ -566,11 +626,15 @@ class CompiledNetwork:
                     out = impl.apply(conf, p, ins, ctx)
             except Exception as e:
                 shapes = [getattr(t.data, "shape", None) for t in ins]
-                e.add_note(
+                note = (
                     f"while applying layer {name!r} (type={conf.type}, "
                     f"size={conf.size}, inputs={list(conf.inputs)} with "
                     f"shapes {shapes})"
                 )
+                if hasattr(e, "add_note"):  # py3.11+
+                    e.add_note(note)
+                elif e.args and isinstance(e.args[0], str):
+                    e.args = (f"{e.args[0]}\n{note}",) + e.args[1:]
                 raise
             if mixed and not impl.full_precision:
                 # Enforce the compute dtype at every layer boundary —

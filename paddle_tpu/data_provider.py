@@ -22,10 +22,21 @@ trainer's DataFeeder, with the same decorator knobs:
 
 from __future__ import annotations
 
+import collections.abc
 import functools
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
+
+
+def _is_oneshot_iter(v) -> bool:
+    """py2-era providers yield ``map(int, row)``-style fields — and just as
+    legally, generator expressions; under py3 all of these are one-shot
+    iterators the feeder can't len()/index.  Any Iterator counts (str/bytes/
+    ndarray are Iterables, not Iterators — excluded for clarity)."""
+    return isinstance(v, collections.abc.Iterator) and not isinstance(
+        v, (str, bytes, np.ndarray)
+    )
 
 from paddle_tpu.core import data_types as dt
 from paddle_tpu.reader import decorator as reader_dec
@@ -187,18 +198,15 @@ def provider(
                                     "input_types was not a dict"
                                 )
                             sample = tuple(sample[n] for n in eff_names)
-                        elif isinstance(sample, (map, filter, zip)):
-                            # py2-era providers yield `map(int, row), label`
-                            # style fields — under py3 those are one-shot
-                            # iterators (reference benchmark/paddle/rnn/
-                            # provider.py:72); materialize so the feeder
-                            # can len()/index them
+                        elif _is_oneshot_iter(sample):
+                            # a whole-sample iterator (map/filter/zip or a
+                            # generator expression, reference benchmark/
+                            # paddle/rnn/provider.py:72); materialize so the
+                            # feeder can len()/index it
                             sample = tuple(sample)
                         if isinstance(sample, tuple):
                             sample = tuple(
-                                list(fld)
-                                if isinstance(fld, (map, filter, zip))
-                                else fld
+                                list(fld) if _is_oneshot_iter(fld) else fld
                                 for fld in sample
                             )
                         if check and eff_types:

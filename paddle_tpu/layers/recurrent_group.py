@@ -546,9 +546,8 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             )
         for (pname, is_seq) in static_info:
             if not is_seq:
-                d = static_batch[pname].data
                 pre_preset[pname] = SeqTensor(
-                    jnp.tile(d, (t_max,) + (1,) * (d.ndim - 1))
+                    _tile_rows(static_batch[pname].data, t_max)
                 )
         pro_outs, _ = subnet.apply(
             params, {}, state=sub_state0, train=ctx.train, rng=None,
@@ -581,7 +580,7 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         for n in pro_sliced
     )
 
-    def body(carry_all, scan_in):
+    def body_core(carry_all, scan_in):
         carry, sub_state = carry_all
         n_x = len(xs)
         xt = scan_in[:n_x]
@@ -632,11 +631,47 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             outs[n] for n in frontier_scan
         )
 
+    # Mask-aware scan early-exit: when a batch's true max length sits below
+    # the padded ladder rung (the bucket-shape contract pads T up to 16·2^k
+    # — core.batch.canonicalize_batch / DataFeeder(ladder=...)), the
+    # trailing scan steps are pure padding for EVERY row.  Wrapping the body
+    # in lax.cond on a per-step any-row-live bit turns those dead steps into
+    # a carry pass-through: the compiled shape stays the rung's (one
+    # executable per bucket), the executed trip count shrinks to the bucket
+    # bound.  Reverse groups flip their inputs, so their dead steps sit at
+    # the START of the scan — the per-step bit covers both ends.
+    from paddle_tpu.utils.flags import get_flag
+
+    scan_xs = tuple(xs) + pro_stacked + (mask_seq, t_iota)
+    body = body_core
+    if get_flag("scan_early_exit"):
+        active_seq = jnp.any(valid, axis=1)  # [T] any row live at step t
+        # dead steps must emit the live branch's exact output structure;
+        # abstract-eval the body once (shapes only, no FLOPs) to know it
+        slice0 = jax.tree_util.tree_map(lambda v: v[0], scan_xs)
+        ys_struct = jax.eval_shape(
+            lambda c, s: body_core(c, s)[1], (init_carry, sub_state0), slice0
+        )
+
+        def body(carry_all, scan_in):
+            def live(c):
+                return body_core(c, scan_in[:-1])
+
+            def dead(c):
+                zeros = jax.tree_util.tree_map(
+                    lambda st: jnp.zeros(st.shape, st.dtype), ys_struct
+                )
+                return c, zeros
+
+            return jax.lax.cond(scan_in[-1], live, dead, carry_all)
+
+        scan_xs = scan_xs + (active_seq,)
+
     # Memory/step placeholders ride the compiler's data path per step.
     (_, sub_state_out), ys_stacked = jax.lax.scan(
         body,
         (init_carry, sub_state0),
-        tuple(xs) + pro_stacked + (mask_seq, t_iota),
+        scan_xs,
         unroll=_GROUP_UNROLL,
     )
     if sub_state0:
@@ -662,10 +697,9 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
                 preset[n] = SeqTensor(
                     d.reshape((t_max * b,) + d.shape[2:])
                 )
-            else:  # step-invariant static: tile, don't stack
-                d = static_batch[n].data
+            else:  # step-invariant static: broadcast per step, don't stack
                 preset[n] = SeqTensor(
-                    jnp.tile(d, (t_max,) + (1,) * (d.ndim - 1))
+                    _tile_rows(static_batch[n].data, t_max)
                 )
         epi_outs, _ = subnet.apply(
             params, {}, state=sub_state0, train=ctx.train, rng=None,
@@ -916,6 +950,18 @@ def _seq_memory_widths(
         f"{conf.name}: sequence-memory padded width did not reach a fixed "
         f"point (last {widths}); a step whose linked sequence grows every "
         "iteration cannot be carried through a static-shape scan"
+    )
+
+
+def _tile_rows(d: jnp.ndarray, t: int) -> jnp.ndarray:
+    """Step-invariant [B, ...] value expanded to the time-flattened
+    [t*B, ...] preset rows of the hoisted prologue/epilogue.  broadcast_to +
+    reshape instead of jnp.tile: XLA keeps the T× expansion a broadcast
+    fused into the consumer rather than a materialized copy (a wide static
+    — e.g. an encoder summary feeding the hoisted suffix — would otherwise
+    cost T× its footprint in HBM)."""
+    return jnp.broadcast_to(d[None], (t,) + d.shape).reshape(
+        (t * d.shape[0],) + d.shape[1:]
     )
 
 

@@ -218,7 +218,14 @@ def _parse_param_conf(buf: bytes, member: str = "?"):
         elif wire == 5:
             i += 4
         else:
-            break  # unknown wire type: stop rather than misparse
+            # wire types 3/4 (proto2 groups) and 6/7 don't appear in any
+            # ParameterConfig a reference build can write; a partial parse
+            # here would silently load the array flat (shapeless), so fail
+            # loudly like the varint-overrun path does
+            raise ValueError(
+                f"corrupt ParameterConfig member {member!r}: unknown proto "
+                f"wire type {wire} (field {field}) at byte {i}"
+            )
     return name, dims
 
 
@@ -260,6 +267,17 @@ class DetachedParameters:
 
     @staticmethod
     def from_tar(f) -> "DetachedParameters":
+        if isinstance(f, Parameters) or not hasattr(f, "read"):
+            # the class/instance duality of Parameters.from_tar (_FromTar):
+            # an unbound-style call Parameters.from_tar(params_obj, f) lands
+            # here with the Parameters object as `f` — catch it before
+            # tarfile produces an opaque error
+            raise TypeError(
+                "Parameters.from_tar on the CLASS is the static constructor "
+                "taking a single binary file object (got "
+                f"{type(f).__name__}); to merge a tar into an existing "
+                "Parameters call params.from_tar(f) / params.init_from_tar(f)"
+            )
         return DetachedParameters(dict(_read_tar_members(f)))
 
     def names(self):

@@ -1,3 +1,8 @@
+from paddle_tpu.reader import bucketing  # noqa: F401
+from paddle_tpu.reader.bucketing import (  # noqa: F401
+    sort_within_window,
+    token_budget_batch,
+)
 from paddle_tpu.reader.decorator import (  # noqa: F401
     buffered,
     cache,

@@ -15,7 +15,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from paddle_tpu.core.batch import SeqTensor
+from paddle_tpu.core.batch import SeqTensor, ladder_len
 from paddle_tpu.core.data_types import InputType, SeqLevel, SlotKind
 
 
@@ -61,12 +61,20 @@ class DataFeeder:
         min_seq_len: int = 8,
         dtype=np.float32,
         feed_dtypes: Optional[Dict[str, Any]] = None,
+        ladder: Optional[Sequence[int]] = None,
     ):
         """feed_dtypes: per-slot WIRE dtype override for dense slots (e.g.
         {"image": np.uint8}) — the batch crosses host->device at 1/4 the
         bytes and the jitted step casts + normalizes on device (the data
         layer's feed_scale/feed_shift attrs; reference DataProvider ships
-        bytes the same way, mnist_bin_part is uint8 on disk)."""
+        bytes the same way, mnist_bin_part is uint8 on disk).
+
+        ladder: canonical sequence-length rungs (core.batch.DEFAULT_LADDER)
+        replacing the multiple-of-``seq_multiple`` rounding — the feed half
+        of the bucket-shape contract: padded lengths come from a small
+        geometric set, so the jitted step's shape cache stays bounded over
+        any length distribution (pair with reader.bucketing batches that
+        fill a token budget per rung)."""
         self.data_types = list(data_types)
         self.feed_dtypes = dict(feed_dtypes or {})
         if feeding is None:
@@ -77,6 +85,7 @@ class DataFeeder:
             self.index = {name: i for i, name in enumerate(feeding)}
         self.seq_multiple = seq_multiple
         self.min_seq_len = min_seq_len
+        self.ladder = tuple(ladder) if ladder else None
         self.dtype = dtype
 
     # ------------------------------------------------------------------
@@ -103,6 +112,8 @@ class DataFeeder:
 
     # ------------------------------------------------------------------
     def _bucket_len(self, max_len: int) -> int:
+        if self.ladder:
+            return ladder_len(max(max_len, self.min_seq_len), self.ladder)
         return max(_round_up(max_len, self.seq_multiple), self.min_seq_len)
 
     def _convert_slot(
@@ -191,7 +202,17 @@ class DataFeeder:
         dtype = self.dtype if dtype is None else dtype
         b = len(col)
         n_sub = np.asarray([len(s) for s in col], dtype=np.int32)
-        s_max = max(_round_up(int(n_sub.max()) if b else 1, 4), 4)
+        raw_s = int(n_sub.max()) if b else 1
+        if self.ladder:
+            # the S axis is a compiled extent too: ladder it so nested
+            # batches keep the bounded-shape contract — on the shallow
+            # 4-based sub-ladder, since subsequence counts are usually
+            # small and the 16-based time ladder would pad them 4-8x
+            from paddle_tpu.core.batch import DEFAULT_SUB_LADDER
+
+            s_max = ladder_len(max(raw_s, 1), DEFAULT_SUB_LADDER)
+        else:
+            s_max = max(_round_up(raw_s, 4), 4)
         sub_lengths = np.zeros((b, s_max), dtype=np.int32)
         max_t = 1
         for i, sample in enumerate(col):

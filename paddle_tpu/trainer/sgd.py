@@ -32,6 +32,17 @@ _log = logging.getLogger("paddle_tpu.trainer")
 from paddle_tpu.utils.timers import stat_timer
 
 
+def _batch_rows(batch) -> int:
+    """Sample count of a staged batch (any slot's leading dim).  Cost and
+    metric aggregation weight by this: with the bucketed feed, batch sizes
+    vary ~32x across length rungs, and an unweighted mean-over-batches would
+    give a long-sequence sample many times the weight of a short one."""
+    for t in batch.values():
+        data = t.data if hasattr(t, "data") else t
+        return int(data.shape[0])
+    return 1
+
+
 class SGD:
     """paddle.v2.trainer.SGD(cost, parameters, update_equation, ...)"""
 
@@ -144,6 +155,17 @@ class SGD:
         self._opt_state = self.optimizer.init(self.parameters.params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
+        # Per-bucket dispatch accounting: every train/eval batch's shape
+        # signature is observed here (core.compiler.CompileShapeCache), so
+        # the StatSet plane carries compile hit/miss counters and a bounded-
+        # shape check is one property read away.  With the bucketing feed on
+        # (use_bucketing flag / DataFeeder(ladder=...)) misses stay bounded
+        # by the shape-ladder size; an unbucketed variable-length feed shows
+        # its per-shape recompiles here instead of as silent latency.
+        from paddle_tpu.core.compiler import CompileShapeCache
+
+        self.compile_cache = CompileShapeCache("train_step")
+        self._eval_cache = CompileShapeCache("eval_step")
         # dynamic-width (batch-wide trans) weights resolve exactly ONCE, at
         # the first batch this trainer ever sees; a later batch-size change
         # must fail loudly, never silently re-draw trained weights
@@ -185,10 +207,32 @@ class SGD:
         # data layers declaring a narrow wire dtype (data_layer(feed_dtype=
         # "uint8")) feed raw and cast+normalize on device (_feed_transform)
         from paddle_tpu.reader.feeder import feed_dtypes_of
+        from paddle_tpu.utils import flags as _flags
 
+        # bucketing feed: padded lengths come from the canonical shape
+        # ladder instead of multiple-of-8 rounding, completing the contract
+        # reader.bucketing packs batches for (bounded jit shapes)
+        ladder = None
+        if _flags.get_flag("use_bucketing"):
+            if self.network.has_dynamic_widths:
+                # batch-wide-trans weights pin to the FIRST batch's size and
+                # any later batch-size change is a hard XLA shape error; the
+                # token-budget batcher varies batch size per rung by design,
+                # so the combination can only explode mid-epoch — refuse now
+                raise ValueError(
+                    "use_bucketing is incompatible with dynamic (batch-wide "
+                    "trans) width layers: bucketed batch sizes vary per "
+                    "length rung, but these weights train at exactly one "
+                    "batch size.  Feed this network with paddle.batch "
+                    "(fixed size, drop_last=True) instead."
+                )
+            from paddle_tpu.core.batch import DEFAULT_LADDER
+
+            ladder = DEFAULT_LADDER
         return DataFeeder(
             self.topology.data_types(), feeding,
             feed_dtypes=feed_dtypes_of(self.topology),
+            ladder=ladder,
         )
 
     def train(
@@ -248,6 +292,7 @@ class SGD:
                     **opt_state, "pass": jnp.asarray(pass_id, jnp.int32)
                 }
             pass_costs: List[float] = []
+            pass_weights: List[int] = []
             pass_accums: Dict[str, np.ndarray] = {}
             batches = (
                 prefetch(reader(), _stage)
@@ -268,6 +313,14 @@ class SGD:
                     if chg:  # weight shapes moved: optimizer slots follow
                         opt_state = self.optimizer.init(params)
                 event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                if self.compile_cache.observe(batch) and self._step_count:
+                    # a NEW batch shape after warmup = a jit recompile; say
+                    # so at debug level (the hit/miss counters aggregate in
+                    # the StatSet table either way)
+                    _log.debug(
+                        "train batch %d brings new shape (distinct shapes "
+                        "now %d)", batch_id, self.compile_cache.n_shapes,
+                    )
                 with stat_timer("train_step"):
                     self._rng, step_rng = jax.random.split(self._rng)
                     params, state, opt_state, metrics = self._train_step(
@@ -296,6 +349,7 @@ class SGD:
                     )
                 cost = float(metrics["cost"])
                 pass_costs.append(cost)
+                pass_weights.append(_batch_rows(batch))
                 evaluator, accums = self._split_metrics(metrics)
                 for k, v in accums.items():
                     pass_accums[k] = pass_accums.get(k, 0) + v
@@ -315,8 +369,20 @@ class SGD:
             self.parameters.params, self.parameters.state = params, state
             self._opt_state = opt_state
             pass_metrics = {
-                "mean_cost": float(np.mean(pass_costs)) if pass_costs else 0.0
+                # per-SAMPLE mean: weight each batch by its row count (batch
+                # sizes vary across rungs under the bucketed feed)
+                "mean_cost": float(np.average(pass_costs, weights=pass_weights))
+                if pass_costs else 0.0
             }
+            cc = self.compile_cache
+            if cc.n_shapes > 1:
+                # per-bucket dispatch table (reference prints its StatSet
+                # per log period; shape traffic is the TPU-relevant stat)
+                _log.info(
+                    "pass %d bucket dispatch: %d distinct batch shapes, "
+                    "%d compile misses / %d hits",
+                    pass_id, cc.n_shapes, cc.misses, cc.hits,
+                )
             pass_metrics.update(self._finalize(pass_accums))
             event_handler(v2_event.EndPass(pass_id, pass_metrics))
             if save_dir and (pass_id + 1 - start_pass) % saving_period == 0:
@@ -332,9 +398,10 @@ class SGD:
 
         feeder = self._make_feeder(feeding)
         costs: List[float] = []
+        weights: List[int] = []
         sums: Dict[str, float] = {}
         accum_sums: Dict[str, np.ndarray] = {}
-        n = 0
+        n = 0.0
         stage = lambda b: shard_batch(feeder(b), self.mesh)
         batches = (
             prefetch(reader(), stage) if async_load_data
@@ -352,19 +419,26 @@ class SGD:
                 if chg:
                     self.parameters.params = p2
                     self._opt_state = self.optimizer.init(p2)
+            self._eval_cache.observe(batch)
             metrics = self._eval_step(
                 self.parameters.params, self.parameters.state, batch
             )
+            rows = _batch_rows(batch)
             costs.append(float(metrics["cost"]))
+            weights.append(rows)
             scalars, accums = self._split_metrics(metrics)
             for k, v in scalars.items():
-                sums[k] = sums.get(k, 0.0) + v
+                sums[k] = sums.get(k, 0.0) + v * rows
             for k, v in accums.items():
                 accum_sums[k] = accum_sums.get(k, 0) + v
-            n += 1
+            n += rows
+        # per-sample means (batch sizes vary under the bucketed feed)
         avg = {k: v / max(n, 1) for k, v in sums.items()}
         avg.update(self._finalize(accum_sums))
-        return v2_event.TestResult(avg, float(np.mean(costs)) if costs else 0.0)
+        return v2_event.TestResult(
+            avg,
+            float(np.average(costs, weights=weights)) if costs else 0.0,
+        )
 
     # ------------------------------------------------------------------
     def save_parameter_to_tar(self, f) -> None:

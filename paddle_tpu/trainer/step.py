@@ -18,7 +18,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.core.compiler import CompiledNetwork, NetState, Params
+from paddle_tpu.core.batch import DEFAULT_LADDER, canonicalize_batch
+from paddle_tpu.core.compiler import (
+    CompiledNetwork,
+    CompileShapeCache,
+    NetState,
+    Params,
+)
 from paddle_tpu.optimizer import Optimizer, OptState
 from paddle_tpu.parallel.mesh import DATA_AXIS
 
@@ -177,6 +183,46 @@ def make_multi_train_step(
         in_shardings=(repl, repl, repl, batch_sh, repl),
         out_shardings=(repl, repl, repl, repl),
     )
+
+
+def make_bucketed_train_step(
+    network: CompiledNetwork,
+    optimizer: Optimizer,
+    mesh: Optional[Mesh] = None,
+    extra_metrics: Optional[
+        Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
+    ] = None,
+    infer_param_shardings: bool = False,
+    prune_masks: Optional[Params] = None,
+    ladder=DEFAULT_LADDER,
+    cache: Optional[CompileShapeCache] = None,
+):
+    """A train step that enforces the bucket-shape contract at the dispatch
+    boundary: every incoming batch is canonicalized to the shape ladder
+    (core.batch.canonicalize_batch) BEFORE it reaches jax.jit, so the
+    executable cache is keyed per ladder rung — bounded recompiles however
+    lengths are distributed — and every dispatch is accounted against a
+    :class:`~paddle_tpu.core.compiler.CompileShapeCache` (hit/miss counters
+    in the StatSet plane).
+
+    Returns ``(step, cache)``; ``step`` has the make_train_step signature.
+    Feeds that already ladder their shapes (DataFeeder(ladder=...)) pay only
+    the shape check; anything else — hand-built batches, exotic readers —
+    gets padded up to the nearest rung here."""
+    inner = make_train_step(
+        network, optimizer, mesh, extra_metrics,
+        infer_param_shardings=infer_param_shardings,
+        prune_masks=prune_masks,
+    )
+    if cache is None:
+        cache = CompileShapeCache("train_step")
+
+    def step(params, state, opt_state, batch, rng):
+        batch = canonicalize_batch(batch, ladder)
+        cache.observe(batch)
+        return inner(params, state, opt_state, batch, rng)
+
+    return step, cache
 
 
 def make_eval_step(

@@ -73,6 +73,26 @@ define_flag("beam_size", 5, "default generation beam width")
 define_flag("check_nans", False, "enable jax nan-debugging (FP trap equivalent)")
 define_flag("compute_dtype", "", "bfloat16 enables mixed precision")
 define_flag("profile_dir", "", "write jax profiler traces here when set")
+define_flag("use_bucketing", False,
+            "length-bucketed feed for variable-length sequence workloads: "
+            "the trainer/CLI batch readers route through reader.bucketing."
+            "token_budget_batch (batch size scales inversely with bucket "
+            "length, tokens/step ~constant) and the DataFeeder pads to the "
+            "canonical 16*2^k shape ladder (core.batch.DEFAULT_LADDER) so "
+            "jit recompiles stay bounded by the ladder size; reference v1 "
+            "configs opt in via this flag with zero config edits")
+define_flag("bucketing_token_budget", 0,
+            "padded tokens per step for use_bucketing (0 = derive from the "
+            "config batch size x the tallest ladder rung of the first "
+            "window — the same padded token count the unbucketed feed "
+            "would have spent per step)")
+define_flag("scan_early_exit", True,
+            "recurrent_group scans skip dead steps: when every row of a "
+            "step is padding (the batch's true max length sits below the "
+            "padded ladder rung), a lax.cond passes the carry through "
+            "instead of running the step body — the compiled shape stays "
+            "the rung's, the executed trip count shrinks to the bucket "
+            "bound")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

@@ -46,6 +46,18 @@ class StatSet:
             with self._lock:
                 self._stats.setdefault(name, _Stat()).add(dt)
 
+    def incr(self, name: str, n: int = 1) -> None:
+        """Count-only stat (no wall time) — e.g. the compile-cache hit/miss
+        counters (core/compiler.py CompileShapeCache).  Shares the summary /
+        print surface with the timers: `count` is the signal, times stay 0."""
+        with self._lock:
+            self._stats.setdefault(name, _Stat()).count += n
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            s = self._stats.get(name)
+            return s.count if s else 0
+
     def reset(self) -> None:
         with self._lock:
             self._stats.clear()

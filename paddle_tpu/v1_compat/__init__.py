@@ -42,6 +42,7 @@ __all__ = [
     "make_data_reader",
     "make_provider_reader",
     "make_config_reader",
+    "make_batched_reader",
 ]
 
 
@@ -481,6 +482,32 @@ def make_config_reader(
     if dc is not None and getattr(dc, "kind", None) == "simple":
         return make_simple_data_reader(parsed, config_dir, train=train)
     return make_provider_reader(parsed, config_dir, train=train)
+
+
+def make_batched_reader(
+    parsed: ParsedConfig, config_dir: str, batch_size: int, train: bool = True
+):
+    """Sample reader → minibatch reader for a parsed v1 config, honoring the
+    bucketing flags: with ``use_bucketing`` on, variable-length samples route
+    through :func:`reader.bucketing.token_budget_batch` (token budget =
+    ``bucketing_token_budget`` flag, else derived from ``batch_size`` × the
+    first window's tallest ladder rung) so reference configs opt into
+    length-bucketed feeding WITHOUT any config edits — the trainer's
+    DataFeeder pads the emitted batches to the same shape ladder (SGD reads
+    the flag too).  Flag off: plain ``paddle.batch`` semantics."""
+    rd = make_config_reader(parsed, config_dir, train=train)
+    from paddle_tpu.utils.flags import get_flag
+
+    if not get_flag("use_bucketing"):
+        from paddle_tpu import minibatch
+
+        return minibatch.batch(rd, batch_size)
+    from paddle_tpu.reader.bucketing import token_budget_batch
+
+    budget = get_flag("bucketing_token_budget") or None
+    return token_budget_batch(
+        rd, token_budget=budget, batch_size=batch_size
+    )
 
 
 def _mark_unresolved_msg(parsed: ParsedConfig, reason: str) -> None:
