@@ -13,7 +13,7 @@ CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 .PHONY: test verify bench test-all
 
 test:
-	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow"
+	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

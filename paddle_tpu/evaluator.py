@@ -482,6 +482,80 @@ def seq_text_printer_evaluator(
     return Evaluator(nm, [input], update, lambda a: {})
 
 
+def maxframe_printer_evaluator(
+    input: LayerOutput, name: Optional[str] = None
+) -> Evaluator:
+    """Print, per sample, the FRAME (timestep) holding the maximum
+    activation and that value (reference max_frame_printer,
+    Evaluator.cpp:1061 MaxFramePrinter — prints the max-value frame of
+    each sequence).  Non-sequence inputs degenerate to the per-sample max
+    feature.  Runs host-side via io_callback so it works under jit."""
+    nm = name or auto_name("maxframe_printer")
+
+    def to_text(data, lengths):
+        import numpy as np
+
+        data = np.asarray(data)
+        lengths = None if lengths is None else np.asarray(lengths)
+        lines = []
+        for i in range(data.shape[0]):
+            row = data[i]
+            if lengths is not None:
+                row = row[: max(int(lengths[i]), 1)]
+            flat = row.reshape(row.shape[0], -1) if row.ndim > 1 else (
+                row.reshape(-1, 1)
+            )
+            per_frame = flat.max(axis=-1)
+            frame = int(np.argmax(per_frame))
+            lines.append(
+                f"sample {i}: frame {frame} value {float(per_frame[frame]):.6g}"
+            )
+        print(f"{nm}:\n" + "\n".join(lines))
+
+    def update(outs):
+        t = outs[input.name]
+        jax.experimental.io_callback(
+            to_text, None, t.data,
+            t.lengths if t.is_seq else None, ordered=True,
+        )
+        return {}
+
+    return Evaluator(nm, [input], update, lambda a: {})
+
+
+def classification_error_printer_evaluator(
+    input: LayerOutput, label: LayerOutput, name: Optional[str] = None
+) -> Evaluator:
+    """Print the PER-INSTANCE classification error indicators (reference
+    classification_error_printer, Evaluator.cpp:1337
+    ClassificationErrorPrinter — the per-sample view of
+    classification_error, printed instead of aggregated).  Sequence inputs
+    print one 0/1 per valid timestep."""
+    nm = name or auto_name("classification_error_printer")
+
+    def to_text(err, w):
+        import numpy as np
+
+        err = np.asarray(err)
+        w = np.asarray(w)
+        vals = [
+            str(int(e)) for e, ww in zip(err.reshape(-1), w.reshape(-1))
+            if ww > 0
+        ]
+        print(f"{nm}: [" + " ".join(vals) + "]")
+
+    def update(outs):
+        pred = outs.get(input.name + "@logits")
+        if pred is None:
+            pred = outs[input.name]
+        p, ids, w = _flat_valid(pred, outs[label.name])
+        err = (jnp.argmax(p, axis=-1) != ids).astype(jnp.float32)
+        jax.experimental.io_callback(to_text, None, err, w, ordered=True)
+        return {}
+
+    return Evaluator(nm, [input, label], update, lambda a: {})
+
+
 def gradient_printer_evaluator(
     input: LayerOutput, name: Optional[str] = None
 ) -> Evaluator:

@@ -580,6 +580,30 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         for n in pro_sliced
     )
 
+    # Fused attention-GRU lowering: when the whole remaining loop body IS
+    # the v1 attention-decoder idiom (layers/attention.py
+    # match_attention_gru_step), replace the generic per-layer scan with
+    # the fused custom-VJP core (ops/rnn.py _attgru_core) — state
+    # projection + GRU gates share one GEMM per step, the target-side
+    # input projection runs once on the whole sequence, and the backward
+    # defers every weight gradient to post-scan einsums.  v1 configs hit
+    # this with no edits; any structural mismatch falls through to the
+    # generic scan below.
+    fused_hs = None
+    from paddle_tpu.utils.flags import get_flag
+
+    if (
+        rows_hoistable
+        and len(memories) == 1
+        and not sub_state0
+        and get_flag("fused_attention_gru")
+    ):
+        fused_hs = _try_fused_attention_gru(
+            conf, subnet, params, memories[0], scan_names, static_info,
+            static_batch, scanned, xs, mask_seq, init_carry, ctx,
+            set(body_only), frontier_scan,
+        )
+
     def body_core(carry_all, scan_in):
         carry, sub_state = carry_all
         n_x = len(xs)
@@ -640,11 +664,9 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     # executable per bucket), the executed trip count shrinks to the bucket
     # bound.  Reverse groups flip their inputs, so their dead steps sit at
     # the START of the scan — the per-step bit covers both ends.
-    from paddle_tpu.utils.flags import get_flag
-
     scan_xs = tuple(xs) + pro_stacked + (mask_seq, t_iota)
     body = body_core
-    if get_flag("scan_early_exit"):
+    if fused_hs is None and get_flag("scan_early_exit"):
         active_seq = jnp.any(valid, axis=1)  # [T] any row live at step t
         # dead steps must emit the live branch's exact output structure;
         # abstract-eval the body once (shapes only, no FLOPs) to know it
@@ -668,14 +690,17 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
         scan_xs = scan_xs + (active_seq,)
 
     # Memory/step placeholders ride the compiler's data path per step.
-    (_, sub_state_out), ys_stacked = jax.lax.scan(
-        body,
-        (init_carry, sub_state0),
-        scan_xs,
-        unroll=_GROUP_UNROLL,
-    )
-    if sub_state0:
-        ctx.new_state[conf.name] = sub_state_out
+    if fused_hs is not None:
+        ys_stacked = (SeqTensor(fused_hs),)
+    else:
+        (_, sub_state_out), ys_stacked = jax.lax.scan(
+            body,
+            (init_carry, sub_state0),
+            scan_xs,
+            unroll=_GROUP_UNROLL,
+        )
+        if sub_state0:
+            ctx.new_state[conf.name] = sub_state_out
 
     group_logits = None
     if epilogue is not None:
@@ -740,6 +765,110 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
             jnp.swapaxes(group_logits, 0, 1), lengths
         )
     return SeqTensor(ys, lengths)
+
+
+def _try_fused_attention_gru(
+    conf, subnet, params, mem, scan_names, static_info, static_batch,
+    scanned, xs, mask_seq, init_carry, ctx, body_only, frontier_scan,
+):
+    """Lower a matched attention-GRU decoder step onto ops/rnn._attgru_core.
+
+    Returns the [T, B, H] hidden sequence (time-major, matching what the
+    generic scan would emit for the gru frontier value), or None when the
+    step doesn't match / a runtime precondition fails — the caller then
+    runs the generic scan.  Numerics are pinned identical to the unfused
+    lowering by tests/test_attention_gru_fused.py."""
+    from paddle_tpu.core.compiler import _cast_floats
+    from paddle_tpu.layers.attention import match_attention_gru_step
+    from paddle_tpu.ops.rnn import _attgru_core
+    from paddle_tpu.utils.flags import get_flag
+
+    sub_topo: Topology = conf.attrs["_sub_topology"]
+    static_seq = {p for (p, is_seq) in static_info if is_seq}
+    match = match_attention_gru_step(
+        sub_topo.layers, mem, set(scan_names), static_seq
+    )
+    if match is None:
+        return None
+    # the fused core must replace the loop body EXACTLY: the scan's only
+    # emitted value is the gru state, and every loop-resident layer is part
+    # of the matched pattern (no extra step outputs, no side computation)
+    if tuple(frontier_scan) != (match.gru,):
+        return None
+    loop_layers = {
+        n for n in body_only
+        if sub_topo.layers[n].type not in ("data", "step_input", "memory")
+    }
+    if loop_layers != set(match.matched):
+        return None
+    # runtime preconditions on the actual tensors
+    enc_t = static_batch[match.enc_name]
+    ep_t = static_batch[match.ep_name]
+    if enc_t.data.ndim != 3 or ep_t.data.ndim != 3:
+        return None
+    # the unfused path masks the score softmax by enc_proj's lengths and
+    # the context sum by enc's — only equivalent to the core's single mask
+    # when they are the same lengths array (they are: enc_proj is a rowwise
+    # projection of enc, which propagates the identical lengths object)
+    if enc_t.lengths is not ep_t.lengths and not (
+        enc_t.lengths is None and ep_t.lengths is None
+    ):
+        return None
+    scan_idx = {n: i for i, n in enumerate(scan_names)}
+    for _slot, pname in match.scan_slots:
+        x = xs[scan_idx[pname]]
+        s_in = scanned[scan_idx[pname]]
+        if (
+            x.lengths is not None  # SubsequenceInput slice: not a plain row
+            or x.data.ndim != 3
+            or getattr(s_in, "sparse_ids", False)
+            or not jnp.issubdtype(x.data.dtype, jnp.floating)
+        ):
+            return None
+
+    mixed = ctx.dtype != jnp.dtype(jnp.float32)
+
+    def layer_p(name):
+        p = subnet.layer_params(params, name)
+        return _cast_floats(p, ctx.dtype) if mixed else p
+
+    p_sp = layer_p(match.state_proj)
+    p_sc = layer_p(match.scores)
+    p_in = layer_p(match.in_proj)
+    p_gru = layer_p(match.gru)
+    if "w_h" not in p_gru or "w_c" not in p_gru:
+        return None
+
+    # fused state weight: one [H, P+2H] GEMM covers the attention state
+    # projection AND the GRU update/reset gates
+    w1 = jnp.concatenate([p_sp["w0"], p_gru["w_h"]], axis=1)
+    v = p_sc["w0"][:, 0]
+    w_ctx = p_in[f"w{match.ctx_slot}"]
+    w_c = p_gru["w_c"]
+
+    # target-side gate projections for the WHOLE sequence, outside the scan
+    # (the generic path re-ran this [B,*]x[*,3H] GEMM every step because it
+    # shares an fc with the in-loop context term)
+    xg = None
+    for slot, pname in match.scan_slots:
+        x = xs[scan_idx[pname]].data  # [T, B, D], already flipped if reverse
+        term = jnp.einsum("tbd,dg->tbg", x, p_in[f"w{slot}"])
+        xg = term if xg is None else xg + term
+    for p in (p_in, p_gru):
+        if "b" in p:
+            xg = xg + p["b"]
+    ep = ep_t.data
+    if "b" in p_sp:
+        ep = ep + p_sp["b"]  # state-proj bias is step-invariant: fold here
+
+    emask = enc_t.mask(bool) if enc_t.lengths is not None else None
+    hs, _h_last = _attgru_core(
+        (match.gate_act, match.act, match.att_act,
+         bool(get_flag("scan_early_exit"))),
+        xg, enc_t.data, ep, emask, w1, v, w_ctx, w_c,
+        init_carry[mem.name], mask_seq > 0,
+    )
+    return hs
 
 
 # Layer types whose rows are independent (time can fold into batch): every

@@ -207,6 +207,35 @@ class Seq2SeqGenerator:
         self._scan_names = dec_conf.attrs["_scan_placeholders"]
         self._static_info = dec_conf.attrs["_static_placeholders"]
         self._memories = dec_conf.attrs["_memories"]
+        # Fused decode stepping: when the decoder step matches the
+        # attention-GRU idiom (the same matcher the training scan uses,
+        # layers/attention.py), each beam step runs the fused chain
+        # (ops/rnn.attention_gru_step) + the vocab head directly instead of
+        # interpreting the sub-network layer by layer — in particular the
+        # [B*K, S]-row expand+fc state projection collapses to one
+        # [B*K, H] GEMM per step.  Structural mismatch -> generic stepping.
+        self._match = None
+        if len(self._memories) == 1:
+            from paddle_tpu.layers.attention import match_attention_gru_step
+
+            m = match_attention_gru_step(
+                self._sub_topo.layers,
+                self._memories[0],
+                set(self._scan_names),
+                {p for p, is_seq in self._static_info if is_seq},
+            )
+            head = self._sub_topo.layers.get("dec_out")
+            if (
+                m is not None
+                and len(m.scan_slots) == 1
+                and m.scan_slots[0][1] == self._scan_names[0]
+                and head is not None
+                and head.type == "fc"
+                and head.act == "softmax"
+                and head.drop_rate == 0.0
+                and tuple(head.inputs) == (m.gru,)
+            ):
+                self._match = m
         # Pruned encoder-only graph: generation must not pay for the training
         # decoder scan + softmax + cost (and must not require dummy trg slots).
         self._enc_net = CompiledNetwork(
@@ -222,9 +251,49 @@ class Seq2SeqGenerator:
 
     def _step_fn(self, statics, gp):
         """Build step_fn(ids, carry) for beam/greedy: embeds ids with the
-        trained trg_emb table, runs the decoder sub-network once."""
+        trained trg_emb table, runs the decoder sub-network once — through
+        the fused attention-GRU step when the topology matched."""
+        from paddle_tpu.utils.flags import get_flag
+
         emb_w = gp["trg_emb"]["w"]
         sub_params = gp["decoder"]
+        m0 = self._memories[0] if self._memories else None
+
+        if self._match is not None and get_flag("fused_attention_gru"):
+            from paddle_tpu.ops.rnn import attention_gru_step
+
+            mt = self._match
+            lp = lambda n: self._subnet.layer_params(sub_params, n)
+            p_in = lp(mt.in_proj)
+            p_gru = lp(mt.gru)
+            p_sp = lp(mt.state_proj)
+            p_head = lp("dec_out")
+            w1 = jnp.concatenate([p_sp["w0"], p_gru["w_h"]], axis=1)
+            v = lp(mt.scores)["w0"][:, 0]
+            w_emb = p_in[f"w{mt.scan_slots[0][0]}"]
+            bias = sum(p["b"] for p in (p_in, p_gru) if "b" in p)
+            enc_t = statics[mt.enc_name]
+            ep = statics[mt.ep_name].data
+            if "b" in p_sp:
+                ep = ep + p_sp["b"]
+            emask = enc_t.mask(bool) if enc_t.lengths is not None else None
+
+            def step_fn(ids, carry):
+                xg = jnp.take(emb_w, ids, axis=0) @ w_emb
+                if not isinstance(bias, int):
+                    xg = xg + bias
+                h_t = attention_gru_step(
+                    xg, carry[m0.name], enc_t.data, ep, emask, w1, v,
+                    p_in[f"w{mt.ctx_slot}"], p_gru["w_c"],
+                    gate_act=mt.gate_act, act=mt.act, att_act=mt.att_act,
+                )
+                logits = h_t @ p_head["w0"]
+                if "b" in p_head:
+                    logits = logits + p_head["b"]
+                prob = jax.nn.softmax(logits, axis=-1)
+                return jnp.log(jnp.maximum(prob, 1e-9)), {m0.name: h_t}
+
+            return step_fn
 
         def step_fn(ids, carry):
             sub_batch = dict(statics)

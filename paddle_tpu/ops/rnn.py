@@ -371,6 +371,330 @@ def _gru_core_bwd(acts, res, cts):
 _gru_core.defvjp(_gru_core_fwd, _gru_core_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Fused attention-GRU decoder step — the NMT decoder recurrence
+# ---------------------------------------------------------------------------
+#
+# The v1 attention decoder (networks.py simple_attention + gru_step inside a
+# recurrent_group) lowers, layer by layer, to a per-step chain of SIX
+# dependent GEMMs — expand+fc state projection (computed on [B*S] rows, S×
+# redundant), score fc, context reduce, input fc, GRU gate GEMM, GRU
+# candidate GEMM — which is exactly the per-timestep launch/latency overhead
+# the reference's fused decoder kernels exist to kill (reference:
+# paddle/cuda/src/hl_cuda_lstm.cu, 872 LoC of hand-fused per-step math).
+#
+# The fused core below collapses the step to the MINIMAL dependent chain:
+#
+#   a1    = h₋ @ [W_sp | U_ur]            one [B,H]x[H,P+2H] GEMM (state
+#                                         projection + GRU update/reset
+#                                         gates share the h₋ operand)
+#   α     = softmax_S(act(ep + sp) · v)   score matvec (+ static enc mask)
+#   ctx   = α · enc                       context reduce
+#   p     = xg_t + ctx @ W_ctx            one [B,E]x[E,3H] GEMM (the
+#                                         target-embedding half of the v1
+#                                         "input fc" is precomputed for the
+#                                         WHOLE sequence outside the scan)
+#   c̃    = act(p_c + (r∘h₋) @ W_c)       the one unavoidable second link
+#   h     = (1-u)∘h₋ + u∘c̃
+#
+# i.e. 2 chained [B,H]-class GEMMs + the attention matvec/reduce per step,
+# with the same custom-VJP discipline as the cells above: the backward scan
+# runs only transposed chain GEMMs; every weight gradient (dW1, dW_ctx,
+# dW_c, dv) and the static-input gradients (d_enc, d_ep) are post-scan
+# einsums over the saved sequences.
+
+
+def _att_scores(att_act: str, ep, sp, v):
+    """[B, S] unnormalized attention scores: act(ep + sp[:,None,:]) · v."""
+    return jnp.einsum(
+        "bsp,p->bs", get_activation(att_act)(ep + sp[:, None, :]), v
+    )
+
+
+def _att_softmax(score, emask):
+    """Masked softmax over S, replicating the sequence_softmax activation
+    (ops/activations.py): -1e9 fill, softmax, then zero the padding."""
+    if emask is not None:
+        score = jnp.where(emask, score, -1e9)
+    alpha = jax.nn.softmax(score, axis=-1)
+    if emask is not None:
+        alpha = alpha * emask.astype(alpha.dtype)
+    return alpha
+
+
+def _attgru_step(acts, xg_t, h_p, enc, ep, emask, w1, v, w_ctx, w_c, m):
+    """One fused decoder step.  Returns (h_t, saved) where saved carries the
+    residuals the hand-written backward needs."""
+    p_dim = ep.shape[-1]
+    h = h_p.shape[-1]
+    a1 = h_p @ w1  # [B, P+2H]: state projection + GRU u/r gates fused
+    sp, ur = a1[:, :p_dim], a1[:, p_dim:]
+    alpha = _att_softmax(_att_scores(acts[2], ep, sp, v), emask)
+    ctxv = jnp.einsum("bs,bse->be", alpha, enc)
+    p = xg_t + ctxv @ w_ctx  # [B, 3H] in (u, r, c) slot order
+    pu = p[:, :h] + ur[:, :h]
+    pr = p[:, h : 2 * h] + ur[:, h:]
+    rh = _gru_reset(acts, pr, h_p)
+    cpre = p[:, 2 * h :] + rh @ w_c
+    h_t = _gru_final(acts, pu, cpre, h_p, m)
+    return h_t, (sp, alpha, ctxv, pu, pr, cpre)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _attgru_core(opts, xg, enc, ep, emask, w1, v, w_ctx, w_c, h0, mask):
+    """Time-major fused attention-GRU recurrence with a hand-written VJP.
+
+    opts: (gate_act, act, att_act, early_exit).
+    xg: [T,B,3H] precomputed target-side gate projections (+ biases);
+    enc: [B,S,E] context values; ep: [B,S,P] score keys (+ biases);
+    emask: [B,S] bool encoder validity or None; w1: [H,P+2H] fused
+    state weight [W_state_proj | U_ur]; v: [P] score vector; w_ctx:
+    [E,3H]; w_c: [H,H]; mask: [T,B,1] bool decoder-step validity.
+    Returns (hs [T,B,H], h_last)."""
+    hs, *_rest, h_last = _attgru_fwd_scan(
+        opts, xg, enc, ep, emask, w1, v, w_ctx, w_c, h0, mask
+    )
+    return hs, h_last
+
+
+def _cond_step(active, live_fn, carry, ys_struct):
+    """Shared early-exit step wrapper for the fused scans: run the live
+    body when any batch row is live at this step, else pass the carry
+    through emitting zeros in the live branch's exact output structure."""
+
+    def dead(c):
+        return c, jax.tree_util.tree_map(
+            lambda st: jnp.zeros(st.shape, st.dtype), ys_struct
+        )
+
+    return lax.cond(active, live_fn, dead, carry)
+
+
+def _attgru_fwd_scan(opts, xg, enc, ep, emask, w1, v, w_ctx, w_c, h0, mask):
+    acts, early = opts[:3], opts[3]
+
+    def live(h_p, x_t, m):
+        h_t, saved = _attgru_step(
+            acts, x_t, h_p, enc, ep, emask, w1, v, w_ctx, w_c, m
+        )
+        return h_t, (h_t,) + saved
+
+    if early:
+        # bucketed feeds pad T up to a ladder rung: steps past every row's
+        # true length are dead for the WHOLE batch — skip their FLOPs, keep
+        # the compiled shape (same contract as the generic group scan)
+        active_seq = jnp.any(mask[:, :, 0], axis=1)  # [T]
+        ys_struct = jax.eval_shape(
+            lambda h, x, m: live(h, x, m)[1],
+            h0, jax.tree_util.tree_map(lambda u: u[0], xg), mask[0],
+        )
+
+        def step(h_p, inp):
+            x_t, m, a = inp
+            h_t, ys = _cond_step(
+                a, lambda h: live(h, x_t, m), h_p, ys_struct
+            )
+            # dead steps must still emit the CARRY as the step output so
+            # hs stays the masked carry-through sequence
+            ys = (jnp.where(a, ys[0], h_p),) + ys[1:]
+            return h_t, ys
+
+        h_last, seqs = lax.scan(
+            step, h0, (xg, mask, active_seq), unroll=_UNROLL_FUSED
+        )
+    else:
+        h_last, seqs = lax.scan(
+            lambda h_p, inp: live(h_p, *inp), h0, (xg, mask),
+            unroll=_UNROLL_FUSED,
+        )
+    hs, sp_seq, alpha_seq, ctx_seq, pu_seq, pr_seq, cpre_seq = seqs
+    return hs, sp_seq, alpha_seq, ctx_seq, pu_seq, pr_seq, cpre_seq, h_last
+
+
+def _attgru_core_fwd(opts, xg, enc, ep, emask, w1, v, w_ctx, w_c, h0, mask):
+    hs, sp_seq, alpha_seq, ctx_seq, pu_seq, pr_seq, cpre_seq, h_last = (
+        _attgru_fwd_scan(opts, xg, enc, ep, emask, w1, v, w_ctx, w_c, h0, mask)
+    )
+    res = (
+        sp_seq, alpha_seq, ctx_seq, pu_seq, pr_seq, cpre_seq, hs,
+        enc, ep, emask, w1, v, w_ctx, w_c, h0, mask,
+    )
+    return (hs, h_last), res
+
+
+def _attgru_core_bwd(opts, res, cts):
+    acts, early = opts[:3], opts[3]
+    (sp_seq, alpha_seq, ctx_seq, pu_seq, pr_seq, cpre_seq, hs,
+     enc, ep, emask, w1, v, w_ctx, w_c, h0, mask) = res
+    dhs, dh_last = cts
+    h = h0.shape[-1]
+    h_prev_seq = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    w1_t, w_ctx_t, w_c_t = w1.T, w_ctx.T, w_c.T
+    f_att = get_activation(acts[2])
+
+    def live(dh, sp, alpha, pu, pr, cpre, h_p, m):
+        # GRU tail (same structure as _gru_core_bwd, via the elementwise
+        # closures so activation choices stay exact)
+        _, vjp_final = jax.vjp(
+            lambda a, c, hp: _gru_final(acts, a, c, hp, m), pu, cpre, h_p
+        )
+        dpu, dcpre, dh_p = vjp_final(dh)
+        drh = dcpre @ w_c_t  # chain GEMM 1
+        rh, vjp_reset = jax.vjp(
+            lambda p_r, hp: _gru_reset(acts, p_r, hp), pr, h_p
+        )
+        dpr, dh_p_r = vjp_reset(drh)
+        dxg = jnp.concatenate([dpu, dpr, dcpre], axis=-1)  # == dp
+        dctx = dxg @ w_ctx_t  # chain GEMM 2
+        dalpha = jnp.einsum("be,bse->bs", dctx, enc)
+        # masked-softmax VJP: padding has alpha == 0, so it drops out
+        dpre = alpha * (
+            dalpha - jnp.sum(alpha * dalpha, axis=-1, keepdims=True)
+        )
+        # score backward: dsp[b,p] = v[p] * Σ_s dpre·act'(ep+sp); act' via
+        # jvp so any registered activation works (elementwise, fuses)
+        x_s = ep + sp[:, None, :]
+        _, fp = jax.jvp(f_att, (x_s,), (jnp.ones_like(x_s),))
+        dsp = jnp.einsum("bs,bsp->bp", dpre, fp) * v
+        da1 = jnp.concatenate([dsp, dpu, dpr], axis=-1)
+        dh_p = dh_p + dh_p_r + da1 @ w1_t  # chain GEMM 3 (the h₋ link)
+        return dh_p, (da1, dxg, dctx, dpre, rh)
+
+    if early:
+        active_seq = jnp.any(mask[:, :, 0], axis=1)
+        ys_struct = jax.eval_shape(
+            lambda *a: live(*a)[1],
+            dhs[0], sp_seq[0], alpha_seq[0], pu_seq[0], pr_seq[0],
+            cpre_seq[0], h_prev_seq[0], mask[0],
+        )
+
+        def step(dh, inp):
+            sp, alpha, pu, pr, cpre, h_p, m, dh_out, a = inp
+            dh = dh + dh_out
+            return _cond_step(
+                a, lambda d: live(d, sp, alpha, pu, pr, cpre, h_p, m),
+                dh, ys_struct,
+            )
+
+        xs_bwd = (
+            sp_seq, alpha_seq, pu_seq, pr_seq, cpre_seq, h_prev_seq, mask,
+            dhs, active_seq,
+        )
+    else:
+        def step(dh, inp):
+            sp, alpha, pu, pr, cpre, h_p, m, dh_out = inp
+            return live(dh + dh_out, sp, alpha, pu, pr, cpre, h_p, m)
+
+        xs_bwd = (
+            sp_seq, alpha_seq, pu_seq, pr_seq, cpre_seq, h_prev_seq, mask,
+            dhs,
+        )
+
+    dh0, (da1_seq, dxg_seq, dctx_seq, dpre_seq, rh_seq) = lax.scan(
+        step, dh_last, xs_bwd, reverse=True, unroll=_UNROLL_FUSED
+    )
+
+    # every weight gradient is ONE post-scan einsum at >= f32 accumulation
+    acc = jnp.promote_types(w1.dtype, jnp.float32)
+    dw1 = jnp.einsum(
+        "tbh,tbg->hg", h_prev_seq, da1_seq, preferred_element_type=acc
+    ).astype(w1.dtype)
+    dw_ctx = jnp.einsum(
+        "tbe,tbg->eg", ctx_seq, dxg_seq, preferred_element_type=acc
+    ).astype(w_ctx.dtype)
+    dw_c = jnp.einsum(
+        "tbh,tbg->hg", rh_seq, dxg_seq[..., 2 * h :],
+        preferred_element_type=acc,
+    ).astype(w_c.dtype)
+    d_enc = jnp.einsum(
+        "tbs,tbe->bse", alpha_seq, dctx_seq, preferred_element_type=acc
+    ).astype(enc.dtype)
+    # static score-key gradients: the [T,B,S,P] act/act' tensors are traced
+    # broadcasts that XLA fuses straight into the t-reduction
+    x_big = ep[None] + sp_seq[:, :, None, :]
+    th_big = f_att(x_big)
+    _, fp_big = jax.jvp(f_att, (x_big,), (jnp.ones_like(x_big),))
+    dv = jnp.einsum(
+        "tbs,tbsp->p", dpre_seq, th_big, preferred_element_type=acc
+    ).astype(v.dtype)
+    d_ep = (
+        jnp.einsum(
+            "tbs,tbsp->bsp", dpre_seq, fp_big, preferred_element_type=acc
+        )
+        * v.astype(acc)
+    ).astype(ep.dtype)
+    d_emask = (
+        None if emask is None else np.zeros(emask.shape, jax.dtypes.float0)
+    )
+    d_mask = np.zeros(mask.shape, jax.dtypes.float0)
+    return (
+        dxg_seq, d_enc, d_ep, d_emask, dw1, dv, dw_ctx, dw_c, dh0, d_mask
+    )
+
+
+_attgru_core.defvjp(_attgru_core_fwd, _attgru_core_bwd)
+
+
+def attention_gru_step(
+    xg_t, h_p, enc, enc_proj, enc_mask, w1, v, w_ctx, w_c,
+    *, gate_act: str = "sigmoid", act: str = "tanh", att_act: str = "tanh",
+):
+    """One fused decoder step for GENERATION (beam/greedy stepping): same
+    math as the scan core's step, no mask (every generated step is live).
+    xg_t: [B, 3H] this step's target-side gate projections (+ biases)."""
+    m = jnp.ones((h_p.shape[0], 1), bool)
+    h_t, _ = _attgru_step(
+        (gate_act, act, att_act), xg_t, h_p, enc, enc_proj, enc_mask,
+        w1, v, w_ctx, w_c, m,
+    )
+    return h_t
+
+
+def attention_gru_scan(
+    gates: jnp.ndarray,  # [B, T, 3H] target-side input projections (+bias)
+    enc: jnp.ndarray,  # [B, S, E] encoded sequence (context values)
+    enc_proj: jnp.ndarray,  # [B, S, P] projected keys (+ any biases folded)
+    w1: jnp.ndarray,  # [H, P+2H] fused [W_state_proj | U_ur]
+    v: jnp.ndarray,  # [P] attention score vector
+    w_ctx: jnp.ndarray,  # [E, 3H] context -> gates projection
+    w_c: jnp.ndarray,  # [H, H] GRU candidate recurrent weight
+    enc_lengths: Optional[jnp.ndarray] = None,
+    lengths: Optional[jnp.ndarray] = None,
+    *,
+    gate_act: str = "sigmoid",
+    act: str = "tanh",
+    att_act: str = "tanh",
+    reverse: bool = False,
+    h0: Optional[jnp.ndarray] = None,
+    early_exit: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused Bahdanau-attention GRU decoder over a padded batch.
+
+    Semantically identical to the unfused v1 lowering (simple_attention +
+    gru_step in a recurrent_group) — pinned by tests/test_attention_gru_fused
+    against naive autodiff in f64.  Returns ([B, T, H], h_last)."""
+    b, t, _g3 = gates.shape
+    h = w_c.shape[0]
+    xs = _time_major(gates)
+    if reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = _mask_seq(lengths, t, reverse)
+    if mask is None:
+        mask = jnp.ones((t, b, 1), bool)
+    emask = None
+    if enc_lengths is not None:
+        s = enc.shape[1]
+        emask = jnp.arange(s, dtype=jnp.int32)[None, :] < enc_lengths[:, None]
+    h_prev = h0 if h0 is not None else jnp.zeros((b, h), gates.dtype)
+    hs, h_last = _attgru_core(
+        (gate_act, act, att_act, bool(early_exit)),
+        xs, enc, enc_proj, emask, w1, v, w_ctx, w_c, h_prev, mask,
+    )
+    if reverse:
+        hs = jnp.flip(hs, axis=0)
+    return jnp.swapaxes(hs, 0, 1), h_last
+
+
 def simple_rnn_scan(
     x: jnp.ndarray,  # [B, T, H] input projections
     w_h: jnp.ndarray,  # [H, H]

@@ -93,6 +93,14 @@ define_flag("scan_early_exit", True,
             "instead of running the step body — the compiled shape stays "
             "the rung's, the executed trip count shrinks to the bucket "
             "bound")
+define_flag("fused_attention_gru", True,
+            "recurrent_group decoder steps that match the v1 attention-GRU "
+            "idiom (simple_attention + gru_step — the NMT decoder) lower "
+            "onto the fused custom-VJP scan core (ops/rnn.py _attgru_core: "
+            "state projection + GRU gates share one GEMM, the target-side "
+            "input projection hoists out of the scan, weight grads are "
+            "post-scan einsums) instead of the generic per-layer scan body; "
+            "non-matching steps always use the generic path")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

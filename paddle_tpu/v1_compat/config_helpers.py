@@ -503,5 +503,9 @@ chunk_evaluator = _recording_evaluator(_ev.chunk_evaluator)
 detection_map_evaluator = _recording_evaluator(_ev.detection_map_evaluator)
 value_printer_evaluator = _recording_evaluator(_ev.value_printer_evaluator)
 maxid_printer_evaluator = _recording_evaluator(_ev.maxid_printer_evaluator)
+maxframe_printer_evaluator = _recording_evaluator(_ev.maxframe_printer_evaluator)
+classification_error_printer_evaluator = _recording_evaluator(
+    _ev.classification_error_printer_evaluator
+)
 gradient_printer_evaluator = _recording_evaluator(_ev.gradient_printer_evaluator)
 seqtext_printer_evaluator = _recording_evaluator(_ev.seq_text_printer_evaluator)

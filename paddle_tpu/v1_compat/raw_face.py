@@ -311,6 +311,20 @@ def Evaluator(name: str, type: str, inputs, **kw):
             chunk_scheme=kw.get("chunk_scheme", "IOB"),
             num_chunk_types=kw.get("num_chunk_types", 1), name=name,
         ),
+        "value_printer": lambda: E.value_printer_evaluator(
+            input=refs[0], name=name
+        ),
+        "max_id_printer": lambda: E.maxid_printer_evaluator(
+            input=refs[0], name=name
+        ),
+        "max_frame_printer": lambda: E.maxframe_printer_evaluator(
+            input=refs[0], name=name
+        ),
+        "classification_error_printer": (
+            lambda: E.classification_error_printer_evaluator(
+                input=refs[0], label=refs[1], name=name
+            )
+        ),
     }.get(type)
     if factory is None:
         raise KeyError(f"raw Evaluator type {type!r} not supported")

@@ -119,6 +119,7 @@ _SLOW_TESTS = {
     "test_nmt_cost_decreases",
     "test_param_init_stable_across_processes",
     "test_pipeline_gradients_match_sequential",
+    "test_profiler_trace_writes",
     "test_pipeline_matches_sequential",
     "test_prelu_grad",
     "test_rank_cost_grad",
@@ -139,6 +140,7 @@ _SLOW_TESTS = {
     "test_transformer_trains_on_copy_task",
     "test_transformer_with_sequence_parallel_matches_dense",
     "test_vae_config_builds_and_trains",
+    "test_vae_reconstructs_and_samples",
 }
 
 

@@ -194,3 +194,102 @@ def test_multi_binary_ce_multi_id_labels_multi_hot():
     assert t.shape == (2, 5)
     np.testing.assert_allclose(t[0], [0, 1, 0, 1, 0])
     np.testing.assert_allclose(t[1], [1, 0, 0, 0, 0])
+
+
+# ---------------------------------------------------------------------------
+# the two round-6 printers (reference Evaluator.cpp:1061 MaxFramePrinter,
+# :1337 ClassificationErrorPrinter) — DSL surface + v1 raw-face wiring
+# ---------------------------------------------------------------------------
+
+
+def test_maxframe_printer_prints_max_frame(capfd):
+    from paddle_tpu.evaluator import maxframe_printer_evaluator
+
+    x = L.data("x", paddle.data_type.dense_vector_sequence(2))
+    ev = maxframe_printer_evaluator(x, name="mf")
+    data = jnp.asarray(
+        [
+            [[0.0, 1.0], [5.0, 0.0], [0.0, 9.0]],  # max at frame 2
+            [[7.0, 0.0], [0.0, 1.0], [8.0, 8.0]],  # len 2 -> max at frame 0
+        ]
+    )
+    outs = {"x": SeqTensor(data, jnp.asarray([3, 2], jnp.int32))}
+    assert _run_ev([ev], outs) == {}
+    jax.effects_barrier()
+    out = capfd.readouterr().out
+    assert "sample 0: frame 2 value 9" in out
+    assert "sample 1: frame 0 value 7" in out
+
+
+def test_maxframe_printer_non_seq(capfd):
+    from paddle_tpu.evaluator import maxframe_printer_evaluator
+
+    x = L.data("x", paddle.data_type.dense_vector(3))
+    ev = maxframe_printer_evaluator(x, name="mf2")
+    outs = {"x": non_seq(jnp.asarray([[1.0, 4.0, 2.0]]))}
+    _run_ev([ev], outs)
+    jax.effects_barrier()
+    assert "sample 0: frame 1 value 4" in capfd.readouterr().out
+
+
+def test_classification_error_printer_per_instance(capfd):
+    from paddle_tpu.evaluator import classification_error_printer_evaluator
+
+    x = L.data("x", paddle.data_type.dense_vector(3))
+    y = L.data("y", paddle.data_type.integer_value(3))
+    ev = classification_error_printer_evaluator(x, y, name="cep")
+    outs = {
+        "x": non_seq(jnp.asarray([[0.9, 0.1, 0.0], [0.1, 0.8, 0.1],
+                                  [0.3, 0.3, 0.4]])),
+        "y": SeqTensor(jnp.asarray([0, 0, 2], jnp.int32)),
+    }
+    assert _run_ev([ev], outs) == {}
+    jax.effects_barrier()
+    assert "cep: [0 1 0]" in capfd.readouterr().out
+
+
+def test_classification_error_printer_masks_padding(capfd):
+    from paddle_tpu.evaluator import classification_error_printer_evaluator
+
+    x = L.data("x", paddle.data_type.dense_vector_sequence(2))
+    y = L.data("y", paddle.data_type.integer_value_sequence(2))
+    ev = classification_error_printer_evaluator(x, y, name="cepseq")
+    pred = jnp.asarray([[[0.9, 0.1], [0.2, 0.8], [0.5, 0.5]]])
+    lens = jnp.asarray([2], jnp.int32)
+    outs = {
+        "x": SeqTensor(pred, lens),
+        "y": SeqTensor(jnp.asarray([[0, 0, 1]], jnp.int32), lens),
+    }
+    _run_ev([ev], outs)
+    jax.effects_barrier()
+    out = capfd.readouterr().out
+    assert "cepseq: [0 1]" in out  # padding step 2 excluded
+
+
+def test_printer_evaluators_via_raw_face():
+    """The reference raw-config Evaluator() face wires both new printer
+    types (plus the existing value/maxid printers)."""
+    from paddle_tpu.v1_compat import raw_face
+
+    cfg = """
+Layer(name="x", type="data", size=3)
+Layer(name="y", type="data", size=3)
+Layer(name="fc", type="fc", size=3, active_type="softmax",
+      inputs=[Input("x", parameter_name="w")], bias=Bias())
+Evaluator(name="ev_mf", type="max_frame_printer", inputs=["fc"])
+Evaluator(name="ev_cep", type="classification_error_printer",
+          inputs=["fc", "y"])
+Evaluator(name="ev_vp", type="value_printer", inputs=["fc"])
+Outputs("fc")
+"""
+    import tempfile, os
+
+    from paddle_tpu.v1_compat import parse_config
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "conf.py")
+        with open(p, "w") as f:
+            f.write(cfg)
+        parsed = parse_config(p, "")
+    names = sorted(ev.name for ev in parsed.evaluators)
+    assert names == ["ev_cep", "ev_mf", "ev_vp"]
