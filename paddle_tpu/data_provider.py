@@ -229,6 +229,19 @@ def provider(
                 shuffle_flag = is_train
             if shuffle_flag:
                 rd = reader_dec.shuffle(rd, pool_size)
+            if cache == CacheType.CACHE_PASS_IN_MEM:
+                # the TPU-native half of CACHE_PASS_IN_MEM: tag the reader
+                # so the trainer keeps the DECODED pass device-resident and
+                # replays it for epochs >= 2 (reader/pass_cache.py); the
+                # host-RAM cache above still spares the generator re-run for
+                # the capture epoch's own restarts.  paddle.batch and
+                # token_budget_batch propagate the tags.  Replay shuffling
+                # follows the provider's own shuffle intent: a
+                # should_shuffle=False provider (ordered/curriculum data)
+                # must replay in capture order, like the reference's
+                # host-RAM cache did.
+                rd.cache_pass_in_mem = True
+                rd.cache_pass_shuffle = bool(shuffle_flag)
             return rd
 
         def resolve_input_types(file_list=(), **hook_kwargs):

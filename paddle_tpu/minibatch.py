@@ -16,4 +16,8 @@ def batch(reader, batch_size: int, drop_last: bool = False):
         if b and not drop_last:
             yield b
 
-    return batch_reader
+    from paddle_tpu.reader.pass_cache import copy_cache_tags
+
+    # carry the @provider(cache=CACHE_PASS_IN_MEM) tags through to the
+    # trainer (reader/pass_cache.py device-resident replay)
+    return copy_cache_tags(reader, batch_reader)

@@ -30,6 +30,30 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` across jax versions: the top-level name (jax >=
+    0.5) with a fallback to ``jax.experimental.shard_map`` — call sites
+    (ring attention, the GPipe pipeline, the allreduce bench) stay one
+    spelling."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:  # older jax: experimental namespace only
+        from jax.experimental.shard_map import shard_map as fn
+    if "check_vma" in kwargs:
+        # the replication-check kwarg was renamed check_rep -> check_vma;
+        # mid-window jax exposes the top-level name but still takes
+        # check_rep, so translate by the actual signature, not the lookup
+        # path
+        try:
+            import inspect
+
+            sig_params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            sig_params = {"check_vma": None}
+        if "check_vma" not in sig_params and "check_rep" in sig_params:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+    return fn(*args, **kwargs)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     data: int = -1  # -1 = all remaining devices

@@ -205,4 +205,8 @@ def token_budget_batch(
                 rng.shuffle(leftovers)
             yield from leftovers
 
-    return batched_reader
+    from paddle_tpu.reader.pass_cache import copy_cache_tags
+
+    # carry the @provider(cache=CACHE_PASS_IN_MEM) tags through to the
+    # trainer; cached replay is per-bucket-shape aware (pass_cache.py)
+    return copy_cache_tags(reader, batched_reader)

@@ -58,6 +58,7 @@ class SGD:
         evaluators: Optional[Sequence] = None,
     ):
         self.evaluators = list(evaluators or [])
+        self._seed = seed  # also keys the pass-cache replay shuffle
         if isinstance(cost, Topology) and not extra_layers and not self.evaluators:
             # e.g. a v1_compat parse_config result's topology
             self.topology = cost
@@ -155,6 +156,8 @@ class SGD:
         self._opt_state = self.optimizer.init(self.parameters.params)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
+        self._pass_cache = None  # set per train() call when caching is on
+        self._pass_cache_reader = None  # the reader the cache was built for
         # Per-bucket dispatch accounting: every train/eval batch's shape
         # signature is observed here (core.compiler.CompileShapeCache), so
         # the StatSet plane carries compile hit/miss counters and a bounded-
@@ -261,7 +264,17 @@ class SGD:
         JAX's async dispatch handles the device side; this hides the host
         side.  The reader runs up to 3 batches ahead of the consuming step;
         set False for inline single-thread feeding if the reader mutates
-        state the training loop observes (or isn't thread-compatible)."""
+        state the training loop observes (or isn't thread-compatible).
+
+        Device-resident pass cache (the TPU-native CACHE_PASS_IN_MEM,
+        reference PyDataProvider2.cpp:69): when the reader was built from
+        ``@provider(cache=CacheType.CACHE_PASS_IN_MEM)`` (the factory tags
+        it) or the ``cache_pass_in_mem`` flag is on, epoch 1's staged
+        batches stay on device (reader/pass_cache.py: HBM-budgeted, wire
+        dtype preserved, optional ``data_echo_factor`` echo) and every
+        later pass replays them with a seed-reproducible on-device shuffle
+        — zero H2D traffic, no per-batch Python feed.  A pass that blows
+        the HBM budget falls back to streaming with a warning."""
         if event_handler is None:
             event_handler = lambda e: None
         from paddle_tpu.reader.prefetch import prefetch
@@ -277,6 +290,49 @@ class SGD:
         def _stage(data_batch):
             with stat_timer("feed"):
                 return shard_batch(feeder(data_batch), self.mesh)
+
+        # epoch-aware feed switch: capture pass 1 into the device-resident
+        # cache, replay it for every later pass (per-bucket batches keep
+        # their own shapes, so this composes with use_bucketing).  A
+        # single-pass run can never replay, so it must not pin the pass in
+        # HBM — data echo still applies (it needs the batch in hand, not
+        # the cache).
+        pass_cache = None
+        cache_requested = _flags.get_flag("cache_pass_in_mem") or bool(
+            getattr(reader, "cache_pass_in_mem", False)
+        )
+        echo_factor = (
+            max(int(_flags.get_flag("data_echo_factor")), 1)
+            if cache_requested
+            else 1
+        )
+        if cache_requested:
+            # the cache lives with its data source (reference
+            # CACHE_PASS_IN_MEM keeps the pass for the provider's
+            # lifetime): a later train() call with the SAME reader object
+            # replays immediately — even its first pass pays zero H2D; a
+            # different reader frees the stale pass before any re-capture
+            prev = self._pass_cache
+            if (
+                prev is not None
+                and prev.ready
+                and self._pass_cache_reader is reader
+            ):
+                pass_cache = prev
+            else:
+                if prev is not None:
+                    prev.drop()
+                if num_passes > 1:
+                    from paddle_tpu.reader.pass_cache import PassCache
+
+                    pass_cache = PassCache.from_flags(
+                        reader, seed=self._seed, echo_factor=echo_factor
+                    )
+        elif self._pass_cache is not None:
+            # caching switched off since the last call: release the HBM
+            self._pass_cache.drop()
+        self._pass_cache = pass_cache
+        self._pass_cache_reader = reader if pass_cache is not None else None
 
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state
@@ -294,11 +350,24 @@ class SGD:
             pass_costs: List[float] = []
             pass_weights: List[int] = []
             pass_accums: Dict[str, np.ndarray] = {}
-            batches = (
-                prefetch(reader(), _stage)
-                if async_load_data
-                else map(_stage, reader())
-            )
+            if pass_cache is not None and pass_cache.ready:
+                # cached pass: device-resident replay, seed-reproducible
+                # shuffle, zero H2D — the feeder/prefetcher never runs
+                batches = pass_cache.epoch(pass_id)
+            else:
+                batches = (
+                    prefetch(reader(), _stage)
+                    if async_load_data
+                    else map(_stage, reader())
+                )
+                if pass_cache is not None and pass_cache.active:
+                    batches = pass_cache.capture(batches)
+                elif echo_factor > 1 and pass_id == start_pass:
+                    # single-pass (or overflowed) run with data echo: train
+                    # each transferred batch echo_factor times, retain none
+                    batches = (
+                        b for bb in batches for b in (bb,) * echo_factor
+                    )
             for batch_id, batch in enumerate(batches):
                 if not self._width_resolved:
                     # fc/matrix-projection weights over a whole-minibatch

@@ -101,6 +101,27 @@ define_flag("fused_attention_gru", True,
             "input projection hoists out of the scan, weight grads are "
             "post-scan einsums) instead of the generic per-layer scan body; "
             "non-matching steps always use the generic path")
+define_flag("cache_pass_in_mem", False,
+            "device-resident pass cache (the TPU-native CacheType."
+            "CACHE_PASS_IN_MEM, reference PyDataProvider2.cpp:69): epoch 1 "
+            "captures every staged batch in its wire form (uint8 stays "
+            "uint8 — ~1 byte/px of HBM; normalize stays fused in the step) "
+            "and every later epoch replays it from HBM with a reproducible "
+            "on-device jax.random.permutation shuffle — zero H2D traffic, "
+            "repeat-epoch training goes compute-bound.  @provider(cache="
+            "CacheType.CACHE_PASS_IN_MEM) configs opt in with zero edits; "
+            "this flag forces it for any reader")
+define_flag("data_echo_factor", 1,
+            "train each epoch-1 batch N times back-to-back (data echo) so "
+            "the H2D-bound first epoch amortizes every transfer N-fold; "
+            "1 = off.  Applies whenever the pass cache is enabled")
+define_flag("pass_cache_hbm_budget_mb", 4096,
+            "PER-DEVICE HBM budget for the device-resident pass cache; a "
+            "pass that does not fit falls back to streaming with a "
+            "warning.  Sizing rule: budget >= n_samples x bytes_per_sample "
+            "in wire form / data-axis size (uint8 224x224x3 ~ 0.15 "
+            "MB/image; a batch sharded over n chips counts its largest "
+            "per-device shard)")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

@@ -1,0 +1,483 @@
+"""Device-resident pass cache + data echo (reader/pass_cache.py — the
+TPU-native CACHE_PASS_IN_MEM, reference PyDataProvider2.cpp:69).
+
+Covers: cached-vs-streamed training parity (identical trained parameters for
+the same batch order), HBM-budget overflow falling back to streaming with a
+warning, per-bucket composition with ``use_bucketing``, data echo, shuffle
+reproducibility from the pass seed, and the v1 zero-edit face — a reference-
+style config whose ``@provider(cache=CacheType.CACHE_PASS_IN_MEM)`` rides
+through ``parse_config``/``make_batched_reader`` into the trainer's device
+cache.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor, batch_shape_key
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.reader.pass_cache import PassCache, batch_nbytes
+from paddle_tpu.utils.flags import reset_flags, set_flag
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags():
+    yield
+    reset_flags()
+
+
+def _device_batch(seed=0, b=4, d=6):
+    import jax
+
+    rng = np.random.RandomState(seed)
+    return {
+        "x": SeqTensor(jax.device_put(rng.randn(b, d).astype(np.float32))),
+        "y": SeqTensor(jax.device_put(rng.randint(0, 3, b).astype(np.int32))),
+    }
+
+
+# ---------------------------------------------------------------------------
+# PassCache unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_capture_seal_replay_roundtrip():
+    cache = PassCache(seed=3)
+    batches = [_device_batch(i) for i in range(5)]
+    consumed = list(cache.capture(iter(batches)))
+    assert consumed == batches  # echo off: pass-through
+    assert cache.ready and cache.n_batches == 5
+    assert cache.nbytes == sum(batch_nbytes(b) for b in batches)
+    replay = list(cache.epoch(1))
+    # a permutation of the SAME device batches (by identity, no copies)
+    assert sorted(map(id, replay)) == sorted(map(id, batches))
+
+
+def test_epoch_order_reproducible_from_pass_seed():
+    batches = [_device_batch(i) for i in range(8)]
+    a, b = PassCache(seed=7), PassCache(seed=7)
+    for x in batches:
+        a.observe(x)
+        b.observe(x)
+    a.seal(), b.seal()
+    assert a.epoch_order(1) == b.epoch_order(1)  # same seed+pass = same order
+    assert a.epoch_order(1) == a.epoch_order(1)  # stable across calls
+    assert a.epoch_order(1) != a.epoch_order(2)  # passes decorrelate
+    c = PassCache(seed=7, shuffle=False)
+    for x in batches:
+        c.observe(x)
+    c.seal()
+    assert c.epoch_order(1) == list(range(8))
+
+
+def test_hbm_budget_overflow_falls_back_to_streaming(caplog):
+    batches = [_device_batch(i) for i in range(4)]
+    per = batch_nbytes(batches[0])
+    cache = PassCache(hbm_budget_bytes=2 * per + per // 2, echo_factor=1)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.pass_cache"):
+        consumed = list(cache.capture(iter(batches)))
+    assert consumed == batches  # training itself is untouched
+    assert not cache.active and not cache.ready
+    assert cache.n_batches == 0 and cache.nbytes == 0  # references released
+    assert any("falling back to streaming" in r.message for r in caplog.records)
+
+
+def test_data_echo_repeats_first_epoch_batches():
+    batches = [_device_batch(i) for i in range(3)]
+    cache = PassCache(echo_factor=3)
+    consumed = list(cache.capture(iter(batches)))
+    assert len(consumed) == 9
+    for i, b in enumerate(batches):
+        assert all(x is b for x in consumed[3 * i : 3 * i + 3])
+    assert cache.ready and cache.n_batches == 3  # cached once, trained 3x
+
+
+def test_sample_shuffle_permutes_rows_consistently_across_slots():
+    import jax
+
+    b, d = 8, 4
+    data = np.arange(b * d, dtype=np.float32).reshape(b, d)
+    lens = np.arange(b, dtype=np.int32) + 1
+    batch = {
+        "w": SeqTensor(
+            jax.device_put(data), jax.device_put(lens)
+        ),
+        "y": SeqTensor(jax.device_put(np.arange(b, dtype=np.int32))),
+    }
+    cache = PassCache(seed=5, sample_shuffle=True)
+    cache.observe(batch)
+    cache.seal()
+    (out,) = list(cache.epoch(2))
+    w, y = np.asarray(out["w"].data), np.asarray(out["y"].data)
+    wl = np.asarray(out["w"].lengths)
+    assert sorted(y.tolist()) == list(range(b))  # a permutation, no loss
+    assert y.tolist() != list(range(b))  # and it actually shuffled
+    for row, sample_id in enumerate(y):
+        # every slot (data, lengths, label) moved together
+        np.testing.assert_array_equal(w[row], data[sample_id])
+        assert wl[row] == lens[sample_id]
+    # reproducibility: a fresh cache with the same seed replays identically
+    c2 = PassCache(seed=5, sample_shuffle=True)
+    c2.observe(batch)
+    c2.seal()
+    (rep,) = list(c2.epoch(2))
+    np.testing.assert_array_equal(np.asarray(rep["y"].data), y)
+
+
+def test_abandoned_capture_restarts_clean():
+    cache = PassCache()
+    gen = cache.capture(iter([_device_batch(0), _device_batch(1)]))
+    next(gen)  # abandon mid-pass
+    gen.close()
+    assert not cache.ready and cache.n_batches == 1
+    list(cache.capture(iter([_device_batch(2), _device_batch(3)])))
+    assert cache.ready and cache.n_batches == 2  # no mixed partial pass
+
+
+# ---------------------------------------------------------------------------
+# trainer integration
+# ---------------------------------------------------------------------------
+
+
+def _dense_model():
+    reset_auto_names()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(6))
+    h = paddle.layer.fc(x, size=8, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(h, size=3, act=paddle.activation.Softmax())
+    y = paddle.layer.data("y", paddle.data_type.integer_value(3))
+    return paddle.layer.classification_cost(input=pred, label=y)
+
+
+def _dense_samples(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.randn(6).astype(np.float32), int(rng.randint(3)))
+        for _ in range(n)
+    ]
+
+
+def _train(reader, num_passes, collect=None, seed=0):
+    cost = _dense_model()
+    params = paddle.parameters.create(cost, seed=0)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params, seed=seed,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+    )
+    tr.train(
+        reader=reader, num_passes=num_passes,
+        event_handler=collect or (lambda e: None), async_load_data=False,
+    )
+    return tr
+
+
+def test_cached_vs_streamed_training_parity():
+    """Same batches via the device cache vs plain streaming produce
+    IDENTICAL trained parameters (acceptance criterion): run the cached
+    trainer, read back the replay order its cache actually used, then
+    stream exactly that order through an uncached trainer."""
+    import jax
+
+    samples = _dense_samples(16)
+    batches = [samples[i : i + 4] for i in range(0, 16, 4)]
+
+    def reader():
+        yield from samples
+
+    set_flag("cache_pass_in_mem", True)
+    cached = _train(paddle.batch(reader, 4), num_passes=3)
+    cache = cached._pass_cache
+    assert cache is not None and cache.ready and cache.n_batches == 4
+    orders = [cache.epoch_order(p) for p in (1, 2)]
+
+    reset_flags()
+    calls = {"n": 0}
+
+    def replay_reader():
+        i = calls["n"]
+        calls["n"] += 1
+        order = list(range(4)) if i == 0 else orders[i - 1]
+        for bi in order:
+            yield from batches[bi]
+
+    streamed = _train(paddle.batch(replay_reader, 4), num_passes=3)
+    assert streamed._pass_cache is None
+    for name in cached.parameters.params:
+        for k, a in cached.parameters.params[name].items():
+            np.testing.assert_array_equal(
+                np.asarray(a),
+                np.asarray(streamed.parameters.params[name][k]),
+                err_msg=f"{name}.{k} diverged between cached and streamed",
+            )
+
+
+def test_trainer_overflow_streams_every_pass(caplog):
+    samples = _dense_samples(16)
+
+    def reader():
+        yield from samples
+
+    set_flag("cache_pass_in_mem", True)
+    set_flag("pass_cache_hbm_budget_mb", 0)  # nothing fits
+    events = []
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.pass_cache"):
+        tr = _train(
+            paddle.batch(reader, 4), num_passes=2,
+            collect=lambda e: events.append(e)
+            if isinstance(e, paddle.event.EndIteration) else None,
+        )
+    assert any("falling back to streaming" in r.message for r in caplog.records)
+    assert tr._pass_cache is not None and not tr._pass_cache.active
+    assert len(events) == 8  # both passes trained, streamed
+
+
+def test_trainer_data_echo_first_pass_only():
+    samples = _dense_samples(16)
+
+    def reader():
+        yield from samples
+
+    set_flag("cache_pass_in_mem", True)
+    set_flag("data_echo_factor", 2)
+    events = []
+    tr = _train(
+        paddle.batch(reader, 4), num_passes=2,
+        collect=lambda e: events.append(e.batch_id)
+        if isinstance(e, paddle.event.EndIteration) else None,
+    )
+    # pass 0: 4 batches x echo 2 = 8 iterations; pass 1: cached replay, 4
+    assert len(events) == 12
+    assert tr._pass_cache.ready and tr._pass_cache.n_batches == 4
+
+
+def test_single_pass_run_retains_nothing_but_still_echoes():
+    """num_passes=1 can never replay, so the trainer must NOT pin the pass
+    in HBM — and data echo (which needs only the batch in hand) still
+    applies to the one pass."""
+    samples = _dense_samples(16)
+
+    def reader():
+        yield from samples
+
+    set_flag("cache_pass_in_mem", True)
+    tr = _train(paddle.batch(reader, 4), num_passes=1)
+    assert tr._pass_cache is None  # no retention for a single-pass run
+
+    set_flag("data_echo_factor", 2)
+    events = []
+    tr2 = _train(
+        paddle.batch(reader, 4), num_passes=1,
+        collect=lambda e: events.append(e)
+        if isinstance(e, paddle.event.EndIteration) else None,
+    )
+    assert tr2._pass_cache is None
+    assert len(events) == 8  # 4 batches x echo 2, zero batches retained
+
+
+def test_cache_reused_across_train_calls_same_reader():
+    """The cache lives with its data source (reference CACHE_PASS_IN_MEM
+    semantics): a second train() with the SAME reader object replays
+    immediately — even its first pass pays zero H2D; a different reader
+    frees the stale pass."""
+    samples = _dense_samples(16)
+
+    def reader():
+        yield from samples
+
+    rd = paddle.batch(reader, 4)
+    set_flag("cache_pass_in_mem", True)
+    cost = _dense_model()
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=paddle.parameters.create(cost, seed=0), seed=0,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+    )
+    tr.train(reader=rd, num_passes=2, async_load_data=False)
+    first = tr._pass_cache
+    assert first.ready and first.n_batches == 4
+    # same reader object, even a single pass: replayed from the held cache
+    tr.train(reader=rd, num_passes=1, async_load_data=False)
+    assert tr._pass_cache is first and first.ready
+    # a different reader: the stale pass is freed before re-capture
+    rd2 = paddle.batch(reader, 4)
+    tr.train(reader=rd2, num_passes=2, async_load_data=False)
+    assert tr._pass_cache is not first
+    assert not first.active and first.n_batches == 0  # dropped
+    assert tr._pass_cache.ready and tr._pass_cache.n_batches == 4
+
+
+def test_pass_cache_composes_with_use_bucketing():
+    """Variable-length corpus under use_bucketing: the cache captures the
+    per-rung batch shapes as-is (per-bucket caching) and the cached epoch
+    replays the same shape multiset interleaved across rungs."""
+    reset_auto_names()
+    w = paddle.layer.data(
+        "w", paddle.data_type.integer_value_sequence(30)
+    )
+    emb = paddle.layer.embedding(w, size=4)
+    pooled = paddle.layer.last_seq(emb)
+    pred = paddle.layer.fc(pooled, size=2, act=paddle.activation.Softmax())
+    y = paddle.layer.data("y", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+
+    rng = np.random.RandomState(1)
+    samples = [
+        ([int(t) for t in rng.randint(1, 30, size=l)], int(l % 2))
+        for l in rng.randint(2, 60, size=64)
+    ]
+
+    from paddle_tpu.reader.bucketing import token_budget_batch
+
+    set_flag("cache_pass_in_mem", True)
+    set_flag("use_bucketing", True)
+    params = paddle.parameters.create(cost, seed=0)
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=params,
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-2),
+    )
+    tr.train(
+        reader=token_budget_batch(
+            lambda: iter(samples), token_budget=128, drop_last=True
+        ),
+        num_passes=3,
+        async_load_data=False,
+    )
+    cache = tr._pass_cache
+    assert cache is not None and cache.ready
+    assert cache.n_buckets > 1, "corpus should span several ladder rungs"
+    captured = sorted(
+        batch_shape_key(b) for b in cache._batches
+    )
+    replayed = sorted(batch_shape_key(b) for b in cache.epoch(2))
+    assert captured == replayed
+
+
+# ---------------------------------------------------------------------------
+# v1 zero-edit face
+# ---------------------------------------------------------------------------
+
+
+def test_provider_cache_tag_propagates_through_batchers():
+    from paddle_tpu.data_provider import CacheType, integer_value, provider
+    from paddle_tpu.reader.bucketing import token_budget_batch
+
+    @provider(
+        input_types=[integer_value(4)], cache=CacheType.CACHE_PASS_IN_MEM,
+        should_shuffle=False,
+    )
+    def proc(settings, f):
+        for i in range(8):
+            yield (i % 4,)
+
+    rd = proc()
+    assert getattr(rd, "cache_pass_in_mem", False)
+    assert getattr(paddle.batch(rd, 2), "cache_pass_in_mem", False)
+    assert getattr(
+        token_budget_batch(rd, token_budget=8), "cache_pass_in_mem", False
+    )
+
+    @provider(input_types=[integer_value(4)], should_shuffle=False)
+    def proc_nocache(settings, f):
+        yield (0,)
+
+    assert not getattr(proc_nocache(), "cache_pass_in_mem", False)
+    assert not getattr(
+        paddle.batch(proc_nocache(), 2), "cache_pass_in_mem", False
+    )
+
+
+def test_should_shuffle_false_replays_in_capture_order():
+    """A should_shuffle=False provider (ordered/curriculum data) must replay
+    cached epochs in capture order — the shuffle intent rides the reader tag
+    into the trainer's PassCache."""
+    from paddle_tpu.data_provider import CacheType, integer_value, provider
+    from paddle_tpu.reader.bucketing import token_budget_batch
+
+    def make(should_shuffle):
+        @provider(
+            input_types=[integer_value(4)],
+            cache=CacheType.CACHE_PASS_IN_MEM,
+            should_shuffle=should_shuffle,
+        )
+        def proc(settings, f):
+            yield (0,)
+
+        return proc()
+
+    ordered = make(False)
+    assert ordered.cache_pass_shuffle is False
+    assert paddle.batch(ordered, 2).cache_pass_shuffle is False
+    assert token_budget_batch(ordered, token_budget=8).cache_pass_shuffle is False
+    assert make(True).cache_pass_shuffle is True
+
+    # end-to-end: the trainer's cache honors it
+    samples = _dense_samples(16)
+
+    def reader():
+        yield from samples
+
+    rd = paddle.batch(reader, 4)
+    rd.cache_pass_in_mem = True
+    rd.cache_pass_shuffle = False
+    tr = _train(rd, num_passes=3)
+    cache = tr._pass_cache
+    assert cache.ready and not cache.shuffle
+    assert cache.epoch_order(1) == [0, 1, 2, 3]
+    assert cache.epoch_order(2) == [0, 1, 2, 3]
+
+
+def test_v1_config_cache_pass_in_mem_run_sweep(tmp_path):
+    """A reference-style config whose provider declares
+    cache=CacheType.CACHE_PASS_IN_MEM trains through the v1 face with ZERO
+    edits and lands in the device cache: parse_config -> make_batched_reader
+    (tag propagated) -> SGD.train captures pass 1 and replays pass 2 from
+    HBM."""
+    from paddle_tpu.v1_compat import (
+        make_batched_reader,
+        make_optimizer,
+        parse_config,
+    )
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "define_py_data_sources2(train_list='t', test_list=None,\n"
+        "                        module='cache_prov', obj='process')\n"
+        "settings(batch_size=4, learning_rate=1e-3,\n"
+        "         learning_method=MomentumOptimizer())\n"
+        "img = data_layer(name='pixel', size=12)\n"
+        "lbl = data_layer(name='label', size=3)\n"
+        "fc1 = fc_layer(input=img, size=3, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=fc1, label=lbl))\n"
+    )
+    (tmp_path / "cache_prov.py").write_text(
+        "from paddle.trainer.PyDataProvider2 import *\n"
+        "@provider(input_types=[dense_vector(12), integer_value(3)],\n"
+        "          cache=CacheType.CACHE_PASS_IN_MEM, should_shuffle=False)\n"
+        "def process(settings, f):\n"
+        "    for i in range(16):\n"
+        "        yield [0.125 * (i % 8)] * 12, i % 3\n"
+    )
+    (tmp_path / "t").write_text("dummy\n")
+    p = parse_config(str(cfg))
+    reader = make_batched_reader(
+        p, str(tmp_path), p.settings.batch_size, train=True
+    )
+    assert getattr(reader, "cache_pass_in_mem", False), (
+        "CACHE_PASS_IN_MEM must survive the v1 reader pipeline untagged-free"
+    )
+    params = paddle.parameters.create(p.topology, seed=0)
+    tr = paddle.trainer.SGD(
+        cost=p.topology, parameters=params,
+        update_equation=make_optimizer(p.settings),
+    )
+    events = []
+    tr.train(
+        reader=reader, num_passes=2, feeding=p.feeding,
+        event_handler=lambda e: events.append(e)
+        if isinstance(e, paddle.event.EndIteration) else None,
+        async_load_data=False,
+    )
+    cache = tr._pass_cache
+    assert cache is not None and cache.ready and cache.n_batches == 4
+    assert len(events) == 8  # pass 1 streamed+captured, pass 2 replayed
