@@ -1,19 +1,37 @@
 # Test/verify entry points (the reference's build-scripts plane,
 # paddle/scripts/travis/, as make targets).
 #
-#   make test    — fast tier: every test not marked `slow`; < 5 min on the
-#                  virtual 8-device CPU mesh.  This is the default CI gate.
+#   make lint    — static analysis: AST self-lint over paddle_tpu + bench.py
+#                  (analysis/ast_rules) and graph-lint over every shipped
+#                  demo config (tests/configs/).  Zero findings = pass.
+#   make test    — fast tier: lint, then every test not marked `slow`;
+#                  < 6 min on the virtual 8-device CPU mesh.  The CI gate.
 #   make verify  — the full suite, then a bench smoke (one metric) and the
 #                  8-device multichip dry-run compile.
 #   make bench   — the full benchmark set (one JSON line per metric).
+#   make tier1-check / tier1-update — diff (or re-snapshot) the tier-1
+#                  failing-test SET against tests/tier1_failures_baseline.txt
+#                  (scripts/tier1_failset.py), so CI catches a newly broken
+#                  test even when another fix keeps the count unchanged.
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test verify bench test-all
+.PHONY: test verify bench test-all lint tier1-check tier1-update
 
-test:
+lint:
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
+	$(CPU_ENV) $(PY) -m paddle_tpu lint \
+		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
+
+test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
+
+tier1-check:
+	$(CPU_ENV) $(PY) scripts/tier1_failset.py --check
+
+tier1-update:
+	$(CPU_ENV) $(PY) scripts/tier1_failset.py --update
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -584,14 +584,18 @@ def bench_nmt_generate() -> dict:
             jax.device_put(np.full((b,), src_len, np.int32)),
         )
     }
-    fn = jax.jit(lambda bt: gen.generate(bt))
-    fn, flops = _aot(fn, batch)
-    seqs, scores = fn(batch)
+    # weights ride as an ARGUMENT, not a trace-time closure constant
+    # (analysis.trace_lint T102: closure-captured params can't be donated
+    # and re-ship with every compile)
+    gp = params.params
+    fn = jax.jit(lambda p, bt: gen.generate(bt, params=p))
+    fn, flops = _aot(fn, gp, batch)
+    seqs, scores = fn(gp, batch)
     float(np.asarray(scores)[0, 0])  # device sync
     iters = 8
     t0 = time.perf_counter()
     for _ in range(iters):
-        seqs, scores = fn(batch)
+        seqs, scores = fn(gp, batch)
     float(np.asarray(scores)[0, 0])
     dt = (time.perf_counter() - t0) / iters
     # emitted top-beam tokens (eos-terminated) per second

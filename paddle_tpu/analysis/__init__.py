@@ -1,0 +1,57 @@
+"""Static analysis — config-time diagnostics and jaxpr-level TPU hazard
+checks (the reference ``config_parser.py`` config_assert plane, grown into
+three passes over the trace-time graph stack):
+
+  * :mod:`~paddle_tpu.analysis.graph_lint` — abstract shape/dtype/arity
+    propagation over the Topology IR before any trace (rules ``G###``);
+  * :mod:`~paddle_tpu.analysis.trace_lint` — jaxpr inspection of the
+    compiled step for TPU hazards: f64 leaks, closure-captured weights,
+    host callbacks, recompile churn (rules ``T###``);
+  * :mod:`~paddle_tpu.analysis.ast_rules` — self-lint of paddle_tpu's own
+    source for trace-time discipline (rules ``A###``).
+
+All passes share one diagnostic model (rule id, severity, layer/file
+provenance, fix hint — :mod:`~paddle_tpu.analysis.diagnostics`) and are
+wired into the CLI as ``paddle-tpu lint`` / ``make lint``.
+"""
+
+from paddle_tpu.analysis.ast_rules import lint_file, lint_package
+from paddle_tpu.analysis.diagnostics import (
+    Diagnostic,
+    DiagnosticError,
+    Severity,
+    config_assert,
+    errors,
+    format_diagnostics,
+    raise_if_errors,
+)
+from paddle_tpu.analysis.graph_lint import (
+    attr_key_universe,
+    lint_parsed,
+    lint_topology,
+)
+from paddle_tpu.analysis.trace_lint import (
+    lint_jaxpr,
+    lint_step,
+    recompile_audit,
+    trace_step,
+)
+
+__all__ = [
+    "Diagnostic",
+    "DiagnosticError",
+    "Severity",
+    "attr_key_universe",
+    "config_assert",
+    "errors",
+    "format_diagnostics",
+    "lint_file",
+    "lint_jaxpr",
+    "lint_package",
+    "lint_parsed",
+    "lint_step",
+    "lint_topology",
+    "raise_if_errors",
+    "recompile_audit",
+    "trace_step",
+]

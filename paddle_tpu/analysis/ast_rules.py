@@ -1,0 +1,297 @@
+"""Self-lint: custom AST rules over paddle_tpu's own source.
+
+The graph and trace linters check the user's model; these rules check *us* —
+the host-side Python that builds and drives the traced program.  They encode
+the trace-time discipline jax demands (everything outside jnp is frozen into
+the jaxpr at trace time) plus repo invariants the runtime can't check early.
+
+Rules (``A###``):
+
+  A201 time-in-jit        ``time.time()``-family calls inside a function
+                          traced by ``jax.jit`` — the value is baked in at
+                          trace time and never ticks again
+  A202 host-rng-in-jit    ``random.*`` / ``np.random.*`` sampling inside a
+                          jitted function — one draw at trace time, the
+                          same "random" constant every step (use
+                          ``jax.random`` with a threaded key)
+  A203 unseeded-reader-rng  direct global-module ``random.X(...)`` /
+                          ``np.random.X(...)`` sampling in reader/dataset
+                          modules — reader order becomes irreproducible and
+                          immune to the ``seed`` flag (thread an explicit
+                          ``rng`` / ``random.Random(seed)``)
+  A204 duplicate-flag     the same flag name registered twice via
+                          ``define_flag`` (the loser silently wins; see
+                          utils/flags.py re-registration guard)
+
+Run via :func:`lint_package` (the ``paddle-tpu lint`` CLI / ``make lint``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+
+_TIME_FNS = frozenset({
+    "time", "perf_counter", "monotonic", "process_time", "time_ns",
+    "perf_counter_ns", "monotonic_ns",
+})
+
+# numpy/np-module RNG samplers + `random` module samplers; seeding calls and
+# generator constructors are fine (they are how you FIX the finding)
+_RNG_OK = frozenset({"RandomState", "default_rng", "Random", "seed", "SeedSequence"})
+
+# reader-plane modules for A203 (package-relative path prefixes)
+_READER_PREFIXES = ("reader" + os.sep, "dataset" + os.sep)
+
+
+def _name_of(node: ast.AST) -> Optional[str]:
+    """Dotted name of an expression like ``np.random.rand`` -> that string."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _jit_aliases(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(names bound to jax.jit itself, module aliases of jax) — so both
+    ``jax.jit(f)`` and ``from jax import jit; jit(f)`` are recognized."""
+    jit_names: Set[str] = set()
+    jax_mods: Set[str] = {"jax"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    jax_mods.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "jit":
+                        jit_names.add(a.asname or "jit")
+    return jit_names, jax_mods
+
+
+def _is_jit_expr(node: ast.AST, jit_names: Set[str], jax_mods: Set[str]) -> bool:
+    """True for ``jax.jit``, a bare jit alias, or ``partial(jax.jit, ...)``."""
+    dotted = _name_of(node)
+    if dotted is not None:
+        if dotted in jit_names:
+            return True
+        head, _, tail = dotted.rpartition(".")
+        if tail == "jit" and head in jax_mods:
+            return True
+    if isinstance(node, ast.Call):  # partial(jax.jit, ...) decorator form
+        fn = _name_of(node.func)
+        if fn in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0], jit_names, jax_mods)
+    return False
+
+
+def _jitted_functions(tree: ast.Module) -> Tuple[List[ast.AST], List[ast.Lambda]]:
+    """FunctionDefs traced by jax.jit in this module: decorated with it, or
+    passed to it by name (``jax.jit(step, ...)`` — the trainer/step builder
+    idiom).  By-name resolution is SCOPE-AWARE: ``jax.jit(step)`` binds to
+    the innermost ``def step`` visible from the call site (longest enclosing
+    scope prefix), not to every same-named def in the module — two factories
+    each defining a local ``step`` where only one is jitted must not flag
+    the other.  Lambdas passed inline come back separately."""
+    jit_names, jax_mods = _jit_aliases(tree)
+    lambdas: List[ast.Lambda] = []
+    funcs: List[ast.AST] = []
+    # (scope path where DEFINED, name, node) / (scope path of the CALL, name)
+    defs: List[Tuple[Tuple[str, ...], str, ast.AST]] = []
+    calls: List[Tuple[Tuple[str, ...], str]] = []
+
+    def walk(node: ast.AST, scope: Tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    _is_jit_expr(d, jit_names, jax_mods)
+                    for d in child.decorator_list
+                ):
+                    funcs.append(child)
+                defs.append((scope, child.name, child))
+                walk(child, scope + (child.name,))
+            else:
+                if isinstance(child, ast.Call) and _is_jit_expr(
+                    child.func, jit_names, jax_mods
+                ) and child.args:
+                    arg = child.args[0]
+                    if isinstance(arg, ast.Name):
+                        calls.append((scope, arg.id))
+                    elif isinstance(arg, ast.Lambda):
+                        lambdas.append(arg)
+                walk(child, scope)
+
+    walk(tree, ())
+
+    for cscope, name in calls:
+        best = None
+        for dscope, dname, dnode in defs:
+            if dname != name or dscope != cscope[: len(dscope)]:
+                continue  # not this name / not visible from the call site
+            if best is None or len(dscope) > len(best[0]):
+                best = (dscope, dnode)
+        if best is not None and best[1] not in funcs:
+            funcs.append(best[1])
+    return funcs, lambdas
+
+
+def _host_rng_heads(tree: ast.Module) -> Set[str]:
+    """Dotted-name heads that denote HOST RNG modules in this file.  Only
+    an actual ``import random`` binds the bare name ``random`` to the
+    stdlib module — ``from jax import random`` binds the (key-threaded,
+    jit-safe) jax namespace to the same name and must NOT flag."""
+    heads: Set[str] = {"np.random", "numpy.random"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "random":
+                    heads.add(a.asname or "random")
+                elif a.name == "numpy.random":
+                    heads.add(a.asname or "numpy.random")
+    return heads
+
+
+def _scan_traced_body(body: ast.AST, relpath: str, diags: List[Diagnostic],
+                      owner: str, rng_heads: Set[str]) -> None:
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _name_of(node.func)
+        if dotted is None:
+            continue
+        head, _, tail = dotted.rpartition(".")
+        if head == "time" and tail in _TIME_FNS:
+            diags.append(Diagnostic(
+                rule="A201", severity=Severity.ERROR,
+                message=f"`{dotted}()` inside jit-traced function "
+                f"{owner!r} — evaluated once at trace time, constant "
+                "forever after",
+                source=relpath, line=node.lineno,
+                hint="time on the host around the dispatch "
+                "(utils.timers.stat_timer), never inside the traced step",
+            ))
+        elif head in rng_heads and tail not in _RNG_OK:
+            diags.append(Diagnostic(
+                rule="A202", severity=Severity.ERROR,
+                message=f"`{dotted}(...)` inside jit-traced function "
+                f"{owner!r} — drawn once at trace time, the same value "
+                "every step",
+                source=relpath, line=node.lineno,
+                hint="use jax.random with a key threaded through the step "
+                "(ApplyContext.layer_rng)",
+            ))
+
+
+def _scan_reader_rng(tree: ast.Module, relpath: str,
+                     diags: List[Diagnostic], rng_heads: Set[str]) -> None:
+    # `import random as _random` aliases resolve; `from jax import random`
+    # does not flag (the shared _host_rng_heads resolution, same as A202)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _name_of(node.func)
+        if dotted is None:
+            continue
+        head, _, tail = dotted.rpartition(".")
+        if head in rng_heads and tail not in _RNG_OK:
+            diags.append(Diagnostic(
+                rule="A203", severity=Severity.ERROR,
+                message=f"global-RNG call `{dotted}(...)` in reader module "
+                "— sample order is irreproducible and ignores the `seed` "
+                "flag",
+                source=relpath, line=node.lineno,
+                hint="accept an explicit `rng` (random.Random/np.random."
+                "RandomState seeded from the seed flag) and sample from it",
+            ))
+
+
+def _scan_flag_defs(tree: ast.Module, relpath: str,
+                    defs: Dict[str, Tuple[str, int]],
+                    diags: List[Diagnostic]) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _name_of(node.func)
+        if dotted is None or dotted.split(".")[-1] != "define_flag":
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant):
+            continue
+        name = node.args[0].value
+        if not isinstance(name, str):
+            continue
+        if name in defs:
+            first_file, first_line = defs[name]
+            diags.append(Diagnostic(
+                rule="A204", severity=Severity.ERROR,
+                message=f"flag {name!r} registered twice (first at "
+                f"{first_file}:{first_line})",
+                source=relpath, line=node.lineno,
+                hint="reuse the existing flag or pick a distinct name; "
+                "conflicting re-registration raises at import "
+                "(utils.flags.define_flag)",
+            ))
+        else:
+            defs[name] = (relpath, node.lineno)
+
+
+def lint_file(path: str, root: Optional[str] = None,
+              _flag_defs: Optional[Dict[str, Tuple[str, int]]] = None
+              ) -> List[Diagnostic]:
+    """All AST rules over one source file."""
+    relpath = os.path.relpath(path, root) if root else path
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Diagnostic(
+            rule="A200", severity=Severity.ERROR,
+            message=f"syntax error: {e.msg}", source=relpath, line=e.lineno,
+        )]
+    diags: List[Diagnostic] = []
+    funcs, lambdas = _jitted_functions(tree)
+    rng_heads = _host_rng_heads(tree)
+    for fn in funcs:
+        _scan_traced_body(fn, relpath, diags, fn.name, rng_heads)
+    for lam in lambdas:
+        _scan_traced_body(lam, relpath, diags, "<lambda>", rng_heads)
+    if relpath.replace("paddle_tpu" + os.sep, "", 1).startswith(
+        _READER_PREFIXES
+    ) or os.sep + "dataset" + os.sep in relpath or (
+        os.sep + "reader" + os.sep in relpath
+    ):
+        _scan_reader_rng(tree, relpath, diags, rng_heads)
+    if _flag_defs is not None:
+        _scan_flag_defs(tree, relpath, _flag_defs, diags)
+    return diags
+
+
+def lint_package(root: Optional[str] = None,
+                 extra_paths: Optional[List[str]] = None) -> List[Diagnostic]:
+    """Run every AST rule over the paddle_tpu package tree (plus any
+    ``extra_paths`` files, e.g. bench.py) — the ``paddle-tpu lint`` body."""
+    if root is None:
+        import paddle_tpu
+
+        root = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+    diags: List[Diagnostic] = []
+    flag_defs: Dict[str, Tuple[str, int]] = {}
+    base = os.path.dirname(root)
+    files: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        files.extend(
+            os.path.join(dirpath, fn) for fn in sorted(filenames)
+            if fn.endswith(".py")
+        )
+    for path in sorted(files) + list(extra_paths or ()):
+        diags.extend(lint_file(path, root=base, _flag_defs=flag_defs))
+    return diags

@@ -1,0 +1,131 @@
+"""Shared diagnostic model for every static-analysis pass.
+
+The reference front-loads ~hundreds of per-layer ``config_assert`` checks in
+``config_parser.py`` (reference: python/paddle/trainer/config_parser.py:178
+``config_assert(bool, msg)`` → ``logger.fatal`` with layer provenance) so a
+bad ModelConfig dies at parse time instead of mid-training inside the gserver
+interpreter.  This module is the TPU-native equivalent's common currency: one
+:class:`Diagnostic` record (rule id, severity, layer/file provenance, fix
+hint) shared by the graph linter (``analysis.graph_lint``), the jaxpr trace
+linter (``analysis.trace_lint``) and the AST self-linter
+(``analysis.ast_rules``), plus the formatter every error path routes through
+so users always see *which layer* (or file) produced a finding.
+
+Rule-id namespaces:  ``G###`` graph lint · ``T###`` trace hygiene ·
+``A###`` AST self-lint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterable, List, Optional, Sequence
+
+
+class Severity(enum.IntEnum):
+    """INFO < WARNING < ERROR; ERROR means the graph cannot run correctly,
+    WARNING a silent perf/correctness hazard, INFO a notable observation."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # "error" not "Severity.ERROR" in output
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding.  ``layer`` carries graph provenance (dotted path for a
+    layer inside a recurrent_group sub-topology); ``source``/``line`` carry
+    file provenance (the v1 config that created the layer, or the analyzed
+    source file for AST rules); ``hint`` is the config_assert-style fix
+    suggestion."""
+
+    rule: str
+    severity: Severity
+    message: str
+    layer: Optional[str] = None
+    source: Optional[str] = None
+    line: Optional[int] = None
+    hint: Optional[str] = None
+
+    def format(self) -> str:
+        where = ""
+        if self.source:
+            where = f" --> {self.source}" + (f":{self.line}" if self.line else "")
+        head = f"{self.severity}[{self.rule}]"
+        if self.layer is not None:
+            head += f" layer {self.layer!r}"
+        out = f"{head}: {self.message}"
+        if where:
+            out += f"\n   {where}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+def format_diagnostics(diags: Sequence[Diagnostic]) -> str:
+    """Multi-finding report, errors first, with a one-line tally footer —
+    the shape of the reference's config_parser failure dump."""
+    if not diags:
+        return "no diagnostics"
+    ordered = sorted(diags, key=lambda d: (-int(d.severity), d.rule))
+    lines = [d.format() for d in ordered]
+    n_err = sum(1 for d in diags if d.severity == Severity.ERROR)
+    n_warn = sum(1 for d in diags if d.severity == Severity.WARNING)
+    lines.append(f"{len(diags)} diagnostic(s): {n_err} error(s), {n_warn} warning(s)")
+    return "\n".join(lines)
+
+
+class DiagnosticError(ValueError):
+    """Raised where the reference would ``config_assert``-abort.  Subclasses
+    ValueError so every pre-existing ``except ValueError`` / pytest.raises
+    site keeps working; carries the structured diagnostics for programmatic
+    consumers (the CLI, tests asserting rule ids)."""
+
+    def __init__(self, diagnostics):
+        if isinstance(diagnostics, Diagnostic):
+            diagnostics = [diagnostics]
+        self.diagnostics: List[Diagnostic] = list(diagnostics)
+        super().__init__(format_diagnostics(self.diagnostics))
+
+    @property
+    def rules(self) -> List[str]:
+        return [d.rule for d in self.diagnostics]
+
+
+def config_assert(
+    cond: bool,
+    rule: str,
+    message: str,
+    *,
+    layer: Optional[str] = None,
+    source: Optional[str] = None,
+    hint: Optional[str] = None,
+) -> None:
+    """The reference's ``config_assert`` (config_parser.py:178): raise a
+    :class:`DiagnosticError` with full provenance when ``cond`` is false."""
+    if not cond:
+        raise DiagnosticError(
+            Diagnostic(
+                rule=rule,
+                severity=Severity.ERROR,
+                message=message,
+                layer=layer,
+                source=source,
+                hint=hint,
+            )
+        )
+
+
+def errors(diags: Iterable[Diagnostic]) -> List[Diagnostic]:
+    return [d for d in diags if d.severity == Severity.ERROR]
+
+
+def raise_if_errors(diags: Sequence[Diagnostic]) -> None:
+    """Abort (DiagnosticError) when any ERROR-severity finding is present;
+    warnings/info never raise."""
+    errs = errors(diags)
+    if errs:
+        raise DiagnosticError(errs)

@@ -611,6 +611,66 @@ def cmd_plotcurve(argv: List[str]) -> int:
     return plot_main(argv)
 
 
+def cmd_lint(argv: List[str]) -> int:
+    """``paddle-tpu lint`` — static analysis (analysis/):
+
+    * no --config: AST self-lint over the paddle_tpu package source
+      (+ any --extra files), rules A###;
+    * --config=conf.py: parse the v1 config and graph-lint its topology
+      (rules G###) with layer + config provenance.
+
+    Exit 0 only when no diagnostics fire (``make lint``'s contract)."""
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu lint",
+        description="config-time graph lint + package self-lint "
+        "(the reference config_parser's config_assert plane)",
+    )
+    ap.add_argument("--config", action="append", default=[],
+                    help="v1 config file to graph-lint (repeatable; one "
+                    "process lints the whole corpus; skips the self-lint)")
+    ap.add_argument("--config_args", default="",
+                    help="comma-separated key=value pairs for the config(s)")
+    ap.add_argument("--extra", action="append", default=[],
+                    help="extra .py files to self-lint (e.g. bench.py)")
+    ap.add_argument("--min-severity", default=None,
+                    choices=["info", "warning", "error"],
+                    help="only report findings at or above this severity")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import analysis
+
+    diags = []
+    if args.config:
+        from paddle_tpu.v1_compat import parse_config
+
+        for cfg in args.config:
+            if len(args.config) > 1:
+                print(f"graph-lint {cfg}")
+            try:
+                parsed = parse_config(os.path.abspath(cfg), args.config_args)
+            except analysis.DiagnosticError as e:
+                # build-time findings (duplicate names, feed-slot errors)
+                # report like any other lint result, not as a traceback —
+                # re-homed onto this config so the merged report attributes
+                # them to the right file
+                import dataclasses as _dc
+
+                diags.extend(
+                    _dc.replace(d, source=cfg) for d in e.diagnostics
+                )
+                continue
+            diags.extend(analysis.lint_parsed(parsed))
+    else:
+        diags = analysis.lint_package(extra_paths=args.extra)
+
+    if args.min_severity:
+        floor = analysis.Severity[args.min_severity.upper()]
+        diags = [d for d in diags if d.severity >= floor]
+
+    print(analysis.format_diagnostics(diags))
+    return 1 if diags else 0
+
+
 _COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
@@ -618,6 +678,7 @@ _COMMANDS = {
     "make_diagram": cmd_make_diagram,
     "merge_model": cmd_merge_model,
     "plotcurve": cmd_plotcurve,
+    "lint": cmd_lint,
 }
 
 
@@ -632,6 +693,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    make_diagram      write a Graphviz diagram of a config")
         print("    merge_model       bundle config + parameters into one file")
         print("    plotcurve         plot training curves from a log")
+        print("    lint              static analysis: graph-lint a config, or")
+        print("                      self-lint the package source")
         return 0 if argv else 1
     cmd, rest = argv[0], argv[1:]
     if cmd not in _COMMANDS:

@@ -137,14 +137,36 @@ def _set_path(d, path, v):
     cur[path[-1]] = v
 
 
+def _share_conflict_error(message: str, layer: str):
+    """Parameter-sharing conflict in the shared diagnostic format (rule
+    G006 — same id the graph linter reports for these, so config-time and
+    build-time findings read identically).  DiagnosticError subclasses
+    ValueError: every existing except/raises site keeps working."""
+    from paddle_tpu.analysis.diagnostics import (
+        Diagnostic,
+        DiagnosticError,
+        Severity,
+    )
+
+    return DiagnosticError(Diagnostic(
+        rule="G006",
+        severity=Severity.ERROR,
+        layer=layer,
+        message=message,
+        hint="give the parameters distinct ParamAttr names, or align the "
+        "declaring layers' shapes/forms",
+    ))
+
+
 def _mixed_forms_error(key_owners, g, path, decl) -> ValueError:
     """Mixed whole-layer/per-key declaration of one global parameter name."""
     ol, ok, owhole = key_owners[g]
     kind = "whole-layer inside a recurrent_group" if owhole else "per-key"
-    return ValueError(
+    return _share_conflict_error(
         f"parameter name {g!r} is declared {decl} by {'.'.join(path)!r} but "
         f"{kind} by {ol!r}.{'.'.join(ok)!r}; sharing across the two forms "
-        "is not supported — use distinct names"
+        "is not supported — use distinct names",
+        ".".join(path),
     )
 
 
@@ -286,11 +308,12 @@ class CompiledNetwork:
                     # legacy one-parameter layer inside a group: share its
                     # whole inner dict at `rel`
                     if pname in owners:
-                        raise ValueError(
+                        raise _share_conflict_error(
                             f"parameter name {pname!r} is declared whole-layer "
                             f"both at top level ({owners[pname]!r}) and inside "
                             f"a recurrent_group ({'.'.join(path)!r}); use "
-                            "distinct names"
+                            "distinct names",
+                            ".".join(path),
                         )
                     if pname in key_owners and not key_owners[pname][2]:
                         raise _mixed_forms_error(
@@ -309,11 +332,12 @@ class CompiledNetwork:
                 if not gname:
                     continue
                 if gname in owners:
-                    raise ValueError(
+                    raise _share_conflict_error(
                         f"parameter name {gname!r} is declared per-key by "
                         f"{'.'.join(path)!r}.{key!r} but whole-layer by "
                         f"{owners[gname]!r}; sharing across the two layer "
-                        "kinds is not supported — use distinct names"
+                        "kinds is not supported — use distinct names",
+                        ".".join(path),
                     )
                 if gname in key_owners and key_owners[gname][2]:
                     raise _mixed_forms_error(key_owners, gname, path, "per-key")
@@ -364,10 +388,11 @@ class CompiledNetwork:
                 want = jax.tree_util.tree_map(jnp.shape, p)
                 have = jax.tree_util.tree_map(jnp.shape, params.get(owner, {}))
                 if want != have:
-                    raise ValueError(
-                        f"layer {name!r} shares parameter "
-                        f"{conf.attr('param_name')!r} with {owner!r} but "
-                        f"expects shapes {want} != owner's {have}"
+                    raise _share_conflict_error(
+                        f"shares parameter {conf.attr('param_name')!r} with "
+                        f"{owner!r} but expects shapes {want} != owner's "
+                        f"{have}",
+                        name,
                     )
                 continue
             for relpath, (ol, orel) in self._shared_keys.get(name, {}).items():
@@ -379,10 +404,11 @@ class CompiledNetwork:
                 want = jax.tree_util.tree_map(jnp.shape, mine)
                 have = jax.tree_util.tree_map(jnp.shape, owner_val)
                 if want != have:
-                    raise ValueError(
-                        f"layer {name!r} parameter {'.'.join(relpath)!r} "
-                        f"shares storage with {ol!r}.{'.'.join(orel)!r} but "
-                        f"expects shapes {want} != owner's {have}"
+                    raise _share_conflict_error(
+                        f"parameter {'.'.join(relpath)!r} shares storage "
+                        f"with {ol!r}.{'.'.join(orel)!r} but expects shapes "
+                        f"{want} != owner's {have}",
+                        name,
                     )
                 _del_path(p, relpath)
             if p:
@@ -625,16 +651,41 @@ class CompiledNetwork:
                 with jax.named_scope(f"{conf.type}:{name}"):
                     out = impl.apply(conf, p, ins, ctx)
             except Exception as e:
-                shapes = [getattr(t.data, "shape", None) for t in ins]
-                note = (
-                    f"while applying layer {name!r} (type={conf.type}, "
-                    f"size={conf.size}, inputs={list(conf.inputs)} with "
-                    f"shapes {shapes})"
+                # layer-provenance note in the shared diagnostic format
+                # (analysis.diagnostics) — trace-time shape errors read like
+                # the graph linter's config-time findings, naming the layer
+                from paddle_tpu.analysis.diagnostics import (
+                    Diagnostic,
+                    Severity,
                 )
+
+                shapes = [getattr(t.data, "shape", None) for t in ins]
+                note = Diagnostic(
+                    rule="T100",
+                    severity=Severity.ERROR,
+                    layer=name,
+                    message=(
+                        f"failed while applying this layer (type={conf.type}, "
+                        f"size={conf.size}, inputs={list(conf.inputs)} with "
+                        f"shapes {shapes})"
+                    ),
+                    hint="run analysis.graph_lint.lint_topology on this "
+                    "topology — most shape/arity mistakes are caught "
+                    "before tracing",
+                ).format()
                 if hasattr(e, "add_note"):  # py3.11+
                     e.add_note(note)
-                elif e.args and isinstance(e.args[0], str):
-                    e.args = (f"{e.args[0]}\n{note}",) + e.args[1:]
+                else:
+                    # py3.10: emulate PEP 678 — populate __notes__ for
+                    # introspection AND splice into args for display
+                    try:
+                        notes = list(getattr(e, "__notes__", ()) or ())
+                        notes.append(note)
+                        e.__notes__ = notes
+                    except (AttributeError, TypeError):  # pragma: no cover
+                        pass
+                    if e.args and isinstance(e.args[0], str):
+                        e.args = (f"{e.args[0]}\n{note}",) + e.args[1:]
                 raise
             if mixed and not impl.full_precision:
                 # Enforce the compute dtype at every layer boundary —

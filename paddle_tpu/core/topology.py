@@ -101,17 +101,38 @@ class Topology:
         self.outputs: Tuple[LayerOutput, ...] = tuple(outputs)
         self.layers: Dict[str, LayerConf] = {}
         order: List[str] = []
-        seen: set = set()
+        # name -> conf at FIRST sighting (before recursing into parents):
+        # duplicate detection must compare against this, not self.layers —
+        # a duplicate on an ancestor path is met while its descendant's
+        # conf is seen but not yet stored in self.layers, and comparing
+        # against the incomplete dict would silently drop the ancestor
+        seen: Dict[str, LayerConf] = {}
 
         def visit(lo: LayerOutput) -> None:
             if lo.conf.name in seen:
-                existing = self.layers.get(lo.conf.name)
+                existing = seen.get(lo.conf.name)
                 if existing is not None and existing != lo.conf:
-                    raise ValueError(
-                        f"two different layers share the name {lo.conf.name!r}"
+                    from paddle_tpu.analysis.diagnostics import (
+                        Diagnostic,
+                        DiagnosticError,
+                        Severity,
                     )
+
+                    raise DiagnosticError(Diagnostic(
+                        rule="G016",
+                        severity=Severity.ERROR,
+                        layer=lo.conf.name,
+                        message=(
+                            f"two different layers share the name "
+                            f"{lo.conf.name!r} (types "
+                            f"{existing.type!r} vs {lo.conf.type!r})"
+                        ),
+                        hint="give one of them an explicit distinct name= "
+                        "(auto_name counters reset per config; see "
+                        "reset_auto_names)",
+                    ))
                 return
-            seen.add(lo.conf.name)
+            seen[lo.conf.name] = lo.conf
             for p in lo.parents:
                 visit(p)
             self.layers[lo.conf.name] = lo.conf
@@ -155,12 +176,22 @@ class Topology:
         for name, conf in self.data_layers().items():
             why = conf.attrs.get("_v1_unresolved")
             if why:
-                raise ValueError(
-                    f"cannot feed data layer {name!r}: {why}.  Fix the "
-                    "provider (declare input_types, or make its init_hook "
-                    "runnable — e.g. fetch the dataset it reads), or feed "
-                    "through an explicit DataFeeder with the true types."
+                from paddle_tpu.analysis.diagnostics import (
+                    Diagnostic,
+                    DiagnosticError,
+                    Severity,
                 )
+
+                raise DiagnosticError(Diagnostic(
+                    rule="G011",
+                    severity=Severity.ERROR,
+                    layer=name,
+                    message=f"cannot feed data layer {name!r}: {why}",
+                    hint="fix the provider (declare input_types, or make "
+                    "its init_hook runnable — e.g. fetch the dataset it "
+                    "reads), or feed through an explicit DataFeeder with "
+                    "the true types",
+                ))
             assert conf.input_type is not None, f"data layer {name} missing input_type"
             out.append((name, conf.input_type))
         return out

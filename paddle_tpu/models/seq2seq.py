@@ -308,12 +308,17 @@ class Seq2SeqGenerator:
 
         return step_fn
 
-    def _prepare(self, batch):
+    def _prepare(self, batch, params=None):
         # materialize once per batch: the pruned encoder net and the decoder
         # sub-network were compiled without the full net's sharing maps, so
         # shared keys (tied embeddings, ...) must be grafted back before
-        # either reads params by layer name
-        gp = self.net.materialize_shared(self.params.params)
+        # either reads params by layer name.  `params` lets a jitted caller
+        # pass the weights as an ARGUMENT — jitting a closure over
+        # self.params would bake every weight into the jaxpr as a constant
+        # (trace-lint rule T102: no donation, re-shipped per compile).
+        gp = self.net.materialize_shared(
+            self.params.params if params is None else params
+        )
         outs = self._encode(batch, gp)
         statics = {}
         static_layers = ["enc", "enc_proj"]
@@ -325,12 +330,17 @@ class Seq2SeqGenerator:
         b = boot.shape[0]
         return statics, carry, b, gp
 
-    def generate(self, batch, beam_size: Optional[int] = None):
-        """Beam-search decode; returns (sequences [B,K,T], scores [B,K])."""
+    def generate(self, batch, beam_size: Optional[int] = None, *, params=None):
+        """Beam-search decode; returns (sequences [B,K,T], scores [B,K]).
+
+        ``params`` (default: the constructor's Parameters) exists for jitted
+        callers: ``jax.jit(lambda p, bt: gen.generate(bt, params=p))`` keeps
+        the weights as executable arguments instead of trace-time constants
+        (trace-lint T102)."""
         from paddle_tpu.ops.beam import beam_search
 
         k = beam_size or self.beam_size
-        statics, carry, b, gp = self._prepare(batch)
+        statics, carry, b, gp = self._prepare(batch, params)
         # static tensors must be expanded to B*K rows inside beam_search —
         # it repeats carry but statics stay per-row: expand here.
         statics_k = {
@@ -354,10 +364,10 @@ class Seq2SeqGenerator:
             norm_fn=self.norm_fn,
         )
 
-    def generate_greedy(self, batch):
+    def generate_greedy(self, batch, *, params=None):
         from paddle_tpu.ops.beam import greedy_search
 
-        statics, carry, b, gp = self._prepare(batch)
+        statics, carry, b, gp = self._prepare(batch, params)
         return greedy_search(
             self._step_fn(statics, gp),
             carry,

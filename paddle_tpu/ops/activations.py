@@ -38,6 +38,11 @@ def get_activation(name: str) -> Activation:
         ) from None
 
 
+def registered_activations():
+    """Sorted registered activation names (the graph linter's G013 domain)."""
+    return sorted(_ACTIVATIONS)
+
+
 def apply_activation(name: str, x: jnp.ndarray, mask: Optional[jnp.ndarray] = None):
     if name in ("sequence_softmax",):
         return _ACTIVATIONS[name](x, mask)

@@ -25,20 +25,27 @@ def map_readers(func, *readers: Reader) -> Reader:
     return reader
 
 
-def shuffle(reader: Reader, buf_size: int) -> Reader:
-    """Buffered shuffle (decorator.py:60)."""
+def shuffle(reader: Reader, buf_size: int, rng=None) -> Reader:
+    """Buffered shuffle (decorator.py:60).
+
+    ``rng`` is the shuffling stream (anything with ``.shuffle``, e.g.
+    ``random.Random(seed)``); None uses the process-global ``random``
+    stream, which ``paddle.init(seed=...)`` seeds — pass an explicit rng
+    for order reproducible independent of other global-stream consumers
+    (self-lint rule A203)."""
+    stream = rng if rng is not None else _random
 
     def shuffled():
         buf: List[Any] = []
         for e in reader():
             buf.append(e)
             if len(buf) >= buf_size:
-                _random.shuffle(buf)
+                stream.shuffle(buf)
                 for b in buf:
                     yield b
                 buf = []
         if buf:
-            _random.shuffle(buf)
+            stream.shuffle(buf)
             for b in buf:
                 yield b
 

@@ -20,6 +20,22 @@ _ENV_PREFIX = "PADDLE_TPU_"
 
 
 def define_flag(name: str, default, help_: str = "") -> None:
+    """Register a flag.  Re-registering an existing name with the identical
+    type+default is an idempotent no-op (module reloads); a CONFLICTING
+    re-registration raises — the reference gflags aborts on duplicate
+    DEFINE_* the same way.  (Silently letting the last definition win is
+    how a plugin's `seed` flag used to steal the trainer's; the self-lint
+    rule A204 catches the static cases, this guards the dynamic ones.)"""
+    if name in _DEFS:
+        old_type, old_default, _ = _DEFS[name]
+        if old_type is not type(default) or old_default != default:
+            raise ValueError(
+                f"flag {name!r} is already defined with default "
+                f"{old_default!r} ({old_type.__name__}); re-registering it "
+                f"with default {default!r} ({type(default).__name__}) would "
+                "silently change behavior — reuse the existing flag or "
+                "pick a distinct name"
+            )
     _DEFS[name] = (type(default), default, help_)
 
 

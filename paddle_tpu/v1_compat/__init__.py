@@ -89,6 +89,12 @@ class ParsedConfig:
     # old-face TrainData/TestData declarations (config_parser.py:1115)
     train_data: Optional[object] = None
     test_data: Optional[object] = None
+    # provenance + parse-level context for the graph linter
+    # (analysis.graph_lint.lint_parsed): the config file that built this
+    # topology, and EVERY layer name the config created — including ones
+    # that never reached an output (dead-layer rule G005)
+    source_file: Optional[str] = None
+    all_layer_names: List[str] = dataclasses.field(default_factory=list)
 
     def serialize(self) -> str:
         return self.topology.serialize()
@@ -958,6 +964,8 @@ def parse_config(config, config_arg_str: str = "") -> ParsedConfig:
         ),
         output_layers=[l.name for l in state.outputs],
         evaluators=list(state.evaluators),
+        source_file=config_file,
+        all_layer_names=list(state.all_layers),
     )
     _resolve_provider_types(parsed, config_dir)
     return parsed

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Tier-1 failure-SET snapshot — CI compares which tests fail, not how many.
+
+The tier-1 gate (ROADMAP.md) historically compared failure *counts* against
+the seed baseline, which lets a PR trade one fixed test for one newly broken
+test invisibly.  This script snapshots the exact set of failing test ids to
+``tests/tier1_failures_baseline.txt`` and diffs the current run against it:
+
+  python scripts/tier1_failset.py --check --from-log /tmp/_t1.log
+      parse an existing ``pytest -q`` log (fast; no re-run) and fail (exit
+      1) on any test failing that is not in the committed baseline.  Tests
+      that now PASS are reported as improvements (exit 0) with a reminder
+      to re-snapshot.
+
+  python scripts/tier1_failset.py --check
+      run the tier-1 suite itself first (the ROADMAP command), then diff.
+
+  python scripts/tier1_failset.py --update [--from-log ...]
+      rewrite the baseline from the run/log.
+
+Log format: the ``FAILED <nodeid>[ - msg]`` / ``ERROR <nodeid>`` lines of
+pytest's short test summary (printed by default, including under ``-q``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tests", "tier1_failures_baseline.txt")
+
+# the ROADMAP.md tier-1 command, minus the pipefail/dots accounting
+TIER1_CMD = [
+    sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+    "--continue-on-collection-errors", "-p", "no:cacheprovider",
+    "-p", "no:xdist", "-p", "no:randomly",
+]
+
+_LINE = re.compile(r"^(FAILED|ERROR)\s+(.+)$")
+
+
+def _strip_message(rest: str) -> str:
+    """Node id without pytest's appended ' - <message>'.  Parametrized ids
+    may themselves contain ' - ' inside their [...] part, so cut at the
+    first ' - ' OUTSIDE brackets, not the first one anywhere."""
+    depth = 0
+    for i, c in enumerate(rest):
+        if c == "[":
+            depth += 1
+        elif c == "]":
+            depth = max(depth - 1, 0)
+        elif depth == 0 and rest.startswith(" - ", i):
+            return rest[:i]
+    return rest
+
+
+def parse_failures(text: str) -> set:
+    """Failing node ids from pytest's SHORT TEST SUMMARY section only —
+    captured test output can legitimately contain lines starting with
+    'ERROR ...' (log records), so everything before the summary marker is
+    ignored.  Falls back to the whole text when the marker is absent
+    (truncated log)."""
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        if "short test summary info" in line:
+            lines = lines[i + 1:]
+            break
+    out = set()
+    for line in lines:
+        m = _LINE.match(line.strip())
+        if m:
+            out.add(_strip_message(m.group(2)).strip().rstrip(":"))
+    return out
+
+
+def run_tier1() -> str:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    with tempfile.NamedTemporaryFile("w+", suffix=".log", delete=False) as f:
+        proc = subprocess.run(
+            TIER1_CMD, cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        f.write(proc.stdout)
+        print(f"(tier-1 log: {f.name})")
+    tail = "\n".join(proc.stdout.splitlines()[-3:])
+    print(tail)
+    return proc.stdout
+
+
+def load_baseline() -> set:
+    if not os.path.exists(BASELINE):
+        return set()
+    with open(BASELINE) as f:
+        return {
+            ln.strip() for ln in f
+            if ln.strip() and not ln.startswith("#")
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--check", action="store_true",
+                      help="diff the failure set against the baseline")
+    mode.add_argument("--update", action="store_true",
+                      help="rewrite the baseline from this run/log")
+    ap.add_argument("--from-log", default=None,
+                    help="parse this pytest log instead of running the suite")
+    args = ap.parse_args()
+
+    if args.from_log:
+        with open(args.from_log) as f:
+            text = f.read()
+    else:
+        text = run_tier1()
+    failures = parse_failures(text)
+
+    if args.update:
+        with open(BASELINE, "w") as f:
+            f.write(
+                "# Tier-1 failing-test baseline (the SET CI diffs against,\n"
+                "# scripts/tier1_failset.py).  One pytest node id per line;\n"
+                "# update with: python scripts/tier1_failset.py --update "
+                "[--from-log L]\n"
+            )
+            for t in sorted(failures):
+                f.write(t + "\n")
+        print(f"baseline updated: {len(failures)} failing test(s) -> {BASELINE}")
+        return 0
+
+    baseline = load_baseline()
+    new = sorted(failures - baseline)
+    fixed = sorted(baseline - failures)
+    print(
+        f"tier-1 failure set: {len(failures)} failing, baseline "
+        f"{len(baseline)}"
+    )
+    if fixed:
+        print(f"\n{len(fixed)} baseline failure(s) now PASS (improvement):")
+        for t in fixed:
+            print(f"  + {t}")
+        print("  (re-snapshot with --update to lock these in)")
+    if new:
+        print(f"\n{len(new)} NEW failure(s) not in the baseline (REGRESSION):")
+        for t in new:
+            print(f"  - {t}")
+        return 1
+    print("no new failures vs baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+# Shipped demo config: MNIST MLP in the reference v1 trainer-config dialect
+# (the v1_api_demo/mnist shape) — part of the graph-lint zero-false-positive
+# corpus (tests/test_graph_lint.py, `make lint`); feed it through an
+# explicit DataFeeder (or add define_py_data_sources2) to train.
+from paddle.trainer_config_helpers import *  # noqa: F401,F403
+
+settings(batch_size=32, learning_rate=1e-3, learning_method=AdamOptimizer())
+
+img = data_layer(name="pixel", size=784)
+hidden1 = fc_layer(input=img, size=128, act=ReluActivation())
+hidden2 = fc_layer(input=hidden1, size=64, act=ReluActivation())
+predict = fc_layer(input=hidden2, size=10, act=SoftmaxActivation())
+
+if get_config_arg("is_predict", bool, False):
+    outputs(predict)
+else:
+    label = data_layer(name="label", size=10)
+    cls = classification_cost(input=predict, label=label)
+    outputs(cls)

@@ -1,0 +1,62 @@
+# Shipped demo config: attention NMT decoder in the v1 dialect (the
+# demo/seqToseq shape: bi-GRU encoder + simple_attention + gru_step inside a
+# recurrent_group).  Corpus member proving the graph linter stays silent on
+# the exact idiom the PR-2 fused attention-GRU matcher targets — and the
+# base for the G010 mutation (dropout inside the pattern defeats the fused
+# lowering).
+from paddle.trainer_config_helpers import *  # noqa: F401,F403
+
+src_vocab = 40
+trg_vocab = 45
+word_dim = 16
+hidden_dim = 16
+
+settings(batch_size=8, learning_rate=5e-4, learning_method=AdamOptimizer())
+
+src = data_layer(name="src_word", size=src_vocab)
+src_emb = embedding_layer(input=src, size=word_dim, name="src_emb")
+enc_fw = simple_gru(input=src_emb, size=hidden_dim, name="enc_fw")
+enc_bw = simple_gru(input=src_emb, size=hidden_dim, reverse=True, name="enc_bw")
+enc = concat_layer(input=[enc_fw, enc_bw], name="enc")
+enc_proj = fc_layer(
+    input=enc, size=hidden_dim, act=IdentityActivation(), bias_attr=False,
+    name="enc_proj",
+)
+boot = fc_layer(
+    input=first_seq(input=enc, name="enc_first"), size=hidden_dim,
+    act=TanhActivation(), name="dec_boot",
+)
+
+trg = data_layer(name="trg_word", size=trg_vocab)
+trg_emb = embedding_layer(input=trg, size=word_dim, name="trg_emb")
+
+
+def decoder_step(trg_emb_t, enc_seq, enc_p):
+    state = memory(name="dec_state", size=hidden_dim, boot_layer=boot)
+    context = simple_attention(
+        encoded_sequence=enc_seq, encoded_proj=enc_p, decoder_state=state,
+        name="att",
+    )
+    gate_in = fc_layer(
+        input=[context, trg_emb_t], size=hidden_dim * 3,
+        act=IdentityActivation(), bias_attr=False, name="dec_in_proj",
+    )
+    gru = gru_step_layer(
+        input=gate_in, output_mem=state, size=hidden_dim, name="dec_state",
+    )
+    return fc_layer(
+        input=gru, size=trg_vocab, act=SoftmaxActivation(), name="dec_out",
+    )
+
+
+dec = recurrent_group(
+    step=decoder_step,
+    input=[
+        trg_emb,
+        StaticInput(input=enc, is_seq=True),
+        StaticInput(input=enc_proj, is_seq=True),
+    ],
+    name="decoder",
+)
+label = data_layer(name="trg_next", size=trg_vocab)
+outputs(classification_cost(input=dec, label=label, name="nmt_cost"))

@@ -1,0 +1,19 @@
+# Shipped demo config: quick_start-style LSTM text classification in the v1
+# dialect (embedding -> lstm -> pooling -> softmax) — graph-lint corpus
+# member exercising sequence layers and dropout placement.
+from paddle.trainer_config_helpers import *  # noqa: F401,F403
+
+dict_dim = 100
+settings(batch_size=16, learning_rate=2e-3, learning_method=AdamOptimizer())
+
+words = data_layer(name="word", size=dict_dim)
+emb = embedding_layer(input=words, size=32)
+lstm = simple_lstm(input=emb, size=32)
+pooled = pooling_layer(input=lstm, pooling_type=MaxPooling())
+hidden = fc_layer(
+    input=pooled, size=32, act=TanhActivation(),
+    layer_attr=ExtraAttr(drop_rate=0.1),
+)
+predict = fc_layer(input=hidden, size=2, act=SoftmaxActivation())
+label = data_layer(name="label", size=2)
+outputs(classification_cost(input=predict, label=label))

@@ -1,0 +1,436 @@
+"""Graph linter (analysis/graph_lint.py): the zero-false-positive corpus —
+every shipped demo config and model-zoo topology lints clean — plus a
+mutation suite proving each rule fires with its exact rule id (the
+config_assert contract: provenance + fix hint on every finding)."""
+
+import dataclasses
+import os
+
+import jax
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.activation as A
+import paddle_tpu.layers as L
+from paddle_tpu.analysis import (
+    Diagnostic,
+    DiagnosticError,
+    Severity,
+    format_diagnostics,
+    lint_parsed,
+    lint_topology,
+)
+from paddle_tpu.core.data_types import integer_value
+from paddle_tpu.core.topology import (
+    LayerConf,
+    LayerOutput,
+    Topology,
+    reset_auto_names,
+)
+
+HERE = os.path.dirname(__file__)
+CONFIGS = os.path.join(HERE, "configs")
+
+
+def rules(diags):
+    return [d.rule for d in diags]
+
+
+def _lint(outs, **kw):
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return lint_topology(Topology(list(outs)), **kw)
+
+
+# ---------------------------------------------------------------------------
+# corpus: every shipped demo config and model-zoo builder must be silent
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "cfg", sorted(f for f in os.listdir(CONFIGS) if f.endswith(".py"))
+)
+def test_demo_config_corpus_lints_clean(cfg):
+    from paddle_tpu.v1_compat import parse_config
+
+    parsed = parse_config(os.path.join(CONFIGS, cfg))
+    diags = lint_parsed(parsed)
+    assert not diags, format_diagnostics(diags)
+
+
+def _zoo():
+    from paddle_tpu.models.lenet import lenet_cost
+    from paddle_tpu.models.resnet import resnet_cost
+    from paddle_tpu.models.seq2seq import seq2seq_cost
+    from paddle_tpu.models.sequence_tagging import ner_crf_cost
+    from paddle_tpu.models.transformer import transformer_cost
+
+    return {
+        "lenet": lambda: list(lenet_cost()),
+        "resnet18": lambda: [resnet_cost(depth=18, class_num=10, img_size=32)[0]],
+        "seq2seq": lambda: [seq2seq_cost(40, 45, word_dim=16, hidden_dim=16)[0]],
+        "ner_crf": lambda: list(ner_crf_cost(60, 5)),
+        "transformer": lambda: [
+            transformer_cost(
+                src_vocab=50, trg_vocab=50, n_layers=1, d_model=32,
+                n_heads=4, d_ff=64,
+            )
+        ],
+        "transformer_moe": lambda: [
+            transformer_cost(
+                src_vocab=50, trg_vocab=50, n_layers=1, d_model=32,
+                n_heads=4, d_ff=64, moe_experts=4,
+            )
+        ],
+    }
+
+
+@pytest.mark.parametrize("name", sorted(_zoo()))
+def test_model_zoo_lints_clean(name):
+    reset_auto_names()
+    outs = _zoo()[name]()
+    diags = _lint([o for o in outs if isinstance(o, LayerOutput)])
+    assert not diags, f"{name}:\n" + format_diagnostics(diags)
+
+
+# ---------------------------------------------------------------------------
+# mutation suite: one deliberately-broken graph per rule, exact id asserted
+# ---------------------------------------------------------------------------
+
+
+def _mlp():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    h = L.fc(x, size=8, act=A.Tanh(), name="hidden")
+    return x, h
+
+
+def test_g001_unknown_layer_type():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="mystery", type="warp_drive", size=8, inputs=("hidden",)),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G001" in rules(d)
+    (g1,) = [x for x in d if x.rule == "G001"]
+    assert g1.layer == "mystery" and g1.hint
+
+
+def test_g002_dangling_input():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="sum", type="addto", size=8,
+                  inputs=("hidden", "ghost_layer")),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G002" in rules(d)
+
+
+def test_g003_arity_mismatch():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="gru", type="gru_step", size=8, inputs=("hidden",)),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G003" in rules(d)
+
+
+def test_g004_width_mismatch_addto():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    a = L.fc(x, size=8, name="a")
+    b = L.fc(x, size=12, name="b")
+    bad = LayerOutput(
+        LayerConf(name="sum", type="addto", size=8, inputs=("a", "b")),
+        [a, b],
+    )
+    d = _lint(bad)
+    assert "G004" in rules(d)
+
+
+def test_g004_width_mismatch_gru_gates():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    gates = L.fc(x, size=16, name="gates")  # needs 3*size = 24
+    state = L.fc(x, size=8, name="state")
+    bad = LayerOutput(
+        LayerConf(name="gru", type="gru_step", size=8,
+                  inputs=("gates", "state")),
+        [gates, state],
+    )
+    d = _lint(bad)
+    assert "G004" in rules(d)
+
+
+def test_g005_dead_layer():
+    x, h = _mlp()
+    dead = L.fc(x, size=4, name="orphan")  # built, reaches no output
+    d = _lint(h, created=["x", "hidden", "orphan"])
+    assert "G005" in rules(d)
+    (g5,) = [y for y in d if y.rule == "G005"]
+    assert "orphan" in g5.message
+    # evaluator-rooted layers are NOT dead
+    d2 = _lint(h, created=["x", "hidden", "orphan"],
+               evaluator_layers=["orphan"])
+    assert "G005" not in rules(d2)
+
+
+def test_g006_param_share_shape_conflict():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    y = L.data("y", paddle.data_type.dense_vector(12))
+    a = L.fc(x, size=8, name="a", param_attr=paddle.attr.ParamAttr(name="shared"))
+    b = L.fc(y, size=8, name="b", param_attr=paddle.attr.ParamAttr(name="shared"))
+    cat = L.concat([a, b], name="cat")
+    d = _lint(cat)
+    assert "G006" in rules(d)
+
+
+def test_g007_unknown_attr_key():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="opt", type="fc", size=8, inputs=("hidden",),
+                  attrs={"kernel_sz": 3}),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G007" in rules(d)
+    (g7,) = [y for y in d if y.rule == "G007"]
+    assert "kernel_sz" in g7.message and g7.severity == Severity.WARNING
+
+
+def test_g008_unknown_shard_axis():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="wide", type="fc", size=8, inputs=("hidden",),
+                  shard_axis="tensor"),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G008" in rules(d)
+
+
+def test_g009_dynamic_width_with_bucketing():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="dyn", type="fc", size=8, inputs=("hidden",),
+                  attrs={"dynamic_width_in": (0,)}),
+        [h],
+    )
+    d = _lint(bad, bucketing=True)
+    assert "G009" in rules(d)
+    # without bucketing the construct is legal
+    d2 = _lint(bad, bucketing=False)
+    assert "G009" not in rules(d2)
+
+
+def _attention_decoder(drop_in_pattern: float = 0.0):
+    from paddle_tpu.models.seq2seq import _encoder_and_boot
+
+    reset_auto_names()
+    enc, enc_proj, boot = _encoder_and_boot(30, 8, 8)
+    trg = L.data("trg_word", paddle.data_type.integer_value_sequence(30))
+    trg_emb = L.embedding(trg, size=8, name="trg_emb")
+
+    def step(trg_emb_t, enc_seq, enc_p):
+        state = L.memory("dec_state", 8, boot_layer=boot)
+        context = paddle.networks.simple_attention(
+            encoded_sequence=enc_seq, encoded_proj=enc_p,
+            decoder_state=state, name="att",
+        )
+        extra = (
+            {"layer_attr": paddle.attr.ExtraAttr(drop_rate=drop_in_pattern)}
+            if drop_in_pattern else {}
+        )
+        inputs = L.fc(
+            [context, trg_emb_t], size=24, act=A.Identity(),
+            bias_attr=False, name="dec_in_proj", **extra,
+        )
+        gru = L.gru_step(inputs, state, size=8, name="dec_state")
+        return L.fc(gru, size=30, act=A.Softmax(), name="dec_out")
+
+    return L.recurrent_group(
+        step,
+        [trg_emb, L.StaticInput(enc, is_seq=True),
+         L.StaticInput(enc_proj, is_seq=True)],
+        name="decoder",
+    )
+
+
+def test_g010_dropout_defeats_fused_matcher():
+    dec = _attention_decoder(drop_in_pattern=0.3)
+    d = _lint(dec)
+    assert rules(d) == ["G010"], format_diagnostics(d)
+    (g10,) = d
+    assert "dec_in_proj" in g10.message and g10.severity == Severity.WARNING
+
+
+def test_g010_silent_when_pattern_fuses():
+    dec = _attention_decoder(drop_in_pattern=0.0)
+    d = _lint(dec)
+    assert "G010" not in rules(d), format_diagnostics(d)
+
+
+def test_g011_unresolved_data_slot():
+    reset_auto_names()
+    conf = LayerConf(
+        name="w", type="data", size=10,
+        input_type=paddle.data_type.dense_vector(10),
+        attrs={"_v1_unresolved": "provider module not importable"},
+    )
+    lo = LayerOutput(conf)
+    out = LayerOutput(
+        LayerConf(name="fc", type="fc", size=4, inputs=("w",)), [lo]
+    )
+    d = _lint(out)
+    assert "G011" in rules(d)
+    # the feed boundary raises the same rule as a hard error
+    with pytest.raises(DiagnosticError) as ei:
+        Topology([out]).data_types()
+    assert ei.value.rules == ["G011"]
+
+
+def test_g013_unknown_activation():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="act", type="fc", size=8, inputs=("hidden",),
+                  act="quantum"),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G013" in rules(d)
+
+
+def test_g014_drop_rate_out_of_range():
+    x, h = _mlp()
+    bad = LayerOutput(
+        LayerConf(name="drp", type="fc", size=8, inputs=("hidden",),
+                  drop_rate=1.5),
+        [h],
+    )
+    d = _lint(bad)
+    assert "G014" in rules(d)
+
+
+def test_g015_data_size_vs_input_type_dim():
+    reset_auto_names()
+    conf = LayerConf(
+        name="pix", type="data", size=784,
+        input_type=paddle.data_type.dense_vector(100),
+    )
+    d = _lint(LayerOutput(conf))
+    assert "G015" in rules(d)
+
+
+def test_g016_duplicate_layer_name_raises_diagnostic():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(4))
+    a = LayerOutput(LayerConf(name="twin", type="fc", size=4, inputs=("x",)), [x])
+    b = LayerOutput(LayerConf(name="twin", type="fc", size=8, inputs=("x",)), [x])
+    cat = LayerOutput(
+        LayerConf(name="cat", type="concat", size=12, inputs=("twin", "twin")),
+        [a, b],
+    )
+    with pytest.raises(DiagnosticError) as ei:
+        Topology([cat])
+    assert ei.value.rules == ["G016"]
+    assert "twin" in str(ei.value)
+
+
+def test_g017_label_dim_mismatch():
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    pred = L.fc(x, size=10, act=A.Softmax(), name="pred")
+    lbl = LayerOutput(
+        LayerConf(name="lbl", type="data", size=7, input_type=integer_value(7))
+    )
+    cost = LayerOutput(
+        LayerConf(name="ce", type="cross_entropy", size=1,
+                  inputs=("pred", "lbl"), bias=False),
+        [pred, lbl],
+    )
+    d = _lint(cost)
+    assert "G017" in rules(d)
+
+
+# ---------------------------------------------------------------------------
+# diagnostic model / formatter
+# ---------------------------------------------------------------------------
+
+
+def test_diagnostic_format_carries_provenance_and_hint():
+    d = Diagnostic(
+        rule="G004", severity=Severity.ERROR, message="widths differ",
+        layer="sum", source="conf.py", line=12, hint="align the sizes",
+    )
+    s = d.format()
+    assert "error[G004]" in s and "'sum'" in s
+    assert "conf.py:12" in s and "fix: align the sizes" in s
+
+
+def test_format_diagnostics_orders_errors_first():
+    ds = [
+        Diagnostic(rule="G007", severity=Severity.WARNING, message="w"),
+        Diagnostic(rule="G004", severity=Severity.ERROR, message="e"),
+    ]
+    text = format_diagnostics(ds)
+    assert text.index("G004") < text.index("G007")
+    assert "1 error(s), 1 warning(s)" in text
+
+
+def test_compiler_share_conflict_is_diagnostic_formatted():
+    """Satellite: core.compiler's parameter-sharing errors carry the shared
+    diagnostic format (rule G006 + layer + hint) while staying ValueError."""
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    y = L.data("y", paddle.data_type.dense_vector(12))
+    a = L.fc(x, size=8, name="a", param_attr=paddle.attr.ParamAttr(name="shared"))
+    b = L.fc(y, size=8, name="b", param_attr=paddle.attr.ParamAttr(name="shared"))
+    net = CompiledNetwork(Topology([L.concat([a, b], name="cat")]))
+    with pytest.raises(ValueError) as ei:
+        net.init_params(jax.random.PRNGKey(0))
+    assert isinstance(ei.value, DiagnosticError)
+    assert ei.value.rules == ["G006"]
+    assert "error[G006]" in str(ei.value) and "fix:" in str(ei.value)
+
+
+def test_g016_duplicate_name_on_ancestor_path():
+    """Review regression: a duplicate met while its descendant's conf is
+    seen but not yet stored must still raise — the old check compared
+    against the incomplete layers dict and silently dropped the ancestor."""
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(4))
+    inner = LayerOutput(
+        LayerConf(name="twin", type="fc", size=4, inputs=("x",)), [x]
+    )
+    outer = LayerOutput(
+        LayerConf(name="twin", type="fc", size=8, inputs=("twin",)), [inner]
+    )
+    with pytest.raises(DiagnosticError) as ei:
+        Topology([outer])
+    assert ei.value.rules == ["G016"]
+
+
+def test_g009_fires_inside_recurrent_group():
+    """Review regression: a dynamic-width layer nested in a sub-topology is
+    caught at config time, not just by the runtime trainer guard."""
+    reset_auto_names()
+    x = L.data("x", paddle.data_type.dense_vector(8))
+    inner = LayerOutput(
+        LayerConf(name="dyn", type="fc", size=8, inputs=(),
+                  attrs={"dynamic_width_in": (0,)})
+    )
+    group = LayerOutput(
+        LayerConf(name="grp", type="recurrent_group", size=8, inputs=("x",),
+                  attrs={"_sub_topology": Topology([inner])}),
+        [x],
+    )
+    d = _lint(group, bucketing=True)
+    assert "G009" in rules(d)
+    (g9,) = [y for y in d if y.rule == "G009"]
+    assert "grp.dyn" in g9.message
