@@ -13,11 +13,16 @@
 #                  failing-test SET against tests/tier1_failures_baseline.txt
 #                  (scripts/tier1_failset.py), so CI catches a newly broken
 #                  test even when another fix keeps the count unchanged.
+#   make chaos   — the fast fault-injection subset (NaN-inject, torn
+#                  checkpoint, subprocess kill -9 + --resume): the
+#                  robustness plane proven against real injected failures.
+#                  These tests live in tests/ unmarked, so `make test`
+#                  runs them too; this target is the focused drill.
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test verify bench test-all lint tier1-check tier1-update
+.PHONY: test verify bench test-all lint tier1-check tier1-update chaos
 
 lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
@@ -32,6 +37,9 @@ tier1-check:
 
 tier1-update:
 	$(CPU_ENV) $(PY) scripts/tier1_failset.py --update
+
+chaos:
+	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -177,6 +177,12 @@ class CheckpointManager:
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
+        from paddle_tpu.robustness import chaos as _chaos
+
+        if _chaos.fire("torn_checkpoint"):
+            # simulate a crash mid-write: the step dir exists, the data file
+            # is truncated (restore must detect it and fall back)
+            _chaos.tear_file(os.path.join(final, "state.npz"))
         self._retain()
 
     def _retain(self) -> None:
@@ -232,8 +238,28 @@ class CheckpointManager:
         return _unflatten_into(template, arrays), meta.get("extra", {})
 
     def restore_latest(self, template: Any):
-        step = self.latest_step()
-        if step is None:
-            return None
-        tree, extra = self.restore(step, template)
-        return step, tree, extra
+        """Newest RESTORABLE checkpoint as ``(step, tree, extra)`` — or None
+        when the directory holds none that loads.
+
+        Unlike :meth:`restore` (strict: a caller naming a step deserves the
+        error), this walks newest → oldest past torn/corrupt step dirs: a
+        truncated ``state.npz`` (crash mid-write), a CRC mismatch (bit rot),
+        or a missing ``meta.json`` must never brick a resume while an older
+        retained checkpoint is intact — the Go pserver's checkpoint loader
+        takes the same stance (service.go:244: a bad CRC fails over rather
+        than wedging the shard)."""
+        import logging
+
+        log = logging.getLogger("paddle_tpu.checkpoint")
+        for step in reversed(self.all_steps()):
+            try:
+                tree, extra = self.restore(step, template)
+            except Exception as exc:  # noqa: BLE001 — any torn artifact
+                log.warning(
+                    "checkpoint ckpt-%08d unusable (%s: %s); falling back "
+                    "to the previous retained checkpoint",
+                    step, type(exc).__name__, exc,
+                )
+                continue
+            return step, tree, extra
+        return None

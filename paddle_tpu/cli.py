@@ -84,6 +84,32 @@ def _build_train_parser() -> argparse.ArgumentParser:
         help="train each epoch-1 batch N times (data echo) to amortize "
         "its host->device transfer; needs the pass cache enabled",
     )
+    ap.add_argument(
+        "--checkpoint_dir", default=None,
+        help="fault-tolerance plane (robustness/): write full-state "
+        "checkpoints (params + optimizer state + RNG + pass/batch "
+        "position) here every --checkpoint_period_batches batches and at "
+        "pass boundaries; enables divergence auto-rollback and "
+        "preemption-safe shutdown (SIGTERM -> final checkpoint + "
+        "PREEMPTED marker)",
+    )
+    ap.add_argument(
+        "--checkpoint_period_batches", type=int, default=None,
+        help="full-state checkpoint cadence in batches (default: the "
+        "checkpoint_period_batches flag); each checkpoint is the rollback "
+        "anchor and the kill -9 resume point",
+    )
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="restore the latest good checkpoint from --checkpoint_dir "
+        "(walking past torn ones) and continue mid-pass where the "
+        "interrupted run stopped",
+    )
+    ap.add_argument(
+        "--chaos", default=None,
+        help="arm chaos fault points, e.g. 'nan_batch@5,kill@12' "
+        "(robustness/chaos.py; testing only)",
+    )
     return ap
 
 
@@ -252,6 +278,10 @@ def cmd_train(argv: List[str]) -> int:
         _flags.set_flag("cache_pass_in_mem", True)
     if args.data_echo_factor is not None:
         _flags.set_flag("data_echo_factor", args.data_echo_factor)
+    if args.chaos:
+        from paddle_tpu.robustness import chaos as _chaos
+
+        _chaos.arm(args.chaos)
     _flags.set_flag("trainer_count", args.trainer_count)
     seed = _flags.get_flag("seed")
 
@@ -339,7 +369,16 @@ def _job_train(args, parsed, trainer, batch_size, config_dir,
         saving_period_by_batches=args.saving_period_by_batches or None,
         start_pass=args.start_pass,
         async_load_data=args.async_load_data,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_period_batches=args.checkpoint_period_batches,
+        resume=args.resume,
     )
+    if getattr(trainer, "preempted", False):
+        _echo(
+            f"PREEMPTED: state checkpointed under {args.checkpoint_dir}; "
+            "restart with --resume to continue"
+        )
+        return 75  # EX_TEMPFAIL: restart me
     return 0
 
 

@@ -2,7 +2,14 @@
 
 from __future__ import annotations
 
+import hashlib
+import logging
 import os
+import random
+import time
+from typing import Callable, Optional
+
+_log = logging.getLogger("paddle_tpu.dataset")
 
 DATA_HOME = os.environ.get(
     "PADDLE_TPU_DATA", os.path.expanduser("~/.cache/paddle_tpu/dataset")
@@ -15,6 +22,92 @@ def data_path(*parts: str) -> str:
 
 def exists(*parts: str) -> bool:
     return os.path.exists(data_path(*parts))
+
+
+def md5file(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _urllib_fetch(url: str, dest: str) -> None:
+    """Stream ``url`` into ``dest`` (the default fetcher; tests inject a
+    fake via ``download(fetch_fn=...)``)."""
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=60) as r, open(dest, "wb") as f:
+        for chunk in iter(lambda: r.read(1 << 20), b""):
+            f.write(chunk)
+
+
+def download(
+    url: str,
+    module: str,
+    md5sum: Optional[str] = None,
+    save_name: Optional[str] = None,
+    max_retries: int = 5,
+    backoff: float = 0.5,
+    fetch_fn: Optional[Callable[[str, str], None]] = None,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> str:
+    """Fetch ``url`` into the dataset cache with bounded retry (reference
+    python/paddle/v2/dataset/common.py:37 ``download`` — which dies on the
+    first flaky HTTP read; this one doesn't).
+
+    Robustness contract:
+
+    * **bounded retry with exponential backoff + jitter** — up to
+      ``max_retries`` attempts, sleeping ``backoff * 2**attempt`` seconds
+      plus up to 25% jitter between them (the jitter keeps a fleet of
+      trainers from re-hammering a recovering mirror in lockstep);
+    * **partial-file cleanup** — every attempt writes to a ``.part`` file
+      that is removed on failure and atomically renamed into place only
+      after the (optional) md5 check passes, so a torn download can never
+      be mistaken for the dataset by the next run;
+    * an md5 mismatch counts as a failed attempt (truncated-but-complete
+      HTTP bodies exist), and a cached file that matches short-circuits.
+
+    Returns the cached file path."""
+    if max_retries < 1:
+        raise ValueError(f"max_retries must be >= 1, got {max_retries}")
+    fetch = fetch_fn or _urllib_fetch
+    jitter = rng or random.Random()
+    dirname = data_path(module)
+    os.makedirs(dirname, exist_ok=True)
+    dest = os.path.join(dirname, save_name or url.split("/")[-1])
+    if os.path.exists(dest) and (md5sum is None or md5file(dest) == md5sum):
+        return dest
+    part = dest + ".part"
+    last_err: Optional[Exception] = None
+    for attempt in range(max_retries):
+        if attempt:
+            delay = backoff * (2 ** (attempt - 1))
+            delay *= 1.0 + 0.25 * jitter.random()
+            _log.warning(
+                "download %s failed (%s); retry %d/%d in %.2fs",
+                url, last_err, attempt, max_retries - 1, delay,
+            )
+            sleep(delay)
+        try:
+            fetch(url, part)
+            if md5sum is not None and md5file(part) != md5sum:
+                raise IOError(
+                    f"md5 mismatch for {url} (torn or tampered download)"
+                )
+            os.replace(part, dest)
+            return dest
+        except Exception as exc:  # noqa: BLE001 — retry any fetch failure
+            last_err = exc
+            try:
+                os.remove(part)  # never leave a torn .part behind
+            except OSError:
+                pass
+    raise IOError(
+        f"download {url} failed after {max_retries} attempt(s): {last_err}"
+    )
 
 
 def synth_two_class_docs(

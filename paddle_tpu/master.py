@@ -33,13 +33,23 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu.io import recordio
 
-__all__ = ["Service", "Server", "Client", "MasterRPCError"]
+__all__ = [
+    "Service", "Server", "Client", "MasterRPCError", "MasterTransportError",
+]
 
 
 class MasterRPCError(RuntimeError):
     """The master executed the call and reported an application error —
     distinct from transport failures so HA clients do not reconnect-retry
     deterministic errors."""
+
+
+class MasterTransportError(ConnectionError):
+    """The TRANSPORT failed (broken pipe / EOF / refused) and the client's
+    short reconnect-retry window was exhausted — the call may or may not
+    have executed.  Subclasses ConnectionError so HA wrappers (master_ha.
+    HAClient) treat it as 'leader gone, re-discover', never as an
+    application error."""
 
 
 @dataclasses.dataclass
@@ -340,6 +350,7 @@ class Server:
 
     def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu"):
         self.service = service
+        self._authkey = authkey
         self._listener = Listener(address, authkey=authkey)
         self.address = self._listener.address
         self._stop = False
@@ -395,9 +406,17 @@ class Server:
     def close(self) -> None:
         """Stop accepting AND drop live per-connection handler threads — a
         deposed HA leader must not keep serving stale state to connected
-        clients."""
+        clients.  The accept loop is WOKEN with a dummy connection before
+        the listener closes: a thread blocked in accept() holds the
+        listening socket open past Listener.close(), which would keep the
+        port bound and break a master restarting on its own address."""
         self._stop = True
+        try:
+            _ConnClient(tuple(self.address), authkey=self._authkey).close()
+        except Exception:  # noqa: BLE001 — wake-up is best effort
+            pass
         self._listener.close()
+        self._thread.join(timeout=5)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
@@ -414,14 +433,25 @@ class Client:
     Server.  Records stream task-by-task; at a pass boundary next_record
     returns None once (like the reference's empty-record pass signal)."""
 
-    def __init__(self, master, authkey: bytes = b"paddle-tpu", trainer_id: str = "0"):
+    def __init__(
+        self,
+        master,
+        authkey: bytes = b"paddle-tpu",
+        trainer_id: str = "0",
+        reconnect_tries: int = 5,
+        reconnect_backoff: float = 0.1,
+    ):
         if isinstance(master, Service):
             self._service = master
             self._conn = None
         else:
             self._service = None
-            self._conn = _ConnClient(tuple(master), authkey=authkey)
+            self._address = tuple(master)
+            self._authkey = authkey
+            self._conn = _ConnClient(self._address, authkey=authkey)
             self._conn_lock = threading.Lock()
+        self.reconnect_tries = max(int(reconnect_tries), 1)
+        self.reconnect_backoff = float(reconnect_backoff)
         self.trainer_id = trainer_id
         self._records: List[bytes] = []
         self._pending_task = None  # (task_id, epoch) awaiting ack-on-drain
@@ -430,11 +460,41 @@ class Client:
         self._renew_interval = self.lease_renew_secs
 
     def _call(self, method: str, *args):
+        """One RPC.  Transient TRANSPORT failures (connection reset / EOF on
+        the pipe — a master restarting, a dropped socket) get a short
+        reconnect-retry with exponential backoff before surfacing as
+        :class:`MasterTransportError`; the retried call is re-sent whole
+        (every master method is idempotent-or-epoch-guarded, so an
+        at-least-once duplicate is absorbed server-side).  Application
+        errors surface as :class:`MasterRPCError` immediately — the master
+        EXECUTED the call; retrying a deterministic failure is futile."""
         if self._service is not None:
             return getattr(self._service, method)(*args)
+        last_err: Optional[Exception] = None
         with self._conn_lock:
-            self._conn.send((method, args))
-            ok, result = self._conn.recv()
+            for attempt in range(self.reconnect_tries):
+                try:
+                    if self._conn is None:
+                        self._conn = _ConnClient(
+                            self._address, authkey=self._authkey
+                        )
+                    self._conn.send((method, args))
+                    ok, result = self._conn.recv()
+                    break
+                except (ConnectionError, EOFError, OSError) as exc:
+                    last_err = exc
+                    if self._conn is not None:
+                        try:
+                            self._conn.close()
+                        except OSError:
+                            pass
+                        self._conn = None
+                    if attempt + 1 >= self.reconnect_tries:
+                        raise MasterTransportError(
+                            f"master RPC {method}: transport failed after "
+                            f"{self.reconnect_tries} attempt(s): {exc!r}"
+                        ) from exc
+                    time.sleep(self.reconnect_backoff * (2 ** attempt))
         if not ok:
             raise MasterRPCError(f"master RPC {method} failed: {result}")
         return result

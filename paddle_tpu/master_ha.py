@@ -95,6 +95,14 @@ class LeaseFile:
     def renew(self) -> bool:
         if self.current_owner() != self.owner_id:
             return False  # usurped (we were stale and someone claimed)
+        from paddle_tpu.robustness import chaos as _chaos
+
+        if _chaos.fire("stale_lease"):
+            # chaos drill: the leader BELIEVES it renewed but the heartbeat
+            # never reached shared storage (GC pause, NFS stall) — the lease
+            # goes stale underneath it and a standby must take over while
+            # this side detects the usurper and steps down
+            return True
         os.utime(self.path, None)
         return True
 

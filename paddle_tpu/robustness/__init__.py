@@ -1,0 +1,42 @@
+"""Fault-tolerant training: guard + recovery + chaos.
+
+The reference stack survives failures at every tier — the Go master requeues
+timed-out tasks and discards poison tasks after ``failure_max`` retries
+(go/master/service.go:80-459), the Go pserver checkpoints optimizer state
+with CRC so a restarted shard resumes (go/pserver/service.go:244-303).  This
+package closes the same loop for the TPU-native trainer, where there is no
+pserver and the whole jit-visible state pytree is the unit of recovery:
+
+* :mod:`~paddle_tpu.robustness.sentinel` — device-fused finiteness flag +
+  host-side EMA loss-spike judgment (divergence detection).
+* :mod:`~paddle_tpu.robustness.recovery` — rollback to last-good full-state
+  checkpoints with the master's failure_max retry/quarantine discipline
+  applied to data windows.
+* :mod:`~paddle_tpu.robustness.preemption` — SIGTERM/SIGINT → synchronous
+  final checkpoint + ``PREEMPTED`` marker; ``--resume`` restores mid-pass.
+* :mod:`~paddle_tpu.robustness.chaos` — named fault points (NaN batch, torn
+  checkpoint write, SIGKILL at step N, stale HA lease) armed by flag/env,
+  proving the above against real injected failures.
+"""
+
+from paddle_tpu.robustness import chaos  # noqa: F401
+from paddle_tpu.robustness.preemption import (  # noqa: F401
+    MARKER_NAME,
+    PreemptionGuard,
+    clear_marker,
+    read_marker,
+    write_marker,
+)
+from paddle_tpu.robustness.recovery import RecoveryCoordinator  # noqa: F401
+from paddle_tpu.robustness.sentinel import DivergenceSentinel  # noqa: F401
+
+__all__ = [
+    "chaos",
+    "DivergenceSentinel",
+    "RecoveryCoordinator",
+    "PreemptionGuard",
+    "MARKER_NAME",
+    "write_marker",
+    "read_marker",
+    "clear_marker",
+]

@@ -1,0 +1,180 @@
+"""Chaos harness — named fault points for fault-injection testing.
+
+The reference proves its fault tolerance the hard way: the Go master/pserver
+tests kill real processes and the trainer requeues/recovers
+(go/master/service_internal_test.go, the failure_max discipline of
+go/master/service.go:308).  This module is the injection side of that story
+for the TPU-native stack: production code consults cheap, normally-inert
+fault points, and a test (or an operator running a game-day drill) arms them
+through one spec string.
+
+Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
+
+    point[@occurrence][,point[@occurrence]...]
+
+    nan_batch@5        poison the 5th staged training batch with NaN
+    torn_checkpoint@2  truncate the 2nd checkpoint's state.npz after write
+    kill@12            SIGKILL the process right after train step 12
+    stale_lease@3      the HA leader's 3rd lease renewal silently no-ops
+
+``@occurrence`` counts *consultations* of that point (1-based); omitting it
+means "every time".  Each armed point fires at most once per occurrence —
+``fire()`` is exact-match, not ">=", so ``kill@12`` kills exactly at the
+12th consultation and a resumed process (whose counter restarts) can be
+armed differently via the environment.
+
+Fault points are zero-cost when unarmed: ``fire()`` is a dict lookup on an
+empty dict.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "arm",
+    "disarm",
+    "fire",
+    "active_spec",
+    "poison_batch",
+    "tear_file",
+    "KNOWN_POINTS",
+]
+
+_log = logging.getLogger("paddle_tpu.robustness.chaos")
+
+_ENV = "PADDLE_TPU_CHAOS"
+
+# the documented fault surface; arming an unknown point raises so a typo'd
+# drill never silently tests nothing
+KNOWN_POINTS = frozenset(
+    {"nan_batch", "torn_checkpoint", "kill", "stale_lease"}
+)
+
+# point -> occurrence to fire at (None = every consultation)
+_armed: Dict[str, Optional[int]] = {}
+# point -> how many times it has been consulted
+_counts: Dict[str, int] = {}
+_env_loaded = False
+
+
+def _parse(spec: str) -> Dict[str, Optional[int]]:
+    out: Dict[str, Optional[int]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, occ = part.partition("@")
+        if name not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown chaos point {name!r}; known: {sorted(KNOWN_POINTS)}"
+            )
+        out[name] = int(occ) if occ else None
+    return out
+
+
+def arm(spec: str) -> None:
+    """Arm fault points from a spec string (replaces any previous arming)."""
+    global _env_loaded
+    _env_loaded = True  # an explicit arm overrides the environment
+    _armed.clear()
+    _counts.clear()
+    _armed.update(_parse(spec))
+    if _armed:
+        _log.warning("chaos armed: %s", spec)
+
+
+def disarm() -> None:
+    global _env_loaded
+    _armed.clear()
+    _counts.clear()
+    _env_loaded = True  # stay disarmed even if the env var is set
+
+
+def active_spec() -> str:
+    _load_env()
+    return ",".join(
+        f"{k}@{v}" if v is not None else k for k, v in sorted(_armed.items())
+    )
+
+
+def _load_env() -> None:
+    """Lazily pick up the ``chaos`` flag once (the flags plane resolves the
+    PADDLE_TPU_CHAOS environment variable itself) — subprocess tests arm the
+    child through its environment without touching its code."""
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    try:
+        from paddle_tpu.utils import flags as _flags
+
+        spec = _flags.get_flag("chaos")
+    except KeyError:  # flag plane not loaded (stripped deployment)
+        spec = os.environ.get(_ENV)
+    if spec:
+        _armed.update(_parse(spec))
+        _log.warning("chaos armed from %s: %s", _ENV, spec)
+
+
+def fire(point: str) -> bool:
+    """Consult a fault point.  Returns True when the point should inject its
+    fault at this consultation.  Unarmed points cost one dict lookup."""
+    _load_env()
+    if point not in _armed:
+        return False
+    _counts[point] = _counts.get(point, 0) + 1
+    occ = _armed[point]
+    hit = occ is None or _counts[point] == occ
+    if hit:
+        _log.warning(
+            "chaos point %r firing (consultation %d)", point, _counts[point]
+        )
+    return hit
+
+
+# ---------------------------------------------------------------------------
+# Injection helpers (the code each point runs when it fires)
+# ---------------------------------------------------------------------------
+
+def poison_batch(batch):
+    """NaN-poison the first floating-point slot of a feed batch (host side,
+    pre-device_put) — the inject-NaN-batch fault.  Returns the batch."""
+    for key in batch:
+        t = batch[key]
+        data = t.data if hasattr(t, "data") else t
+        arr = np.asarray(data)
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = arr.copy()
+            arr.reshape(-1)[0] = np.nan
+            if hasattr(t, "data"):
+                t.data = arr
+            else:
+                batch[key] = arr
+            _log.warning("chaos: poisoned batch slot %r with NaN", key)
+            return batch
+    _log.warning("chaos: nan_batch fired but batch has no float slot")
+    return batch
+
+
+def tear_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Truncate a file in place — the torn/partial checkpoint write fault
+    (a crash mid-write leaves exactly this on disk)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(int(size * keep_fraction), 1))
+    _log.warning("chaos: tore %s to %d/%d bytes",
+                 path, max(int(size * keep_fraction), 1), size)
+
+
+def kill_self() -> None:
+    """SIGKILL this process — no handlers, no atexit, no flush (the
+    preemption-without-warning fault)."""
+    import signal
+
+    _log.warning("chaos: SIGKILL self (pid %d)", os.getpid())
+    os.kill(os.getpid(), signal.SIGKILL)

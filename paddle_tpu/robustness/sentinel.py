@@ -1,0 +1,149 @@
+"""Divergence sentinel — the host half of the fused health check.
+
+The device half lives in the jitted train step (trainer/step.py): one scalar
+``health`` flag (loss AND gradient global-norm both finite) computed inside
+the same XLA program as the step, and a per-leaf select that keeps params /
+optimizer state / layer state untouched when the flag is down — a non-finite
+batch is *skipped*, never applied.  The flag rides the step's metric outputs,
+so observing it costs no extra device round-trip: the training loop already
+fetches the cost scalar each iteration, and fetch-free loops (multi-step
+scan dispatch) fold it across the scan and check every K dispatches.
+
+This class is the judgment layer over those observations:
+
+* **skip accounting** — every down flag bumps ``robustness.skipped_steps``
+  (StatSet); ``skip_limit`` consecutive skips declare divergence (the data
+  window is poisoned beyond what per-step skipping can absorb).
+* **EMA loss-spike detection** — finite but exploding losses never trip the
+  finiteness flag; an exponential moving average of the healthy cost plus a
+  spike factor catches them: ``patience`` consecutive observations above
+  ``spike_factor x EMA`` declare divergence (TensorFlow's user-level
+  health-check model, arXiv:1605.08695 §4.4 — the non-blocking signal that
+  triggers user-level recovery).
+
+Verdicts: ``"ok"`` | ``"skip"`` (step was dropped on device) |
+``"diverged"`` (roll back — see robustness.recovery).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+from paddle_tpu.utils.timers import global_stats
+
+__all__ = ["DivergenceSentinel"]
+
+_log = logging.getLogger("paddle_tpu.robustness")
+
+
+class DivergenceSentinel:
+    def __init__(
+        self,
+        skip_limit: int = 3,
+        ema_decay: float = 0.98,
+        spike_factor: float = 4.0,
+        spike_patience: int = 3,
+        warmup_steps: int = 20,
+        min_spike_cost: float = 1e-3,
+        stats=None,
+    ):
+        """warmup_steps: observations before the EMA is trusted (early
+        training legitimately moves fast).  min_spike_cost: absolute floor
+        under which no cost counts as a spike (a jitter from 1e-6 to 4e-6
+        is convergence noise, not divergence)."""
+        self.skip_limit = max(int(skip_limit), 1)
+        self.ema_decay = float(ema_decay)
+        self.spike_factor = float(spike_factor)
+        self.spike_patience = max(int(spike_patience), 1)
+        self.warmup_steps = int(warmup_steps)
+        self.min_spike_cost = float(min_spike_cost)
+        self._stats = stats if stats is not None else global_stats
+        self.reset()
+        # lifetime counters survive reset() — reset clears the *judgment*
+        # state after a rollback, not the run's history
+        self.total_skipped = 0
+        self.total_spikes = 0
+
+    # ------------------------------------------------------------------
+    def reset(self) -> None:
+        """Forget judgment state (EMA, streaks) — called after a rollback so
+        the restored trajectory is judged fresh, not against the diverged
+        run's statistics."""
+        self.ema: Optional[float] = None
+        self._n_obs = 0
+        self._skip_streak = 0
+        self._spike_streak = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def steady(self) -> bool:
+        """No skip/spike streak in flight — safe to call this state
+        'last-good' (the recovery plane refuses to anchor a checkpoint on a
+        trajectory that is mid-incident)."""
+        return self._skip_streak == 0 and self._spike_streak == 0
+
+    def observe(self, cost: float, healthy: bool) -> str:
+        """Fold one step's fetched (cost, health-flag) pair; returns the
+        verdict for THIS step."""
+        self._n_obs += 1
+        if not healthy:
+            self._skip_streak += 1
+            self.total_skipped += 1
+            self._stats.incr("robustness.skipped_steps")
+            _log.warning(
+                "sentinel: non-finite step skipped on device "
+                "(streak %d/%d)", self._skip_streak, self.skip_limit,
+            )
+            if self._skip_streak >= self.skip_limit:
+                return "diverged"
+            return "skip"
+        self._skip_streak = 0
+        if not math.isfinite(cost):
+            # healthy flag up but fetched cost non-finite: only possible
+            # when the sentinel's device half is disabled — treat as a skip
+            # that DID apply (no select protected the params)
+            self.total_skipped += 1
+            self._stats.incr("robustness.skipped_steps")
+            return "diverged"
+        if (
+            self.ema is not None
+            and self._n_obs > self.warmup_steps
+            and cost > self.min_spike_cost
+            and cost > self.spike_factor * self.ema
+        ):
+            self._spike_streak += 1
+            self.total_spikes += 1
+            self._stats.incr("robustness.loss_spikes")
+            _log.warning(
+                "sentinel: loss spike %.6g vs EMA %.6g "
+                "(streak %d/%d)", cost, self.ema,
+                self._spike_streak, self.spike_patience,
+            )
+            if self._spike_streak >= self.spike_patience:
+                return "diverged"
+            # a spiking cost must not drag the EMA up toward itself —
+            # the baseline stays the pre-spike trajectory
+            return "ok"
+        self._spike_streak = 0
+        self.ema = (
+            cost
+            if self.ema is None
+            else self.ema_decay * self.ema + (1.0 - self.ema_decay) * cost
+        )
+        self._stats.observe("robustness.loss_ema", self.ema)
+        return "ok"
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_flags(cls, stats=None) -> "DivergenceSentinel":
+        from paddle_tpu.utils import flags as _flags
+
+        return cls(
+            skip_limit=_flags.get_flag("sentinel_skip_limit"),
+            ema_decay=_flags.get_flag("sentinel_ema_decay"),
+            spike_factor=_flags.get_flag("sentinel_spike_factor"),
+            spike_patience=_flags.get_flag("sentinel_spike_patience"),
+            stats=stats,
+        )

@@ -29,7 +29,7 @@ from paddle_tpu.trainer.evaluators import default_metrics_fn
 from paddle_tpu.trainer.step import make_eval_step, make_train_step
 
 _log = logging.getLogger("paddle_tpu.trainer")
-from paddle_tpu.utils.timers import stat_timer
+from paddle_tpu.utils.timers import global_stats, stat_timer
 
 
 def _batch_rows(batch) -> int:
@@ -250,6 +250,9 @@ class SGD:
         start_pass: int = 0,
         show_parameter_stats_period: Optional[int] = None,
         async_load_data: bool = True,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_period_batches: Optional[int] = None,
+        resume: bool = False,
     ) -> None:
         """Pass loop with the reference trainer's checkpoint cadence: every
         `saving_period` passes (and optionally every `saving_period_by_batches`
@@ -274,10 +277,34 @@ class SGD:
         dtype preserved, optional ``data_echo_factor`` echo) and every
         later pass replays them with a seed-reproducible on-device shuffle
         — zero H2D traffic, no per-batch Python feed.  A pass that blows
-        the HBM budget falls back to streaming with a warning."""
+        the HBM budget falls back to streaming with a warning.
+
+        Fault tolerance (robustness/): with ``checkpoint_dir`` set, the
+        trainer writes full-state checkpoints (params + optimizer state +
+        RNG + pass/batch position) every ``checkpoint_period_batches``
+        batches (None = the flag) and at every pass boundary.  The
+        divergence sentinel (``divergence_sentinel`` flag, fused into the
+        jitted step) skips non-finite steps on device; when it declares
+        divergence (skip streak or EMA loss spike), the trainer rolls back
+        to the last-good checkpoint and applies the master's ``failure_max``
+        discipline to the offending data window — retry from the retained
+        batches, then quarantine and continue.  SIGTERM/SIGINT trigger a
+        synchronous final checkpoint + ``PREEMPTED`` marker and return
+        (``self.preempted`` is True); ``resume=True`` restores the latest
+        good checkpoint (walking past torn ones) and skips the interrupted
+        pass's already-consumed batches, so with a deterministic streamed
+        reader the resumed trajectory matches an uninterrupted run
+        bit-for-bit.  (A ``cache_pass_in_mem`` run resumes from the
+        checkpoint but streams its remaining passes — the interrupted
+        process's device-resident capture cannot be reconstructed.)"""
         if event_handler is None:
             event_handler = lambda e: None
+        import itertools
+        from collections import deque
+        from contextlib import nullcontext
+
         from paddle_tpu.reader.prefetch import prefetch
+        from paddle_tpu.robustness import chaos as _chaos
         from paddle_tpu.utils import flags as _flags
 
         if show_parameter_stats_period is None:  # explicit 0 still disables
@@ -289,7 +316,65 @@ class SGD:
 
         def _stage(data_batch):
             with stat_timer("feed"):
-                return shard_batch(feeder(data_batch), self.mesh)
+                fed = feeder(data_batch)
+                if _chaos.fire("nan_batch"):
+                    fed = _chaos.poison_batch(fed)
+                return shard_batch(fed, self.mesh)
+
+        # -- robustness plane: sentinel + rollback + preemption ----------
+        self.preempted = False
+        sentinel = None
+        if _flags.get_flag("divergence_sentinel"):
+            from paddle_tpu.robustness.sentinel import DivergenceSentinel
+
+            sentinel = DivergenceSentinel.from_flags()
+        recovery = manager = None
+        if checkpoint_dir:
+            from paddle_tpu import checkpoint as _ckpt
+            from paddle_tpu.robustness.recovery import RecoveryCoordinator
+
+            manager = _ckpt.CheckpointManager(checkpoint_dir)
+            recovery = RecoveryCoordinator.from_flags(
+                save_fn=lambda step, extra: self.save_checkpoint(
+                    manager, step=step, extra=extra
+                ),
+                restore_fn=lambda: self._restore_latest_full(manager),
+            )
+            if checkpoint_period_batches is None:
+                checkpoint_period_batches = _flags.get_flag(
+                    "checkpoint_period_batches"
+                )
+            if not resume and manager.latest_step() is not None:
+                _log.warning(
+                    "checkpoint_dir %s already holds checkpoints from a "
+                    "previous run but resume=False — a rollback could "
+                    "restore stale state; use a fresh directory or resume",
+                    checkpoint_dir,
+                )
+        elif resume:
+            raise ValueError("resume=True requires checkpoint_dir")
+
+        resume_extra = None
+        skip_batches = 0
+        first_pass = start_pass
+        if resume:
+            resume_extra = recovery.resume()
+            if resume_extra is None:
+                _log.warning(
+                    "resume: no usable checkpoint under %s; starting fresh",
+                    checkpoint_dir,
+                )
+            else:
+                from paddle_tpu.robustness.preemption import clear_marker
+
+                clear_marker(checkpoint_dir)
+                first_pass = int(resume_extra.get("pass_id", start_pass))
+                skip_batches = int(resume_extra.get("batch_id", -1)) + 1
+                _log.info(
+                    "resumed at step %d: pass %d, skipping %d already-"
+                    "consumed batch(es)",
+                    self._step_count, first_pass, skip_batches,
+                )
 
         # epoch-aware feed switch: capture pass 1 into the device-resident
         # cache, replay it for every later pass (per-bucket batches keep
@@ -301,6 +386,23 @@ class SGD:
         cache_requested = _flags.get_flag("cache_pass_in_mem") or bool(
             getattr(reader, "cache_pass_in_mem", False)
         )
+        if cache_requested and resume_extra is not None and not (
+            self._pass_cache is not None and self._pass_cache.ready
+        ):
+            # a resumed process cannot reconstruct the interrupted run's
+            # cache: a mid-pass resume would capture only the pass's TAIL,
+            # and even a pass-boundary resume would capture the wrong pass
+            # (the original captured pass `first_pass` raw order and
+            # replays every later pass shuffled) — stream the remaining
+            # passes instead.  The trajectory still continues exactly from
+            # the checkpoint, but epoch order past it is the streamed
+            # reader's, not the cached replay's.
+            _log.warning(
+                "pass cache disabled on resume: the interrupted run's "
+                "capture cannot be reconstructed mid-stream; streaming "
+                "the remaining passes",
+            )
+            cache_requested = False
         echo_factor = (
             max(int(_flags.get_flag("data_echo_factor")), 1)
             if cache_requested
@@ -336,7 +438,33 @@ class SGD:
 
         params, state = self.parameters.params, self.parameters.state
         opt_state = self._opt_state
-        for pass_id in range(start_pass, start_pass + num_passes):
+        if recovery is not None:
+            from paddle_tpu.robustness.preemption import (
+                PreemptionGuard,
+                write_marker,
+            )
+
+            guard = PreemptionGuard()
+            if resume_extra is None and self._width_resolved:
+                # rollback needs an anchor before the first batch lands —
+                # otherwise an early divergence has nothing to restore.
+                # (A dynamic-width network's weight shapes pin to the FIRST
+                # batch; anchoring pre-resolution would restore placeholder
+                # shapes into a loop that believes widths are resolved, so
+                # its anchor waits for the first periodic checkpoint.)
+                recovery.checkpoint(
+                    self._step_count,
+                    {
+                        "step_count": self._step_count,
+                        "pass_id": first_pass,
+                        "batch_id": -1,
+                    },
+                )
+        else:
+            guard = None
+        with (guard if guard is not None else nullcontext()):
+          for pass_id in range(first_pass, start_pass + num_passes):
+            skip = skip_batches if pass_id == first_pass else 0
             event_handler(v2_event.BeginPass(pass_id))
             if "pass" in opt_state:
                 # pass_manual schedule: the optimizer reads the pass index
@@ -350,25 +478,52 @@ class SGD:
             pass_costs: List[float] = []
             pass_weights: List[int] = []
             pass_accums: Dict[str, np.ndarray] = {}
+            # rollback bookmarks: the pass report must not double-count a
+            # retried window (truncate back to the last checkpoint's mark)
+            costs_mark = 0
+            accums_mark: Dict[str, np.ndarray] = {}
             if pass_cache is not None and pass_cache.ready:
                 # cached pass: device-resident replay, seed-reproducible
                 # shuffle, zero H2D — the feeder/prefetcher never runs
                 batches = pass_cache.epoch(pass_id)
+                if skip:
+                    batches = itertools.islice(batches, skip, None)
             else:
+                raw = iter(reader())
+                if skip:
+                    # resume mid-pass: drain the already-consumed batches
+                    # without staging them (the reader's own RNG stream
+                    # advances exactly as the interrupted run's did)
+                    for _ in range(skip):
+                        next(raw, None)
                 batches = (
-                    prefetch(reader(), _stage)
+                    prefetch(raw, _stage)
                     if async_load_data
-                    else map(_stage, reader())
+                    else map(_stage, raw)
                 )
                 if pass_cache is not None and pass_cache.active:
                     batches = pass_cache.capture(batches)
-                elif echo_factor > 1 and pass_id == start_pass:
+                elif echo_factor > 1 and pass_id == first_pass:
                     # single-pass (or overflowed) run with data echo: train
                     # each transferred batch echo_factor times, retain none
                     batches = (
                         b for bb in batches for b in (bb,) * echo_factor
                     )
-            for batch_id, batch in enumerate(batches):
+            live = iter(batches)
+            replay: deque = deque()
+            batch_id = skip - 1
+            while True:
+                if replay:
+                    _, bid, batch = replay.popleft()
+                    is_live = False
+                else:
+                    try:
+                        batch = next(live)
+                    except StopIteration:
+                        break
+                    batch_id += 1
+                    bid = batch_id
+                    is_live = True
                 if not self._width_resolved:
                     # fc/matrix-projection weights over a whole-minibatch
                     # trans have a batch-dependent height; the FIRST batch
@@ -381,25 +536,45 @@ class SGD:
                     )
                     if chg:  # weight shapes moved: optimizer slots follow
                         opt_state = self.optimizer.init(params)
-                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                event_handler(v2_event.BeginIteration(pass_id, bid))
                 if self.compile_cache.observe(batch) and self._step_count:
                     # a NEW batch shape after warmup = a jit recompile; say
                     # so at debug level (the hit/miss counters aggregate in
                     # the StatSet table either way)
                     _log.debug(
                         "train batch %d brings new shape (distinct shapes "
-                        "now %d)", batch_id, self.compile_cache.n_shapes,
+                        "now %d)", bid, self.compile_cache.n_shapes,
                     )
+                if is_live and recovery is not None:
+                    recovery.record(pass_id, bid, batch)
                 with stat_timer("train_step"):
                     self._rng, step_rng = jax.random.split(self._rng)
                     params, state, opt_state, metrics = self._train_step(
                         params, state, opt_state, batch, step_rng
                     )
                 self._step_count += 1
+                health = metrics.pop("health", None)
+                grad_norm = metrics.pop("grad_norm", None)
+                cost = float(metrics["cost"])
+                if _chaos.fire("kill"):  # hard-preemption drill: no flush
+                    _chaos.kill_self()
+                verdict = "ok"
+                if sentinel is not None and health is not None:
+                    # this loop fetches the cost scalar every step anyway
+                    # (events need it), so judging every step costs no
+                    # extra sync — sentinel_check_interval only matters for
+                    # fetch-free multi-step dispatch loops, which use the
+                    # folded health/skipped_steps of make_multi_train_step
+                    healthy = float(health) >= 0.5
+                    if healthy and grad_norm is not None:
+                        global_stats.observe(
+                            "robustness.grad_norm", float(grad_norm)
+                        )
+                    verdict = sentinel.observe(cost, healthy)
                 if log_period and self._step_count % log_period == 0:
                     _log.info(
                         "pass %d batch %d cost %.6f",
-                        pass_id, batch_id, float(metrics["cost"]),
+                        pass_id, bid, cost,
                     )
                 if (
                     show_parameter_stats_period
@@ -416,24 +591,100 @@ class SGD:
                         self._step_count,
                         format_parameter_stats(parameter_stats(params)),
                     )
-                cost = float(metrics["cost"])
-                pass_costs.append(cost)
-                pass_weights.append(_batch_rows(batch))
-                evaluator, accums = self._split_metrics(metrics)
-                for k, v in accums.items():
-                    pass_accums[k] = pass_accums.get(k, 0) + v
-                evaluator.update(self._finalize(accums))
+                evaluator: Dict[str, float] = {}
+                if verdict == "ok":
+                    pass_costs.append(cost)
+                    pass_weights.append(_batch_rows(batch))
+                    evaluator, accums = self._split_metrics(metrics)
+                    for k, v in accums.items():
+                        pass_accums[k] = pass_accums.get(k, 0) + v
+                    evaluator.update(self._finalize(accums))
                 event_handler(
-                    v2_event.EndIteration(pass_id, batch_id, cost, evaluator)
+                    v2_event.EndIteration(pass_id, bid, cost, evaluator)
                 )
+                if not is_live and not replay and recovery is not None:
+                    recovery.replay_done()  # window re-applied cleanly
+                if verdict == "diverged":
+                    if recovery is None:
+                        _log.error(
+                            "divergence detected at pass %d batch %d but no "
+                            "checkpoint_dir is set — cannot roll back",
+                            pass_id, bid,
+                        )
+                        if sentinel is not None:
+                            sentinel.reset()
+                    else:
+                        action, window = recovery.on_divergence()
+                        if action != "none":
+                            # restore_fn updated self.*; resync the loop's
+                            # working refs and drop the undone bookkeeping
+                            params = self.parameters.params
+                            state = self.parameters.state
+                            opt_state = self._opt_state
+                            del pass_costs[costs_mark:]
+                            del pass_weights[costs_mark:]
+                            pass_accums = {
+                                k: np.copy(v) for k, v in accums_mark.items()
+                            }
+                            if sentinel is not None:
+                                sentinel.reset()
+                            if action == "retry":
+                                replay = deque(window)
+                    continue
                 if (
-                    save_dir
-                    and saving_period_by_batches
-                    and (batch_id + 1) % saving_period_by_batches == 0
+                    recovery is not None
+                    and verdict == "ok"
+                    and checkpoint_period_batches
+                    and not recovery.replaying
+                    and (sentinel is None or sentinel.steady)
+                    and self._step_count % checkpoint_period_batches == 0
                 ):
                     self.parameters.params, self.parameters.state = params, state
                     self._opt_state = opt_state
-                    self.save_pass(save_dir, pass_id, batch_id=batch_id + 1)
+                    recovery.checkpoint(
+                        self._step_count,
+                        {
+                            "step_count": self._step_count,
+                            "pass_id": pass_id,
+                            "batch_id": bid,
+                        },
+                    )
+                    costs_mark = len(pass_costs)
+                    accums_mark = {
+                        k: np.copy(v) for k, v in pass_accums.items()
+                    }
+                if (
+                    save_dir
+                    and saving_period_by_batches
+                    and (bid + 1) % saving_period_by_batches == 0
+                ):
+                    self.parameters.params, self.parameters.state = params, state
+                    self._opt_state = opt_state
+                    self.save_pass(save_dir, pass_id, batch_id=bid + 1)
+                if guard is not None and guard.triggered:
+                    # preemption: finish THIS step's bookkeeping, persist a
+                    # synchronous final checkpoint + marker, hand back
+                    self.parameters.params, self.parameters.state = params, state
+                    self._opt_state = opt_state
+                    extra = {
+                        "step_count": self._step_count,
+                        "pass_id": pass_id,
+                        "batch_id": bid,
+                        "preempted": True,
+                    }
+                    self.save_checkpoint(
+                        manager, step=self._step_count, extra=extra
+                    )
+                    write_marker(
+                        checkpoint_dir, {**extra, "signal": guard.signum}
+                    )
+                    self.preempted = True
+                    _log.warning(
+                        "preempted at pass %d batch %d (step %d): state "
+                        "checkpointed under %s; restart with resume=True",
+                        pass_id, bid, self._step_count, checkpoint_dir,
+                    )
+                    return
             # persist latest values so checkpoints/test see them
             self.parameters.params, self.parameters.state = params, state
             self._opt_state = opt_state
@@ -456,6 +707,17 @@ class SGD:
             event_handler(v2_event.EndPass(pass_id, pass_metrics))
             if save_dir and (pass_id + 1 - start_pass) % saving_period == 0:
                 self.save_pass(save_dir, pass_id)
+            if recovery is not None:
+                # pass boundary = a natural last-good anchor; position says
+                # "start of the next pass" so resume never re-reads this one
+                recovery.checkpoint(
+                    self._step_count,
+                    {
+                        "step_count": self._step_count,
+                        "pass_id": pass_id + 1,
+                        "batch_id": -1,
+                    },
+                )
         self.parameters.params, self.parameters.state = params, state
         self._opt_state = opt_state
 
@@ -551,16 +813,34 @@ class SGD:
             "rng": self._rng,
         }
 
-    def save_checkpoint(self, manager, step: Optional[int] = None, async_: bool = False) -> None:
+    def save_checkpoint(
+        self,
+        manager,
+        step: Optional[int] = None,
+        async_: bool = False,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
         """Write params + optimizer state + counters through a
         checkpoint.CheckpointManager (the Go-pserver-style full checkpoint,
-        reference go/pserver/service.go:244-303 — sans pserver)."""
+        reference go/pserver/service.go:244-303 — sans pserver).  ``extra``
+        merges into the meta's extra dict (the recovery plane stores the
+        pass/batch position there)."""
         manager.save(
             step if step is not None else self._step_count,
             self._full_state(),
-            extra={"step_count": self._step_count},
+            extra={"step_count": self._step_count, **(extra or {})},
             async_=async_,
         )
+
+    def _apply_restored(self, tree, extra) -> None:
+        self.parameters.params = tree["params"]
+        self.parameters.state = tree["state"]
+        self._opt_state = tree["opt_state"]
+        import jax.numpy as jnp
+
+        self._rng = jnp.asarray(tree["rng"])
+        self._step_count = int(extra.get("step_count", self._step_count))
+        self._reshard_after_restore()
 
     def restore_checkpoint(self, manager, step: Optional[int] = None) -> bool:
         """Restore the latest (or given) checkpoint; returns False when the
@@ -572,15 +852,20 @@ class SGD:
             _, tree, extra = restored
         else:
             tree, extra = manager.restore(step, self._full_state())
-        self.parameters.params = tree["params"]
-        self.parameters.state = tree["state"]
-        self._opt_state = tree["opt_state"]
-        import jax.numpy as jnp
-
-        self._rng = jnp.asarray(tree["rng"])
-        self._step_count = int(extra.get("step_count", self._step_count))
-        self._reshard_after_restore()
+        self._apply_restored(tree, extra)
         return True
+
+    def _restore_latest_full(self, manager) -> Optional[Dict[str, Any]]:
+        """restore_checkpoint returning the checkpoint's ``extra`` dict (the
+        recovery/resume position plane) — None when nothing restorable; a
+        torn/corrupt newest checkpoint falls back to the previous retained
+        one inside the manager."""
+        restored = manager.restore_latest(self._full_state())
+        if restored is None:
+            return None
+        _, tree, extra = restored
+        self._apply_restored(tree, extra)
+        return dict(extra)
 
     def _reshard_after_restore(self) -> None:
         """Checkpoints come back as host arrays; re-apply the model-axis

@@ -71,14 +71,43 @@ def apply_prune_masks(params: Params, masks: Optional[Params]) -> Params:
     return out
 
 
+def _global_norm(grads) -> jnp.ndarray:
+    """float32 l2 norm over every gradient leaf — one fused reduction; any
+    NaN/Inf leaf makes the result non-finite, so finiteness of this single
+    scalar is the whole-tree health signal."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    )
+
+
+def _sentinel_enabled(sentinel: Optional[bool]) -> bool:
+    if sentinel is not None:
+        return bool(sentinel)
+    from paddle_tpu.utils.flags import get_flag
+
+    return bool(get_flag("divergence_sentinel"))
+
+
 def _train_step_body(
     network: CompiledNetwork,
     optimizer: Optimizer,
     extra_metrics=None,
     prune_masks: Optional[Params] = None,
+    sentinel: Optional[bool] = None,
 ):
     """The un-jitted single-step computation shared by make_train_step and
-    make_multi_train_step: forward, grad, optimizer update, metrics."""
+    make_multi_train_step: forward, grad, optimizer update, metrics.
+
+    sentinel (None = the ``divergence_sentinel`` flag): fuse a finiteness
+    check of the loss and the gradient global-norm into the step.  The
+    ``health`` flag (1.0 = finite) rides the metrics — no extra host sync —
+    and an unhealthy step passes params / layer state / optimizer state
+    through UNCHANGED (per-leaf select), so one NaN batch is a skipped step,
+    not a corrupted run (robustness/sentinel.py is the host-side judge)."""
+    guard = _sentinel_enabled(sentinel)
 
     def step(params, state, opt_state, batch, rng):
         def loss_fn(p):
@@ -90,6 +119,22 @@ def _train_step_body(
         new_params, new_opt_state = optimizer.update(grads, opt_state, params)
         new_params = apply_prune_masks(new_params, prune_masks)
         metrics = {"cost": cost}
+        if guard:
+            grad_norm = _global_norm(grads)
+            healthy = jnp.isfinite(cost.astype(jnp.float32)) & jnp.isfinite(
+                grad_norm
+            )
+
+            def keep(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(healthy, n, o), new, old
+                )
+
+            new_params = keep(new_params, params)
+            new_state = keep(new_state, state)
+            new_opt_state = keep(new_opt_state, opt_state)
+            metrics["health"] = healthy.astype(jnp.float32)
+            metrics["grad_norm"] = grad_norm
         if extra_metrics is not None:
             metrics.update(extra_metrics(outs))
         return new_params, new_state, new_opt_state, metrics
@@ -106,6 +151,7 @@ def make_train_step(
     ] = None,
     infer_param_shardings: bool = False,
     prune_masks: Optional[Params] = None,
+    sentinel: Optional[bool] = None,
 ):
     """Returns jitted
     (params, state, opt_state, batch, rng) ->
@@ -114,8 +160,10 @@ def make_train_step(
     With infer_param_shardings=True the params/opt_state shardings follow the
     argument placement (use parallel.sharding.shard_params first) so
     model-axis-sharded tables stay sharded through the update; otherwise
-    params are pinned replicated."""
-    step = _train_step_body(network, optimizer, extra_metrics, prune_masks)
+    params are pinned replicated.  sentinel: see _train_step_body."""
+    step = _train_step_body(
+        network, optimizer, extra_metrics, prune_masks, sentinel=sentinel
+    )
 
     if mesh is None or infer_param_shardings:
         # No mesh, or sharding flows from the arguments (batch via
@@ -142,6 +190,7 @@ def make_multi_train_step(
         Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
     ] = None,
     prune_masks: Optional[Params] = None,
+    sentinel: Optional[bool] = None,
 ):
     """``n_steps`` train steps in ONE dispatch: lax.scan of the single-step
     body over batches stacked on a leading [n_steps, ...] axis.
@@ -156,8 +205,15 @@ def make_multi_train_step(
     amortizes that cost K-fold, which is also how a production input
     pipeline behaves locally (async dispatch keeps the device queue full).
     The reference's TrainerBenchmark loop has no such boundary — its
-    trainOneBatch is a C++ call."""
-    step = _train_step_body(network, optimizer, extra_metrics, prune_masks)
+    trainOneBatch is a C++ call.
+
+    With the sentinel on, each scanned step skips independently on device;
+    the returned metrics fold the whole dispatch: ``health`` is the MIN over
+    the K steps and ``skipped_steps`` counts the dropped ones, so a fetch
+    every K dispatches still sees every skip."""
+    step = _train_step_body(
+        network, optimizer, extra_metrics, prune_masks, sentinel=sentinel
+    )
 
     def multi(params, state, opt_state, batches, rng):
         rngs = jax.random.split(rng, n_steps)
@@ -171,7 +227,11 @@ def make_multi_train_step(
         (p, s, o), ms = jax.lax.scan(
             body, (params, state, opt_state), (batches, rngs)
         )
-        return p, s, o, jax.tree_util.tree_map(lambda x: x[-1], ms)
+        out = jax.tree_util.tree_map(lambda x: x[-1], ms)
+        if "health" in ms:
+            out["health"] = jnp.min(ms["health"])
+            out["skipped_steps"] = jnp.sum(1.0 - ms["health"])
+        return p, s, o, out
 
     if mesh is None:
         return jax.jit(multi, donate_argnums=(0, 1, 2))

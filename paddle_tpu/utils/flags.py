@@ -138,6 +138,47 @@ define_flag("pass_cache_hbm_budget_mb", 4096,
             "in wire form / data-axis size (uint8 224x224x3 ~ 0.15 "
             "MB/image; a batch sharded over n chips counts its largest "
             "per-device shard)")
+define_flag("divergence_sentinel", True,
+            "fold a device-side finiteness check of loss + gradient global-"
+            "norm into the jitted train step (robustness/): one fused "
+            "scalar health flag rides the step's metric outputs, and a "
+            "non-finite step is SKIPPED on device (params/opt-state pass "
+            "through unchanged) instead of corrupting the run.  The flag "
+            "costs one norm reduction per step and no extra host sync")
+define_flag("sentinel_check_interval", 1,
+            "health-flag fetch cadence for FETCH-FREE dispatch loops "
+            "(multi-step scan drivers fold min-health + skip counts per "
+            "dispatch, trainer/step.py make_multi_train_step, and check "
+            "the fold every N dispatches).  SGD.train ignores this: its "
+            "loop syncs on the cost scalar every step anyway, so it "
+            "judges every step at zero extra cost")
+define_flag("sentinel_skip_limit", 3,
+            "consecutive device-skipped (non-finite) steps that declare "
+            "divergence and trigger rollback (robustness.recovery)")
+define_flag("sentinel_ema_decay", 0.98,
+            "decay of the healthy-loss EMA the spike detector compares "
+            "against")
+define_flag("sentinel_spike_factor", 4.0,
+            "a fetched cost above spike_factor x EMA counts as a loss "
+            "spike; sentinel_spike_patience consecutive spikes declare "
+            "divergence even when every value is finite")
+define_flag("sentinel_spike_patience", 3,
+            "consecutive EMA spikes before the sentinel declares "
+            "divergence")
+define_flag("failure_max", 3,
+            "rollback retries of the same data window before it is "
+            "quarantined and training continues past it — the go/master "
+            "processFailedTask discipline (service.go:308) applied to "
+            "training-state recovery")
+define_flag("checkpoint_period_batches", 50,
+            "full-state checkpoint cadence (in batches) when the trainer "
+            "runs with checkpoint_dir; each checkpoint is the rollback "
+            "anchor AND the preemption/kill -9 resume point, and bounds "
+            "the replay window retained on device")
+define_flag("chaos", "",
+            "chaos fault-point spec, e.g. 'nan_batch@5,kill@12' "
+            "(robustness/chaos.py; env PADDLE_TPU_CHAOS reaches "
+            "subprocesses) — NEVER set in production")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

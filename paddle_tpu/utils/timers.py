@@ -53,6 +53,13 @@ class StatSet:
         with self._lock:
             self._stats.setdefault(name, _Stat()).count += n
 
+    def observe(self, name: str, value: float) -> None:
+        """Value stat: fold a measured scalar (gradient norm, loss EMA)
+        into the same summary surface — `total`/`avg`/`max` are over the
+        observed values instead of wall seconds."""
+        with self._lock:
+            self._stats.setdefault(name, _Stat()).add(float(value))
+
     def count(self, name: str) -> int:
         with self._lock:
             s = self._stats.get(name)
