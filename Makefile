@@ -13,11 +13,15 @@
 #                  failing-test SET against tests/tier1_failures_baseline.txt
 #                  (scripts/tier1_failset.py), so CI catches a newly broken
 #                  test even when another fix keeps the count unchanged.
-#   make chaos   — the fast fault-injection subset (NaN-inject, torn
-#                  checkpoint, subprocess kill -9 + --resume): the
-#                  robustness plane proven against real injected failures.
-#                  These tests live in tests/ unmarked, so `make test`
-#                  runs them too; this target is the focused drill.
+#                  tier1-check also verifies the multi-process e2e files
+#                  stay slow-marked (--slow-guard) — they must never creep
+#                  into the fast tier.
+#   make chaos   — the fault-injection drills: the single-process subset
+#                  (NaN-inject, torn checkpoint, subprocess kill -9 +
+#                  --resume) plus the elastic kill-one-of-N scenarios
+#                  (tests/test_elastic_e2e.py: 4 worker processes, one
+#                  SIGKILLed mid-pass holding a shard lease — leases
+#                  requeue, params stay bit-for-bit).
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
@@ -33,6 +37,7 @@ test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
 
 tier1-check:
+	$(CPU_ENV) $(PY) scripts/tier1_failset.py --slow-guard
 	$(CPU_ENV) $(PY) scripts/tier1_failset.py --check
 
 tier1-update:
@@ -40,6 +45,7 @@ tier1-update:
 
 chaos:
 	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_elastic_e2e.py -q
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

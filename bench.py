@@ -1558,6 +1558,132 @@ def bench_scaling_virtual8() -> dict:
     }
 
 
+def bench_elastic_scaling() -> dict:
+    """1→N multi-PROCESS scaling-efficiency curve over the elastic cluster
+    plane (ROADMAP item 3, the MULTICHIP_r06 record): N real worker
+    processes lease data-shard tasks from an HA master, contribute
+    deterministic per-task gradients, fence + reduce per pass, and write
+    sharded checkpoints.  Workers run the numpy model so the curve measures
+    task compute + lease/RPC/fence coordination, not interpreter boot
+    (per-worker work-phase timestamps bound the span).  CPU processes on an
+    oversubscribed container make the absolute speedup correctness-grade;
+    what the guard holds is that the protocol round-trips at N>=4 with
+    per-N parameter equality (the N-invariance of the task-ordered
+    reduction)."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from paddle_tpu.io import recordio
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.master_ha import HAMaster
+
+    base = tempfile.mkdtemp(prefix="elastic-bench-")
+    rng = np.random.RandomState(0)
+    dim, hidden, n_rec, passes = 256, 512, 16384, 2
+    w_true = rng.randn(dim).astype(np.float32)
+    data = os.path.join(base, "data.rio")
+    recordio.write_records(
+        data,
+        (
+            np.concatenate(
+                [x := rng.randn(dim).astype(np.float32),
+                 [np.float32(np.tanh(x @ w_true))]]
+            ).astype(np.float32).tobytes()
+            for _ in range(n_rec)
+        ),
+        max_chunk_records=64,
+    )  # 256 chunks -> 32 tasks/pass at 8 chunks/task
+
+    def run_fleet(n: int):
+        d = os.path.join(base, f"n{n}")
+        ck = os.path.join(d, "ck")
+        ha = HAMaster(
+            os.path.join(d, "ha"), [data], owner_id="bench-driver",
+            lease_timeout=5.0, chunks_per_task=8, timeout_s=60.0,
+            worker_timeout_s=5.0, auto_rotate=False,
+            snapshot_min_interval_s=0.5,
+        )
+        ha.start()
+        assert ha.wait_leader(30)
+        # one BLAS thread per worker: otherwise a single process already
+        # saturates every core and the process-scaling curve measures
+        # oversubscription, not the cluster plane
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu", OMP_NUM_THREADS="1",
+            OPENBLAS_NUM_THREADS="1", MKL_NUM_THREADS="1",
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.trainer.elastic",
+                 "--dir", os.path.join(d, "ha"), "--worker-id", f"w{i}",
+                 "--num-passes", str(passes), "--model", "numpy",
+                 "--model-arg", f"dim={dim}",
+                 "--model-arg", f"hidden={hidden}",
+                 "--model-arg", "lr=0.01",
+                 "--min-workers", str(n),
+                 "--checkpoint-dir", ck,
+                 "--stats-out", os.path.join(d, f"stats{i}.json")],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            for i in range(n)
+        ]
+        rcs = [p.wait() for p in procs]
+        ha.stop()
+        assert all(rc == 0 for rc in rcs), f"n={n}: worker rcs {rcs}"
+        stats = []
+        for i in range(n):
+            with open(os.path.join(d, f"stats{i}.json")) as f:
+                stats.append(json.load(f))
+        span = max(s["t_work1"] for s in stats) - min(
+            s["t_work0"] for s in stats
+        )
+        from paddle_tpu.trainer.elastic import NumpyLinearModel
+
+        mgr = CheckpointManager(ck)
+        restored = mgr.restore_latest(
+            NumpyLinearModel(dim, hidden=hidden, seed=0).state()
+        )
+        assert restored is not None, f"n={n}: no committed manifest"
+        return {
+            "span_s": span,
+            "records_per_s": n_rec * passes / max(span, 1e-9),
+            "tasks": sum(s["tasks_done"] for s in stats),
+            "params": restored[1],
+        }
+
+    curve = {}
+    ref_params = None
+    for n in (1, 2, 4):
+        r = run_fleet(n)
+        if ref_params is None:
+            ref_params = r["params"]
+        else:
+            assert np.array_equal(ref_params["w"], r["params"]["w"]), (
+                f"n={n}: reduction is not N-invariant"
+            )
+        curve[n] = {
+            "span_s": round(r["span_s"], 3),
+            "records_per_s": round(r["records_per_s"], 1),
+        }
+    speedup = curve[4]["records_per_s"] / curve[1]["records_per_s"]
+    cores = os.cpu_count() or 1
+    return {
+        "metric": "elastic_scaling_4proc_correctness_only",
+        "value": round(speedup, 3),
+        "unit": "x n4/n1 records/s (cpu multi-process; correctness gate + "
+        "N-invariance proof, not a scaling claim)",
+        "efficiency_4proc": round(speedup / min(4, cores), 3),
+        "host_cores": cores,
+        "curve": curve,
+        "n_records": n_rec,
+        "passes": passes,
+        "backend": "cpu-multiprocess",
+        "vs_baseline": None,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Regression guard — diff every metric against the best committed prior
 # round (the reference keeps its whole perf table as one versioned artifact,
@@ -1645,6 +1771,7 @@ def main() -> None:
     results = []
     for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,
+               bench_elastic_scaling,
                bench_transformer,
                bench_transformer_long_context, bench_transformer_xl_context,
                bench_lstm_textcls,

@@ -116,9 +116,31 @@ def _unflatten_into(template, arrays: Dict[str, np.ndarray]):
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def _shard_file(shard_id: int, num_shards: int) -> str:
+    return f"shard-{shard_id:05d}-of-{num_shards:05d}"
+
+
 class CheckpointManager:
     """Step-indexed checkpoints under ``directory/ckpt-%08d/`` with atomic
-    rename, CRC verification, retention, and optional async writes."""
+    rename, CRC verification, retention, and optional async writes.
+
+    Two write layouts share one read path:
+
+    * **single-writer** (:meth:`save`) — ``state.npz`` + ``meta.json``,
+      committed by atomically renaming the whole step directory;
+    * **sharded multi-writer** (:meth:`save_shard` + :meth:`commit`) — each
+      elastic worker writes ``shard-%05d-of-%05d.npz`` (its slice of the
+      sorted leaf names, round-robin) plus a CRC sidecar straight into the
+      step directory, and the step becomes restorable only when a
+      ``MANIFEST.json`` lands via atomic rename.  A crash that strands a
+      manifest-less shard set, or a torn shard under a committed manifest
+      (CRC mismatch), makes that step unrestorable and
+      :meth:`restore_latest` walks back to the previous complete manifest —
+      the multi-writer generalization of the Go pserver's CRC-checked shard
+      checkpoints (go/pserver/service.go:244-303)."""
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = directory
@@ -185,12 +207,146 @@ class CheckpointManager:
             _chaos.tear_file(os.path.join(final, "state.npz"))
         self._retain()
 
+    # -- sharded multi-writer plane (elastic scale-out) ------------------
+    def save_shard(
+        self,
+        step: int,
+        shard_id: int,
+        num_shards: int,
+        tree: Any,
+        async_: bool = False,
+    ) -> None:
+        """Write THIS process's shard of the state pytree: the
+        ``shard_id``-th slice of the sorted flattened leaf names, taken
+        round-robin over ``num_shards``.  Host-materializes before handing
+        off (the training loop may donate the device buffers immediately);
+        ``async_=True`` runs the disk write off the hot path on a
+        background thread — failures surface from :meth:`wait` and from the
+        next ``save``/``save_shard``.  The step only becomes restorable
+        once every shard landed and :meth:`commit` published the
+        manifest."""
+        # select THIS shard's leaves by key first, then device_get only
+        # those: materializing the whole tree on every worker would pay N
+        # full device-to-host transfers per checkpoint — the cost sharding
+        # exists to avoid
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        keyed = {jax.tree_util.keystr(path): leaf for path, leaf in leaves}
+        keys = sorted(keyed)[shard_id::num_shards]
+        mine = {k: np.asarray(jax.device_get(keyed[k])) for k in keys}
+        self.wait()  # serialize with (and surface) any in-flight write
+        if async_:
+
+            def run():
+                try:
+                    self._write_shard(step, shard_id, num_shards, mine)
+                except BaseException as exc:  # surfaced by the next wait()
+                    self._pending_error = exc
+
+            t = threading.Thread(target=run, daemon=False)
+            t.start()
+            self._pending = t
+        else:
+            self._write_shard(step, shard_id, num_shards, mine)
+
+    def _write_shard(
+        self, step: int, shard_id: int, num_shards: int, arrays: Dict[str, np.ndarray]
+    ) -> None:
+        d = os.path.join(self.directory, f"ckpt-{step:08d}")
+        os.makedirs(d, exist_ok=True)
+        base = _shard_file(shard_id, num_shards)
+        fd, tmp = tempfile.mkstemp(prefix=f".tmp-{base}-", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            crc = _crc_file(tmp)
+            os.replace(tmp, os.path.join(d, base + ".npz"))
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        side = {"crc32": crc, "n_leaves": len(arrays)}
+        side_tmp = os.path.join(d, "." + base + ".json.tmp")
+        with open(side_tmp, "w") as f:
+            json.dump(side, f)
+        os.replace(side_tmp, os.path.join(d, base + ".json"))
+        from paddle_tpu.robustness import chaos as _chaos
+
+        if _chaos.fire("torn_checkpoint"):
+            # crash-mid-write drill: the shard file is truncated AFTER its
+            # CRC was recorded — a committed manifest must fail restore and
+            # fall back to the previous complete one
+            _chaos.tear_file(os.path.join(d, base + ".npz"))
+
+    def commit(
+        self,
+        step: int,
+        num_shards: int,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> bool:
+        """Publish a sharded step: verify every shard (and its CRC sidecar)
+        landed, then atomically rename ``MANIFEST.json`` into place — the
+        single commit point that makes the step restorable.  Idempotent
+        (True if a manifest already exists) and safe to attempt from every
+        worker: returns False — without committing — while any shard is
+        missing (e.g. its writer died before the write finished)."""
+        d = os.path.join(self.directory, f"ckpt-{step:08d}")
+        man_path = os.path.join(d, MANIFEST_NAME)
+        if os.path.exists(man_path):
+            return True
+        shards: Dict[str, int] = {}
+        n_leaves = 0
+        for i in range(num_shards):
+            base = _shard_file(i, num_shards)
+            side_path = os.path.join(d, base + ".json")
+            if not os.path.exists(os.path.join(d, base + ".npz")):
+                return False
+            try:
+                with open(side_path) as f:
+                    side = json.load(f)
+            except (OSError, ValueError):
+                return False
+            shards[base + ".npz"] = side["crc32"]
+            n_leaves += side.get("n_leaves", 0)
+        manifest = {
+            "step": step,
+            "num_shards": num_shards,
+            "shards": shards,
+            "n_leaves": n_leaves,
+            "timestamp": time.time(),
+            "extra": extra or {},
+        }
+        tmp = man_path + f".tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, man_path)
+        self._retain()
+        return True
+
     def _retain(self) -> None:
-        steps = self.all_steps()
-        for s in steps[: -self.max_to_keep]:
-            shutil.rmtree(
-                os.path.join(self.directory, f"ckpt-{s:08d}"), ignore_errors=True
-            )
+        """Keep the newest ``max_to_keep`` COMMITTED steps.  Only committed
+        steps count toward the quota and only steps OLDER than the oldest
+        kept committed one are deleted: an uncommitted shard set that is
+        still being written by other workers is always newer than the kept
+        window and must never be reaped, while a stranded torn/uncommitted
+        newest step must never push the last restorable manifest out."""
+        committed = [s for s in self.all_steps() if self._is_committed(s)]
+        if len(committed) <= self.max_to_keep:
+            return
+        keep_from = committed[-self.max_to_keep]
+        for s in self.all_steps():
+            if s < keep_from:
+                shutil.rmtree(
+                    os.path.join(self.directory, f"ckpt-{s:08d}"),
+                    ignore_errors=True,
+                )
+
+    def _is_committed(self, step: int) -> bool:
+        d = os.path.join(self.directory, f"ckpt-{step:08d}")
+        return os.path.exists(os.path.join(d, "meta.json")) or os.path.exists(
+            os.path.join(d, MANIFEST_NAME)
+        )
 
     def wait(self) -> None:
         """Join any in-flight async write; re-raises its failure so a broken
@@ -218,15 +374,37 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def meta(self, step: int) -> Dict[str, Any]:
-        with open(
-            os.path.join(self.directory, f"ckpt-{step:08d}", "meta.json")
-        ) as f:
-            return json.load(f)
+        """The step's meta/manifest dict (meta.json for single-writer
+        steps, MANIFEST.json for sharded ones)."""
+        d = os.path.join(self.directory, f"ckpt-{step:08d}")
+        for name in ("meta.json", MANIFEST_NAME):
+            path = os.path.join(d, name)
+            if os.path.exists(path):
+                with open(path) as f:
+                    return json.load(f)
+        raise IOError(f"checkpoint {d}: no meta.json or {MANIFEST_NAME}")
 
     def restore(self, step: int, template: Any):
         """Verify CRC, then rebuild the pytree into `template`'s structure.
-        Returns (tree, extra)."""
+        Returns (tree, extra).  Sharded steps (MANIFEST.json) merge every
+        shard, verifying each against its manifest CRC; an uncommitted
+        shard set (no manifest) is unrestorable by definition."""
         d = os.path.join(self.directory, f"ckpt-{step:08d}")
+        man_path = os.path.join(d, MANIFEST_NAME)
+        if os.path.exists(man_path):
+            with open(man_path) as f:
+                manifest = json.load(f)
+            arrays: Dict[str, np.ndarray] = {}
+            for fname, crc in manifest["shards"].items():
+                path = os.path.join(d, fname)
+                if _crc_file(path) != crc:
+                    raise IOError(
+                        f"checkpoint shard {path} corrupt: crc mismatch vs "
+                        f"manifest {crc:#x}"
+                    )
+                with np.load(path) as z:
+                    arrays.update({k: z[k] for k in z.files})
+            return _unflatten_into(template, arrays), manifest.get("extra", {})
         meta = self.meta(step)
         data_path = os.path.join(d, "state.npz")
         if _crc_file(data_path) != meta["crc32"]:

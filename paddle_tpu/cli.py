@@ -650,6 +650,82 @@ def cmd_plotcurve(argv: List[str]) -> int:
     return plot_main(argv)
 
 
+def cmd_worker(argv: List[str]) -> int:
+    """``paddle-tpu worker`` — one elastic trainer process (scale-out
+    plane, trainer/elastic.py): leases data-shard tasks from the master,
+    contributes deterministic per-task gradients, reduces at pass fences,
+    writes its sharded-checkpoint shard."""
+    from paddle_tpu.trainer import elastic
+
+    return elastic.main(argv)
+
+
+def cmd_master(argv: List[str]) -> int:
+    """``paddle-tpu master`` — one HA master candidate for the elastic
+    cluster plane: campaigns for the file lease under --dir, serves the
+    task queues when leader (publishing its endpoint for HAClient
+    discovery), hot-stands-by otherwise.  Runs until SIGTERM/SIGINT."""
+    import signal
+
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu master",
+        description="HA master candidate (worker registry + shard leases "
+        "+ pass fences; master.py/master_ha.py)",
+    )
+    ap.add_argument("--dir", required=True,
+                    help="shared discovery/lease/snapshot directory")
+    ap.add_argument("--patterns", required=True,
+                    help="comma-separated recordio globs to partition")
+    ap.add_argument("--chunks-per-task", type=int, default=8)
+    ap.add_argument("--timeout-s", type=float, default=60.0,
+                    help="per-task shard-lease timeout")
+    ap.add_argument("--worker-timeout-s", type=float, default=10.0,
+                    help="worker registry heartbeat-lease timeout")
+    ap.add_argument("--failure-max", type=int, default=3)
+    ap.add_argument("--lease-timeout", type=float, default=5.0,
+                    help="leader-election lease timeout (master_ha)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.master_ha import HAMaster
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    ha = HAMaster(
+        args.dir,
+        [p for p in args.patterns.split(",") if p],
+        lease_timeout=args.lease_timeout,
+        chunks_per_task=args.chunks_per_task,
+        timeout_s=args.timeout_s,
+        worker_timeout_s=args.worker_timeout_s,
+        failure_max=args.failure_max,
+        auto_rotate=False,  # elastic workers fence their pass boundaries
+    )
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    ha.start()
+    _echo(f"master candidate {ha.owner_id} campaigning in {args.dir}")
+    announced = False
+    while not stop["flag"]:
+        # snapshot the server ref: the HA thread nulls it on step-down
+        # between the leader check and the address read
+        srv = ha.server
+        if ha.is_leader.is_set() and srv is not None and not announced:
+            host, port = srv.address
+            _echo(f"LEADER {host}:{port}")
+            announced = True
+        elif not ha.is_leader.is_set():
+            announced = False
+        time.sleep(0.2)
+    ha.stop()
+    return 0
+
+
 def cmd_lint(argv: List[str]) -> int:
     """``paddle-tpu lint`` — static analysis (analysis/):
 
@@ -718,6 +794,8 @@ _COMMANDS = {
     "merge_model": cmd_merge_model,
     "plotcurve": cmd_plotcurve,
     "lint": cmd_lint,
+    "worker": cmd_worker,
+    "master": cmd_master,
 }
 
 
@@ -734,6 +812,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    plotcurve         plot training curves from a log")
         print("    lint              static analysis: graph-lint a config, or")
         print("                      self-lint the package source")
+        print("    master            run an HA master candidate (elastic")
+        print("                      scale-out: registry + shard leases)")
+        print("    worker            run one elastic trainer process against")
+        print("                      a master discovery directory")
         return 0 if argv else 1
     cmd, rest = argv[0], argv[1:]
     if cmd not in _COMMANDS:

@@ -39,39 +39,43 @@ def init_cluster(
     coordinator: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
-) -> bool:
-    """Join the cluster (jax.distributed.initialize) if multi-process env is
-    configured; returns True when a multi-host runtime was formed.  Call
-    before any other jax use.  Single-host (no env): no-op — the reference's
-    `paddle.init(trainer_count=...)` local mode."""
-    coordinator = coordinator or os.environ.get(ENV_COORD)
-    num_processes = num_processes or int(os.environ.get(ENV_NPROC, "0") or 0)
-    if not coordinator or num_processes <= 1:
-        return False
-    process_id = (
-        process_id
-        if process_id is not None
-        else int(os.environ.get(ENV_PROC_ID, "0") or 0)
-    )
-    import jax
+    use_jax_distributed: Optional[bool] = None,
+):
+    """Join the cluster if a multi-process environment is configured;
+    returns the formed :class:`~paddle_tpu.parallel.mesh.ProcessGroup`
+    (truthy exactly when a multi-process group exists).  Call before any
+    other jax use.  Single-host (no env): no-op group — the reference's
+    `paddle.init(trainer_count=...)` local mode.
 
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
+    On TPU pods (``PADDLE_TPU_DIST_BACKEND=jax``) this is a real
+    ``jax.distributed`` runtime; elsewhere it is the subprocess/CPU shim —
+    membership is recorded and cross-process reduction rides the master
+    coordination plane (see parallel/mesh.py init_process_group)."""
+    from paddle_tpu.parallel.mesh import init_process_group
+
+    return init_process_group(
+        coordinator, num_processes, process_id,
+        use_jax_distributed=use_jax_distributed,
     )
-    return True
 
 
 def build_worker_env(
     coordinator: str, num_processes: int, process_id: int
 ) -> Dict[str, str]:
-    """Environment fragment for one worker process."""
-    return {
+    """Environment fragment for one worker process.  The launcher host's
+    PADDLE_TPU_DIST_BACKEND choice travels with the job — remote (ssh)
+    workers only see the inlined fragment, and without it they would
+    silently fall back to the coordination-service shim on a pod where the
+    operator asked for the real jax.distributed runtime."""
+    env = {
         ENV_COORD: coordinator,
         ENV_NPROC: str(num_processes),
         ENV_PROC_ID: str(process_id),
     }
+    backend = os.environ.get("PADDLE_TPU_DIST_BACKEND")
+    if backend:
+        env["PADDLE_TPU_DIST_BACKEND"] = backend
+    return env
 
 
 def build_commands(
@@ -81,13 +85,19 @@ def build_commands(
     script_args: Sequence[str] = (),
     python: str = sys.executable,
     workdir: Optional[str] = None,
+    extra_env: Optional[Dict[int, Dict[str, str]]] = None,
 ) -> List[List[str]]:
     """One command per host: local hosts (localhost/127.0.0.1) run directly,
     remote hosts through ssh with the env inlined — the reference pushed
-    jobs with fabric the same way (cluster_train/paddle.py job_start)."""
+    jobs with fabric the same way (cluster_train/paddle.py job_start).
+
+    ``extra_env``: per-process-id environment additions — how a chaos drill
+    arms a fault point (e.g. ``PADDLE_TPU_CHAOS=kill_worker@2``) on worker
+    k of N and nowhere else."""
     cmds: List[List[str]] = []
     for pid, host in enumerate(hosts):
         env = build_worker_env(coordinator, len(hosts), pid)
+        env.update((extra_env or {}).get(pid, {}))
         assignments = [f"{k}={v}" for k, v in env.items()]
         base = [python, script, *script_args]
         if host in ("localhost", "127.0.0.1"):
@@ -109,38 +119,64 @@ def launch(
     script_args: Sequence[str] = (),
     workdir: Optional[str] = None,
     poll_interval: float = 0.2,
+    elastic: bool = False,
+    extra_env: Optional[Dict[int, Dict[str, str]]] = None,
+    exit_codes: Optional[List[int]] = None,
 ) -> int:
-    """Start every worker and wait.  First worker to exit NONZERO kills the
-    rest (a dead coordinator would otherwise hang every other process inside
-    jax.distributed.initialize — the reference fabric launcher tears the job
-    down on first failure too).  Returns the first nonzero exit code."""
+    """Start every worker and wait.
+
+    Default (gang) mode: the first worker to exit NONZERO kills the rest (a
+    dead coordinator would otherwise hang every other process inside
+    jax.distributed.initialize — the reference fabric launcher tears the
+    job down on first failure too); returns the first nonzero exit code.
+
+    ``elastic=True`` (the lease-based membership mode): a dying worker is
+    the EXPECTED failure case, not the job's — survivors keep running, the
+    master requeues the dead worker's shard leases, and the launcher simply
+    waits for everyone.  Returns 0 when at least one worker finished
+    cleanly, else the first nonzero code.  ``exit_codes`` (when given) is
+    extended with every worker's return code in process-id order, so a
+    chaos harness can assert the kill actually landed."""
     procs = [
         subprocess.Popen(cmd)
         for cmd in build_commands(
-            hosts, coordinator, script, script_args, workdir=workdir
+            hosts, coordinator, script, script_args, workdir=workdir,
+            extra_env=extra_env,
         )
     ]
     rc = 0
     try:
-        while rc == 0 and any(p.poll() is None for p in procs):
-            rc = next((p.poll() for p in procs if p.poll() not in (None, 0)), 0)
-            if rc == 0:
-                time.sleep(poll_interval)
-        if rc:  # tear the job down on first failure
+        if elastic:
             for p in procs:
-                if p.poll() is None:
-                    p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
                 p.wait()
-            rc = rc or (p.returncode or 0)
+            codes = [p.returncode or 0 for p in procs]
+            rc = 0 if any(c == 0 for c in codes) else next(
+                (c for c in codes if c), 0
+            )
+        else:
+            while rc == 0 and any(p.poll() is None for p in procs):
+                rc = next(
+                    (p.poll() for p in procs if p.poll() not in (None, 0)), 0
+                )
+                if rc == 0:
+                    time.sleep(poll_interval)
+            if rc:  # tear the job down on first failure
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                rc = rc or (p.returncode or 0)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        if exit_codes is not None:
+            exit_codes.extend(p.returncode or 0 for p in procs)
     return rc
 
 
@@ -157,6 +193,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--dry-run", action="store_true", help="print commands only")
+    ap.add_argument(
+        "--elastic", action="store_true",
+        help="lease-based membership: a dying worker is tolerated (its "
+        "shard leases requeue via the master) instead of tearing down the "
+        "job",
+    )
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -168,7 +210,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(" ".join(shlex.quote(c) for c in cmd))
         return 0
     return launch(
-        hosts, args.coordinator, args.script, args.script_args, workdir=args.workdir
+        hosts, args.coordinator, args.script, args.script_args,
+        workdir=args.workdir, elastic=args.elastic,
     )
 
 

@@ -18,6 +18,20 @@ Pieces:
   * ``Client``     — ``set_dataset / next_record / ...`` parity with
                      python/paddle/v2/master/client.py; works against an
                      in-process Service or a remote Server address.
+
+Elastic cluster plane (the scale-out completion of the Go master's
+fault-tolerance model, arXiv:1605.08695 §4.4):
+  * worker registry — ``register_worker``/``heartbeat`` leases, pruned by
+    the same clock discipline as task leases; a dead worker's pending task
+    leases requeue to survivors immediately (the etcd-lease-expiry path of
+    go/master/service.go, minus etcd).
+  * pass fence — ``fence_arrive``/``fence_status``: a barrier over the LIVE
+    membership, so a worker that died (and was pruned) never wedges the
+    pass boundary.
+  * result plane — ``task_finished(task_id, epoch, result)`` attaches a
+    per-task payload (the epoch guard rejects zombie owners);
+    ``pass_results`` hands the full map back so every worker reduces the
+    pass deterministically in task-id order (trainer/elastic.py).
 """
 
 from __future__ import annotations
@@ -90,6 +104,7 @@ class Service:
         auto_rotate: bool = True,
         snapshot_min_interval_s: float = 1.0,
         clock=time.time,
+        worker_timeout_s: float = 10.0,
     ):
         """auto_rotate=True mirrors the reference: the moment a pass drains,
         done tasks recycle into todo and other trainers stream straight into
@@ -107,12 +122,23 @@ class Service:
         self._last_snapshot = 0.0
         self._flush_timer: Optional[threading.Timer] = None
         self.todo: List[Task] = []
-        self.pending: Dict[int, Tuple[Task, float]] = {}  # id -> (task, deadline)
+        # id -> (task, lease deadline, owner worker id or None)
+        self.pending: Dict[int, Tuple[Task, float, Optional[str]]] = {}
         self.done: List[Task] = []
         self.discarded: List[Task] = []
         self.fail_events = 0
         self.pass_id = 0
         self._save_holder: Optional[Tuple[str, float]] = None
+        # -- elastic cluster plane (registry / fences / results) ----------
+        self.worker_timeout_s = worker_timeout_s
+        self.workers: Dict[str, float] = {}  # worker id -> heartbeat deadline
+        # pass_id -> {task_id: payload}; only the trailing passes are
+        # retained (a slow or late-joining worker may still need pass P's
+        # map while P+1 streams)
+        self.results: Dict[int, Dict[int, Any]] = {}
+        self._pass_done: Dict[int, int] = {}  # pass -> done count at rotation
+        # fence id -> {"arrived": set, "released": None | frozen info dict}
+        self.fences: Dict[str, Dict[str, Any]] = {}
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
 
@@ -140,13 +166,22 @@ class Service:
             return len(self.todo) + len(self.pending) + len(self.done)
 
     # -- task lifecycle --------------------------------------------------
-    def get_task(self):
+    def get_task(self, worker_id: Optional[str] = None):
         """Pop a todo task into pending with a lease deadline (reference
         service.go:362 GetTask).  Returns the task dict, the string "wait"
         when all remaining tasks are leased to other workers (mid-pass
-        starvation), or None at a pass boundary."""
+        starvation), or None at a pass boundary.  ``worker_id`` (when the
+        caller is a registered elastic worker) records the lease owner so
+        a pruned worker's leases requeue without waiting out the per-task
+        timeout."""
         with self._lock:
+            self._prune_workers()
             self._requeue_expired()
+            if worker_id is not None:
+                # a polling worker is alive by definition: auto-(re)register
+                # even if the prune just expired it (prune targets SILENT
+                # workers — hung or dead — which never reach this line)
+                self.workers[worker_id] = self._clock() + self.worker_timeout_s
             if not self.todo and not self.pending and self.done:
                 if not self.auto_rotate:
                     return None  # hold the barrier until start_new_pass()
@@ -155,27 +190,50 @@ class Service:
             if not self.todo:
                 return "wait" if self.pending else None
             task = self.todo.pop(0)
-            self.pending[task.task_id] = (task, self._clock() + self.timeout_s)
+            self.pending[task.task_id] = (
+                task, self._clock() + self.timeout_s, worker_id
+            )
             self._snapshot()
             return {
                 "task": task.to_json(),
                 "epoch": task.epoch,
                 "timeout_s": self.timeout_s,
+                # which pass this task belongs to: an elastic worker that
+                # believes it is on an earlier pass detects the skew here
+                # and catches up BEFORE computing with stale parameters
+                "pass_id": self.pass_id,
             }
 
     def _rotate_pass(self) -> None:
         """Recycle done → todo; epochs reset so past failures don't carry."""
+        # freeze the completed pass's done count: late joiners use it to
+        # verify a retained result map is COMPLETE before replay-applying it
+        self._pass_done[self.pass_id] = len(self.done)
         self.todo = self.done
         for t in self.todo:
             t.epoch = 0
         self.done = []
         self.pass_id += 1
+        # retain only the trailing passes' result maps (a slow worker may
+        # still be fetching pass P's results while P+1 streams)
+        for p in [p for p in self.results if p < self.pass_id - 2]:
+            del self.results[p]
+        for p in [p for p in self._pass_done if p < self.pass_id - 2]:
+            del self._pass_done[p]
         self._snapshot(force=True)
 
-    def start_new_pass(self) -> int:
-        """Explicit pass barrier release (auto_rotate=False mode)."""
+    def start_new_pass(self, target_pass: Optional[int] = None) -> int:
+        """Explicit pass barrier release (auto_rotate=False mode).
+
+        ``target_pass`` makes the release idempotent for a fleet: the pass
+        rotates only while ``pass_id < target_pass``, so a straggler that
+        calls ``start_new_pass(p+1)`` after a fast worker already drained
+        pass p+1 cannot double-rotate the queue past it."""
         with self._lock:
-            if not self.todo and not self.pending and self.done:
+            if (
+                not self.todo and not self.pending and self.done
+                and (target_pass is None or self.pass_id < target_pass)
+            ):
                 self._rotate_pass()
             return self.pass_id
 
@@ -188,19 +246,30 @@ class Service:
             ent = self.pending.get(task_id)
             if ent is None or ent[0].epoch != epoch:
                 return False
-            self.pending[task_id] = (ent[0], self._clock() + self.timeout_s)
+            self.pending[task_id] = (
+                ent[0], self._clock() + self.timeout_s, ent[2]
+            )
             return True
 
-    def task_finished(self, task_id: int, epoch: Optional[int] = None) -> bool:
+    def task_finished(
+        self, task_id: int, epoch: Optional[int] = None, result: Any = None
+    ) -> bool:
         """epoch (when given) guards against a stale holder acking a task
         that expired and was re-served at a higher epoch — same discipline
-        as task_failed (reference service.go:404 checks task epoch)."""
+        as task_failed (reference service.go:404 checks task epoch).
+
+        ``result`` (elastic workers): the task's reduction payload — e.g. a
+        gradient-contribution tree — stored under the current pass for
+        ``pass_results``.  A rejected (zombie) ack never stores its result,
+        so the surviving re-computation's bits win."""
         with self._lock:
             ent = self.pending.get(task_id)
             if ent is None or (epoch is not None and ent[0].epoch != epoch):
                 return False
             del self.pending[task_id]
             self.done.append(ent[0])
+            if result is not None:
+                self.results.setdefault(self.pass_id, {})[task_id] = result
             self._snapshot()
             return True
 
@@ -240,10 +309,185 @@ class Service:
 
     def _requeue_expired(self) -> None:
         now = self._clock()
-        expired = [tid for tid, (_, dl) in self.pending.items() if dl < now]
+        expired = [tid for tid, ent in self.pending.items() if ent[1] < now]
         for tid in expired:
-            task, _ = self.pending.pop(tid)
+            task = self.pending.pop(tid)[0]
             self._process_failed(task)
+
+    # -- elastic cluster plane: registry / fences / results ---------------
+    def register_worker(self, worker_id: str) -> Dict[str, Any]:
+        """Join (or rejoin) the worker registry under a heartbeat lease.
+        Returns the cluster view the worker needs to enter the pass loop —
+        idempotent, so a worker that outlived a master failover (the new
+        leader recovers queues from the snapshot but the registry is
+        runtime state) just re-registers."""
+        with self._lock:
+            self._prune_workers()
+            self.workers[worker_id] = self._clock() + self.worker_timeout_s
+            return {
+                "pass_id": self.pass_id,
+                "timeout_s": self.worker_timeout_s,
+                "auto_rotate": self.auto_rotate,
+                "workers": sorted(self.workers),
+            }
+
+    def heartbeat(self, worker_id: str) -> bool:
+        """Renew the registry lease; False means the worker expired (or the
+        master failed over) and must ``register_worker`` again."""
+        with self._lock:
+            self._prune_workers()
+            if worker_id not in self.workers:
+                return False
+            self.workers[worker_id] = self._clock() + self.worker_timeout_s
+            return True
+
+    def deregister_worker(self, worker_id: str) -> None:
+        """Graceful leave: held task leases go back to todo WITHOUT a
+        failure event (the task_returned discipline — leaving is not a
+        crash)."""
+        with self._lock:
+            self.workers.pop(worker_id, None)
+            held = [
+                tid for tid, ent in self.pending.items() if ent[2] == worker_id
+            ]
+            for tid in held:
+                self.todo.append(self.pending.pop(tid)[0])
+            if held:
+                self._snapshot()
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            self._prune_workers()
+            return sorted(self.workers)
+
+    def _prune_workers(self) -> None:
+        """Expire silent workers and requeue their task leases NOW — the
+        kill-one-of-N path: a dead worker costs one registry lease timeout,
+        not the job (and not even the longer per-task lease timeout)."""
+        now = self._clock()
+        dead = [w for w, dl in self.workers.items() if dl < now]
+        for w in dead:
+            del self.workers[w]
+            held = [tid for tid, ent in self.pending.items() if ent[2] == w]
+            for tid in held:
+                self._process_failed(self.pending.pop(tid)[0])
+            if held:
+                self._snapshot()
+
+    def fence_arrive(
+        self, fence_id: str, worker_id: str,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Arrive at a barrier.  The fence releases once every LIVE worker
+        has arrived (membership is evaluated per poll, so a worker that
+        died — and was pruned — never wedges the boundary).  Release
+        freezes the arrived set and the done-task count: late arrivals see
+        the frozen view and can tell they missed the membership cut.
+
+        ``meta`` declares per-worker capabilities; ``{"ckpt": True}`` opts
+        the worker into the frozen ``writers`` set, so the shard-writer
+        roster is negotiated among checkpoint-enabled workers rather than
+        assumed equal to the whole membership (one checkpoint-less worker
+        must not doom every manifest commit)."""
+        with self._lock:
+            f = self.fences.setdefault(
+                fence_id, {"arrived": set(), "released": None, "meta": {}}
+            )
+            if f["released"] is None:
+                f["arrived"].add(worker_id)
+                if meta:
+                    f["meta"][worker_id] = dict(meta)
+            if worker_id in self.workers:
+                # arriving (and re-arriving while polling) is a liveness
+                # signal: renew so a worker parked at a slow barrier is
+                # never pruned mid-wait.  Renew-only — a PRUNED worker
+                # re-joins through register_worker/get_task, keeping the
+                # missed-the-membership-cut semantics observable.
+                self.workers[worker_id] = self._clock() + self.worker_timeout_s
+            if len(self.fences) > 64:  # bound runtime state
+                for stale in list(self.fences)[: len(self.fences) - 64]:
+                    if stale != fence_id:
+                        del self.fences[stale]
+            return self._fence_view(fence_id)
+
+    def fence_status(self, fence_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return self._fence_view(fence_id)
+
+    def _fence_view(self, fence_id: str) -> Dict[str, Any]:
+        f = self.fences.get(fence_id)
+        if f is None:
+            return {"known": False, "released": False}
+        if f["released"] is None:
+            self._prune_workers()
+            members = None
+            if self.workers and set(self.workers) <= f["arrived"]:
+                members = sorted(f["arrived"] & set(self.workers))
+            elif not self.workers and f["arrived"]:
+                # no registry (legacy/single-worker use): whoever arrived
+                # is the membership
+                members = sorted(f["arrived"])
+            if members is not None:
+                f["released"] = {
+                    "workers": members,
+                    "writers": [
+                        w for w in members
+                        if f["meta"].get(w, {}).get("ckpt")
+                    ],
+                    "n_done": len(self.done),
+                    "pass_id": self.pass_id,
+                }
+        if f["released"] is None:
+            return {
+                "known": True, "released": False,
+                "n_arrived": len(f["arrived"]),
+            }
+        return {"known": True, "released": True, **f["released"]}
+
+    def pass_results(self, pass_id: int) -> Dict[str, Any]:
+        """``{"results": {task_id: payload}, "n_done": int|None}`` for one
+        pass — every worker reduces the map in sorted task-id order, so the
+        update is bit-identical fleet-wide regardless of which worker
+        computed which task.  ``n_done`` is the pass's frozen done count
+        once it rotated (None while the pass is still current — the fence
+        view carries the authoritative count there): a late joiner replays
+        a retained pass only when ``len(results) == n_done``."""
+        with self._lock:
+            return {
+                "results": dict(self.results.get(pass_id, {})),
+                "n_done": self._pass_done.get(pass_id),
+            }
+
+    def requeue_unresulted(self) -> int:
+        """Move done tasks that have NO stored result for the current pass
+        back to todo.  After a master failover the queue snapshot survives
+        but the in-memory result payloads do not; recomputing the orphaned
+        tasks is safe because contributions are deterministic per task.
+        Returns the number requeued.  (Never call this from the legacy
+        record-streaming flow — its done tasks legitimately carry no
+        results.)"""
+        with self._lock:
+            have = self.results.get(self.pass_id, {})
+            orphaned = [t for t in self.done if t.task_id not in have]
+            if orphaned:
+                self.done = [t for t in self.done if t.task_id in have]
+                self.todo.extend(orphaned)
+                self._snapshot()
+            return len(orphaned)
+
+    def stats(self) -> Dict[str, Any]:
+        """Cluster-plane observability snapshot (cheap, lock-consistent)."""
+        with self._lock:
+            self._prune_workers()
+            return {
+                "pass_id": self.pass_id,
+                "n_todo": len(self.todo),
+                "n_pending": len(self.pending),
+                "n_done": len(self.done),
+                "n_discarded": len(self.discarded),
+                "fail_events": self.fail_events,
+                "workers": sorted(self.workers),
+            }
 
     # -- save-model arbitration (reference service.go:461-497) -----------
     def request_save_model(self, trainer_id: str, block_secs: float) -> bool:
@@ -297,8 +541,8 @@ class Service:
             "pass_id": self.pass_id,
             "todo": [t.to_json() for t in self.todo],
             "pending": [
-                {"task": t.to_json(), "deadline": dl}
-                for (t, dl) in self.pending.values()
+                {"task": t.to_json(), "deadline": dl, "owner": owner}
+                for (t, dl, owner) in self.pending.values()
             ],
             "done": [t.to_json() for t in self.done],
             "discarded": [t.to_json() for t in self.discarded],
@@ -341,7 +585,11 @@ def reader_over(next_record_fn):
 
 _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
             "task_returned", "renew_lease", "request_save_model", "n_tasks",
-            "start_new_pass")
+            "start_new_pass",
+            # elastic cluster plane
+            "register_worker", "heartbeat", "deregister_worker",
+            "live_workers", "fence_arrive", "fence_status", "pass_results",
+            "requeue_unresulted", "stats")
 
 
 class Server:
@@ -506,8 +754,21 @@ class Client:
     def request_save_model(self, block_secs: float = 60.0) -> bool:
         return self._call("request_save_model", self.trainer_id, block_secs)
 
-    def start_new_pass(self) -> int:
-        return self._call("start_new_pass")
+    def start_new_pass(self, target_pass: Optional[int] = None) -> int:
+        return self._call("start_new_pass", target_pass)
+
+    def __getattr__(self, name: str):
+        """Every other RPC method (the elastic cluster surface — get_task,
+        task_finished(task, epoch, result), register_worker/heartbeat,
+        fence_arrive/fence_status, pass_results, requeue_unresulted,
+        stats, ...) delegates positionally straight from ``_METHODS`` —
+        ONE definition instead of a hand-kept mirror per client class.
+        Signatures/semantics are the Service methods'."""
+        if name in _METHODS:
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(
+            f"{type(self).__name__!s} has no attribute {name!r}"
+        )
 
     def next_record(self) -> Optional[bytes]:
         """The next record of the current task, fetching a new task when the

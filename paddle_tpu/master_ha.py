@@ -42,12 +42,26 @@ class LeaseFile:
     lease_timeout).  Claims go through an exclusively-created claim file +
     atomic rename so two candidates racing for a stale lease cannot both
     win (the one whose rename lands second just overwrites with its own
-    identity and the loser detects the foreign owner on verify)."""
+    identity and the loser detects the foreign owner on verify).
 
-    def __init__(self, dir_: str, owner_id: str, lease_timeout: float = 5.0):
+    ``clock``/``sleep`` are injectable (the PR-5 injectable-sleep pattern):
+    staleness is judged against ``clock()`` and every heartbeat/claim stamps
+    the file's mtime from the same clock, so lease-expiry tests advance a
+    fake clock instead of sleeping real wall time."""
+
+    def __init__(
+        self,
+        dir_: str,
+        owner_id: str,
+        lease_timeout: float = 5.0,
+        clock=time.time,
+        sleep=time.sleep,
+    ):
         self.dir = dir_
         self.owner_id = owner_id
         self.lease_timeout = lease_timeout
+        self._clock = clock
+        self._sleep = sleep
         self.path = os.path.join(dir_, "leader.lease")
         os.makedirs(dir_, exist_ok=True)
 
@@ -61,7 +75,7 @@ class LeaseFile:
 
     def is_stale(self) -> bool:
         try:
-            return time.time() - os.path.getmtime(self.path) > self.lease_timeout
+            return self._clock() - os.path.getmtime(self.path) > self.lease_timeout
         except OSError:
             return True  # missing == stale
 
@@ -73,8 +87,10 @@ class LeaseFile:
         if not self.is_stale():
             return self.current_owner() == self.owner_id
         claim = os.path.join(self.dir, f".claim-{self.owner_id}")
+        now = self._clock()
         with open(claim, "w") as f:
-            json.dump({"owner": self.owner_id, "t": time.time()}, f)
+            json.dump({"owner": self.owner_id, "t": now}, f)
+        os.utime(claim, (now, now))  # mtime from the SAME clock is_stale reads
         # Re-check right before the rename: a stalled-but-alive leader may
         # have renewed since our staleness read (shrinks the clobber window
         # to the check->rename gap; the remaining dual-leader window is
@@ -89,7 +105,7 @@ class LeaseFile:
         os.replace(claim, self.path)
         # verify after the dust settles: a racing rename may have landed on
         # top of ours (last-writer-wins is exactly one winner)
-        time.sleep(0.01)
+        self._sleep(0.01)
         return self.current_owner() == self.owner_id
 
     def renew(self) -> bool:
@@ -103,7 +119,11 @@ class LeaseFile:
             # goes stale underneath it and a standby must take over while
             # this side detects the usurper and steps down
             return True
-        os.utime(self.path, None)
+        now = self._clock()
+        try:
+            os.utime(self.path, (now, now))
+        except OSError:
+            return False  # lease file vanished under us: treat as usurped
         return True
 
     def release(self) -> None:
@@ -286,11 +306,24 @@ class HAClient:
     def next_record(self):
         return self._call("next_record")
 
-    def start_new_pass(self):
-        return self._call("start_new_pass")
+    def start_new_pass(self, target_pass=None):
+        return self._call("start_new_pass", target_pass)
 
     def request_save_model(self, block_secs: float = 60.0):
         return self._call("request_save_model", block_secs)
+
+    def __getattr__(self, name):
+        """The elastic cluster surface (get_task, task_finished, registry,
+        fences, pass_results, ...) delegates from ``master._METHODS`` with
+        the reconnect-on-failover discipline of :meth:`_call` — mirrors
+        Client.__getattr__, one definition for the whole surface."""
+        from paddle_tpu.master import _METHODS
+
+        if name in _METHODS:
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(
+            f"{type(self).__name__!s} has no attribute {name!r}"
+        )
 
     def reader(self):
         from paddle_tpu.master import reader_over

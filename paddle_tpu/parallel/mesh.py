@@ -60,6 +60,109 @@ class MeshConfig:
     model: int = 1
 
 
+# ---------------------------------------------------------------------------
+# Multi-process process group — jax.distributed with a coordination-service
+# fallback shim (the shard_map-shim pattern: one spelling across jax
+# versions/backends).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ProcessGroup:
+    """Membership of a multi-process run.
+
+    backend:
+      * ``"jax-distributed"`` — a real jax.distributed runtime was formed;
+        jax.devices() is the GLOBAL device set and in-program collectives
+        cross processes over ICI/DCN.
+      * ``"shim"`` — the dev-container fallback (older jax, or CPU-only
+        platforms where jax.distributed cannot form a backend): each
+        process keeps its local devices and cross-process reduction rides
+        the master coordination service instead (trainer/elastic.py's
+        pass-fence + task-result reduce).
+      * ``"single"`` — no multi-process environment configured.
+    """
+
+    num_processes: int = 1
+    process_id: int = 0
+    coordinator: Optional[str] = None
+    backend: str = "single"
+
+    def __bool__(self) -> bool:  # truthy == genuinely multi-process
+        return self.num_processes > 1
+
+
+_process_group = ProcessGroup()
+
+
+def init_process_group(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    use_jax_distributed: Optional[bool] = None,
+) -> ProcessGroup:
+    """Join (or declare) the process group.  Arguments default from the
+    launcher environment (``PADDLE_TPU_COORDINATOR`` etc.).
+
+    ``use_jax_distributed``: None (default) consults the
+    ``PADDLE_TPU_DIST_BACKEND=jax`` environment switch — on TPU pods the
+    real runtime is what you want, but on the CPU dev container calling
+    ``jax.distributed.initialize`` would hang against a coordinator that
+    can never form a device backend, so the shim is the default there."""
+    global _process_group
+    import os
+
+    from paddle_tpu import launcher as _launcher
+
+    coordinator = coordinator or os.environ.get(_launcher.ENV_COORD)
+    if num_processes is None:
+        num_processes = int(os.environ.get(_launcher.ENV_NPROC, "0") or 0)
+    if process_id is None:
+        process_id = int(os.environ.get(_launcher.ENV_PROC_ID, "0") or 0)
+    if not coordinator or num_processes <= 1:
+        _process_group = ProcessGroup()
+        return _process_group
+    if use_jax_distributed is None:
+        use_jax_distributed = (
+            os.environ.get("PADDLE_TPU_DIST_BACKEND", "") == "jax"
+        )
+    backend = "shim"
+    if use_jax_distributed:
+        import logging
+
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            backend = "jax-distributed"
+        except (AttributeError, NotImplementedError, RuntimeError, ValueError) as exc:
+            # older jax / no distributable backend: fall back to the shim —
+            # but the operator EXPLICITLY asked for the real runtime, so
+            # say loudly that they are not getting it (a silent shim on a
+            # pod means N unsynchronized replicas, not one job)
+            logging.getLogger("paddle_tpu.parallel").warning(
+                "PADDLE_TPU_DIST_BACKEND=jax requested but "
+                "jax.distributed.initialize failed (%s: %s); falling back "
+                "to the coordination-service shim — in-program collectives "
+                "will NOT cross processes", type(exc).__name__, exc,
+            )
+            backend = "shim"
+    _process_group = ProcessGroup(
+        num_processes=num_processes,
+        process_id=process_id,
+        coordinator=coordinator,
+        backend=backend,
+    )
+    return _process_group
+
+
+def current_process_group() -> ProcessGroup:
+    return _process_group
+
+
 _default_mesh: Optional[Mesh] = None
 
 

@@ -16,6 +16,13 @@ Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
     torn_checkpoint@2  truncate the 2nd checkpoint's state.npz after write
     kill@12            SIGKILL the process right after train step 12
     stale_lease@3      the HA leader's 3rd lease renewal silently no-ops
+    kill_worker@2      SIGKILL an elastic worker as it takes its 2nd task
+                       (mid-pass, HOLDING a shard lease: arm on worker k of
+                       N via its environment — the kill-one-of-N drill)
+    worker_hang@2      an elastic worker freezes (GC pause / NFS stall) on
+                       its 2nd task for PADDLE_TPU_CHAOS_HANG_SECS (default
+                       20s): registry + shard leases expire underneath it
+                       and it must rejoin as a late worker
 
 ``@occurrence`` counts *consultations* of that point (1-based); omitting it
 means "every time".  Each armed point fires at most once per occurrence —
@@ -52,7 +59,8 @@ _ENV = "PADDLE_TPU_CHAOS"
 # the documented fault surface; arming an unknown point raises so a typo'd
 # drill never silently tests nothing
 KNOWN_POINTS = frozenset(
-    {"nan_batch", "torn_checkpoint", "kill", "stale_lease"}
+    {"nan_batch", "torn_checkpoint", "kill", "stale_lease",
+     "kill_worker", "worker_hang"}
 )
 
 # point -> occurrence to fire at (None = every consultation)
@@ -178,3 +186,15 @@ def kill_self() -> None:
 
     _log.warning("chaos: SIGKILL self (pid %d)", os.getpid())
     os.kill(os.getpid(), signal.SIGKILL)
+
+
+def hang(seconds: Optional[float] = None) -> None:
+    """Freeze the caller — the stalled-but-alive worker fault (a GC pause
+    or NFS stall long enough that every lease it holds expires).  Duration
+    comes from ``PADDLE_TPU_CHAOS_HANG_SECS`` unless given."""
+    import time
+
+    if seconds is None:
+        seconds = float(os.environ.get("PADDLE_TPU_CHAOS_HANG_SECS", "20"))
+    _log.warning("chaos: hanging pid %d for %.1fs", os.getpid(), seconds)
+    time.sleep(seconds)

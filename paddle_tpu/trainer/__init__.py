@@ -2,5 +2,6 @@ from paddle_tpu.trainer.sgd import SGD  # noqa: F401
 from paddle_tpu.trainer.step import (  # noqa: F401
     make_eval_step,
     make_forward_fn,
+    make_grad_step,
     make_train_step,
 )

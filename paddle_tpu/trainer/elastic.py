@@ -1,0 +1,762 @@
+"""Elastic multi-process training — the pass-synchronous worker driver over
+the master's cluster plane (worker registry, shard leases, fences, results).
+
+This completes the reference's fault-tolerance story end-to-end (the Go
+master's lease-based dispatch, go/master/service.go, in the TF-paper model
+of arXiv:1605.08695 §4.4): N trainer processes lease data-shard tasks from
+the master, each computes a DETERMINISTIC per-task gradient contribution
+(trainer/step.py make_grad_step, or any model honoring the protocol below),
+and submits it with the epoch-guarded ``task_finished`` ack.  At the pass
+boundary every live worker arrives at a fence; on release each worker
+fetches the full ``{task_id: contribution}`` map and reduces it in sorted
+task-id order, so the applied update — and therefore the whole parameter
+trajectory — is bit-identical no matter which worker computed which task.
+
+That invariant is the elasticity mechanism, not a nicety:
+
+  * kill -9 one of N mid-pass → its registry lease expires, the master
+    requeues its held shard leases to survivors (``failure_max`` epoch
+    discipline), the pass completes, and final params match an
+    uninterrupted run bit-for-bit;
+  * a hung worker (GC pause, NFS stall) is pruned the same way; when it
+    wakes, its stale acks are rejected by epoch and it rejoins as a late
+    worker;
+  * a joining worker just registers, restores the latest committed
+    checkpoint manifest, and starts leasing.
+
+Checkpoints are **sharded + asynchronous**: after applying a pass, worker
+rank r of the fence membership writes shard r of the full state off the hot
+path (checkpoint.CheckpointManager.save_shard), and the step commits at the
+NEXT fence — once every writer has joined its background write — by
+publishing ``MANIFEST.json`` atomically.  A worker that died mid-write
+strands an uncommitted shard set that ``restore_latest`` walks straight
+past.
+
+Model protocol (duck-typed; see :class:`NumpyLinearModel` and
+:class:`TrainerTaskModel`):
+
+    task_grad(records, pass_id, task_id) -> (mean_grad_tree, cost_sum, rows)
+        deterministic per (records, pass_id, task_id) — NOT per worker
+    apply(mean_grad_tree) -> None        deterministic state transition
+    state() -> pytree                    full state for checkpointing
+    load(tree, extra) -> None            restore from a checkpoint
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.io import recordio
+from paddle_tpu.robustness import chaos as _chaos
+
+__all__ = [
+    "ElasticWorker",
+    "NumpyLinearModel",
+    "TrainerTaskModel",
+    "reduce_results",
+    "main",
+]
+
+_log = logging.getLogger("paddle_tpu.trainer.elastic")
+
+
+# ---------------------------------------------------------------------------
+# Deterministic reduction over nested-dict gradient trees (numpy, no jax)
+# ---------------------------------------------------------------------------
+
+def _tree_axpy(acc, tree, w: float):
+    """acc += tree * w, recursively over nested dicts; None acc allocates.
+    The scale stays in each leaf's own dtype so every worker runs the exact
+    same float ops in the exact same order."""
+    if isinstance(tree, dict):
+        return {
+            k: _tree_axpy(None if acc is None else acc[k], v, w)
+            for k, v in tree.items()
+        }
+    arr = np.asarray(tree)
+    if np.issubdtype(arr.dtype, np.floating):
+        term = arr * arr.dtype.type(w)
+    else:
+        term = arr * w
+    return term if acc is None else acc + term
+
+
+def _tree_scale(tree, s: float):
+    if isinstance(tree, dict):
+        return {k: _tree_scale(v, s) for k, v in tree.items()}
+    arr = np.asarray(tree)
+    if np.issubdtype(arr.dtype, np.floating):
+        return arr * arr.dtype.type(s)
+    return arr * s
+
+
+def reduce_results(results: Dict[int, Any]) -> Tuple[Any, float, int]:
+    """(mean_grads, mean_cost, total_rows) from a pass's ``{task_id:
+    {"grads", "cost", "rows"}}`` map, reduced in sorted task-id order —
+    the canonical order every worker uses, so the reduction is
+    bit-identical fleet-wide."""
+    order = sorted(results)
+    if not order:
+        raise ValueError("empty result map: nothing to reduce")
+    total_rows = sum(int(results[t]["rows"]) for t in order)
+    acc = None
+    for t in order:
+        acc = _tree_axpy(acc, results[t]["grads"], float(results[t]["rows"]))
+    mean = _tree_scale(acc, 1.0 / total_rows)
+    mean_cost = sum(float(results[t]["cost"]) for t in order) / total_rows
+    return mean, mean_cost, total_rows
+
+
+def _read_task_records(task_json: Dict[str, Any]) -> List[bytes]:
+    recs: List[bytes] = []
+    for c in task_json["chunks"]:
+        with recordio.Reader(c["path"], offset=c["offset"]) as r:
+            for _ in range(c["n_records"]):
+                rec = r.next()
+                if rec is None:
+                    break
+                recs.append(rec)
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# Worker driver
+# ---------------------------------------------------------------------------
+
+class ElasticWorker:
+    """One trainer process of an elastic fleet.
+
+    ``client`` is a master surface (master.Client or master_ha.HAClient)
+    exposing the cluster plane; ``heartbeat_client`` (optional but
+    recommended — the CLI always wires one) renews the registry lease from
+    a side thread so a long jitted compile can't get this worker pruned."""
+
+    def __init__(
+        self,
+        client,
+        worker_id: str,
+        model,
+        manager=None,
+        resume: bool = False,
+        heartbeat_client=None,
+        heartbeat_interval: Optional[float] = None,
+        poll_s: float = 0.02,
+        min_workers: int = 1,
+        clock=time.time,
+        sleep=time.sleep,
+    ):
+        self.client = client
+        self.worker_id = worker_id
+        self.model = model
+        self.manager = manager
+        self.resume = resume
+        self.poll_s = poll_s
+        # gang-start hint: hold the first lease until this many workers
+        # have registered, so a fast-booting worker doesn't race through
+        # whole (small) passes alone while its peers are still starting —
+        # purely a START gate; membership stays fully elastic afterwards
+        self.min_workers = max(int(min_workers), 1)
+        self._clock = clock
+        self._sleep = sleep
+        self._hb = heartbeat_client
+        self._hb_interval = heartbeat_interval
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_pause = threading.Event()
+        self._stop = threading.Event()
+        # a pass whose shards this worker wrote but whose manifest is not
+        # yet published: (step, num_shards, extra)
+        self._pending_commit: Optional[Tuple[int, int, Dict[str, Any]]] = None
+        # observability
+        self.pass_costs: List[float] = []
+        self.tasks_done = 0
+        self.rejected_acks = 0
+        self.busy_s = 0.0
+        self.t_work0: Optional[float] = None
+        self.t_work1: Optional[float] = None
+
+    # -- registry ---------------------------------------------------------
+    def _hb_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            if self._hb_pause.is_set():
+                continue  # simulated full-process freeze: no heartbeats
+            try:
+                if not self._hb.heartbeat(self.worker_id):
+                    # expired (we were pruned) or the master failed over:
+                    # rejoin — the registry is runtime state, not snapshot
+                    self._hb.register_worker(self.worker_id)
+            except Exception:  # noqa: BLE001 — transient; next beat retries
+                pass
+
+    def _start_heartbeat(self, worker_timeout_s: float) -> None:
+        if self._hb is None:
+            return
+        interval = self._hb_interval or max(worker_timeout_s / 3.0, 0.05)
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, args=(interval,), daemon=True
+        )
+        self._hb_thread.start()
+
+    # -- fence ------------------------------------------------------------
+    def _fence(self, fence_id: str) -> Dict[str, Any]:
+        """Arrive and poll until released.  Polling re-arrives: arrival is
+        idempotent, doubles as a liveness signal, and re-registers the
+        barrier after a master failover dropped its fences.  The arrival
+        meta declares whether this worker checkpoints, so the released
+        view's ``writers`` roster covers exactly the shard writers."""
+        meta = {"ckpt": self.manager is not None}
+        view = self.client.fence_arrive(fence_id, self.worker_id, meta)
+        while not view.get("released"):
+            self._sleep(self.poll_s)
+            view = self.client.fence_arrive(fence_id, self.worker_id, meta)
+        return view
+
+    # -- checkpoints ------------------------------------------------------
+    def _write_shard(self, pass_id: int, ranks: List[str]) -> None:
+        if self.manager is None or self.worker_id not in ranks:
+            return  # not a writer, or we missed the membership cut
+        rank, n = ranks.index(self.worker_id), len(ranks)
+        step = pass_id + 1
+        extra = {"pass_id": pass_id, "step_count": step}
+        # off the hot path: the next pass's task compute overlaps this write
+        self.manager.save_shard(
+            step, rank, n, self.model.state(), async_=True
+        )
+        self._pending_commit = (step, n, extra)
+
+    def _commit_pending(self) -> None:
+        """Publish the previous pass's manifest.  Called right after a
+        fence release: every surviving writer joined its async write before
+        arriving, so all shards that will ever land have landed.  Any
+        worker may commit (idempotent); False just means a writer died
+        mid-write and the step stays unrestorable — by design."""
+        if self._pending_commit is None:
+            return
+        step, n, extra = self._pending_commit
+        self._pending_commit = None
+        if not self.manager.commit(step, n, extra=extra):
+            _log.warning(
+                "worker %s: checkpoint step %d left uncommitted (a shard "
+                "writer died mid-write); restore will use the previous "
+                "complete manifest", self.worker_id, step,
+            )
+
+    # -- the pass loop ----------------------------------------------------
+    def _apply_retained_pass(self, pass_id: int) -> None:
+        """Catch up one missed pass from the master's retained result map —
+        how a late joiner (or a worker that detected pass skew) reaches the
+        exact parameter state the fleet computed without re-leasing any
+        task.  Refuses loudly when the retained map is incomplete: applying
+        a partial reduction would silently fork the trajectory."""
+        pr = self.client.pass_results(pass_id)
+        results, n_done = pr["results"], pr["n_done"]
+        if not results or n_done is None or len(results) != n_done:
+            raise RuntimeError(
+                f"worker {self.worker_id}: cannot catch up pass {pass_id} "
+                f"({len(results)}/{n_done} contributions retained) — joined "
+                f"too many passes late with no committed checkpoint "
+                f"covering it"
+            )
+        mean_grads, mean_cost, _ = reduce_results(results)
+        self.model.apply(mean_grads)
+        self.pass_costs.append(mean_cost)
+        _log.info(
+            "worker %s caught up pass %d from retained results",
+            self.worker_id, pass_id,
+        )
+
+    def _catch_up(self, pass_id: int, target: int) -> int:
+        """Reach the exact state "after pass target-1" when the fleet moved
+        on without us (late join, or a hang long enough to be pruned):
+        replay retained result maps; when the gap outruns result retention,
+        restore the latest committed manifest and replay the remainder."""
+        try:
+            for p in range(pass_id, target):
+                self._apply_retained_pass(p)
+            return target
+        except RuntimeError:
+            if self.manager is None:
+                raise
+            restored = self.manager.restore_latest(self.model.state())
+            if restored is None:
+                raise
+            _, tree, extra = restored
+            self.model.load(tree, extra)
+            completed = int(extra.get("pass_id", -1))
+            _log.info(
+                "worker %s rejoining via manifest (pass %d applied)",
+                self.worker_id, completed,
+            )
+            for p in range(completed + 1, target):
+                self._apply_retained_pass(p)
+            return target
+
+    def _run_pass_tasks(self, pass_id: int) -> Optional[int]:
+        """Lease and compute this pass's tasks.  Returns None when the pass
+        drained, or the MASTER's pass id when it is ahead of ours (the
+        fleet fenced and rotated in the gap between our registration and
+        our first lease) — the caller must catch up before computing."""
+        while True:
+            got = self.client.get_task(self.worker_id)
+            if got is None:
+                return None  # pass drained: the master holds the barrier
+            if got == "wait":  # remaining leases held by other workers
+                self._sleep(self.poll_s)
+                continue
+            task, epoch = got["task"], got["epoch"]
+            tid = task["task_id"]
+            master_pass = int(got.get("pass_id", pass_id))
+            if master_pass != pass_id:
+                # our params lag the fleet (it fenced and rotated between
+                # our registration and this lease): hand the task back
+                # untouched — no failure event — and replay the gap first
+                self.client.task_returned(tid, epoch)
+                return master_pass
+            if _chaos.fire("kill_worker"):
+                # die HOLDING the shard lease — the kill-one-of-N drill
+                _chaos.kill_self()
+            if _chaos.fire("worker_hang"):
+                # full-process freeze: heartbeats stop too, so both the
+                # registry lease and this shard lease expire underneath us
+                self._hb_pause.set()
+                _chaos.hang()
+                self._hb_pause.clear()
+            try:
+                records = _read_task_records(task)
+            except IOError:
+                self.client.task_failed(tid, epoch)
+                continue
+            t0 = self._clock()
+            grads, cost_sum, rows = self.model.task_grad(
+                records, pass_id, tid
+            )
+            self.busy_s += self._clock() - t0
+            payload = {
+                "grads": grads, "cost": float(cost_sum), "rows": int(rows)
+            }
+            if self.client.task_finished(tid, epoch, payload):
+                self.tasks_done += 1
+            else:
+                # zombie ack: the lease expired (we hung) and the task was
+                # re-served — the surviving recomputation's bits win
+                self.rejected_acks += 1
+
+    def run(self, num_passes: int) -> Dict[str, Any]:
+        info = self.client.register_worker(self.worker_id)
+        if info.get("auto_rotate"):
+            raise RuntimeError(
+                "elastic training needs a master with auto_rotate=False: "
+                "pass boundaries are fence-synchronized, not free-running"
+            )
+        self._start_heartbeat(float(info.get("timeout_s", 10.0)))
+        try:
+            # gang-start wait polls by RE-REGISTERING: registration renews
+            # our own lease (and returns the roster), so a worker waiting
+            # out a peer's slow boot can never expire into a livelock even
+            # with no heartbeat thread wired
+            while len(info.get("workers", ())) < self.min_workers:
+                self._sleep(max(self.poll_s, 0.05))
+                info = self.client.register_worker(self.worker_id)
+            return self._run(num_passes, info)
+        finally:
+            self._stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5)
+            try:
+                self.client.deregister_worker(self.worker_id)
+            except Exception:  # noqa: BLE001 — the registry lease will expire
+                pass
+
+    def _run(self, num_passes: int, info: Dict[str, Any]) -> Dict[str, Any]:
+        current = int(info.get("pass_id", 0))
+        completed = None
+        # restore when explicitly resuming, OR when joining a cluster that
+        # is already past pass 0 — a joiner MUST reach the fleet's exact
+        # parameter state before contributing (checkpoint manifest first,
+        # retained result maps for the trailing gap)
+        if self.manager is not None and (self.resume or current > 0):
+            restored = self.manager.restore_latest(self.model.state())
+            if restored is not None:
+                _, tree, extra = restored
+                self.model.load(tree, extra)
+                completed = int(extra.get("pass_id", -1))
+                _log.info(
+                    "worker %s restored committed manifest: pass %d applied",
+                    self.worker_id, completed,
+                )
+        if completed is not None:
+            if current < completed:
+                raise RuntimeError(
+                    f"master is at pass {current} but the checkpoint "
+                    f"already applied pass {completed}: the master state "
+                    f"dir is stale relative to the checkpoint dir"
+                )
+            if current == completed:
+                if completed + 1 >= num_passes:
+                    # the job is already complete (we joined after the last
+                    # pass): do NOT rotate the queue past the end — that
+                    # would refill todo for a pass nobody asked for
+                    current = completed + 1
+                else:
+                    current = self.client.start_new_pass(completed + 1)
+            if current == completed:
+                raise RuntimeError(
+                    f"master cannot rotate past pass {completed} (queue "
+                    f"not drained) yet the checkpoint applied it — "
+                    f"mismatched master/checkpoint state"
+                )
+        # late join: replay the passes between the checkpoint (or scratch)
+        # and the master's current pass from the retained result maps
+        for p in range((completed + 1) if completed is not None else 0,
+                       current):
+            self._apply_retained_pass(p)
+        # a restarted master recovered its queues from the snapshot but the
+        # in-memory result payloads died with it: requeue done-but-
+        # unresulted tasks so this pass's contributions are recomputed
+        # (deterministic, so recomputation cannot move the trajectory)
+        requeued = self.client.requeue_unresulted()
+        if requeued:
+            _log.warning(
+                "worker %s: recomputing %d task contributions lost with a "
+                "restarted master", self.worker_id, requeued,
+            )
+        self.t_work0 = self._clock()
+        pass_id = current
+        while pass_id < num_passes:
+            behind = self._run_pass_tasks(pass_id)
+            if behind is None:
+                # drained — but a pruned-then-rejoined worker (hang) may
+                # have slept through whole passes without ever seeing a
+                # skewed lease; one stats probe per pass catches that
+                actual = int(self.client.stats()["pass_id"])
+                if actual > pass_id:
+                    behind = actual
+            if behind is not None:
+                # the fleet fenced + rotated without us: replay the missed
+                # passes, then continue at the master's pass
+                pass_id = self._catch_up(pass_id, behind)
+                continue
+            if self.manager is not None:
+                self.manager.wait()  # join the async shard write pre-fence
+            view = self._fence(f"pass-{pass_id}")
+            self._commit_pending()
+            results = self.client.pass_results(pass_id)["results"]
+            if len(results) != int(view.get("n_done", len(results))):
+                # correctness-first: applying a partial reduction would
+                # silently fork the trajectory.  The heal path is a worker
+                # RESTART — startup recovery calls requeue_unresulted and
+                # the orphaned tasks recompute deterministically (run the
+                # fleet under a supervisor that restarts nonzero exits).
+                raise RuntimeError(
+                    f"pass {pass_id}: fence froze {view.get('n_done')} done "
+                    f"tasks but only {len(results)} contributions exist — "
+                    f"results were lost (master failover mid-pass?); "
+                    f"refusing to apply a partial reduction.  Restart this "
+                    f"worker: startup recovery requeues the unresulted "
+                    f"tasks and recomputes them deterministically"
+                )
+            mean_grads, mean_cost, _rows = reduce_results(results)
+            self.model.apply(mean_grads)
+            self.pass_costs.append(mean_cost)
+            self._write_shard(pass_id, view.get("writers", []))
+            if pass_id + 1 < num_passes:
+                self.client.start_new_pass(pass_id + 1)
+            pass_id += 1
+        if self.manager is not None:
+            self.manager.wait()
+            if self._pending_commit is not None:
+                # final pass: every writer joins at one last barrier, then
+                # anyone publishes the manifest
+                self._fence(f"final-{num_passes - 1}")
+                self._commit_pending()
+        self.t_work1 = self._clock()
+        return {
+            "worker_id": self.worker_id,
+            "pass_costs": self.pass_costs,
+            "tasks_done": self.tasks_done,
+            "rejected_acks": self.rejected_acks,
+            "busy_s": self.busy_s,
+            "t_work0": self.t_work0,
+            "t_work1": self.t_work1,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Built-in models
+# ---------------------------------------------------------------------------
+
+class NumpyLinearModel:
+    """Least-squares regression in pure numpy — the jax-free reference
+    model for cluster-plane tests and the scaling bench (worker startup is
+    then import-light, so the curve measures coordination + compute, not
+    interpreter boot).  Records are float32 vectors ``[x..., y]``.
+
+    ``hidden=0`` (default) is plain linear regression; ``hidden>0`` adds a
+    tanh hidden layer (deterministically seeded init) so the per-task
+    gradient has real arithmetic weight — what the 1→N scaling bench needs
+    to expose coordination overhead honestly."""
+
+    def __init__(self, dim: int, lr: float = 0.1, hidden: int = 0,
+                 seed: int = 0):
+        self.dim = int(dim)
+        self.hidden = int(hidden)
+        self.lr = np.float32(lr)
+        if self.hidden:
+            rng = np.random.RandomState(seed)
+            scale = np.float32(1.0 / np.sqrt(self.dim))
+            self.w1 = (rng.randn(self.dim, self.hidden)
+                       .astype(np.float32) * scale)
+            self.b1 = np.zeros((self.hidden,), np.float32)
+            self.w = np.zeros((self.hidden,), np.float32)
+        else:
+            self.w = np.zeros((self.dim,), np.float32)
+        self.b = np.zeros((), np.float32)
+
+    def task_grad(self, records, pass_id: int, task_id: int):
+        arr = np.stack([np.frombuffer(r, np.float32) for r in records])
+        if arr.shape[1] != self.dim + 1:
+            raise ValueError(
+                f"record width {arr.shape[1]} != dim+1 ({self.dim + 1})"
+            )
+        x, y = arr[:, :-1], arr[:, -1]
+        n = np.float32(len(records))
+        if self.hidden:
+            h = np.tanh(x @ self.w1 + self.b1)
+            err = h @ self.w + self.b - y
+            dh = err[:, None] * self.w[None, :] * (1.0 - h * h)
+            grads = {
+                "w1": x.T @ dh / n,
+                "b1": dh.sum(axis=0, dtype=np.float32) / n,
+                "w": h.T @ err / n,
+                "b": err.mean(dtype=np.float32),
+            }
+        else:
+            err = x @ self.w + self.b - y
+            grads = {"w": x.T @ err / n, "b": err.mean(dtype=np.float32)}
+        cost_sum = float(0.5 * np.sum(err.astype(np.float64) ** 2))
+        return grads, cost_sum, len(records)
+
+    def apply(self, grads) -> None:
+        for name, g in grads.items():
+            setattr(
+                self, name,
+                getattr(self, name) - self.lr * np.asarray(g, np.float32),
+            )
+
+    def state(self):
+        out = {"w": self.w, "b": self.b}
+        if self.hidden:
+            out.update({"w1": self.w1, "b1": self.b1})
+        return out
+
+    def load(self, tree, extra) -> None:
+        for name in self.state():
+            setattr(self, name, np.asarray(tree[name], np.float32))
+
+
+class TrainerTaskModel:
+    """Adapts a :class:`paddle_tpu.trainer.SGD` trainer to the elastic
+    protocol: per-task gradients come from the jitted
+    :func:`~paddle_tpu.trainer.step.make_grad_step`, the reduced update
+    goes through the trainer's own optimizer, and the checkpointed state is
+    the trainer's full state (params + layer state + optimizer state +
+    RNG) — so an elastic fleet trains the same networks, with the same
+    optimizers, as a single-process ``trainer.train`` run.
+
+    ``decode(record) -> sample`` turns one stored record into one feed
+    sample for the trainer's DataFeeder.  The per-task RNG folds in
+    (pass_id, task_id) only — NOT the worker or the task epoch — so a
+    requeued task recomputes bit-identical contributions on any survivor."""
+
+    def __init__(self, trainer, decode):
+        import jax
+
+        from paddle_tpu.trainer.step import make_grad_step
+
+        self._t = trainer
+        self._decode = decode
+        self._feeder = trainer._make_feeder(None)
+        self._gstep = make_grad_step(trainer.network, trainer.mesh)
+        self._apply = jax.jit(
+            lambda g, o, p: trainer.optimizer.update(g, o, p)
+        )
+        self._base_rng = jax.random.PRNGKey(trainer._seed)
+
+    def task_grad(self, records, pass_id: int, task_id: int):
+        import jax
+
+        from paddle_tpu.parallel.mesh import shard_batch
+
+        samples = [self._decode(r) for r in records]
+        batch = shard_batch(self._feeder(samples), self._t.mesh)
+        rng = jax.random.fold_in(
+            jax.random.fold_in(self._base_rng, pass_id), task_id
+        )
+        grads, cost = self._gstep(
+            self._t.parameters.params, self._t.parameters.state, batch, rng
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g: np.asarray(jax.device_get(g)), grads
+        )
+        return grads, float(cost) * len(samples), len(samples)
+
+    def apply(self, grads) -> None:
+        t = self._t
+        t.parameters.params, t._opt_state = self._apply(
+            grads, t._opt_state, t.parameters.params
+        )
+        t._step_count += 1
+
+    def state(self):
+        return self._t._full_state()
+
+    def load(self, tree, extra) -> None:
+        self._t._apply_restored(tree, extra)
+
+
+# ---------------------------------------------------------------------------
+# CLI — the per-process entry point the launcher/bench/chaos tests spawn
+# ---------------------------------------------------------------------------
+
+def _parse_model_args(pairs: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for p in pairs:
+        k, _, v = p.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _build_model(name: str, margs: Dict[str, str], seed: int):
+    if name == "numpy":
+        return NumpyLinearModel(
+            dim=int(margs.get("dim", "8")),
+            lr=float(margs.get("lr", "0.1")),
+            hidden=int(margs.get("hidden", "0")),
+            seed=seed,
+        )
+    if name == "mlp":
+        import paddle_tpu as paddle
+        from paddle_tpu.core.topology import reset_auto_names
+
+        dim = int(margs.get("dim", "8"))
+        classes = int(margs.get("classes", "4"))
+        hidden = int(margs.get("hidden", "16"))
+        lr = float(margs.get("lr", "0.1"))
+        reset_auto_names()
+        paddle.init(seed=seed)
+        x = paddle.layer.data("x", paddle.data_type.dense_vector(dim))
+        h = paddle.layer.fc(x, size=hidden, act=paddle.activation.Tanh())
+        pred = paddle.layer.fc(
+            h, size=classes, act=paddle.activation.Softmax()
+        )
+        label = paddle.layer.data(
+            "label", paddle.data_type.integer_value(classes)
+        )
+        cost = paddle.layer.classification_cost(input=pred, label=label)
+        trainer = paddle.trainer.SGD(
+            cost=cost,
+            parameters=paddle.parameters.create(cost, seed=seed),
+            update_equation=paddle.optimizer.Momentum(
+                learning_rate=lr, momentum=0.9
+            ),
+        )
+
+        def decode(rec: bytes):
+            vec = np.frombuffer(rec, np.float32)
+            return vec[:-1].tolist(), int(vec[-1])
+
+        return trainer.elastic_model(decode)
+    raise ValueError(f"unknown --model {name!r} (numpy, mlp)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu worker",
+        description="One elastic trainer process: leases data-shard tasks "
+        "from the master plane, contributes deterministic per-task "
+        "gradients, reduces at pass fences, writes its checkpoint shard.",
+    )
+    ap.add_argument("--dir", required=True,
+                    help="the HA master discovery directory (master_ha)")
+    ap.add_argument("--worker-id", default=None,
+                    help="default: w<PADDLE_TPU_PROCESS_ID> under the "
+                    "launcher, else host:pid")
+    ap.add_argument("--num-passes", type=int, default=1)
+    ap.add_argument("--model", default="numpy", help="numpy | mlp")
+    ap.add_argument("--model-arg", action="append", default=[],
+                    help="k=v model hyperparameter (repeatable), e.g. dim=8")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="sharded-manifest checkpoint directory (shared)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the latest committed manifest first")
+    ap.add_argument("--stats-out", default=None,
+                    help="write a JSON work summary here on success; a "
+                    "'{worker}' placeholder expands to the worker id, so "
+                    "one launcher argv serves the whole fleet")
+    ap.add_argument("--poll-s", type=float, default=0.02)
+    ap.add_argument("--min-workers", type=int, default=1,
+                    help="hold the first lease until this many workers "
+                    "registered (gang-start hint; membership stays elastic "
+                    "afterwards)")
+    ap.add_argument("--client-timeout", type=float, default=60.0)
+    ap.add_argument("--chaos", default=None,
+                    help="arm chaos points in THIS worker, e.g. "
+                    "'kill_worker@2' (env PADDLE_TPU_CHAOS also works)")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    if args.chaos:
+        _chaos.arm(args.chaos)
+    from paddle_tpu.master_ha import HAClient
+
+    worker_id = args.worker_id
+    if worker_id is None:
+        proc_id = os.environ.get("PADDLE_TPU_PROCESS_ID")
+        worker_id = (
+            f"w{proc_id}" if proc_id is not None
+            else f"{os.uname().nodename}:{os.getpid()}"
+        )
+    manager = None
+    if args.checkpoint_dir:
+        from paddle_tpu.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(args.checkpoint_dir)
+    model = _build_model(
+        args.model, _parse_model_args(args.model_arg), args.seed
+    )
+    worker = ElasticWorker(
+        HAClient(args.dir, timeout=args.client_timeout),
+        worker_id,
+        model,
+        manager=manager,
+        resume=args.resume,
+        heartbeat_client=HAClient(args.dir, timeout=args.client_timeout),
+        poll_s=args.poll_s,
+        min_workers=args.min_workers,
+    )
+    summary = worker.run(args.num_passes)
+    if args.stats_out:
+        path = args.stats_out.replace("{worker}", worker_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(summary, f)
+        os.replace(tmp, path)
+    for i, c in enumerate(summary["pass_costs"]):
+        print(f"worker {worker_id} pass cost {c:.6f} (#{i})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+
+    sys.exit(main())

@@ -722,6 +722,17 @@ class SGD:
         self._opt_state = opt_state
 
     # ------------------------------------------------------------------
+    def elastic_model(self, decode):
+        """Adapt this trainer to the elastic multi-process protocol
+        (trainer/elastic.py): per-task jitted gradient contributions,
+        fence-synchronized deterministic reduction, the trainer's own
+        optimizer applied to the reduced update, and full-state sharded
+        checkpoints.  ``decode(record_bytes) -> feed sample``."""
+        from paddle_tpu.trainer.elastic import TrainerTaskModel
+
+        return TrainerTaskModel(self, decode)
+
+    # ------------------------------------------------------------------
     def test(
         self, reader: Callable, feeding=None, async_load_data: bool = True
     ) -> v2_event.TestResult:

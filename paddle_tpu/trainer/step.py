@@ -285,6 +285,42 @@ def make_bucketed_train_step(
     return step, cache
 
 
+def make_grad_step(
+    network: CompiledNetwork,
+    mesh: Optional[Mesh] = None,
+    infer_param_shardings: bool = False,
+):
+    """Returns jitted ``(params, state, batch, rng) -> (grads, cost)`` —
+    the gradient HALF of the train step, with no optimizer update fused in.
+
+    This is the unit of work of the elastic multi-process trainer
+    (trainer/elastic.py): each leased data-shard task contributes one
+    deterministic gradient tree, the fleet reduces the contributions in
+    task-id order at the pass fence, and every process applies the SAME
+    reduced update — so the result is bit-identical however tasks were
+    distributed, which is what lets a killed worker's shards requeue to
+    survivors without perturbing the trajectory.  Layer-state updates (BN
+    statistics etc.) from the forward pass are intentionally dropped:
+    pass-synchronous reduction has no per-step state stream to thread."""
+
+    def gstep(params, state, batch, rng):
+        def loss_fn(p):
+            return network.cost(p, batch, state=state, rng=rng, train=True)
+
+        (cost, _aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        return grads, cost
+
+    if mesh is None or infer_param_shardings:
+        return jax.jit(gstep)
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(DATA_AXIS))
+    return jax.jit(
+        gstep,
+        in_shardings=(repl, repl, batch_sh, repl),
+        out_shardings=repl,
+    )
+
+
 def make_eval_step(
     network: CompiledNetwork,
     mesh: Optional[Mesh] = None,

@@ -18,6 +18,12 @@ test invisibly.  This script snapshots the exact set of failing test ids to
   python scripts/tier1_failset.py --update [--from-log ...]
       rewrite the baseline from the run/log.
 
+  python scripts/tier1_failset.py --slow-guard
+      verify that the multi-process e2e files (SLOW_ONLY_FILES) collect
+      ZERO tests under the tier-1 ``-m "not slow"`` filter — a forgotten
+      slow mark would drag multi-process process-spawning runs into the
+      fast tier and break its time budget.
+
 Log format: the ``FAILED <nodeid>[ - msg]`` / ``ERROR <nodeid>`` lines of
 pytest's short test summary (printed by default, including under ``-q``).
 """
@@ -42,6 +48,12 @@ TIER1_CMD = [
 ]
 
 _LINE = re.compile(r"^(FAILED|ERROR)\s+(.+)$")
+
+# test files whose EVERY test must stay out of the tier-1 fast tier (they
+# spawn fleets of python processes); enforced by --slow-guard in CI
+SLOW_ONLY_FILES = [
+    "tests/test_elastic_e2e.py",
+]
 
 
 def _strip_message(rest: str) -> str:
@@ -102,6 +114,49 @@ def load_baseline() -> set:
         }
 
 
+def slow_guard() -> int:
+    """Exit 1 when any SLOW_ONLY_FILES test would run in the fast tier."""
+    missing = [
+        f for f in SLOW_ONLY_FILES if not os.path.exists(os.path.join(REPO, f))
+    ]
+    if missing:
+        print(f"SLOW-GUARD FAIL: guarded file(s) do not exist: {missing}")
+        return 1
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", *SLOW_ONLY_FILES],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    # pytest exit 0 = collected-and-deselected, 5 = nothing collected; any
+    # other code (collection error, usage error) means the guard verified
+    # NOTHING and must fail rather than pass vacuously
+    if proc.returncode not in (0, 5):
+        print(
+            f"SLOW-GUARD FAIL: pytest collection exited "
+            f"{proc.returncode}:\n{proc.stdout[-2000:]}"
+        )
+        return 1
+    collected = [
+        ln for ln in proc.stdout.splitlines()
+        if "::" in ln and not ln.startswith(("=", "<"))
+    ]
+    if collected:
+        print(
+            f"SLOW-GUARD FAIL: {len(collected)} multi-process e2e test(s) "
+            "would run in the tier-1 fast tier (missing slow mark):"
+        )
+        for t in collected:
+            print(f"  - {t}")
+        return 1
+    print(
+        f"slow-guard ok: {', '.join(SLOW_ONLY_FILES)} fully excluded from "
+        "tier-1"
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     mode = ap.add_mutually_exclusive_group(required=True)
@@ -109,9 +164,14 @@ def main() -> int:
                       help="diff the failure set against the baseline")
     mode.add_argument("--update", action="store_true",
                       help="rewrite the baseline from this run/log")
+    mode.add_argument("--slow-guard", action="store_true",
+                      help="verify multi-process e2e files stay slow-marked")
     ap.add_argument("--from-log", default=None,
                     help="parse this pytest log instead of running the suite")
     args = ap.parse_args()
+
+    if args.slow_guard:
+        return slow_guard()
 
     if args.from_log:
         with open(args.from_log) as f:

@@ -176,6 +176,165 @@ def test_meta_json(tmp_path):
     assert "crc32" in meta and meta["n_leaves"] == 1
 
 
+# ---------------------------------------------------------------------------
+# sharded multi-writer checkpoints (elastic scale-out plane)
+# ---------------------------------------------------------------------------
+
+def _tree():
+    return {
+        "a": np.arange(6, dtype=np.float32),
+        "b": {"w": np.ones((2, 3), np.float32), "v": np.zeros(4, np.float32)},
+        "c": np.float32(7),
+    }
+
+
+def test_sharded_save_commit_restore_roundtrip(tmp_path):
+    """Two writers, one manifest commit: the merged restore equals the
+    source tree, and the manifest carries the step/extra."""
+    d = str(tmp_path / "ck")
+    t = _tree()
+    w0, w1 = ckpt.CheckpointManager(d), ckpt.CheckpointManager(d)
+    w0.save_shard(1, 0, 2, t)
+    w1.save_shard(1, 1, 2, t, async_=True)
+    w1.wait()
+    assert w0.commit(1, 2, extra={"pass_id": 0})
+    assert w0.commit(1, 2)  # idempotent from any worker
+    step, restored, extra = w0.restore_latest(t)
+    assert step == 1 and extra == {"pass_id": 0}
+    np.testing.assert_array_equal(restored["a"], t["a"])
+    np.testing.assert_array_equal(restored["b"]["w"], t["b"]["w"])
+    np.testing.assert_array_equal(restored["b"]["v"], t["b"]["v"])
+    assert w0.meta(1)["num_shards"] == 2
+
+
+def test_commit_refuses_while_a_shard_is_missing(tmp_path):
+    """A writer died before its shard landed: the step must stay
+    unrestorable (no manifest) rather than commit a partial state."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    mgr.save_shard(1, 0, 3, _tree())
+    mgr.save_shard(1, 2, 3, _tree())
+    assert mgr.commit(1, 3) is False
+    assert mgr.restore_latest(_tree()) is None
+
+
+def test_torn_shard_falls_back_to_previous_complete_manifest(tmp_path):
+    """Save under load across two steps, tear ONE shard of the newest
+    committed step: restore_latest must fall back to the previous complete
+    manifest (the acceptance bullet)."""
+    from paddle_tpu.robustness import chaos
+
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d)
+    t1, t2 = _tree(), _tree()
+    t2["a"] = t2["a"] * 2
+    for step, t in ((1, t1), (2, t2)):
+        mgr.save_shard(step, 0, 2, t)
+        mgr.save_shard(step, 1, 2, t)
+        assert mgr.commit(step, 2, extra={"pass_id": step - 1})
+    chaos.tear_file(
+        os.path.join(d, "ckpt-00000002", "shard-00000-of-00002.npz")
+    )
+    step, restored, extra = mgr.restore_latest(t1)
+    assert step == 1 and extra["pass_id"] == 0
+    np.testing.assert_array_equal(restored["a"], t1["a"])
+
+
+def test_uncommitted_shard_set_is_walked_past(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    t = _tree()
+    mgr.save_shard(1, 0, 1, t)
+    assert mgr.commit(1, 1)
+    mgr.save_shard(2, 0, 2, t)  # second writer never arrives, no commit
+    step, _, _ = mgr.restore_latest(t)
+    assert step == 1
+
+
+def test_shard_leaf_partition_is_disjoint_and_total(tmp_path):
+    """Every flattened leaf lands in exactly one shard."""
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d)
+    t = _tree()
+    for i in range(3):
+        mgr.save_shard(5, i, 3, t)
+    seen = []
+    for i in range(3):
+        with np.load(
+            os.path.join(d, "ckpt-00000005", f"shard-{i:05d}-of-00003.npz")
+        ) as z:
+            seen.extend(z.files)
+    assert sorted(seen) == sorted(set(seen))  # disjoint
+    assert len(seen) == 4  # a, b.w, b.v, c — total
+
+
+def test_retention_never_reaps_last_committed_manifest(tmp_path):
+    """Uncommitted/stranded shard sets must not count toward max_to_keep:
+    steps 6/7 stranded (writers died, no manifest), step 8 committed but
+    torn post-commit — the retention pass that 8's commit triggers must
+    keep the old committed step 5, and restore_latest must land on it."""
+    from paddle_tpu.robustness import chaos
+
+    d = str(tmp_path / "ck")
+    mgr = ckpt.CheckpointManager(d, max_to_keep=3)
+    t = _tree()
+    mgr.save_shard(5, 0, 1, t)
+    assert mgr.commit(5, 1, extra={"pass_id": 4})
+    for step in (6, 7):  # writers died: shards landed, no manifest
+        mgr.save_shard(step, 0, 2, t)
+        assert not mgr.commit(step, 2)
+    mgr.save_shard(8, 0, 1, t)
+    assert mgr.commit(8, 1)  # triggers retention
+    chaos.tear_file(
+        os.path.join(d, "ckpt-00000008", "shard-00000-of-00001.npz")
+    )
+    assert 5 in mgr.all_steps()  # retention kept the restorable step
+    step, _, extra = mgr.restore_latest(t)
+    assert step == 5 and extra["pass_id"] == 4
+
+
+# satellite: a background-thread write failure must never vanish — it
+# re-raises from wait() AND from the next save
+def test_async_write_error_reraises_from_wait(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+
+    def boom(*a, **k):
+        raise IOError("disk full")
+
+    mgr._write = boom
+    mgr.save(1, _tree(), async_=True)
+    with pytest.raises(IOError, match="disk full"):
+        mgr.wait()
+    mgr.wait()  # the error is consumed exactly once
+
+
+def test_async_write_error_reraises_from_next_save(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+    orig = mgr._write
+
+    def boom(*a, **k):
+        raise IOError("disk full")
+
+    mgr._write = boom
+    mgr.save(1, _tree(), async_=True)
+    mgr._pending.join()  # let the failure land without consuming it
+    mgr._write = orig
+    with pytest.raises(IOError, match="disk full"):
+        mgr.save(2, _tree())
+    # and the failed step never became restorable
+    assert mgr.restore_latest(_tree()) is None
+
+
+def test_async_shard_write_error_reraises(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+
+    def boom(*a, **k):
+        raise IOError("enospc")
+
+    mgr._write_shard = boom
+    mgr.save_shard(1, 0, 2, _tree(), async_=True)
+    with pytest.raises(IOError, match="enospc"):
+        mgr.wait()
+
+
 def test_v2_model_save_load_roundtrip(tmp_path):
     """paddle.model.save_model/load_model (reference v2/model.py): plain tar
     without a master; master arbitration grants exactly one trainer."""

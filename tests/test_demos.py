@@ -104,7 +104,62 @@ def test_launcher_single_host_init_is_noop(monkeypatch):
     from paddle_tpu import launcher
 
     monkeypatch.delenv(launcher.ENV_COORD, raising=False)
-    assert launcher.init_cluster() is False
+    group = launcher.init_cluster()
+    assert not group  # falsy ProcessGroup: no multi-process runtime
+    assert group.backend == "single" and group.num_processes == 1
+
+
+def test_launcher_multi_process_shim_records_membership(monkeypatch):
+    """On the CPU dev container init_cluster forms the SHIM group (no
+    jax.distributed runtime to join): membership is recorded and the
+    cross-process reduction rides the master plane instead."""
+    from paddle_tpu import launcher
+    from paddle_tpu.parallel.mesh import current_process_group
+
+    monkeypatch.setenv(launcher.ENV_COORD, "h0:8476")
+    monkeypatch.setenv(launcher.ENV_NPROC, "4")
+    monkeypatch.setenv(launcher.ENV_PROC_ID, "2")
+    monkeypatch.delenv("PADDLE_TPU_DIST_BACKEND", raising=False)
+    group = launcher.init_cluster()
+    try:
+        assert group  # truthy: multi-process membership formed
+        assert group.backend == "shim"
+        assert group.num_processes == 4 and group.process_id == 2
+        assert current_process_group() is group
+    finally:
+        monkeypatch.delenv(launcher.ENV_COORD, raising=False)
+        monkeypatch.delenv(launcher.ENV_NPROC, raising=False)
+        monkeypatch.delenv(launcher.ENV_PROC_ID, raising=False)
+        launcher.init_cluster()  # reset the module-global group
+
+
+def test_launcher_forwards_dist_backend_choice(monkeypatch):
+    """The operator's PADDLE_TPU_DIST_BACKEND choice must travel with the
+    job: remote workers only see the inlined env fragment."""
+    from paddle_tpu import launcher
+
+    monkeypatch.setenv("PADDLE_TPU_DIST_BACKEND", "jax")
+    assert launcher.build_worker_env("h0:1", 4, 2)[
+        "PADDLE_TPU_DIST_BACKEND"
+    ] == "jax"
+    monkeypatch.delenv("PADDLE_TPU_DIST_BACKEND")
+    assert "PADDLE_TPU_DIST_BACKEND" not in launcher.build_worker_env(
+        "h0:1", 4, 2
+    )
+
+
+def test_launcher_extra_env_arms_one_worker(tmp_path):
+    """extra_env reaches exactly the targeted process id — how a chaos
+    drill arms kill_worker on worker k of N."""
+    from paddle_tpu import launcher
+
+    cmds = launcher.build_commands(
+        ["localhost", "localhost", "localhost"], "h0:1", "train.py",
+        extra_env={1: {"PADDLE_TPU_CHAOS": "kill_worker@2"}},
+    )
+    assert "PADDLE_TPU_CHAOS=kill_worker@2" in cmds[1]
+    assert not any("PADDLE_TPU_CHAOS" in c for c in cmds[0])
+    assert not any("PADDLE_TPU_CHAOS" in c for c in cmds[2])
 
 
 def test_launcher_local_dry_run():

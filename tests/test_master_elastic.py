@@ -1,0 +1,431 @@
+"""The master's elastic cluster plane: worker registry heartbeat leases,
+dead-worker shard-lease requeue, pass fences with elastic membership, the
+per-task result plane, and the lease-expiry/zombie-epoch discipline
+(reference go/master/service.go's failure_max model completed fleet-wide,
+arXiv:1605.08695 §4.4).  Everything runs on an injected clock — no real
+sleeps on the lease paths."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import master as master_mod
+from paddle_tpu.io import recordio
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _write(path, n, chunk, tag=""):
+    recordio.write_records(
+        path, (f"{tag}{i}".encode() for i in range(n)),
+        max_chunk_records=chunk,
+    )
+
+
+def _make_service(tmp_path, clock, **kw):
+    _write(str(tmp_path / "d.rio"), 80, chunk=10)
+    kw.setdefault("snapshot_min_interval_s", 0.0)
+    kw.setdefault("chunks_per_task", 2)
+    kw.setdefault("auto_rotate", False)
+    svc = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), clock=clock, **kw
+    )
+    svc.set_dataset([str(tmp_path / "d.rio")])
+    return svc  # 4 tasks
+
+
+# ---------------------------------------------------------------------------
+# satellite: _requeue_expired — expired mid-pass lease re-serves EXACTLY
+# once, and the zombie owner's epoch-guarded ack is rejected
+# ---------------------------------------------------------------------------
+
+def test_expired_lease_hands_task_to_second_client_exactly_once(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, timeout_s=5.0)
+    got_a = svc.get_task("A")
+    tid, epoch = got_a["task"]["task_id"], got_a["epoch"]
+    clk.advance(6.0)  # A's task lease expires mid-pass
+    servings = {}
+    while True:
+        got = svc.get_task("B")
+        if got is None:
+            break
+        assert got != "wait"
+        t = got["task"]["task_id"]
+        servings[t] = servings.get(t, 0) + 1
+        assert svc.task_finished(t, got["epoch"])
+        if t == tid:
+            assert got["epoch"] == epoch + 1  # walked the failure path
+    assert servings[tid] == 1  # re-served exactly once
+    assert svc.fail_events == 1
+
+
+def test_zombie_task_finished_rejected_by_epoch(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, timeout_s=5.0)
+    got_a = svc.get_task("A")
+    tid, epoch = got_a["task"]["task_id"], got_a["epoch"]
+    clk.advance(6.0)
+    got_b = None
+    while got_b is None or got_b["task"]["task_id"] != tid:
+        got_b = svc.get_task("B")
+        assert got_b not in (None, "wait")
+        if got_b["task"]["task_id"] != tid:
+            assert svc.task_finished(
+                got_b["task"]["task_id"], got_b["epoch"]
+            )
+            got_b = None
+    # the original (zombie) owner's ack — with its result — must bounce
+    assert svc.task_finished(tid, epoch, {"g": "zombie"}) is False
+    # the new holder's ack (and result) wins
+    assert svc.task_finished(tid, got_b["epoch"], {"g": "survivor"})
+    assert svc.pass_results(0)["results"][tid] == {"g": "survivor"}
+
+
+# ---------------------------------------------------------------------------
+# worker registry: heartbeat leases, prune -> immediate lease requeue
+# ---------------------------------------------------------------------------
+
+def test_dead_worker_leases_requeue_on_registry_expiry(tmp_path):
+    clk = _FakeClock()
+    # task leases far longer than the registry lease: the requeue must ride
+    # the REGISTRY expiry, not the per-task timeout
+    svc = _make_service(tmp_path, clk, timeout_s=600.0, worker_timeout_s=5.0)
+    svc.register_worker("A")
+    svc.register_worker("B")
+    got = svc.get_task("A")
+    tid = got["task"]["task_id"]
+    clk.advance(3.0)
+    svc.heartbeat("B")
+    clk.advance(3.0)  # A silent for 6s > 5s; B heartbeated at 3s
+    assert svc.live_workers() == ["B"]
+    assert svc.fail_events == 1  # A's lease walked the failure path
+    served = set()
+    while True:
+        g = svc.get_task("B")
+        if g is None:
+            break
+        served.add(g["task"]["task_id"])
+        svc.task_finished(g["task"]["task_id"], g["epoch"])
+    assert tid in served  # the dead worker's shard reached the survivor
+
+
+def test_heartbeat_false_after_expiry_then_reregister(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=5.0)
+    svc.register_worker("A")
+    clk.advance(6.0)
+    assert svc.heartbeat("A") is False  # expired: must re-register
+    info = svc.register_worker("A")
+    assert info["workers"] == ["A"]
+    assert svc.heartbeat("A") is True
+
+
+def test_deregister_returns_leases_without_failure_event(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, timeout_s=600.0)
+    svc.register_worker("A")
+    got = svc.get_task("A")
+    svc.deregister_worker("A")
+    assert svc.fail_events == 0  # graceful leave is not a crash
+    got2 = svc.get_task("B")
+    # the returned task re-serves at the SAME epoch
+    assert got2["task"]["task_id"] in {got["task"]["task_id"], 1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# pass fence: elastic membership
+# ---------------------------------------------------------------------------
+
+def _drain(svc, worker):
+    while True:
+        g = svc.get_task(worker)
+        if g is None:
+            return
+        if g == "wait":
+            continue
+        svc.task_finished(g["task"]["task_id"], g["epoch"], {"rows": 1})
+
+
+def test_fence_releases_when_all_live_arrived(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=50.0)
+    svc.register_worker("A")
+    svc.register_worker("B")
+    _drain(svc, "A")
+    st = svc.fence_arrive("pass-0", "A")
+    assert not st["released"]
+    st = svc.fence_arrive("pass-0", "B")
+    assert st["released"]
+    assert st["workers"] == ["A", "B"]
+    assert st["n_done"] == 4
+
+
+def test_fence_does_not_wedge_on_dead_worker(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=5.0)
+    svc.register_worker("A")
+    svc.register_worker("B")
+    _drain(svc, "A")
+    assert not svc.fence_arrive("pass-0", "A")["released"]
+    clk.advance(6.0)  # B dies silently; prune runs on the next poll
+    st = svc.fence_status("pass-0")
+    assert st["released"] is True
+    assert st["workers"] == ["A"]  # membership froze without the dead B
+
+
+def test_late_arrival_sees_frozen_membership(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=5.0)
+    svc.register_worker("A")
+    _drain(svc, "A")
+    assert svc.fence_arrive("pass-0", "A")["released"]
+    svc.register_worker("C")  # joins after release
+    st = svc.fence_arrive("pass-0", "C")
+    assert st["released"] and "C" not in st["workers"]
+
+
+def test_fence_negotiates_writer_roster(tmp_path):
+    """The shard-writer set is the checkpoint-enabled subset of the
+    membership: one checkpoint-less worker must not doom every manifest
+    commit to a missing shard."""
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=50.0)
+    svc.register_worker("A")
+    svc.register_worker("B")
+    svc.register_worker("C")
+    _drain(svc, "A")
+    svc.fence_arrive("pass-0", "A", {"ckpt": True})
+    svc.fence_arrive("pass-0", "B", {"ckpt": False})
+    st = svc.fence_arrive("pass-0", "C", {"ckpt": True})
+    assert st["released"]
+    assert st["workers"] == ["A", "B", "C"]
+    assert st["writers"] == ["A", "C"]
+
+
+def test_mixed_fleet_checkpoint_commits_without_ckptless_worker(tmp_path):
+    """In-process mixed fleet: a worker WITHOUT --checkpoint-dir rides
+    along and the checkpointing workers' manifest still commits (writer
+    roster excludes it)."""
+    import threading
+
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.trainer.elastic import ElasticWorker, NumpyLinearModel
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4).astype(np.float32)
+    recordio.write_records(
+        str(tmp_path / "d.rio"),
+        (np.concatenate([x := rng.randn(4).astype(np.float32),
+                         [np.float32(x @ w_true)]])
+         .astype(np.float32).tobytes() for _ in range(48)),
+        max_chunk_records=4,
+    )
+    svc = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), chunks_per_task=2,
+        auto_rotate=False, snapshot_min_interval_s=0.0,
+        worker_timeout_s=30.0,
+    )
+    svc.set_dataset([str(tmp_path / "d.rio")])
+    ck = str(tmp_path / "ck")
+    workers = [
+        ElasticWorker(master_mod.Client(svc), "w0", NumpyLinearModel(4),
+                      manager=CheckpointManager(ck)),
+        ElasticWorker(master_mod.Client(svc), "w1", NumpyLinearModel(4),
+                      manager=None),  # no checkpoint dir
+    ]
+    results = {}
+    threads = [
+        threading.Thread(target=lambda w=w: results.update(
+            {w.worker_id: w.run(2)}
+        ))
+        for w in workers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert set(results) == {"w0", "w1"}
+    restored = CheckpointManager(ck).restore_latest(
+        NumpyLinearModel(4).state()
+    )
+    assert restored is not None and restored[0] == 2  # committed
+    assert results["w0"]["pass_costs"] == results["w1"]["pass_costs"]
+
+
+def test_fence_arrive_renews_registry_lease(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=5.0)
+    svc.register_worker("A")
+    svc.register_worker("B")
+    for _ in range(4):  # A parked at the barrier, polling by re-arrival
+        clk.advance(3.0)
+        svc.fence_arrive("pass-0", "A")
+    assert "A" in svc.live_workers()  # never pruned mid-wait
+
+
+# ---------------------------------------------------------------------------
+# pass accounting: guarded rotation, retained results, requeue_unresulted
+# ---------------------------------------------------------------------------
+
+def test_start_new_pass_target_guard_is_idempotent(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    _drain(svc, "A")
+    assert svc.start_new_pass(1) == 1
+    assert svc.start_new_pass(1) == 1  # straggler cannot double-rotate
+    _drain(svc, "A")
+    assert svc.start_new_pass(1) == 1  # target already reached: held
+    assert svc.start_new_pass(2) == 2
+
+
+def test_pass_results_retained_with_done_count_across_rotation(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    _drain(svc, "A")
+    svc.start_new_pass(1)
+    pr = svc.pass_results(0)
+    assert pr["n_done"] == 4 and len(pr["results"]) == 4
+    # current (un-rotated) pass has no frozen count yet
+    assert svc.pass_results(1)["n_done"] is None
+
+
+def test_requeue_unresulted_recomputes_orphaned_done_tasks(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    g1 = svc.get_task("A")
+    svc.task_finished(g1["task"]["task_id"], g1["epoch"], {"g": 1})
+    g2 = svc.get_task("A")
+    svc.task_finished(g2["task"]["task_id"], g2["epoch"])  # result lost
+    assert svc.requeue_unresulted() == 1
+    assert len(svc.done) == 1 and len(svc.todo) == 3
+
+
+# ---------------------------------------------------------------------------
+# the full surface over RPC (Server/Client process boundary)
+# ---------------------------------------------------------------------------
+
+def test_elastic_surface_over_rpc(tmp_path):
+    svc = _make_service(tmp_path, _FakeClock(), worker_timeout_s=60.0)
+    server = master_mod.Server(svc)
+    try:
+        c = master_mod.Client(tuple(server.address))
+        info = c.register_worker("w0")
+        assert info["auto_rotate"] is False
+        assert c.heartbeat("w0") is True
+        done = 0
+        while True:
+            got = c.get_task("w0")
+            if got is None:
+                break
+            payload = {
+                "grads": {"w": np.ones(3, np.float32)},
+                "cost": 1.0,
+                "rows": 10,
+            }
+            assert c.task_finished(
+                got["task"]["task_id"], got["epoch"], payload
+            )
+            done += 1
+        assert done == 4
+        st = c.fence_arrive("pass-0", "w0")
+        assert st["released"] and st["n_done"] == 4
+        results = c.pass_results(0)["results"]
+        assert len(results) == 4
+        np.testing.assert_array_equal(
+            results[0]["grads"]["w"], np.ones(3, np.float32)
+        )
+        assert c.stats()["fail_events"] == 0
+        assert c.start_new_pass(1) == 1
+        c.deregister_worker("w0")
+        c.close()
+    finally:
+        server.close()
+
+
+def test_elastic_worker_inprocess_trains_and_commits(tmp_path):
+    """Fast-tier end-to-end of the worker driver against an in-process
+    Service (no subprocesses, numpy model): passes reduce + apply, cost
+    decreases, and the sharded manifest commits with the pass position."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.trainer.elastic import ElasticWorker, NumpyLinearModel
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4).astype(np.float32)
+    recs = []
+    for _ in range(48):
+        x = rng.randn(4).astype(np.float32)
+        recs.append(
+            np.concatenate([x, [np.float32(x @ w_true)]])
+            .astype(np.float32).tobytes()
+        )
+    recordio.write_records(
+        str(tmp_path / "d.rio"), iter(recs), max_chunk_records=4
+    )
+    svc = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), chunks_per_task=2,
+        auto_rotate=False, snapshot_min_interval_s=0.0,
+    )
+    svc.set_dataset([str(tmp_path / "d.rio")])
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    worker = ElasticWorker(
+        master_mod.Client(svc), "w0", NumpyLinearModel(4, lr=0.2),
+        manager=mgr,
+    )
+    summary = worker.run(3)
+    assert summary["pass_costs"][-1] < summary["pass_costs"][0]
+    assert summary["tasks_done"] == 6 * 3
+    restored = CheckpointManager(str(tmp_path / "ck")).restore_latest(
+        NumpyLinearModel(4).state()
+    )
+    assert restored is not None
+    step, _, extra = restored
+    assert step == 3 and extra["pass_id"] == 2
+
+
+def test_elastic_worker_requires_fenced_master(tmp_path):
+    from paddle_tpu.trainer.elastic import ElasticWorker, NumpyLinearModel
+
+    _write(str(tmp_path / "d.rio"), 8, chunk=4)
+    svc = master_mod.Service(auto_rotate=True)  # free-running: refused
+    svc.set_dataset([str(tmp_path / "d.rio")])
+    worker = ElasticWorker(
+        master_mod.Client(svc), "w0", NumpyLinearModel(4)
+    )
+    with pytest.raises(RuntimeError, match="auto_rotate"):
+        worker.run(1)
+
+
+def test_reduce_results_is_order_canonical():
+    from paddle_tpu.trainer.elastic import reduce_results
+
+    a = {"grads": {"w": np.full(3, 1.0, np.float32)}, "cost": 2.0, "rows": 2}
+    b = {"grads": {"w": np.full(3, 4.0, np.float32)}, "cost": 4.0, "rows": 6}
+    m1, c1, r1 = reduce_results({0: a, 1: b})
+    m2, c2, r2 = reduce_results({1: b, 0: a})  # insertion order must not matter
+    np.testing.assert_array_equal(m1["w"], m2["w"])
+    assert c1 == c2 and r1 == r2 == 8
+    np.testing.assert_allclose(m1["w"], (1.0 * 2 + 4.0 * 6) / 8)
+
+
+def test_snapshot_roundtrip_with_owner_field(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    svc.register_worker("A")
+    svc.get_task("A")  # one pending lease with an owner
+    # recover from the snapshot: pending requeues immediately
+    svc2 = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, snapshot_min_interval_s=0.0,
+    )
+    assert len(svc2.todo) == 4 and not svc2.pending

@@ -38,6 +38,70 @@ def test_lease_stale_takeover(tmp_path):
     assert not a.renew()  # usurped: a must step down
 
 
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _lease(tmp_path, owner, clk, timeout=5.0):
+    return LeaseFile(
+        str(tmp_path), owner, lease_timeout=timeout,
+        clock=clk, sleep=lambda s: None,
+    )
+
+
+def test_lease_injected_clock_staleness_no_real_sleeps(tmp_path):
+    """is_stale/renew are judged entirely against the injected clock: the
+    whole expiry lifecycle runs without a single wall-clock sleep."""
+    clk = _FakeClock()
+    a = _lease(tmp_path, "a", clk)
+    assert a.try_acquire()
+    assert not a.is_stale()
+    clk.advance(4.9)
+    assert not a.is_stale()
+    assert a.renew()  # heartbeat re-stamps mtime from the same clock
+    clk.advance(4.9)
+    assert not a.is_stale()  # renewal actually moved the deadline
+    clk.advance(0.2)
+    assert a.is_stale()
+
+
+def test_lease_renew_fails_after_steal(tmp_path):
+    """The renew-after-steal race: a stalls past its lease timeout, b
+    claims the stale lease, and a's next renew MUST fail (it would
+    otherwise heartbeat b's lease and both sides would believe they
+    lead)."""
+    clk = _FakeClock()
+    a = _lease(tmp_path, "a", clk)
+    b = _lease(tmp_path, "b", clk)
+    assert a.try_acquire()
+    clk.advance(6.0)  # a stalls: the lease goes stale under it
+    assert b.try_acquire()
+    assert not a.renew()  # usurped — a steps down
+    assert b.renew()  # the new owner's heartbeat still works
+    assert b.held_by_me() and not a.held_by_me()
+
+
+def test_lease_claim_races_have_one_winner_fake_clock(tmp_path):
+    clk = _FakeClock()
+    a = _lease(tmp_path, "a", clk)
+    b = _lease(tmp_path, "b", clk)
+    assert a.try_acquire()
+    clk.advance(6.0)
+    # both see the lease stale and race; last-writer-wins leaves exactly
+    # one of them owning
+    ra, rb = a.try_acquire(), b.try_acquire()
+    assert (ra, rb) in ((True, False), (False, True))
+    winner = a if ra else b
+    assert winner.held_by_me()
+
+
 def test_leader_serves_and_publishes_endpoint(tmp_path):
     data = _write_data(tmp_path)
     ha = HAMaster(str(tmp_path / "ha"), [data], owner_id="m0",
