@@ -18,10 +18,14 @@
 #                  into the fast tier.
 #   make chaos   — the fault-injection drills: the single-process subset
 #                  (NaN-inject, torn checkpoint, subprocess kill -9 +
-#                  --resume) plus the elastic kill-one-of-N scenarios
+#                  --resume), the elastic kill-one-of-N scenarios
 #                  (tests/test_elastic_e2e.py: 4 worker processes, one
 #                  SIGKILLed mid-pass holding a shard lease — leases
-#                  requeue, params stay bit-for-bit).
+#                  requeue, params stay bit-for-bit), and the master-
+#                  failover drill (tests/test_master_failover_e2e.py:
+#                  kill -9 the LEADER mid-pass under a 4-worker fleet —
+#                  the standby takes over warm from the journal, zero
+#                  recomputed tasks, bit-for-bit params).
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
@@ -46,6 +50,7 @@ tier1-update:
 chaos:
 	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
 	$(CPU_ENV) $(PY) -m pytest tests/test_elastic_e2e.py -q
+	$(CPU_ENV) $(PY) -m pytest tests/test_master_failover_e2e.py -q
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

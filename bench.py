@@ -1684,6 +1684,141 @@ def bench_elastic_scaling() -> dict:
     }
 
 
+def bench_master_failover() -> dict:
+    import shutil
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="failover-bench-")
+    try:
+        return _bench_master_failover_in(base)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def _bench_master_failover_in(base: str) -> dict:
+    """Recovery-time-after-fault for the cluster plane (ROADMAP item 5's
+    first entry, the MULTICHIP_r07 record; metric vocabulary from the
+    Gemma serving comparison, arXiv:2605.25645): kill -9 the LEADER master
+    mid-pass under a live 4-worker fleet and measure the warm takeover.
+
+    The leader journals every transition (master_journal.py) and a hot
+    standby tails snapshot + journal into a live replica; the ``kill_
+    master`` chaos point SIGKILLs the leader inside ``task_finished``
+    BEFORE the transition executes.  Reported: takeover time from the
+    observed leader death to the standby serving (includes lease-staleness
+    detection — the honest recovery span), journal records replayed, and
+    recomputed tasks, which the bench ASSERTS to be zero: every task of
+    every pass is computed exactly once fleet-wide despite the bounce."""
+    import subprocess
+    import sys
+
+    from paddle_tpu.io import recordio
+    from paddle_tpu.master_ha import HAMaster, discover_endpoint
+
+    rng = np.random.RandomState(0)
+    dim, n_rec, passes, n_workers = 64, 2048, 2, 4
+    w_true = rng.randn(dim).astype(np.float32)
+    data = os.path.join(base, "data.rio")
+    recordio.write_records(
+        data,
+        (
+            np.concatenate(
+                [x := rng.randn(dim).astype(np.float32),
+                 [np.float32(np.tanh(x @ w_true))]]
+            ).astype(np.float32).tobytes()
+            for _ in range(n_rec)
+        ),
+        max_chunk_records=16,
+    )  # 128 chunks -> 16 tasks/pass at 8 chunks/task
+    tasks_per_pass = 16
+    hadir = os.path.join(base, "ha")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", OMP_NUM_THREADS="1",
+        OPENBLAS_NUM_THREADS="1", MKL_NUM_THREADS="1",
+    )
+    lease_timeout = 6.0  # wide: a loaded box must not pre-empt the drill
+    leader = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "master",
+         "--dir", hadir, "--patterns", data,
+         "--chunks-per-task", "8", "--timeout-s", "60",
+         "--worker-timeout-s", "15",
+         "--lease-timeout", str(lease_timeout),
+         "--chaos", "kill_master@10"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    standby = HAMaster(
+        hadir, [data], owner_id="bench-standby", chunks_per_task=8,
+        timeout_s=60.0, worker_timeout_s=15.0, auto_rotate=False,
+        lease_timeout=lease_timeout,
+    )
+    procs = []
+    try:
+        deadline = time.time() + 60
+        while discover_endpoint(hadir) is None:
+            assert leader.poll() is None, "leader master died on boot"
+            assert time.time() < deadline, "no leader endpoint"
+            time.sleep(0.05)
+        standby.start()
+        while standby._replica is None:  # warm takeover or bust
+            assert time.time() < deadline, "standby never built a replica"
+            time.sleep(0.05)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.trainer.elastic",
+                 "--dir", hadir, "--worker-id", f"w{i}",
+                 "--num-passes", str(passes), "--model", "numpy",
+                 "--model-arg", f"dim={dim}", "--model-arg", "lr=0.05",
+                 "--min-workers", str(n_workers),
+                 "--stats-out", os.path.join(base, f"stats{i}.json")],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            for i in range(n_workers)
+        ]
+        while leader.poll() is None:  # the chaos point fires mid-pass 0
+            assert time.time() < deadline, "kill_master chaos never fired"
+            time.sleep(0.005)
+        t_kill = time.time()
+        rcs = [p.wait(timeout=300) for p in procs]
+        assert all(rc == 0 for rc in rcs), f"worker rcs {rcs}"
+        assert standby.is_leader.is_set(), "standby never took over"
+        takeover = dict(standby.last_takeover)
+        master_stats = standby.service.stats()
+    finally:
+        standby.stop()
+        if leader.poll() is None:
+            leader.kill()
+        leader.wait()
+    stats = []
+    for i in range(n_workers):
+        with open(os.path.join(base, f"stats{i}.json")) as f:
+            stats.append(json.load(f))
+    total_acks = sum(s["tasks_done"] for s in stats)
+    recomputed = total_acks - tasks_per_pass * passes
+    assert recomputed == 0, (
+        f"{recomputed} task(s) recomputed across the failover"
+    )
+    assert master_stats["fail_events"] == 0
+    recovery_s = takeover["t_leader"] - t_kill
+    return {
+        "metric": "master_failover_recovery_ms",
+        "value": round(recovery_s * 1000.0, 1),
+        "unit": "ms kill-9-to-serving (lease detection + campaign + journal "
+        "replay; warm standby, cpu container)",
+        "takeover_replay_s": round(takeover["takeover_s"], 4),
+        "replayed_records": takeover["replayed_records"],
+        "recomputed_tasks": recomputed,
+        "warm": takeover["warm"],
+        "lease_timeout_s": lease_timeout,
+        "n_workers": n_workers,
+        "tasks_per_pass": tasks_per_pass,
+        "passes": passes,
+        "fail_events": master_stats["fail_events"],
+        "backend": "cpu-multiprocess",
+        "vs_baseline": None,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Regression guard — diff every metric against the best committed prior
 # round (the reference keeps its whole perf table as one versioned artifact,
@@ -1771,7 +1906,7 @@ def main() -> None:
     results = []
     for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,
-               bench_elastic_scaling,
+               bench_elastic_scaling, bench_master_failover,
                bench_transformer,
                bench_transformer_long_context, bench_transformer_xl_context,
                bench_lstm_textcls,

@@ -17,6 +17,7 @@ argparse also accepts ``--name value``.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import re
 import os
@@ -684,6 +685,21 @@ def cmd_master(argv: List[str]) -> int:
     ap.add_argument("--failure-max", type=int, default=3)
     ap.add_argument("--lease-timeout", type=float, default=5.0,
                     help="leader-election lease timeout (master_ha)")
+    ap.add_argument("--no-journal", action="store_true",
+                    help="legacy debounced-snapshot persistence instead of "
+                    "the fsync'd journal (standbys then take over cold)")
+    ap.add_argument("--journal-compact-every", type=int, default=512,
+                    help="journal records between snapshot compactions")
+    ap.add_argument("--no-journal-fsync", action="store_true",
+                    help="skip the per-record fsync (drills/benches only: "
+                    "a kill -9 may then lose acked records)")
+    ap.add_argument("--stats-out", default=None,
+                    help="append one JSON line here each time THIS "
+                    "candidate assumes leadership (warm/cold, replayed "
+                    "records, takeover span) — the failover drill reads it")
+    ap.add_argument("--chaos", default=None,
+                    help="arm chaos points in THIS candidate, e.g. "
+                    "'kill_master@8' (env PADDLE_TPU_CHAOS also works)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.master_ha import HAMaster
@@ -691,6 +707,10 @@ def cmd_master(argv: List[str]) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    if args.chaos:
+        from paddle_tpu.robustness import chaos as _chaos
+
+        _chaos.arm(args.chaos)
     ha = HAMaster(
         args.dir,
         [p for p in args.patterns.split(",") if p],
@@ -700,6 +720,9 @@ def cmd_master(argv: List[str]) -> int:
         worker_timeout_s=args.worker_timeout_s,
         failure_max=args.failure_max,
         auto_rotate=False,  # elastic workers fence their pass boundaries
+        journal=not args.no_journal,
+        journal_fsync=not args.no_journal_fsync,
+        journal_compact_every=args.journal_compact_every,
     )
     stop = {"flag": False}
 
@@ -712,12 +735,27 @@ def cmd_master(argv: List[str]) -> int:
     _echo(f"master candidate {ha.owner_id} campaigning in {args.dir}")
     announced = False
     while not stop["flag"]:
+        if ha.fatal is not None:
+            _echo(f"FATAL {ha.fatal}")
+            ha.stop()
+            return 1
         # snapshot the server ref: the HA thread nulls it on step-down
         # between the leader check and the address read
         srv = ha.server
         if ha.is_leader.is_set() and srv is not None and not announced:
             host, port = srv.address
             _echo(f"LEADER {host}:{port}")
+            if args.stats_out and ha.last_takeover is not None:
+                try:
+                    with open(args.stats_out, "a") as f:
+                        f.write(json.dumps(
+                            {"owner": ha.owner_id, **ha.last_takeover}
+                        ) + "\n")
+                except OSError as exc:
+                    # the stats line is advisory: an unwritable path must
+                    # not crash the just-elected leader (every candidate
+                    # shares the flag, so it would crash-loop the cluster)
+                    _echo(f"stats-out {args.stats_out} unwritable: {exc}")
             announced = True
         elif not ha.is_leader.is_set():
             announced = False
@@ -732,7 +770,10 @@ def cmd_lint(argv: List[str]) -> int:
     * no --config: AST self-lint over the paddle_tpu package source
       (+ any --extra files), rules A###;
     * --config=conf.py: parse the v1 config and graph-lint its topology
-      (rules G###) with layer + config provenance.
+      (rules G###) with layer + config provenance;
+    * --journal=master_journal-000001.log: verify a master journal file —
+      framing/CRC (J001), unknown record types (J002, the version-skew
+      hard error), sequence monotonicity (J003), torn tail (J004).
 
     Exit 0 only when no diagnostics fire (``make lint``'s contract)."""
     ap = argparse.ArgumentParser(
@@ -747,6 +788,9 @@ def cmd_lint(argv: List[str]) -> int:
                     help="comma-separated key=value pairs for the config(s)")
     ap.add_argument("--extra", action="append", default=[],
                     help="extra .py files to self-lint (e.g. bench.py)")
+    ap.add_argument("--journal", action="append", default=[],
+                    help="master journal file to verify (repeatable; "
+                    "rules J###; skips the self-lint)")
     ap.add_argument("--min-severity", default=None,
                     choices=["info", "warning", "error"],
                     help="only report findings at or above this severity")
@@ -755,6 +799,17 @@ def cmd_lint(argv: List[str]) -> int:
     from paddle_tpu import analysis
 
     diags = []
+    if args.journal:
+        from paddle_tpu import master_journal as _mj
+
+        for jpath in args.journal:
+            for f in _mj.verify_journal(jpath):
+                diags.append(analysis.Diagnostic(
+                    rule=f["rule"],
+                    severity=analysis.Severity[f["severity"].upper()],
+                    message=f["message"],
+                    source=jpath,
+                ))
     if args.config:
         from paddle_tpu.v1_compat import parse_config
 
@@ -775,7 +830,7 @@ def cmd_lint(argv: List[str]) -> int:
                 )
                 continue
             diags.extend(analysis.lint_parsed(parsed))
-    else:
+    if not args.config and not args.journal:
         diags = analysis.lint_package(extra_paths=args.extra)
 
     if args.min_severity:

@@ -19,6 +19,16 @@ Pieces:
                      python/paddle/v2/master/client.py; works against an
                      in-process Service or a remote Server address.
 
+Durable state plane (``journal=True`` — the mode master_ha runs): every
+queue/registry/fence transition appends one CRC-framed, fsync'd record to
+an append-only journal (master_journal.py) BEFORE the RPC that caused it is
+acknowledged, and the JSON snapshot becomes the journal's periodic
+compaction target.  Recovery (or a hot standby tailing the file) replays
+``snapshot + journal`` to the exact pre-crash state — task leases stay
+warm, per-task result payloads survive, and a failover mid-pass completes
+the pass with ZERO recomputed tasks (the etcd-journaled design of
+go/master/etcd_client.go, minus etcd).
+
 Elastic cluster plane (the scale-out completion of the Go master's
 fault-tolerance model, arXiv:1605.08695 §4.4):
   * worker registry — ``register_worker``/``heartbeat`` leases, pruned by
@@ -37,18 +47,27 @@ fault-tolerance model, arXiv:1605.08695 §4.4):
 from __future__ import annotations
 
 import dataclasses
+import errno as _errno
 import glob as _glob
 import json
+import logging
 import os
+import socket as _socket
+import struct as _struct
 import threading
 import time
 from multiprocessing.connection import Client as _ConnClient, Listener
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from paddle_tpu import master_journal as _mj
 from paddle_tpu.io import recordio
+from paddle_tpu.robustness import chaos as _chaos
+
+_log = logging.getLogger("paddle_tpu.master")
 
 __all__ = [
     "Service", "Server", "Client", "MasterRPCError", "MasterTransportError",
+    "MasterTimeoutError",
 ]
 
 
@@ -64,6 +83,17 @@ class MasterTransportError(ConnectionError):
     have executed.  Subclasses ConnectionError so HA wrappers (master_ha.
     HAClient) treat it as 'leader gone, re-discover', never as an
     application error."""
+
+
+class MasterTimeoutError(MasterTransportError):
+    """The per-call DEADLINE elapsed with no reply — the socket may be
+    half-open (a master that bounced without an RST, a frozen leader) and
+    the call may or may not have executed.  Distinct from the generic
+    transport error so callers can observe stuck-vs-dead; still a
+    MasterTransportError/ConnectionError subclass so every HA
+    reconnect-and-rediscover path treats it as 'leader gone' (the whole
+    master surface is idempotent-or-epoch-guarded, so the at-least-once
+    retry that follows is absorbed server-side)."""
 
 
 @dataclasses.dataclass
@@ -105,12 +135,24 @@ class Service:
         snapshot_min_interval_s: float = 1.0,
         clock=time.time,
         worker_timeout_s: float = 10.0,
+        journal: bool = False,
+        journal_fsync: bool = True,
+        journal_compact_every: int = 512,
     ):
         """auto_rotate=True mirrors the reference: the moment a pass drains,
         done tasks recycle into todo and other trainers stream straight into
         the next pass (pass-end is a per-client observation, service.go:404).
         auto_rotate=False holds the pass boundary until start_new_pass() —
-        the synchronized-pass mode a sync-SGD trainer wants."""
+        the synchronized-pass mode a sync-SGD trainer wants.
+
+        ``journal=True`` turns the snapshot file into a journaled state
+        plane: transitions append fsync'd records to master_journal files
+        next to ``snapshot_path``, the snapshot is rewritten only at
+        compaction (every ``journal_compact_every`` records, at
+        set_dataset, and at promotion), and recovery replays snapshot +
+        journal — keeping task leases, results, registry and fences warm
+        across a master death.  ``journal=False`` keeps the legacy
+        debounced-snapshot behavior byte-for-byte."""
         self._lock = threading.RLock()
         self._clock = clock  # injectable for deterministic lease tests
         self.chunks_per_task = chunks_per_task
@@ -139,8 +181,23 @@ class Service:
         self._pass_done: Dict[int, int] = {}  # pass -> done count at rotation
         # fence id -> {"arrived": set, "released": None | frozen info dict}
         self.fences: Dict[str, Dict[str, Any]] = {}
+        # -- durable journal plane (master_journal.py) ---------------------
+        self._journaled = bool(journal)
+        self._journal_fsync = bool(journal_fsync)
+        self.journal_compact_every = int(journal_compact_every)
+        self._journal_writer: Optional[_mj.JournalWriter] = None
+        self._journal_gen = 0
+        self._seq = 0  # last assigned/applied journal sequence number
+        self._records_since_compact = 0
+        self.replayed_records = 0  # how many journal records recovery applied
         if snapshot_path and os.path.exists(snapshot_path):
             self._recover()
+        if self._journaled and self.snapshot_path:
+            # own the plane: start a fresh generation so (a) the next
+            # failover's replay is bounded by THIS leadership's appends and
+            # (b) a deposed predecessor's stragglers land in a file no
+            # snapshot references
+            self._compact(reclaim_orphan=True)
 
     # -- dataset ---------------------------------------------------------
     def set_dataset(self, patterns: Sequence[str]) -> int:
@@ -158,7 +215,10 @@ class Service:
             for i in range(0, len(chunks), self.chunks_per_task):
                 tasks.append(Task(len(tasks), chunks[i : i + self.chunks_per_task]))
             self.todo = tasks
-            self._snapshot(force=True)
+            if self._journaled:
+                self._compact()  # structural change: re-anchor the plane
+            else:
+                self._snapshot(force=True)
             return len(tasks)
 
     def n_tasks(self) -> int:
@@ -181,7 +241,31 @@ class Service:
                 # a polling worker is alive by definition: auto-(re)register
                 # even if the prune just expired it (prune targets SILENT
                 # workers — hung or dead — which never reach this line)
-                self.workers[worker_id] = self._clock() + self.worker_timeout_s
+                self._touch_worker(worker_id)
+                # at-least-once lease delivery: if THIS worker already holds
+                # a pending lease, re-serve it instead of granting another.
+                # The healthy flow never hits this (workers ack before the
+                # next get_task); it exists for the reply-lost case — the
+                # old leader journaled the lease and died before answering,
+                # so the standby's replica holds a warm lease the worker
+                # never heard about.  Re-serving (with a fresh deadline)
+                # completes the delivery; letting it strand would cost a
+                # full task-lease timeout + a recompute.
+                held = sorted(
+                    tid for tid, ent in self.pending.items()
+                    if ent[2] == worker_id
+                )
+                if held:
+                    task = self.pending[held[0]][0]
+                    self.pending[task.task_id] = (
+                        task, self._clock() + self.timeout_s, worker_id
+                    )
+                    return {
+                        "task": task.to_json(),
+                        "epoch": task.epoch,
+                        "timeout_s": self.timeout_s,
+                        "pass_id": self.pass_id,
+                    }
             if not self.todo and not self.pending and self.done:
                 if not self.auto_rotate:
                     return None  # hold the barrier until start_new_pass()
@@ -193,6 +277,13 @@ class Service:
             self.pending[task.task_id] = (
                 task, self._clock() + self.timeout_s, worker_id
             )
+            # the lease grant is journaled so a failover keeps it WARM: the
+            # new leader serves the in-flight worker's eventual ack instead
+            # of re-serving (= recomputing) the task
+            self._journal({
+                "t": "lease", "task": task.task_id, "epoch": task.epoch,
+                "worker": worker_id,
+            })
             self._snapshot()
             return {
                 "task": task.to_json(),
@@ -206,6 +297,14 @@ class Service:
 
     def _rotate_pass(self) -> None:
         """Recycle done → todo; epochs reset so past failures don't carry."""
+        from_pass = self.pass_id
+        self._rotate_pass_state()
+        self._journal({"t": "rotate", "from": from_pass})
+        self._snapshot(force=True)
+
+    def _rotate_pass_state(self) -> None:
+        """The pure state transition of a pass rotation — shared by the
+        live path and journal replay (``apply_record``)."""
         # freeze the completed pass's done count: late joiners use it to
         # verify a retained result map is COMPLETE before replay-applying it
         self._pass_done[self.pass_id] = len(self.done)
@@ -220,7 +319,6 @@ class Service:
             del self.results[p]
         for p in [p for p in self._pass_done if p < self.pass_id - 2]:
             del self._pass_done[p]
-        self._snapshot(force=True)
 
     def start_new_pass(self, target_pass: Optional[int] = None) -> int:
         """Explicit pass barrier release (auto_rotate=False mode).
@@ -252,7 +350,8 @@ class Service:
             return True
 
     def task_finished(
-        self, task_id: int, epoch: Optional[int] = None, result: Any = None
+        self, task_id: int, epoch: Optional[int] = None, result: Any = None,
+        pass_id: Optional[int] = None,
     ) -> bool:
         """epoch (when given) guards against a stale holder acking a task
         that expired and was re-served at a higher epoch — same discipline
@@ -261,17 +360,78 @@ class Service:
         ``result`` (elastic workers): the task's reduction payload — e.g. a
         gradient-contribution tree — stored under the current pass for
         ``pass_results``.  A rejected (zombie) ack never stores its result,
-        so the surviving re-computation's bits win."""
+        so the surviving re-computation's bits win.
+
+        ``pass_id`` (elastic workers, from the lease's ``get_task`` reply)
+        closes the guard rotation re-opens: epochs reset to 0 at every
+        rotation, so (task, epoch) alone cannot tell a pass-N ack from a
+        pass-N+1 task — a sufficiently delayed retry could land a stale
+        contribution in the wrong pass.  A pass-tagged ack for any pass
+        but the current one is rejected outright.
+
+        IDEMPOTENT under client retries: a worker whose first ack landed
+        but whose reply was lost (master bounce mid-call, per-call deadline
+        fired) re-sends the same ``(task, epoch)`` — the duplicate is
+        accepted-and-deduped against ``done``, never double-counted.  And
+        a pass-tagged ack whose lease record died with a legacy
+        (journal-less) master is accepted straight from ``todo`` at the
+        matching epoch, so even a cold failover loses no landed
+        computation (pass-LESS acks — the legacy streaming client — never
+        claim from todo: their task simply re-serves, the flow's normal
+        at-least-once story)."""
+        if _chaos.fire("kill_master"):
+            # the leader-death drill: die BEFORE executing the transition,
+            # mid-pass — the worker's retry must land on the standby
+            _chaos.kill_self()
         with self._lock:
+            if pass_id is not None and pass_id != self.pass_id:
+                return False  # cross-pass zombie: that pass already closed
             ent = self.pending.get(task_id)
-            if ent is None or (epoch is not None and ent[0].epoch != epoch):
+            if ent is not None and (epoch is None or ent[0].epoch == epoch):
+                del self.pending[task_id]
+                self.done.append(ent[0])
+                self._record_finish(task_id, ent[0].epoch, result)
+                return True
+            if epoch is None:
                 return False
-            del self.pending[task_id]
-            self.done.append(ent[0])
-            if result is not None:
-                self.results.setdefault(self.pass_id, {})[task_id] = result
-            self._snapshot()
-            return True
+            # duplicate re-ack after a client retry: already done at this
+            # epoch — accept and dedupe (store the result only if the first
+            # delivery didn't; contributions are deterministic, so either
+            # copy carries the same bits)
+            for t in self.done:
+                if t.task_id == task_id and t.epoch == epoch:
+                    cur = self.results.get(self.pass_id, {})
+                    if result is not None and task_id not in cur:
+                        self._record_finish(task_id, epoch, result)
+                    return True
+            # post-failover ack: the lease evaporated with the old master
+            # (legacy snapshot recovery requeues pending) but the worker's
+            # computation is done — accept it from todo at the matching
+            # epoch instead of forcing a recompute.  Pass-tagged acks only:
+            # rotation resets epochs, so an untagged ack could claim a
+            # LATER pass's copy of the task (the guard above already
+            # rejected tagged acks for a closed pass)
+            if pass_id is None:
+                return False
+            for i, t in enumerate(self.todo):
+                if t.task_id == task_id and t.epoch == epoch:
+                    self.todo.pop(i)
+                    self.done.append(t)
+                    self._record_finish(task_id, epoch, result)
+                    return True
+            return False
+
+    def _record_finish(self, task_id: int, epoch: int, result) -> None:
+        """One acked completion: retain the result payload for the current
+        pass, journal the transition, publish.  Caller holds the lock and
+        has already moved the task into ``done``."""
+        if result is not None:
+            self.results.setdefault(self.pass_id, {})[task_id] = result
+        self._journal({
+            "t": "finish", "task": task_id, "epoch": epoch,
+            "pass": self.pass_id, "result": result,
+        })
+        self._snapshot()
 
     def task_failed(self, task_id: int, epoch: int) -> bool:
         """(reference service.go:442 TaskFailed → processFailedTask:308)"""
@@ -281,6 +441,7 @@ class Service:
                 return False
             del self.pending[task_id]
             self._process_failed(ent[0])
+            self._journal({"t": "fail", "task": task_id, "epoch": epoch})
             self._snapshot()
             return True
 
@@ -295,6 +456,7 @@ class Service:
                 return False
             del self.pending[task_id]
             self.todo.append(ent[0])
+            self._journal({"t": "ret", "task": task_id, "epoch": epoch})
             self._snapshot()
             return True
 
@@ -312,9 +474,21 @@ class Service:
         expired = [tid for tid, ent in self.pending.items() if ent[1] < now]
         for tid in expired:
             task = self.pending.pop(tid)[0]
-            self._process_failed(task)
+            epoch = task.epoch  # _process_failed bumps it; journal the
+            self._process_failed(task)  # epoch the replayed pop must match
+            self._journal({"t": "fail", "task": tid, "epoch": epoch})
 
     # -- elastic cluster plane: registry / fences / results ---------------
+    def _touch_worker(self, worker_id: str) -> None:
+        """(Re)grant the worker's registry lease; callers hold the lock.
+        Journal AFTER the insert: _journal may compact, and the snapshot it
+        publishes must already contain the transition (the record's seq
+        folds below the snapshot's base)."""
+        is_new = worker_id not in self.workers
+        self.workers[worker_id] = self._clock() + self.worker_timeout_s
+        if is_new:
+            self._journal({"t": "join", "worker": worker_id})
+
     def register_worker(self, worker_id: str) -> Dict[str, Any]:
         """Join (or rejoin) the worker registry under a heartbeat lease.
         Returns the cluster view the worker needs to enter the pass loop —
@@ -323,7 +497,7 @@ class Service:
         runtime state) just re-registers."""
         with self._lock:
             self._prune_workers()
-            self.workers[worker_id] = self._clock() + self.worker_timeout_s
+            self._touch_worker(worker_id)
             return {
                 "pass_id": self.pass_id,
                 "timeout_s": self.worker_timeout_s,
@@ -346,12 +520,15 @@ class Service:
         failure event (the task_returned discipline — leaving is not a
         crash)."""
         with self._lock:
-            self.workers.pop(worker_id, None)
+            if self.workers.pop(worker_id, None) is not None:
+                self._journal({"t": "leave", "worker": worker_id})
             held = [
                 tid for tid, ent in self.pending.items() if ent[2] == worker_id
             ]
             for tid in held:
-                self.todo.append(self.pending.pop(tid)[0])
+                task = self.pending.pop(tid)[0]
+                self.todo.append(task)
+                self._journal({"t": "ret", "task": tid, "epoch": task.epoch})
             if held:
                 self._snapshot()
 
@@ -368,9 +545,13 @@ class Service:
         dead = [w for w, dl in self.workers.items() if dl < now]
         for w in dead:
             del self.workers[w]
+            self._journal({"t": "leave", "worker": w, "pruned": True})
             held = [tid for tid, ent in self.pending.items() if ent[2] == w]
             for tid in held:
-                self._process_failed(self.pending.pop(tid)[0])
+                task = self.pending.pop(tid)[0]
+                epoch = task.epoch
+                self._process_failed(task)
+                self._journal({"t": "fail", "task": tid, "epoch": epoch})
             if held:
                 self._snapshot()
 
@@ -393,10 +574,30 @@ class Service:
             f = self.fences.setdefault(
                 fence_id, {"arrived": set(), "released": None, "meta": {}}
             )
-            if f["released"] is None:
+            if f["released"] is None and worker_id not in f["arrived"]:
+                # journal FIRST arrivals only: fence polling re-arrives at
+                # worker heartbeat cadence and must not flood the journal
                 f["arrived"].add(worker_id)
                 if meta:
                     f["meta"][worker_id] = dict(meta)
+                self._journal({
+                    "t": "farrive", "fence": fence_id, "worker": worker_id,
+                    "meta": dict(meta) if meta else None,
+                })
+            elif f["released"] is None and meta:
+                changed = f["meta"].get(worker_id) != dict(meta)
+                f["meta"][worker_id] = dict(meta)
+                if changed:
+                    # a CHANGED meta on re-arrival is durable state too —
+                    # the frozen writers roster derives from it, so a warm
+                    # standby must see the update (re-journaling farrive is
+                    # replay-idempotent: set-add + meta overwrite).  The
+                    # unchanged re-arrivals of fence polling still skip the
+                    # journal, keeping the no-flood property
+                    self._journal({
+                        "t": "farrive", "fence": fence_id,
+                        "worker": worker_id, "meta": dict(meta),
+                    })
             if worker_id in self.workers:
                 # arriving (and re-arriving while polling) is a liveness
                 # signal: renew so a worker parked at a slow barrier is
@@ -437,6 +638,13 @@ class Service:
                     "n_done": len(self.done),
                     "pass_id": self.pass_id,
                 }
+                # the frozen membership view is durable state: a standby
+                # taking over mid-barrier must release the SAME view, not
+                # re-evaluate membership it never observed
+                self._journal({
+                    "t": "frelease", "fence": fence_id,
+                    "view": dict(f["released"]),
+                })
         if f["released"] is None:
             return {
                 "known": True, "released": False,
@@ -472,6 +680,9 @@ class Service:
             if orphaned:
                 self.done = [t for t in self.done if t.task_id in have]
                 self.todo.extend(orphaned)
+                self._journal({
+                    "t": "unres", "tasks": [t.task_id for t in orphaned],
+                })
                 self._snapshot()
             return len(orphaned)
 
@@ -499,23 +710,30 @@ class Service:
             self._save_holder = (trainer_id, now + block_secs)
             return True
 
-    # -- snapshot / recover (reference service.go:165-273, etcd → file) --
+    # -- snapshot / journal / recover (service.go:165-273, etcd → file) --
     def fence(self) -> None:
         """Stop this (deposed) Service from ever writing the shared snapshot
-        again and cancel any pending debounced flush — a new leader owns the
-        file now (the etcd design gets this for free from leases on keys)."""
+        OR appending to the shared journal again, and cancel any pending
+        debounced flush — a new leader owns the files now (the etcd design
+        gets this for free from leases on keys)."""
         with self._lock:
             self.snapshot_path = None
+            if self._journal_writer is not None:
+                self._journal_writer.close()
+                self._journal_writer = None
             if self._flush_timer is not None:
                 self._flush_timer.cancel()
                 self._flush_timer = None
 
     def _snapshot(self, force: bool = False) -> None:
-        """Debounced: per-task transitions at most one write per
-        snapshot_min_interval_s; a skipped write is flushed by a timer so the
-        last transition of a burst always reaches disk.  Structural changes
-        (set_dataset, pass rotation) always write."""
-        if not self.snapshot_path:
+        """Legacy (journal-less) persistence — debounced: per-task
+        transitions at most one write per snapshot_min_interval_s; a skipped
+        write is flushed by a timer so the last transition of a burst always
+        reaches disk.  Structural changes (set_dataset, pass rotation)
+        always write.  In journaled mode this is a no-op: the fsync'd
+        journal append IS the per-transition durability point, and the
+        snapshot is rewritten only at compaction."""
+        if not self.snapshot_path or self._journaled:
             return
         now = time.time()
         if not force and now - self._last_snapshot < self.snapshot_min_interval_s:
@@ -536,8 +754,189 @@ class Service:
             self._last_snapshot = time.time()
             self._write_snapshot()
 
-    def _write_snapshot(self) -> None:
-        state = {
+    def _journal(self, rec: Dict[str, Any]) -> None:
+        """Append one fsync'd record; compact when the generation has grown
+        past ``journal_compact_every`` records.  No-op unless journaled
+        (and not fenced).  Caller holds the lock."""
+        if not self._journaled or self._journal_writer is None:
+            return
+        self._seq += 1
+        self._journal_writer.append(self._seq, rec)
+        self._records_since_compact += 1
+        if self._records_since_compact >= self.journal_compact_every:
+            self._compact()
+
+    def _compact(self, reclaim_orphan: bool = False) -> None:
+        """Fold the journal into the snapshot and start a new generation.
+
+        Crash-ordering: (1) write + fsync the NEW journal generation with
+        the retained per-pass results re-emitted into it (seq > the
+        snapshot's base, so replay re-applies them — the snapshot itself
+        stays pure JSON and never carries binary payloads); (2) atomically
+        publish the snapshot referencing the new generation; (3) delete
+        older generations.  A crash before (2) leaves the old snapshot +
+        old journal fully consistent (the new file is an unreferenced
+        orphan); a crash before (3) leaves a stale-but-unreferenced old
+        generation that the next compaction sweeps.
+
+        Fencing: compaction REWRITES the shared plane (truncates into a
+        generation file, replaces the snapshot, sweeps the rest), so a
+        deposed-but-not-yet-fenced leader running it would corrupt the new
+        leader's live state — the append-side fence ("stragglers land in
+        an unreferenced file") does not cover it.  Two guards: the
+        published snapshot is the ownership record (referencing a
+        generation we did not write means someone else owns the plane →
+        fence, return), and the new generation is created EXCLUSIVELY (a
+        mid-life collision means a racing new leader → fence).  Only a
+        caller that just acquired the HA lease (boot recovery, promote)
+        may pass ``reclaim_orphan=True`` to take over a predecessor's
+        crash orphan — a compaction that died before publishing."""
+        if not self._journaled or not self.snapshot_path:
+            return
+        d = os.path.dirname(self.snapshot_path) or "."
+        # ownership precheck parses the snapshot every time: compaction is
+        # already O(dataset) (result re-emission + full snapshot rewrite),
+        # and a stat-compare shortcut could miss a new leader's publish
+        # (coarse mtime + equal size + recycled inode) — fencing must not
+        # ride on that
+        try:
+            with open(self.snapshot_path) as f:
+                published = json.load(f).get("journal_file")
+        except (OSError, ValueError):
+            published = None  # fresh cluster: no snapshot yet
+        if published is not None and published != _mj.journal_filename(
+            self._journal_gen
+        ):
+            if not reclaim_orphan:
+                self.fence()  # deposed: a new leader published its gen
+                return
+            # we hold the FRESH lease (boot/promote): an unexpected
+            # publisher is a deposed zombie's last-gasp compaction in the
+            # lease-gap window — the RIGHTFUL leader must not cede the
+            # plane (fencing here would leave it serving with snapshot and
+            # journal silently OFF).  Adopt the published generation as
+            # the base and re-anchor above it; the zombie's stragglers are
+            # swept with its file
+            _log.warning(
+                "compaction: snapshot references %s, not our generation "
+                "%s — reclaiming the plane over a deposed leader's "
+                "last-gasp publish (we hold the fresh lease)",
+                published, _mj.journal_filename(self._journal_gen),
+            )
+            self._journal_gen = _mj.parse_generation(published)
+        base_seq = self._seq
+        gen_at_entry = self._journal_gen
+        self._journal_gen += 1
+        fname = _mj.journal_filename(self._journal_gen)
+        jpath = os.path.join(d, fname)
+        writer = None
+        try:
+            try:
+                writer = _mj.JournalWriter(
+                    jpath, fsync=self._journal_fsync, exclusive=True
+                )
+            except FileExistsError:
+                if not reclaim_orphan:
+                    self._journal_gen = gen_at_entry  # honest while fenced
+                    self.fence()  # a racing new leader created it: deposed
+                    return
+                # a predecessor's unpublished file sits on our target name:
+                # a crash orphan — or a zombie's compaction STILL IN FLIGHT.
+                # Removing and recreating the name would defeat the O_EXCL
+                # fence the zombie's own publish path relies on, so NEVER
+                # reuse a contested name: skip above it (the post-publish
+                # sweep collects the leftovers)
+                while writer is None:
+                    self._journal_gen += 1
+                    fname = _mj.journal_filename(self._journal_gen)
+                    jpath = os.path.join(d, fname)
+                    try:
+                        writer = _mj.JournalWriter(
+                            jpath, fsync=self._journal_fsync, exclusive=True
+                        )
+                    except FileExistsError:
+                        continue
+            for p in sorted(self.results):
+                for tid in sorted(self.results[p]):
+                    self._seq += 1
+                    writer.append(self._seq, {
+                        "t": "finish", "task": tid, "pass": p,
+                        "result": self.results[p][tid],
+                    }, sync=False)
+            writer.sync()  # one fsync covers the whole re-emission
+            # last-moment ownership re-verify: if we stalled past the lease
+            # DURING this compaction (e.g. a slow fsync), a new leader may
+            # have re-anchored the plane — and since reclaim skips
+            # contested names, our O_EXCL create cannot catch that case.
+            # Publishing now would replace the rightful leader's snapshot
+            # with stale state, so the snapshot must still reference what
+            # it referenced when we prechecked ownership.
+            try:
+                with open(self.snapshot_path) as f:
+                    published_now = json.load(f).get("journal_file")
+            except (OSError, ValueError):
+                published_now = None
+            if published_now != published:
+                writer.close()
+                try:
+                    os.remove(jpath)
+                except OSError:
+                    pass
+                self.fence()  # deposed mid-compaction
+                return
+            self._write_snapshot(seq=base_seq, journal_file=fname)
+        except OSError as exc:
+            # transient disk failure (ENOSPC, EIO) mid-compaction.  Roll
+            # the generation back so the ownership precheck keeps matching
+            # the published snapshot — a dangling bump would make the NEXT
+            # attempt self-fence this healthy leader, after which every
+            # acked transition would silently skip the journal.  With a
+            # live old writer we keep appending durably to the old
+            # generation and retry after another journal_compact_every
+            # records; at boot/promote there is no old writer to fall back
+            # to (durability would be OFF), so the failure must propagate.
+            if writer is not None:
+                writer.close()
+                try:
+                    os.remove(jpath)  # else the retry would hit O_EXCL
+                except OSError:
+                    pass
+            self._journal_gen = gen_at_entry
+            self._records_since_compact = 0
+            if self._journal_writer is None:
+                raise
+            _log.warning(
+                "journal compaction into %s failed (%s: %s) — keeping the "
+                "current generation, will retry", fname,
+                type(exc).__name__, exc,
+            )
+            return
+        old_writer, self._journal_writer = self._journal_writer, writer
+        if old_writer is not None:
+            old_writer.close()
+        # Sweep ONLY generations strictly below our own.  An "everything
+        # but fname" sweep re-opens the fencing hole the publish path just
+        # closed: a zombie stalled between its publish and its sweep can
+        # wake to find a new leader re-anchored ABOVE it (reclaim adopts
+        # the published generation as its base), and deleting higher-
+        # numbered files would unlink the live generation the current
+        # snapshot references — every transition acked after that would be
+        # invisible to recovery.  Generations are monotonic, so "< ours"
+        # only ever collects our own predecessors and crash orphans we
+        # skipped; a zombie's higher-numbered orphan survives until a
+        # later sweep passes above it.
+        for stale in _glob.glob(os.path.join(d, "master_journal-*.log")):
+            if _mj.parse_generation(stale) < self._journal_gen:
+                try:
+                    os.remove(stale)
+                except OSError:
+                    pass
+        self._records_since_compact = 0
+
+    def state_dict(self) -> Dict[str, Any]:
+        """The JSON-able snapshot of everything but result payloads (those
+        live in the journal).  Caller holds the lock."""
+        return {
             "pass_id": self.pass_id,
             "todo": [t.to_json() for t in self.todo],
             "pending": [
@@ -546,21 +945,264 @@ class Service:
             ],
             "done": [t.to_json() for t in self.done],
             "discarded": [t.to_json() for t in self.discarded],
+            "fail_events": self.fail_events,
+            "workers": sorted(self.workers),
+            "pass_done": {str(p): n for p, n in self._pass_done.items()},
+            "fences": {
+                fid: {
+                    "arrived": sorted(f["arrived"]),
+                    "meta": f["meta"],
+                    "released": f["released"],
+                }
+                for fid, f in self.fences.items()
+            },
         }
+
+    def _write_snapshot(
+        self, seq: Optional[int] = None, journal_file: Optional[str] = None
+    ) -> None:
+        state = self.state_dict()
+        if self._journaled:
+            state["version"] = 2
+            state["seq"] = self._seq if seq is None else seq
+            state["journal_file"] = journal_file
         tmp = self.snapshot_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(state, f)
+            if self._journaled:
+                # compaction publish: the snapshot must be durable before
+                # the old generation is swept.  Legacy mode stays the
+                # best-effort debounced write it always was.
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, self.snapshot_path)
+
+    def load_state(self, state: Dict[str, Any], warm: bool = True) -> None:
+        """Restore from a v2 (journaled) snapshot dict.  ``warm=True``
+        keeps OWNED pending leases pending with FRESH deadlines (the
+        failover path: the owners are probably alive and mid-compute, and
+        their retried acks / re-served get_tasks key on the owner id); the
+        owners that aren't expire into the normal failure path.  An
+        OWNERLESS lease (a legacy streaming client's) requeues immediately
+        even when warm: its holder has no identity to re-serve to, so
+        keeping it warm would just stall the pass for a full task timeout —
+        and the holder's eventual ack still lands via the matching-epoch
+        accept-from-todo branch of ``task_finished``."""
+        with self._lock:
+            now = self._clock()
+            self.pass_id = state["pass_id"]
+            self.todo = [Task.from_json(t) for t in state["todo"]]
+            self.done = [Task.from_json(t) for t in state["done"]]
+            self.discarded = [
+                Task.from_json(t) for t in state.get("discarded", [])
+            ]
+            self.pending = {}
+            for ent in state["pending"]:
+                task = Task.from_json(ent["task"])
+                owner = ent.get("owner")
+                if warm and owner is not None:
+                    self.pending[task.task_id] = (
+                        task, now + self.timeout_s, owner
+                    )
+                else:
+                    self.todo.append(task)
+            self.fail_events = int(state.get("fail_events", 0))
+            self.workers = {
+                w: now + self.worker_timeout_s
+                for w in state.get("workers", [])
+            }
+            self._pass_done = {
+                int(p): n for p, n in state.get("pass_done", {}).items()
+            }
+            self.fences = {
+                fid: {
+                    "arrived": set(f.get("arrived", ())),
+                    "meta": dict(f.get("meta", {})),
+                    "released": f.get("released"),
+                }
+                for fid, f in state.get("fences", {}).items()
+            }
+            self.results = {}
+            self._seq = int(state.get("seq", 0))
+
+    def apply_record(self, seq: int, rec: Dict[str, Any]) -> bool:
+        """Replay one journal record onto this state (recovery, and the hot
+        standby's tail loop).  Sequence-guarded: a double-delivered record
+        (re-read tail, compaction re-emission already applied) is a no-op,
+        so replay is idempotent.  Unknown record types are a HARD error —
+        a version-skewed or corrupt record must never silently vanish from
+        a recovery."""
+        with self._lock:
+            if seq <= self._seq:
+                return False
+            t = rec.get("t")
+            if t not in _mj.RECORD_TYPES:
+                raise _mj.JournalError(
+                    f"unknown journal record type {t!r} at seq {seq} — "
+                    f"refusing to recover past it (version skew or "
+                    f"corruption; run `paddle-tpu lint --journal`)"
+                )
+            getattr(self, f"_apply_{t}")(rec)
+            self._seq = seq
+            self.replayed_records += 1
+            return True
+
+    # -- per-record replay ops (pure state; never journal, never prune) --
+    def _pop_todo(self, task_id: int, epoch: Optional[int]) -> Optional[Task]:
+        for i, task in enumerate(self.todo):
+            if task.task_id == task_id and (
+                epoch is None or task.epoch == epoch
+            ):
+                return self.todo.pop(i)
+        return None
+
+    def _apply_lease(self, rec) -> None:
+        task = self._pop_todo(rec["task"], rec.get("epoch"))
+        if task is not None:
+            self.pending[task.task_id] = (
+                task, self._clock() + self.timeout_s, rec.get("worker")
+            )
+
+    def _apply_finish(self, rec) -> None:
+        p, tid, epoch = rec["pass"], rec["task"], rec.get("epoch")
+        if rec.get("result") is not None:
+            self.results.setdefault(p, {})[tid] = rec["result"]
+        if p != self.pass_id:
+            return  # compaction re-emission for a retained earlier pass
+        ent = self.pending.get(tid)
+        if ent is not None and (epoch is None or ent[0].epoch == epoch):
+            del self.pending[tid]
+            self.done.append(ent[0])
+            return
+        task = self._pop_todo(tid, epoch)
+        if task is not None:
+            self.done.append(task)
+        # else: already done (double delivery across generations) — dedupe
+
+    def _apply_fail(self, rec) -> None:
+        tid, epoch = rec["task"], rec["epoch"]
+        ent = self.pending.get(tid)
+        if ent is not None and ent[0].epoch == epoch:
+            del self.pending[tid]
+            self._process_failed(ent[0])
+            return
+        task = self._pop_todo(tid, epoch)
+        if task is not None:
+            self._process_failed(task)
+
+    def _apply_ret(self, rec) -> None:
+        ent = self.pending.get(rec["task"])
+        if ent is not None and ent[0].epoch == rec["epoch"]:
+            del self.pending[rec["task"]]
+            self.todo.append(ent[0])
+
+    def _apply_rotate(self, rec) -> None:
+        if self.pass_id != rec["from"]:
+            _log.warning(
+                "journal replay: rotate record for pass %d but replica is "
+                "at pass %d — skipping (divergence heals via "
+                "requeue_unresulted)", rec["from"], self.pass_id,
+            )
+            return
+        self._rotate_pass_state()
+
+    def _apply_unres(self, rec) -> None:
+        ids = set(rec["tasks"])
+        moved = [t for t in self.done if t.task_id in ids]
+        self.done = [t for t in self.done if t.task_id not in ids]
+        self.todo.extend(moved)
+        for t in moved:
+            self.results.get(self.pass_id, {}).pop(t.task_id, None)
+
+    def _apply_join(self, rec) -> None:
+        self.workers[rec["worker"]] = self._clock() + self.worker_timeout_s
+
+    def _apply_leave(self, rec) -> None:
+        self.workers.pop(rec["worker"], None)
+
+    def _apply_farrive(self, rec) -> None:
+        f = self.fences.setdefault(
+            rec["fence"], {"arrived": set(), "released": None, "meta": {}}
+        )
+        if f["released"] is None:
+            f["arrived"].add(rec["worker"])
+            if rec.get("meta"):
+                f["meta"][rec["worker"]] = dict(rec["meta"])
+        if len(self.fences) > 64:  # mirror the live bound
+            for stale in list(self.fences)[: len(self.fences) - 64]:
+                if stale != rec["fence"]:
+                    del self.fences[stale]
+
+    def _apply_frelease(self, rec) -> None:
+        f = self.fences.setdefault(
+            rec["fence"], {"arrived": set(), "released": None, "meta": {}}
+        )
+        f["released"] = dict(rec["view"])
+        f["arrived"].update(rec["view"].get("workers", ()))
+
+    def promote(
+        self,
+        snapshot_path: str,
+        journal_fsync: Optional[bool] = None,
+        journal_compact_every: Optional[int] = None,
+    ) -> None:
+        """Turn a replayed standby replica into THE serving, journaling
+        leader: refresh every lease deadline (standby deadlines are stale
+        by construction — the owners get a full fresh window before the
+        prune/expiry discipline judges them), then compact into a fresh
+        journal generation this instance owns."""
+        with self._lock:
+            now = self._clock()
+            self.snapshot_path = snapshot_path
+            self._journaled = True
+            if journal_fsync is not None:
+                self._journal_fsync = bool(journal_fsync)
+            if journal_compact_every is not None:
+                self.journal_compact_every = int(journal_compact_every)
+            pending, self.pending = self.pending, {}
+            for tid, (task, _dl, owner) in pending.items():
+                if owner is not None:
+                    self.pending[tid] = (task, now + self.timeout_s, owner)
+                else:
+                    # replayed ownerless lease (legacy streaming client):
+                    # same requeue-now rationale as load_state — no
+                    # identity to re-serve to, the epoch-matched ack from
+                    # todo still lands
+                    self.todo.append(task)
+            for w in list(self.workers):
+                self.workers[w] = now + self.worker_timeout_s
+            self._compact(reclaim_orphan=True)  # we hold the fresh lease
 
     def _recover(self) -> None:
         with open(self.snapshot_path) as f:
             state = json.load(f)
+        if state.get("journal_file") is not None:
+            # journaled-shape recovery: warm state + bounded journal replay
+            self.load_state(state, warm=True)
+            d = os.path.dirname(self.snapshot_path) or "."
+            self._journal_gen = _mj.parse_generation(state["journal_file"])
+            jpath = os.path.join(d, state["journal_file"])
+            if os.path.exists(jpath):
+                records, info = _mj.read_records(jpath)
+                if info["corrupt"]:
+                    # the prefix is consistent; anything past the rot is
+                    # healed by lease expiry + requeue_unresulted recompute
+                    _log.warning(
+                        "journal %s: %s — recovered the good prefix "
+                        "(%d records)", jpath, info["error"], len(records),
+                    )
+                for seq, rec in records:
+                    self.apply_record(seq, rec)
+            return
+        # legacy snapshot (journal-less master, or an upgrade boot)
         self.pass_id = state["pass_id"]
         self.todo = [Task.from_json(t) for t in state["todo"]]
         self.done = [Task.from_json(t) for t in state["done"]]
         self.discarded = [Task.from_json(t) for t in state.get("discarded", [])]
-        # pending leases do not survive a master restart: requeue immediately
-        # (the reference instead waits for timeout; restart is the slow path)
+        # pending leases do not survive a legacy master restart: requeue
+        # immediately (the reference instead waits for timeout; restart is
+        # the slow path).  A landed-but-unleased computation still counts:
+        # task_finished accepts a matching-epoch ack straight from todo.
         for ent in state["pending"]:
             self.todo.append(Task.from_json(ent["task"]))
 
@@ -582,6 +1224,95 @@ def reader_over(next_record_fn):
 # ---------------------------------------------------------------------------
 # RPC layer
 # ---------------------------------------------------------------------------
+
+def _dial_with_deadline(address, authkey: bytes, timeout: Optional[float]):
+    """Connect + authenticate with a hard deadline.
+
+    The TCP connect itself fails fast against a dead port (RST), but the
+    multiprocessing auth handshake can block FOREVER against a half-open
+    peer — a listener that accepted into its backlog and then froze (the
+    exact state a bouncing master leaves behind).  The stock _ConnClient
+    has no timeout hook, so the dial runs in a watchdog'd helper thread:
+    on deadline the caller raises :class:`MasterTimeoutError` and the
+    helper, when (if) it finally returns, closes the abandoned connection
+    itself.  A timed-out dial parks one daemon thread on the dead socket —
+    bounded by the caller's retry budget, and freed when the peer's TCP
+    stack gives up."""
+    if timeout is None:
+        return _ConnClient(tuple(address), authkey=authkey)
+    box: Dict[str, Any] = {}
+    done = threading.Event()
+    abandoned = threading.Event()
+    lock = threading.Lock()  # serializes the store-vs-abandon handoff
+
+    def _dial():
+        try:
+            conn = _ConnClient(tuple(address), authkey=authkey)
+            with lock:
+                if abandoned.is_set():
+                    conn.close()
+                else:
+                    box["conn"] = conn
+        except Exception as exc:  # noqa: BLE001 — re-raised by the caller
+            box["err"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_dial, daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        # the helper may complete the dial concurrently with this timeout:
+        # under the lock, exactly one side owns (and closes) the conn
+        with lock:
+            abandoned.set()
+            conn = box.pop("conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        raise MasterTimeoutError(
+            f"master dial {tuple(address)}: no auth handshake in {timeout}s "
+            f"(half-open listener?)"
+        )
+    if "err" in box:
+        raise box["err"]
+    _set_io_timeouts(box["conn"], timeout)
+    return box["conn"]
+
+
+def _set_io_timeouts(conn, timeout: float) -> None:
+    """Arm SO_RCVTIMEO + SO_SNDTIMEO on the connection's socket.
+    ``poll(deadline)`` bounds the wait for the FIRST byte of a reply, but
+    Connection.recv() then blocks until the complete message arrives, and
+    Connection.send() blocks whenever the peer stops draining its socket
+    (a multi-MB pickled gradient tree vs a SIGSTOP'd leader fills the
+    kernel buffer) — either way a frozen peer would hang the client past
+    every deadline.  With i/o timeouts on the shared file description, a
+    stalled read/write raises BlockingIOError, which ``_call`` translates
+    into :class:`MasterTimeoutError`.  Best-effort: where the socket op
+    is unavailable the poll() deadline still covers the no-reply case."""
+    if os.name != "posix":
+        # the raw struct-timeval pack below is POSIX layout; Windows
+        # reads SO_RCVTIMEO as a DWORD of MILLISECONDS and would misread
+        # tv_sec as ms, arming absurdly short timeouts — skip, keeping
+        # the poll() deadline coverage
+        return
+    try:
+        s = _socket.socket(fileno=os.dup(conn.fileno()))
+    except OSError:
+        return
+    try:
+        tv_sec = int(timeout)
+        tv_usec = int((timeout - tv_sec) * 1_000_000)
+        tv = _struct.pack("ll", tv_sec, tv_usec)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVTIMEO, tv)
+        s.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDTIMEO, tv)
+    except OSError:
+        pass
+    finally:
+        s.close()
+
 
 _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
             "task_returned", "renew_lease", "request_save_model", "n_tasks",
@@ -611,8 +1342,43 @@ class Server:
         while not self._stop:
             try:
                 conn = self._listener.accept()
-            except OSError:
+            except OSError as exc:
+                if self._stop:
+                    return  # the listener itself closed (Server.close)
+                if isinstance(exc, ConnectionError):
+                    # ConnectionResetError / BrokenPipeError from the auth
+                    # handshake: ONE client hung up (RST mid-challenge) —
+                    # per-client, same discipline as the clause below
+                    continue
+                if exc.errno in (
+                    _errno.EMFILE, _errno.ENFILE,
+                    _errno.ECONNABORTED, _errno.EINTR,
+                ):
+                    # transient: fd exhaustion under a dial storm (every
+                    # timed-out client dial parks a socket) or an aborted
+                    # connect.  The LISTENER is fine — bailing out here
+                    # would leave the port bound-but-dead with clients
+                    # queueing in the backlog until their dial deadlines
+                    time.sleep(0.05)
+                    continue
+                # the listening socket itself is broken: close it so
+                # clients get RST (fail fast into their retry loops)
+                # instead of queueing in a dead backlog
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
                 return
+            except Exception:  # noqa: BLE001 — per-CLIENT handshake failure
+                # A dialer that hung up mid-auth (its deadline fired and it
+                # abandoned the socket — routine during a master bounce) or
+                # presented a bad authkey surfaces here as EOFError /
+                # AuthenticationError.  One client's failed handshake must
+                # never kill the accept loop: the server would keep the
+                # port bound (looking alive) while serving NOBODY — the
+                # exact half-open state the client-side dial deadline
+                # exists to escape.  Drop the connection, keep accepting.
+                continue
             with self._conns_lock:
                 self._conns.append(conn)
             if self._stop:  # closed while accepting: don't serve it
@@ -688,7 +1454,15 @@ class Client:
         trainer_id: str = "0",
         reconnect_tries: int = 5,
         reconnect_backoff: float = 0.1,
+        call_timeout_s: Optional[float] = 60.0,
     ):
+        """``call_timeout_s`` is the per-RPC deadline (dial + reply): a
+        call against a half-open socket — a master that bounced without an
+        RST, a frozen leader — surfaces as :class:`MasterTimeoutError`
+        instead of blocking forever.  ``None`` disables the deadline."""
+        self.call_timeout_s = (
+            None if call_timeout_s is None else float(call_timeout_s)
+        )
         if isinstance(master, Service):
             self._service = master
             self._conn = None
@@ -696,7 +1470,9 @@ class Client:
             self._service = None
             self._address = tuple(master)
             self._authkey = authkey
-            self._conn = _ConnClient(self._address, authkey=authkey)
+            self._conn = _dial_with_deadline(
+                self._address, authkey, self.call_timeout_s
+            )
             self._conn_lock = threading.Lock()
         self.reconnect_tries = max(int(reconnect_tries), 1)
         self.reconnect_backoff = float(reconnect_backoff)
@@ -707,6 +1483,18 @@ class Client:
         self.lease_renew_secs = 10.0  # renewal throttle ceiling
         self._renew_interval = self.lease_renew_secs
 
+    def _timeout(self, msg: str) -> "MasterTimeoutError":
+        """Tear down the (half-open) connection and build the deadline
+        error for the caller to raise: a frozen peer stays frozen, so the
+        socket is dead either way."""
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+        return MasterTimeoutError(msg)
+
     def _call(self, method: str, *args):
         """One RPC.  Transient TRANSPORT failures (connection reset / EOF on
         the pipe — a master restarting, a dropped socket) get a short
@@ -715,7 +1503,15 @@ class Client:
         (every master method is idempotent-or-epoch-guarded, so an
         at-least-once duplicate is absorbed server-side).  Application
         errors surface as :class:`MasterRPCError` immediately — the master
-        EXECUTED the call; retrying a deterministic failure is futile."""
+        EXECUTED the call; retrying a deterministic failure is futile.
+
+        Every remote call carries a DEADLINE (``call_timeout_s``): if the
+        reply doesn't arrive in time — a half-open socket after a master
+        bounce, a frozen leader — the connection is dropped and
+        :class:`MasterTimeoutError` raises immediately (no in-client
+        retry: a frozen peer stays frozen; the HA layer re-discovers the
+        leader instead).  The abandoned call may still execute
+        server-side, which the idempotent surface absorbs on retry."""
         if self._service is not None:
             return getattr(self._service, method)(*args)
         last_err: Optional[Exception] = None
@@ -723,12 +1519,40 @@ class Client:
             for attempt in range(self.reconnect_tries):
                 try:
                     if self._conn is None:
-                        self._conn = _ConnClient(
-                            self._address, authkey=self._authkey
+                        self._conn = _dial_with_deadline(
+                            self._address, self._authkey, self.call_timeout_s
                         )
-                    self._conn.send((method, args))
-                    ok, result = self._conn.recv()
+                    try:
+                        self._conn.send((method, args))
+                    except BlockingIOError as exc:
+                        # SO_SNDTIMEO fired: the peer stopped draining its
+                        # socket mid-request (frozen master, full buffer)
+                        raise self._timeout(
+                            f"master RPC {method}: request stalled "
+                            f"mid-send (frozen master)"
+                        ) from exc
+                    if self.call_timeout_s is not None and not self._conn.poll(
+                        self.call_timeout_s
+                    ):
+                        raise self._timeout(
+                            f"master RPC {method}: no reply in "
+                            f"{self.call_timeout_s}s (half-open socket or "
+                            f"frozen master); the call may have executed"
+                        )
+                    try:
+                        ok, result = self._conn.recv()
+                    except BlockingIOError as exc:
+                        # SO_RCVTIMEO fired mid-message: the peer froze
+                        # after sending a PARTIAL reply — past poll()'s
+                        # first-byte deadline, so surface the same way
+                        raise self._timeout(
+                            f"master RPC {method}: reply stalled "
+                            f"mid-message (frozen master); the call may "
+                            f"have executed"
+                        ) from exc
                     break
+                except MasterTimeoutError:
+                    raise
                 except (ConnectionError, EOFError, OSError) as exc:
                     last_err = exc
                     if self._conn is not None:

@@ -23,6 +23,12 @@ Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
                        its 2nd task for PADDLE_TPU_CHAOS_HANG_SECS (default
                        20s): registry + shard leases expire underneath it
                        and it must rejoin as a late worker
+    kill_master@8      SIGKILL the leader master as the 8th task_finished
+                       ack reaches it, BEFORE the transition executes —
+                       mid-pass, with journaled state on disk: the standby
+                       must take over warm (bounded journal replay, zero
+                       recomputed tasks) and absorb the worker's retried
+                       ack (arm on the leader candidate's environment)
 
 ``@occurrence`` counts *consultations* of that point (1-based); omitting it
 means "every time".  Each armed point fires at most once per occurrence —
@@ -60,7 +66,7 @@ _ENV = "PADDLE_TPU_CHAOS"
 # drill never silently tests nothing
 KNOWN_POINTS = frozenset(
     {"nan_batch", "torn_checkpoint", "kill", "stale_lease",
-     "kill_worker", "worker_hang"}
+     "kill_worker", "worker_hang", "kill_master"}
 )
 
 # point -> occurrence to fire at (None = every consultation)

@@ -150,6 +150,7 @@ class ElasticWorker:
         heartbeat_interval: Optional[float] = None,
         poll_s: float = 0.02,
         min_workers: int = 1,
+        rpc_retry_window_s: float = 60.0,
         clock=time.time,
         sleep=time.sleep,
     ):
@@ -159,6 +160,12 @@ class ElasticWorker:
         self.manager = manager
         self.resume = resume
         self.poll_s = poll_s
+        # bounded ride-through for a master bounce: transport/timeout
+        # failures on the cluster-plane RPCs retry with backoff inside this
+        # window (every master method is idempotent-or-epoch-guarded, so
+        # the at-least-once re-send is absorbed server-side); past it the
+        # worker exits nonzero for its supervisor
+        self.rpc_retry_window_s = float(rpc_retry_window_s)
         # gang-start hint: hold the first lease until this many workers
         # have registered, so a fast-booting worker doesn't race through
         # whole (small) passes alone while its peers are still starting —
@@ -181,6 +188,39 @@ class ElasticWorker:
         self.busy_s = 0.0
         self.t_work0: Optional[float] = None
         self.t_work1: Optional[float] = None
+
+    # -- master RPC with bounded ride-through -----------------------------
+    def _rpc(self, method: str, *args):
+        """One cluster-plane call, retried through a master bounce.
+
+        The client layer already absorbs brief blips (master.Client's
+        reconnect-retry, master_ha.HAClient's re-discover loop); what
+        surfaces here — MasterTransportError / MasterTimeoutError /
+        HAClient's discovery TimeoutError — means the master stayed gone
+        for the client's whole window.  A failover can legitimately take
+        longer (lease timeout + campaign + replay), so retry with backoff
+        until ``rpc_retry_window_s`` elapses, then give up: a supervisor
+        restart + startup recovery is the heal path past that.
+
+        The window is checked BETWEEN attempts — a single in-flight call
+        blocks for at most the client's own per-call deadline, so wire
+        the client's ``call_timeout_s``/discovery timeout to fractions of
+        the window (as ``main()`` does) to keep the total overshoot
+        bounded."""
+        deadline = self._clock() + self.rpc_retry_window_s
+        delay = 0.2
+        while True:
+            try:
+                return getattr(self.client, method)(*args)
+            except (ConnectionError, TimeoutError) as exc:
+                if self._clock() >= deadline:
+                    raise
+                _log.warning(
+                    "worker %s: master RPC %s failed (%r); retrying "
+                    "through the bounce", self.worker_id, method, exc,
+                )
+                self._sleep(min(delay, 2.0))
+                delay *= 2
 
     # -- registry ---------------------------------------------------------
     def _hb_loop(self, interval: float) -> None:
@@ -212,10 +252,10 @@ class ElasticWorker:
         meta declares whether this worker checkpoints, so the released
         view's ``writers`` roster covers exactly the shard writers."""
         meta = {"ckpt": self.manager is not None}
-        view = self.client.fence_arrive(fence_id, self.worker_id, meta)
+        view = self._rpc("fence_arrive", fence_id, self.worker_id, meta)
         while not view.get("released"):
             self._sleep(self.poll_s)
-            view = self.client.fence_arrive(fence_id, self.worker_id, meta)
+            view = self._rpc("fence_arrive", fence_id, self.worker_id, meta)
         return view
 
     # -- checkpoints ------------------------------------------------------
@@ -255,7 +295,7 @@ class ElasticWorker:
         exact parameter state the fleet computed without re-leasing any
         task.  Refuses loudly when the retained map is incomplete: applying
         a partial reduction would silently fork the trajectory."""
-        pr = self.client.pass_results(pass_id)
+        pr = self._rpc("pass_results", pass_id)
         results, n_done = pr["results"], pr["n_done"]
         if not results or n_done is None or len(results) != n_done:
             raise RuntimeError(
@@ -304,7 +344,7 @@ class ElasticWorker:
         fleet fenced and rotated in the gap between our registration and
         our first lease) — the caller must catch up before computing."""
         while True:
-            got = self.client.get_task(self.worker_id)
+            got = self._rpc("get_task", self.worker_id)
             if got is None:
                 return None  # pass drained: the master holds the barrier
             if got == "wait":  # remaining leases held by other workers
@@ -317,7 +357,7 @@ class ElasticWorker:
                 # our params lag the fleet (it fenced and rotated between
                 # our registration and this lease): hand the task back
                 # untouched — no failure event — and replay the gap first
-                self.client.task_returned(tid, epoch)
+                self._rpc("task_returned", tid, epoch)
                 return master_pass
             if _chaos.fire("kill_worker"):
                 # die HOLDING the shard lease — the kill-one-of-N drill
@@ -331,7 +371,7 @@ class ElasticWorker:
             try:
                 records = _read_task_records(task)
             except IOError:
-                self.client.task_failed(tid, epoch)
+                self._rpc("task_failed", tid, epoch)
                 continue
             t0 = self._clock()
             grads, cost_sum, rows = self.model.task_grad(
@@ -341,7 +381,9 @@ class ElasticWorker:
             payload = {
                 "grads": grads, "cost": float(cost_sum), "rows": int(rows)
             }
-            if self.client.task_finished(tid, epoch, payload):
+            # the ack carries the lease's pass tag: a retry delayed past a
+            # rotation is rejected instead of landing in the wrong pass
+            if self._rpc("task_finished", tid, epoch, payload, pass_id):
                 self.tasks_done += 1
             else:
                 # zombie ack: the lease expired (we hung) and the task was
@@ -349,7 +391,7 @@ class ElasticWorker:
                 self.rejected_acks += 1
 
     def run(self, num_passes: int) -> Dict[str, Any]:
-        info = self.client.register_worker(self.worker_id)
+        info = self._rpc("register_worker", self.worker_id)
         if info.get("auto_rotate"):
             raise RuntimeError(
                 "elastic training needs a master with auto_rotate=False: "
@@ -363,7 +405,7 @@ class ElasticWorker:
             # with no heartbeat thread wired
             while len(info.get("workers", ())) < self.min_workers:
                 self._sleep(max(self.poll_s, 0.05))
-                info = self.client.register_worker(self.worker_id)
+                info = self._rpc("register_worker", self.worker_id)
             return self._run(num_passes, info)
         finally:
             self._stop.set()
@@ -405,7 +447,7 @@ class ElasticWorker:
                     # would refill todo for a pass nobody asked for
                     current = completed + 1
                 else:
-                    current = self.client.start_new_pass(completed + 1)
+                    current = self._rpc("start_new_pass", completed + 1)
             if current == completed:
                 raise RuntimeError(
                     f"master cannot rotate past pass {completed} (queue "
@@ -421,7 +463,7 @@ class ElasticWorker:
         # in-memory result payloads died with it: requeue done-but-
         # unresulted tasks so this pass's contributions are recomputed
         # (deterministic, so recomputation cannot move the trajectory)
-        requeued = self.client.requeue_unresulted()
+        requeued = self._rpc("requeue_unresulted")
         if requeued:
             _log.warning(
                 "worker %s: recomputing %d task contributions lost with a "
@@ -435,7 +477,7 @@ class ElasticWorker:
                 # drained — but a pruned-then-rejoined worker (hang) may
                 # have slept through whole passes without ever seeing a
                 # skewed lease; one stats probe per pass catches that
-                actual = int(self.client.stats()["pass_id"])
+                actual = int(self._rpc("stats")["pass_id"])
                 if actual > pass_id:
                     behind = actual
             if behind is not None:
@@ -447,7 +489,7 @@ class ElasticWorker:
                 self.manager.wait()  # join the async shard write pre-fence
             view = self._fence(f"pass-{pass_id}")
             self._commit_pending()
-            results = self.client.pass_results(pass_id)["results"]
+            results = self._rpc("pass_results", pass_id)["results"]
             if len(results) != int(view.get("n_done", len(results))):
                 # correctness-first: applying a partial reduction would
                 # silently fork the trajectory.  The heal path is a worker
@@ -467,7 +509,7 @@ class ElasticWorker:
             self.pass_costs.append(mean_cost)
             self._write_shard(pass_id, view.get("writers", []))
             if pass_id + 1 < num_passes:
-                self.client.start_new_pass(pass_id + 1)
+                self._rpc("start_new_pass", pass_id + 1)
             pass_id += 1
         if self.manager is not None:
             self.manager.wait()
@@ -706,7 +748,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="hold the first lease until this many workers "
                     "registered (gang-start hint; membership stays elastic "
                     "afterwards)")
-    ap.add_argument("--client-timeout", type=float, default=60.0)
+    ap.add_argument("--client-timeout", type=float, default=None,
+                    help="leader-discovery timeout; default derives from "
+                    "--rpc-retry-window-s, an explicit value is used as-is")
+    ap.add_argument("--rpc-retry-window-s", type=float, default=60.0,
+                    help="ride through a master bounce for this long "
+                    "before exiting nonzero for the supervisor")
     ap.add_argument("--chaos", default=None,
                     help="arm chaos points in THIS worker, e.g. "
                     "'kill_worker@2' (env PADDLE_TPU_CHAOS also works)")
@@ -734,15 +781,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     model = _build_model(
         args.model, _parse_model_args(args.model_arg), args.seed
     )
+    # the retry window is only checked BETWEEN calls, so one blocked call
+    # must not be able to eat the whole window: cap the per-call deadline
+    # and the leader re-discovery timeout at fractions of it.  An explicit
+    # --client-timeout is the operator's call and is used as-is.
+    window = args.rpc_retry_window_s
+    client_kw = dict(
+        timeout=(args.client_timeout if args.client_timeout is not None
+                 else max(window / 2.0, 5.0)),
+        call_timeout_s=max(min(15.0, window / 4.0), 2.0),
+    )
     worker = ElasticWorker(
-        HAClient(args.dir, timeout=args.client_timeout),
+        HAClient(args.dir, **client_kw),
         worker_id,
         model,
         manager=manager,
         resume=args.resume,
-        heartbeat_client=HAClient(args.dir, timeout=args.client_timeout),
+        heartbeat_client=HAClient(args.dir, **client_kw),
         poll_s=args.poll_s,
         min_workers=args.min_workers,
+        rpc_retry_window_s=window,
     )
     summary = worker.run(args.num_passes)
     if args.stats_out:

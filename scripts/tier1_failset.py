@@ -53,6 +53,7 @@ _LINE = re.compile(r"^(FAILED|ERROR)\s+(.+)$")
 # spawn fleets of python processes); enforced by --slow-guard in CI
 SLOW_ONLY_FILES = [
     "tests/test_elastic_e2e.py",
+    "tests/test_master_failover_e2e.py",
 ]
 
 

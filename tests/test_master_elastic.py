@@ -429,3 +429,179 @@ def test_snapshot_roundtrip_with_owner_field(tmp_path):
         chunks_per_task=2, auto_rotate=False, snapshot_min_interval_s=0.0,
     )
     assert len(svc2.todo) == 4 and not svc2.pending
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 satellites: idempotent task_finished re-acks, at-least-once lease
+# re-serve, and per-RPC deadlines against half-open/frozen masters
+# ---------------------------------------------------------------------------
+
+def test_duplicate_task_finished_reack_is_deduped(tmp_path):
+    """A worker retrying across a master bounce re-sends the same (task,
+    epoch[, result]): accepted-and-deduped, never double-counted — the
+    regression the zombie-epoch tests above don't cover (same epoch, same
+    owner, duplicate delivery)."""
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    got = svc.get_task("A")
+    tid, epoch = got["task"]["task_id"], got["epoch"]
+    payload = {"g": np.arange(3, dtype=np.float32), "rows": 7}
+    assert svc.task_finished(tid, epoch, payload)
+    n_done = len(svc.done)
+    # the retry (reply lost mid-bounce) and even a third delivery
+    assert svc.task_finished(tid, epoch, payload)
+    assert svc.task_finished(tid, epoch, payload)
+    assert len(svc.done) == n_done  # not double-counted
+    res = svc.pass_results(0)["results"]
+    assert list(res) == [tid]
+    np.testing.assert_array_equal(res[tid]["g"], payload["g"])
+    # epoch-less legacy duplicates still report failure (no guard to dedupe
+    # against — the legacy client never retries across bounces)
+    assert svc.task_finished(tid) is False
+
+
+def test_duplicate_reack_without_result_keeps_first_payload(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    got = svc.get_task("A")
+    tid, epoch = got["task"]["task_id"], got["epoch"]
+    assert svc.task_finished(tid, epoch, {"g": "first"})
+    assert svc.task_finished(tid, epoch, None)  # bare retry
+    assert svc.pass_results(0)["results"][tid] == {"g": "first"}
+
+
+def test_get_task_reserves_held_lease_to_owner(tmp_path):
+    """At-least-once lease delivery: the old leader journaled the grant and
+    died before replying, so the worker retries get_task against a master
+    whose replica already holds its warm lease — it must get the SAME task
+    back (fresh deadline), not a second one."""
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, timeout_s=10.0)
+    got = svc.get_task("A")
+    tid, epoch = got["task"]["task_id"], got["epoch"]
+    clk.advance(6.0)  # more than half the lease burned waiting
+    again = svc.get_task("A")
+    assert again["task"]["task_id"] == tid and again["epoch"] == epoch
+    assert len(svc.pending) == 1
+    # and the deadline was refreshed: the original grant would now be 4s
+    # from expiry, the re-serve gives the full window again
+    assert svc.pending[tid][1] == clk() + 10.0
+    # a DIFFERENT worker still gets a different task
+    other = svc.get_task("B")
+    assert other["task"]["task_id"] != tid
+
+
+def test_client_call_deadline_fires_on_frozen_master(tmp_path):
+    """A frozen leader (GC pause, dead NFS) that accepted the connection
+    but never replies: the per-call deadline surfaces MasterTimeoutError
+    instead of blocking the worker forever."""
+    import time as _time
+
+    class _Frozen(master_mod.Service):
+        def stats(self):
+            _time.sleep(5.0)
+            return super().stats()
+
+    srv = master_mod.Server(_Frozen())
+    try:
+        c = master_mod.Client(
+            srv.address, call_timeout_s=0.3, reconnect_tries=1
+        )
+        t0 = _time.time()
+        with pytest.raises(master_mod.MasterTimeoutError):
+            c.stats()
+        assert _time.time() - t0 < 3.0  # the deadline, not the freeze
+        # timeout is a ConnectionError subclass: HA wrappers re-discover
+        assert issubclass(
+            master_mod.MasterTimeoutError, master_mod.MasterTransportError
+        )
+        assert issubclass(master_mod.MasterTimeoutError, ConnectionError)
+    finally:
+        srv.close()
+
+
+def test_dial_deadline_against_half_open_listener():
+    """A listener that accepts into its backlog and never completes the
+    auth handshake — the exact socket state a bouncing master leaves
+    behind.  The stock multiprocessing dial blocks FOREVER here; ours
+    raises MasterTimeoutError at the deadline."""
+    import socket
+    import time as _time
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    s.listen(1)  # backlog accepts the TCP connect; nobody ever serves it
+    try:
+        t0 = _time.time()
+        with pytest.raises(master_mod.MasterTimeoutError):
+            master_mod.Client(
+                s.getsockname(), call_timeout_s=0.3, reconnect_tries=1
+            )
+        assert _time.time() - t0 < 3.0
+    finally:
+        s.close()
+
+
+def test_accept_loop_survives_client_rst_mid_handshake():
+    """The server side of the bounce: a dialer that hangs up HARD (RST)
+    during the auth handshake — exactly what an abandoned dial-deadline
+    socket produces — surfaces in the accept loop as ConnectionResetError,
+    an OSError.  It must cost that one connection, never the loop: a dead
+    accept loop keeps the port bound (looking alive) while serving nobody,
+    the one half-open state no client-side deadline can heal."""
+    import socket
+    import struct
+    import time as _time
+
+    srv = master_mod.Server(master_mod.Service())
+    try:
+        for _ in range(3):
+            s = socket.socket()
+            s.connect(srv.address)
+            # SO_LINGER(on, 0): close() sends RST, not FIN
+            s.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+            )
+            s.close()
+            _time.sleep(0.05)  # let the accept loop chew the dead socket
+        c = master_mod.Client(
+            srv.address, call_timeout_s=5.0, reconnect_tries=1
+        )
+        assert "n_todo" in c.stats()  # the server still serves
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_elastic_worker_rpc_retry_rides_through_bounce(tmp_path):
+    """ElasticWorker's bounded reconnect: a client that throws transport
+    errors for a while (the master bounce window) then heals — the worker
+    retries inside rpc_retry_window_s instead of dying; past the window it
+    surfaces the error for its supervisor."""
+    from paddle_tpu.trainer.elastic import ElasticWorker, NumpyLinearModel
+
+    clk = _FakeClock()
+
+    class _Bouncy:
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.calls = 0
+
+        def stats(self):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                raise master_mod.MasterTransportError("bounce")
+            return {"pass_id": 3}
+
+    w = ElasticWorker(
+        _Bouncy(3), "w0", NumpyLinearModel(4),
+        rpc_retry_window_s=60.0, clock=clk, sleep=lambda s: clk.advance(s),
+    )
+    assert w._rpc("stats") == {"pass_id": 3}  # rode through 3 failures
+
+    w2 = ElasticWorker(
+        _Bouncy(10 ** 6), "w0", NumpyLinearModel(4),
+        rpc_retry_window_s=5.0, clock=clk, sleep=lambda s: clk.advance(s),
+    )
+    with pytest.raises(master_mod.MasterTransportError):
+        w2._rpc("stats")  # bounded: gives up after the window

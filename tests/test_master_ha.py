@@ -237,3 +237,165 @@ def test_training_survives_failover(tmp_path):
         client.close()
         m0.stop()
         m1.stop()
+
+
+def test_standby_tails_journal_and_takes_over_warm(tmp_path):
+    """ISSUE 7 tentpole at the HA layer: the standby tails the leader's
+    snapshot + journal into a live replica, so winning the campaign is a
+    bounded replay + promote — leases stay warm, result payloads survive,
+    requeue_unresulted finds ZERO tasks to recompute, and the in-flight
+    worker's retried ack is absorbed."""
+    import numpy as np
+
+    from paddle_tpu.master import Client
+
+    data = _write_data(tmp_path)
+    hadir = str(tmp_path / "ha")
+    kw = dict(lease_timeout=1.0, chunks_per_task=2, auto_rotate=False,
+              timeout_s=60.0, worker_timeout_s=60.0)
+    m0 = HAMaster(hadir, [data], owner_id="m0", **kw)
+    m0.start()
+    assert m0.wait_leader(10)
+    m1 = HAMaster(hadir, [data], owner_id="m1", **kw)
+    m1.start()
+
+    # mid-pass workload on the first leader: two finished tasks with
+    # result payloads, one in-flight lease whose reply we'll "lose"
+    c = Client(m0.server.address)
+    c.register_worker("w0")
+    c.register_worker("w1")
+    done = {}
+    for _ in range(2):
+        got = c.get_task("w0")
+        payload = {"g": np.full(4, got["task"]["task_id"], np.float32),
+                   "rows": 5}
+        assert c.task_finished(got["task"]["task_id"], got["epoch"], payload)
+        done[got["task"]["task_id"]] = payload
+    inflight = c.get_task("w1")
+    live_seq = m0.service._seq
+
+    # the standby replica must catch up to the leader's journal tip
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        rep = m1._replica
+        if rep is not None and rep._seq >= live_seq:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("standby never tailed the journal to the live seq")
+
+    m0.freeze()  # kill -9 equivalent: no release, no renewals
+    assert m1.wait_leader(15)
+    assert m1.last_takeover is not None
+    assert m1.last_takeover["warm"] is True
+    assert m1.last_takeover["replayed_records"] > 0
+
+    svc = m1.service
+    assert svc.requeue_unresulted() == 0  # zero recomputed tasks
+    res = svc.pass_results(0)["results"]
+    assert res.keys() == done.keys()
+    for tid, payload in done.items():
+        np.testing.assert_array_equal(res[tid]["g"], payload["g"])
+    # the in-flight lease survived WARM with its owner...
+    tid, epoch = inflight["task"]["task_id"], inflight["epoch"]
+    assert tid in svc.pending and svc.pending[tid][2] == "w1"
+    # ...so the worker's retried ack against the new leader just lands
+    c2 = Client(m1.server.address)
+    assert c2.task_finished(tid, epoch, {"g": np.zeros(4, np.float32)})
+    c2.close()
+    m1.stop()
+
+
+def test_takeover_survives_legacy_snapshot_dropping_replica(tmp_path):
+    """Mixed-config fleet edge: a journaled candidate tails a journaled
+    leader into a replica, but a deposed --no-journal leader publishes a
+    LEGACY snapshot (no journal_file) before the candidate wins the
+    campaign.  The final catch-up tick inside _become_leader then DROPS
+    the replica it was about to promote — takeover must fall through to
+    cold recovery, not crash promoting None (which would release the
+    lease and extend the leaderless window by a full backoff)."""
+    import json
+
+    from paddle_tpu.master import Client
+
+    data = _write_data(tmp_path)
+    hadir = str(tmp_path / "ha")
+    kw = dict(lease_timeout=1.0, chunks_per_task=2, auto_rotate=False,
+              timeout_s=60.0, worker_timeout_s=60.0)
+    m0 = HAMaster(hadir, [data], owner_id="m0", **kw)
+    m0.start()
+    assert m0.wait_leader(10)
+    c = Client(m0.server.address)
+    got = c.get_task("w0")
+    assert c.task_finished(got["task"]["task_id"], got["epoch"], {"r": 1})
+    c.close()
+    live_seq = m0.service._seq
+    snap_path = m0.service.snapshot_path
+
+    m1 = HAMaster(hadir, [data], owner_id="m1", **kw)  # never start()ed
+    deadline = time.time() + 10
+    while m1._replica is None or m1._replica._seq < live_seq:
+        m1._standby_tick()
+        assert time.time() < deadline, "standby never built a live replica"
+        time.sleep(0.02)
+    m0.stop()
+
+    # the deposed --no-journal leader's last word: a journal-less snapshot
+    with open(snap_path) as f:
+        state = json.load(f)
+    state.pop("journal_file", None)
+    with open(snap_path, "w") as f:
+        json.dump(state, f)
+
+    m1._become_leader()
+    try:
+        assert m1.is_leader.is_set()
+        assert m1.service is not None
+        assert m1.last_takeover["warm"] is False  # cold, but ALIVE
+        # the cold service actually serves the legacy snapshot's queue
+        assert m1.service.get_task("w1") not in (None, "wait")
+    finally:
+        m1._step_down()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_poisoned_journal_is_fatal_for_candidate(tmp_path):
+    """An unknown journal record type (version skew / corruption) must
+    kill the whole CANDIDATE loudly — ``fatal`` set, campaign thread dead,
+    the CLI loop exits nonzero — never lurk as a zombie standby that can
+    neither take over nor warn anyone."""
+    import json as _json
+
+    from paddle_tpu import master_journal as mj
+    from paddle_tpu.master import Client
+
+    data = _write_data(tmp_path)
+    hadir = str(tmp_path / "ha")
+    kw = dict(lease_timeout=1.0, chunks_per_task=2, auto_rotate=False,
+              timeout_s=60.0, worker_timeout_s=60.0)
+    m0 = HAMaster(hadir, [data], owner_id="m0", **kw)
+    m0.start()
+    assert m0.wait_leader(10)
+    snap_path = m0.service.snapshot_path
+    c = Client(m0.server.address)
+    c.register_worker("w0")  # journal at least one real record
+    c.close()
+    m0.freeze()  # crashed leader: journal and snapshot stay as-is
+
+    snap = _json.load(open(snap_path))
+    jpath = os.path.join(os.path.dirname(snap_path), snap["journal_file"])
+    w = mj.JournalWriter(jpath, fsync=False, fresh=False)
+    w.append(10 ** 6, {"t": "frobnicate"})  # version-skewed append
+    w.close()
+
+    m1 = HAMaster(hadir, [data], owner_id="m1", **kw)
+    m1.start()
+    deadline = time.time() + 15
+    while m1.fatal is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert m1.fatal is not None and "frobnicate" in m1.fatal
+    m1._thread.join(timeout=10)
+    assert not m1._thread.is_alive()  # crashed loudly, not a zombie
+    assert not m1.is_leader.is_set()

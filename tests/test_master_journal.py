@@ -1,0 +1,700 @@
+"""The master's durable state plane: CRC-framed journal + snapshot
+compaction + replay (master_journal.py, master.Service journal=True).
+
+The contracts under test are the ISSUE-7 satellite list verbatim: a torn
+final record is tolerated (prefix-consistent replay), a CRC-corrupt
+complete record stops replay at the good prefix and is flagged by the
+lint, compaction is equivalence-preserving (replay(snapshot + journal) ==
+live state), replay is idempotent under double delivery, an unknown
+record type is a HARD error everywhere, and a fenced (deposed) leader can
+never append again."""
+
+import ast
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import master as master_mod
+from paddle_tpu import master_journal as mj
+from paddle_tpu.io import recordio
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _write(path, n=80, chunk=10):
+    recordio.write_records(
+        path, (f"{i}".encode() for i in range(n)), max_chunk_records=chunk,
+    )
+
+
+def _make_service(tmp_path, clock=None, **kw):
+    """Journaled 4-task service over a deterministic dataset."""
+    data = str(tmp_path / "d.rio")
+    if not os.path.exists(data):
+        _write(data)
+    kw.setdefault("chunks_per_task", 2)
+    kw.setdefault("auto_rotate", False)
+    kw.setdefault("journal", True)
+    kw.setdefault("journal_fsync", False)  # unit tests grind records
+    svc = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        clock=clock or _FakeClock(), **kw,
+    )
+    svc.set_dataset([data])
+    return svc
+
+
+def _journal_path(tmp_path):
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap.get("journal_file"), "snapshot is not journal-anchored"
+    return str(tmp_path / snap["journal_file"])
+
+
+def _tree_equal(a, b):
+    if isinstance(a, dict) or isinstance(b, dict):
+        return (
+            isinstance(a, dict) and isinstance(b, dict)
+            and a.keys() == b.keys()
+            and all(_tree_equal(a[k], b[k]) for k in a)
+        )
+    return np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def _fingerprint(svc):
+    """Everything the queue/cluster plane knows, minus runtime deadlines
+    (which recovery deliberately refreshes)."""
+    with svc._lock:
+        return {
+            "pass_id": svc.pass_id,
+            "todo": sorted((t.task_id, t.epoch) for t in svc.todo),
+            "pending": sorted(
+                (tid, ent[0].epoch, ent[2])
+                for tid, ent in svc.pending.items()
+            ),
+            "done": sorted((t.task_id, t.epoch) for t in svc.done),
+            "discarded": sorted(t.task_id for t in svc.discarded),
+            "fail_events": svc.fail_events,
+            "workers": sorted(svc.workers),
+            "pass_done": dict(svc._pass_done),
+            "fences": {
+                fid: (sorted(f["arrived"]), f["released"])
+                for fid, f in svc.fences.items()
+            },
+        }
+
+
+def _results_equal(a, b):
+    sa = {p: dict(a.results.get(p, {})) for p in a.results}
+    sb = {p: dict(b.results.get(p, {})) for p in b.results}
+    if sa.keys() != sb.keys():
+        return False
+    for p in sa:
+        if sa[p].keys() != sb[p].keys():
+            return False
+        for tid in sa[p]:
+            if not _tree_equal(sa[p][tid], sb[p][tid]):
+                return False
+    return True
+
+
+def _workload(svc):
+    """A representative mid-pass history touching every record type the
+    live plane emits: leases, finishes with numpy result payloads, a
+    failure, a graceful return, registry join/leave, fence arrivals and a
+    release, one full rotation, and a pass-1 lease."""
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    svc.register_worker("w2")
+    served = {}
+    for w in ("w0", "w1", "w2"):
+        got = svc.get_task(w)
+        served[w] = (got["task"]["task_id"], got["epoch"])
+    # w1's task fails once (epoch walk), w2 hands its back gracefully
+    svc.task_failed(*served["w1"])
+    svc.task_returned(*served["w2"])
+    svc.deregister_worker("w2")
+    # drain pass 0 on w0/w1 with per-task result payloads
+    svc.task_finished(
+        *served["w0"],
+        {"g": np.arange(4, dtype=np.float32) + served["w0"][0], "rows": 10},
+    )
+    while True:
+        got = svc.get_task("w0")
+        if got in (None, "wait"):
+            break
+        svc.task_finished(
+            got["task"]["task_id"], got["epoch"],
+            {"g": np.arange(4, dtype=np.float32) + got["task"]["task_id"],
+             "rows": 10},
+        )
+    svc.fence_arrive("pass-0", "w0", {"ckpt": True})
+    svc.fence_arrive("pass-0", "w1", {"ckpt": False})
+    assert svc.fence_status("pass-0")["released"]
+    svc.start_new_pass(1)
+    got = svc.get_task("w0")  # one warm mid-pass-1 lease
+    assert got not in (None, "wait")
+
+
+# ---------------------------------------------------------------------------
+# framing: torn tail, CRC corruption, unknown types, sequence order
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip(tmp_path):
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    recs = [{"t": "join", "worker": f"w{i}", "blob": b"x" * i}
+            for i in range(20)]
+    for i, r in enumerate(recs):
+        w.append(i + 1, r)
+    w.close()
+    got, info = read = mj.read_records(p)
+    assert not info["torn"] and not info["corrupt"]
+    assert info["end_offset"] == os.path.getsize(p)
+    assert [s for s, _ in got] == list(range(1, 21))
+    assert [r for _, r in got] == recs
+    # offset resume: re-read from the middle yields the tail only
+    mid_off = None
+    off = 0
+    for i, (s, r) in enumerate(got):
+        if i == 10:
+            mid_off = off
+        off += len(mj.encode_frame(s, r))
+    tail, info2 = mj.read_records(p, offset=mid_off)
+    assert [s for s, _ in tail] == list(range(11, 21))
+    # the resume contract a tailer stands on: end_offset is ABSOLUTE, so
+    # feeding it back as the next offset neither regresses (re-reads) nor
+    # lands mid-frame (fake corruption) — frames here are variable-size
+    # on purpose
+    assert info2["end_offset"] == os.path.getsize(p)
+    again, info3 = mj.read_records(p, offset=info2["end_offset"])
+    assert again == [] and not info3["corrupt"]
+    assert info3["end_offset"] == os.path.getsize(p)
+
+
+def test_torn_final_record_is_tolerated(tmp_path):
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    for i in range(3):
+        w.append(i + 1, {"t": "join", "worker": f"w{i}"})
+    w.close()
+    os.truncate(p, os.path.getsize(p) - 3)  # crash mid-append
+    got, info = mj.read_records(p)
+    assert [s for s, _ in got] == [1, 2]
+    assert info["torn"] and not info["corrupt"]
+    findings = mj.verify_journal(p)
+    assert [f["rule"] for f in findings] == ["J004"]
+    assert findings[0]["severity"] == "warning"
+
+
+def test_crc_corrupt_mid_record_stops_at_prefix(tmp_path):
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    offs = []
+    for i in range(3):
+        offs.append(w.tell())
+        w.append(i + 1, {"t": "join", "worker": f"w{i}"})
+    w.close()
+    # flip one payload byte of the COMPLETE middle record
+    with open(p, "r+b") as f:
+        f.seek(offs[1] + 20)
+        b = f.read(1)
+        f.seek(offs[1] + 20)
+        f.write(bytes([b[0] ^ 0xFF]))
+    got, info = mj.read_records(p)
+    assert [s for s, _ in got] == [1]  # replay stops at the good prefix
+    assert info["corrupt"]
+    rules = [f["rule"] for f in mj.verify_journal(p)]
+    assert "J001" in rules
+
+
+def test_unknown_record_type_is_hard_error(tmp_path):
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    w.append(1, {"t": "join", "worker": "w0"})
+    w.append(2, {"t": "frobnicate", "x": 1})  # version skew / corruption
+    w.close()
+    findings = mj.verify_journal(p)
+    assert any(
+        f["rule"] == "J002" and f["severity"] == "error" for f in findings
+    )
+    svc = _make_service(tmp_path)
+    with pytest.raises(mj.JournalError):
+        svc.apply_record(svc._seq + 1, {"t": "frobnicate", "x": 1})
+
+
+def test_non_monotonic_sequence_flagged(tmp_path):
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    w.append(5, {"t": "join", "worker": "a"})
+    w.append(3, {"t": "join", "worker": "b"})
+    w.close()
+    assert any(f["rule"] == "J003" for f in mj.verify_journal(p))
+
+
+def test_cli_lint_journal(tmp_path, capsys):
+    from paddle_tpu.cli import cmd_lint
+
+    p = str(tmp_path / "j.log")
+    w = mj.JournalWriter(p, fsync=False)
+    for i in range(4):
+        w.append(i + 1, {"t": "join", "worker": f"w{i}"})
+    w.close()
+    assert cmd_lint(["--journal", p]) == 0
+    assert "no diagnostics" in capsys.readouterr().out
+    w = mj.JournalWriter(p, fsync=False, fresh=False)
+    w.append(9, {"t": "martian"})
+    w.close()
+    assert cmd_lint(["--journal", p]) == 1
+    assert "J002" in capsys.readouterr().out
+
+
+def test_every_journaled_record_type_is_known_and_applicable():
+    """Self-check folded into the suite: every ``{"t": ...}`` literal that
+    master.py appends is a registered record type, and every registered
+    type has a replay op — so a typo'd emission or a missing handler is a
+    test failure, not a silent recovery hole."""
+    src = open(os.path.join(
+        os.path.dirname(master_mod.__file__), "master.py")).read()
+    emitted = set()
+    for node in ast.walk(ast.parse(src)):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "_journal" and node.args):
+            d = node.args[0]
+            assert isinstance(d, ast.Dict), "journal arg must be a literal"
+            for k, v in zip(d.keys, d.values):
+                if getattr(k, "value", None) == "t":
+                    assert isinstance(v, ast.Constant)
+                    emitted.add(v.value)
+    assert emitted, "no journal emissions found (AST scan broke?)"
+    assert emitted <= mj.RECORD_TYPES
+    for t in mj.RECORD_TYPES:
+        assert hasattr(master_mod.Service, f"_apply_{t}")
+
+
+# ---------------------------------------------------------------------------
+# service-level: recovery equivalence, compaction, idempotence, fencing
+# ---------------------------------------------------------------------------
+
+def test_recovery_replays_to_live_state(tmp_path):
+    svc = _make_service(tmp_path)
+    _workload(svc)
+    live_fp = _fingerprint(svc)
+    svc.fence()  # deposed: the recovering leader owns the files now
+    twin = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, journal=True,
+        journal_fsync=False, clock=_FakeClock(),
+    )
+    assert twin.replayed_records > 0
+    assert _fingerprint(twin) == live_fp
+    assert _results_equal(twin, svc)
+
+
+def test_compaction_equivalence(tmp_path):
+    """Force several mid-workload compactions: the snapshot absorbs the
+    journal, result payloads are re-emitted into the fresh generation, and
+    replay(snapshot + journal) still equals the live state — with exactly
+    one generation left on disk."""
+    svc = _make_service(tmp_path, journal_compact_every=3)
+    _workload(svc)
+    assert svc._journal_gen > 1  # compaction actually happened
+    live_fp = _fingerprint(svc)
+    svc.fence()
+    import glob
+    gens = glob.glob(str(tmp_path / "master_journal-*.log"))
+    assert len(gens) == 1  # older generations swept
+    twin = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, journal=True,
+        journal_fsync=False, clock=_FakeClock(),
+    )
+    assert _fingerprint(twin) == live_fp
+    assert _results_equal(twin, svc)
+
+
+def test_replay_is_idempotent_under_double_delivery(tmp_path):
+    svc = _make_service(tmp_path)
+    _workload(svc)
+    records, info = mj.read_records(_journal_path(tmp_path))
+    assert records and not info["torn"] and not info["corrupt"]
+    svc.fence()
+    twin = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, journal=True,
+        journal_fsync=False, clock=_FakeClock(),
+    )
+    fp = _fingerprint(twin)
+    # a tailing standby re-reading the same records must change nothing
+    assert all(not twin.apply_record(s, r) for s, r in records)
+    assert _fingerprint(twin) == fp
+
+
+def test_torn_tail_recovery_applies_the_prefix(tmp_path):
+    svc = _make_service(tmp_path)
+    got = svc.get_task("w0")
+    tid, epoch = got["task"]["task_id"], got["epoch"]
+    jpath = _journal_path(tmp_path)
+    before_last = os.path.getsize(jpath)
+    fp_before_last = _fingerprint(svc)
+    svc.task_finished(tid, epoch, {"g": np.ones(2, np.float32)})
+    svc.fence()
+    os.truncate(jpath, before_last + 7)  # crash mid-append of the finish
+    twin = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, journal=True,
+        journal_fsync=False, clock=_FakeClock(),
+    )
+    # the torn finish never happened; the lease survives warm, so the
+    # worker's retried ack (at-least-once) completes it without recompute
+    assert _fingerprint(twin) == fp_before_last
+    assert twin.task_finished(tid, epoch, {"g": np.ones(2, np.float32)})
+
+
+def test_failover_keeps_results_and_warm_leases_zero_recompute(tmp_path):
+    """The tentpole contract in miniature: finished tasks keep their
+    result payloads across a failover, in-flight leases stay warm (the
+    retried ack is absorbed), and requeue_unresulted finds NOTHING to
+    recompute."""
+    svc = _make_service(tmp_path)
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    done = {}
+    for _ in range(2):
+        got = svc.get_task("w0")
+        payload = {
+            "g": np.full(3, got["task"]["task_id"], np.float32), "rows": 10,
+        }
+        svc.task_finished(got["task"]["task_id"], got["epoch"], payload)
+        done[got["task"]["task_id"]] = payload
+    inflight = svc.get_task("w1")
+    svc.fence()  # kill -9 the leader
+    twin = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"),
+        chunks_per_task=2, auto_rotate=False, journal=True,
+        journal_fsync=False, clock=_FakeClock(),
+    )
+    assert twin.requeue_unresulted() == 0  # nothing to recompute
+    res = twin.pass_results(0)["results"]
+    assert res.keys() == done.keys()
+    assert all(_tree_equal(res[t], done[t]) for t in done)
+    # w1 never heard the old leader's reply: the re-served lease is the
+    # SAME task, and the retried ack lands
+    tid, epoch = inflight["task"]["task_id"], inflight["epoch"]
+    reserved = twin.get_task("w1")
+    assert reserved["task"]["task_id"] == tid and reserved["epoch"] == epoch
+    assert twin.task_finished(tid, epoch, {"g": np.zeros(3, np.float32)})
+
+
+def test_fenced_leader_cannot_append(tmp_path):
+    svc = _make_service(tmp_path)
+    got = svc.get_task("w0")
+    jpath = _journal_path(tmp_path)
+    size = os.path.getsize(jpath)
+    svc.fence()
+    # the deposed leader still mutates its own memory, but the shared
+    # journal and snapshot never see it
+    svc.task_finished(got["task"]["task_id"], got["epoch"], {"g": [1.0]})
+    svc.register_worker("zombie")
+    assert os.path.getsize(jpath) == size
+
+
+def test_legacy_snapshot_upgrade_boot(tmp_path):
+    """A journal=False master's snapshot (v1, no journal_file) boots a
+    journaled successor: pending requeues (legacy semantics — the lease
+    records never existed), then the plane is journal-anchored."""
+    data = str(tmp_path / "d.rio")
+    _write(data)
+    old = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), chunks_per_task=2,
+        auto_rotate=False, snapshot_min_interval_s=0.0, journal=False,
+    )
+    old.set_dataset([data])
+    got = old.get_task("w0")
+    old.task_finished(got["task"]["task_id"], got["epoch"])
+    old.get_task("w0")  # leave one pending
+    old.fence()
+    new = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), chunks_per_task=2,
+        auto_rotate=False, journal=True, journal_fsync=False,
+    )
+    assert len(new.pending) == 0  # legacy pending went back to todo
+    assert new.n_tasks() == 4
+    assert json.load(open(tmp_path / "snap.json")).get("journal_file")
+
+
+def test_deposed_leader_compaction_fences_instead_of_truncating(tmp_path):
+    """The compaction-side fence: a deposed-but-not-yet-fenced leader that
+    reaches its compaction threshold must NOT rewrite the shared plane —
+    the published snapshot references the NEW leader's generation, so the
+    zombie fences itself instead of truncating the live journal /
+    replacing the snapshot / sweeping the other generations."""
+    a = _make_service(tmp_path)
+    got = a.get_task("w0")
+    a.task_finished(got["task"]["task_id"], got["epoch"], {"r": 1})
+
+    # the new leader recovers from the shared plane and re-anchors it
+    # into its own generation (exactly what boot/promote do)
+    b = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), journal=True,
+        journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+        clock=_FakeClock(),
+    )
+    b_file = mj.journal_filename(b._journal_gen)
+    b_size = os.path.getsize(tmp_path / b_file)
+
+    # the zombie hits its compaction threshold
+    a._compact()
+    assert a.snapshot_path is None  # fenced: never writes shared files again
+    assert a._journal_writer is None
+    # ...and B's plane is untouched: snapshot still references B's
+    # generation, B's journal bytes intact, B can still append
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap["journal_file"] == b_file
+    assert os.path.getsize(tmp_path / b_file) == b_size
+    got_b = b.get_task("w1")
+    assert got_b is not None
+    assert mj.verify_journal(str(tmp_path / b_file)) == []
+
+
+def test_midlife_generation_collision_fences(tmp_path):
+    """If the target generation file already exists at a MID-LIFE
+    compaction (a racing new leader created it in the check-to-create
+    window), the exclusive create fails and the leader fences — only a
+    freshly-acquired lease (boot/promote) may reclaim such a file."""
+    svc = _make_service(tmp_path)
+    racer = tmp_path / mj.journal_filename(svc._journal_gen + 1)
+    racer.write_bytes(b"")  # the racing leader's freshly-created file
+    svc._compact()
+    assert svc.snapshot_path is None  # fenced
+    assert racer.read_bytes() == b""  # never touched the racer's file
+
+
+def test_boot_reclaims_unpublished_crash_orphan(tmp_path):
+    """A compaction that died between writing the new generation and
+    publishing the snapshot leaves an orphan file one generation above
+    the published one.  The next boot (which holds the fresh lease) must
+    reclaim it — not fence on the collision, not recover garbage."""
+    a = _make_service(tmp_path)
+    got = a.get_task("w0")
+    a.task_finished(got["task"]["task_id"], got["epoch"], {"r": 7})
+    fp = _fingerprint(a)
+    orphan = tmp_path / mj.journal_filename(a._journal_gen + 1)
+    orphan.write_bytes(b"half-written garbage")  # crashed mid-compaction
+    a.fence()
+
+    b = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), journal=True,
+        journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+        clock=_FakeClock(),
+    )
+    assert _fingerprint(b) == fp  # recovered the real state...
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap["journal_file"] == mj.journal_filename(b._journal_gen)
+    assert mj.verify_journal(
+        str(tmp_path / snap["journal_file"])
+    ) == []  # ...and owns a clean reclaimed generation
+
+
+def test_failed_compaction_rolls_back_and_retries(tmp_path):
+    """A transient disk failure mid-compaction (ENOSPC, EIO) must not
+    desync the generation counter: a dangling bump would make the NEXT
+    compaction see the published snapshot as someone else's and silently
+    self-fence this HEALTHY leader — acks would keep flowing while the
+    journal silently stopped.  Instead the failed attempt rolls back,
+    appends keep landing durably in the old generation, and a later
+    compaction succeeds and publishes the new one."""
+    svc = _make_service(tmp_path)
+    gen0 = svc._journal_gen
+    got = svc.get_task("w0")
+    svc.task_finished(got["task"]["task_id"], got["epoch"], {"r": 1})
+
+    real = svc._write_snapshot
+    calls = {"n": 0}
+
+    def failing(*a, **kw):
+        calls["n"] += 1
+        raise OSError(28, "No space left on device")
+
+    svc._write_snapshot = failing
+    svc._compact()
+    svc._write_snapshot = real
+
+    assert calls["n"] == 1
+    assert svc.snapshot_path is not None  # NOT fenced
+    assert svc._journal_writer is not None  # still appending durably
+    assert svc._journal_gen == gen0  # generation rolled back
+    # the partial new generation was removed: the retry's O_EXCL create
+    # must not collide with our own failed attempt
+    assert not os.path.exists(tmp_path / mj.journal_filename(gen0 + 1))
+
+    # transitions keep landing in the old generation...
+    got2 = svc.get_task("w1")
+    svc.task_finished(got2["task"]["task_id"], got2["epoch"], {"r": 2})
+    fp = _fingerprint(svc)
+
+    # ...and the retried compaction publishes the next generation cleanly
+    svc._compact()
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap["journal_file"] == mj.journal_filename(gen0 + 1)
+    assert mj.verify_journal(str(tmp_path / snap["journal_file"])) == []
+
+    svc.fence()
+    b = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), journal=True,
+        journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+        clock=_FakeClock(),
+    )
+    assert _fingerprint(b) == fp  # nothing was lost along the way
+
+
+def test_promote_reclaims_plane_over_zombie_last_gasp_publish(tmp_path):
+    """A deposed leader waking in the lease-gap window can publish one
+    last compaction AFTER the standby tailed its final record.  The
+    lease-holding promotion must RECLAIM the plane — adopt the zombie's
+    generation as base and re-anchor above it — not silently fence
+    itself: a self-fenced fresh leader would serve the whole fleet with
+    journal and snapshot OFF, and the next failover would lose the
+    entire leadership's state."""
+    a = _make_service(tmp_path)
+    got = a.get_task("w0")
+    a.task_finished(got["task"]["task_id"], got["epoch"], {"r": 3})
+    fp = _fingerprint(a)
+
+    # the replica a standby's _standby_tick would have built from the
+    # shared plane (snapshot + journal tail)
+    snap_state = json.load(open(tmp_path / "snap.json"))
+    jf = snap_state["journal_file"]
+    replica = master_mod.Service(
+        snapshot_path=None, journal=False, chunks_per_task=2,
+        auto_rotate=False, clock=_FakeClock(),
+    )
+    replica.load_state(snap_state, warm=True)
+    for seq, rec in mj.read_records(str(tmp_path / jf))[0]:
+        replica.apply_record(seq, rec)
+    replica._journal_gen = mj.parse_generation(jf)
+
+    # ...then the zombie (deposed but not yet fenced) publishes one last
+    # compaction before the replica promotes
+    a._compact()
+    zombie_file = json.load(open(tmp_path / "snap.json"))["journal_file"]
+    assert zombie_file != jf
+
+    replica.promote(str(tmp_path / "snap.json"), journal_fsync=False)
+    assert replica.snapshot_path is not None  # NOT fenced
+    assert replica._journal_writer is not None  # journaling is ON
+    assert _fingerprint(replica) == fp
+    published = json.load(open(tmp_path / "snap.json"))["journal_file"]
+    assert published == mj.journal_filename(replica._journal_gen)
+    assert mj.parse_generation(published) > mj.parse_generation(zombie_file)
+    # and the reclaimed plane is live: appends land in the new generation
+    assert replica.get_task("w1") is not None
+    assert mj.verify_journal(str(tmp_path / published)) == []
+
+
+def test_stalled_zombie_compaction_cannot_publish_over_new_leader(tmp_path):
+    """The O_EXCL fence alone cannot stop a leader that stalls INSIDE its
+    compaction (slow fsync) past the lease: a new leader reclaims by
+    skipping the contested generation name, so the zombie's exclusive
+    create already succeeded.  The pre-publish ownership re-verify must
+    catch it: the zombie wakes, sees the snapshot no longer references
+    what it prechecked, fences itself, and never replaces the rightful
+    leader's snapshot with stale state."""
+    a = _make_service(tmp_path)
+    got = a.get_task("w0")
+    a.task_finished(got["task"]["task_id"], got["epoch"], {"r": 1})
+    fp = _fingerprint(a)
+
+    real_sync = mj.JournalWriter.sync
+    state = {"fired": False}
+    b_box = {}
+
+    def stalling_sync(self):
+        if not state["fired"]:
+            state["fired"] = True
+            # while A's compaction is parked on this fsync, its lease
+            # expires and a new leader boots from the shared plane
+            b_box["b"] = master_mod.Service(
+                snapshot_path=str(tmp_path / "snap.json"), journal=True,
+                journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+                clock=_FakeClock(),
+            )
+        return real_sync(self)
+
+    mj.JournalWriter.sync = stalling_sync
+    try:
+        a._compact()  # the zombie's compaction, interleaved with B's boot
+    finally:
+        mj.JournalWriter.sync = real_sync
+
+    b = b_box["b"]
+    assert a.snapshot_path is None  # zombie fenced itself mid-compaction
+    assert _fingerprint(b) == fp  # B recovered the full acked state
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap["journal_file"] == mj.journal_filename(b._journal_gen)
+    assert mj.verify_journal(
+        str(tmp_path / snap["journal_file"])
+    ) == []  # ...and the plane B owns is intact, not overwritten
+
+
+def test_zombie_post_publish_sweep_cannot_delete_new_leaders_generation(
+    tmp_path,
+):
+    """A zombie that stalls BETWEEN its snapshot publish and its
+    old-generation sweep passes every pre-publish fence — its publish was
+    legitimate when it happened.  If the sweep then removes "everything
+    but my own file", it unlinks the live generation a reclaiming new
+    leader anchored ABOVE it (reclaim adopts the published generation as
+    its base), and every transition the new leader acks afterwards is
+    invisible to recovery.  The sweep must only collect generations
+    strictly below the sweeper's own."""
+    a = _make_service(tmp_path)
+    got = a.get_task("w0")
+    a.task_finished(got["task"]["task_id"], got["epoch"], {"r": 1})
+
+    real_write = a._write_snapshot
+    b_box = {}
+
+    def publish_then_stall(**kwargs):
+        real_write(**kwargs)
+        # parked right after its publish, A's lease expires; a new leader
+        # boots from the shared plane and re-anchors ABOVE A's generation
+        b_box["b"] = master_mod.Service(
+            snapshot_path=str(tmp_path / "snap.json"), journal=True,
+            journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+            clock=_FakeClock(),
+        )
+
+    a._write_snapshot = publish_then_stall
+    try:
+        a._compact()  # the zombie wakes and sweeps AFTER B re-anchored
+    finally:
+        del a._write_snapshot
+
+    b = b_box["b"]
+    bfile = tmp_path / mj.journal_filename(b._journal_gen)
+    assert bfile.exists()  # the sweep did not unlink B's live generation
+    snap = json.load(open(tmp_path / "snap.json"))
+    assert snap["journal_file"] == bfile.name
+    # B keeps acking durably: a cold recovery replays to B's live state
+    got = b.get_task("w1")
+    b.task_finished(got["task"]["task_id"], got["epoch"], {"r": 2})
+    fp = _fingerprint(b)
+    c = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), journal=True,
+        journal_fsync=False, chunks_per_task=2, auto_rotate=False,
+        clock=_FakeClock(),
+    )
+    assert _fingerprint(c) == fp
+    assert _results_equal(c, b)
