@@ -2,12 +2,16 @@
 # paddle/scripts/travis/, as make targets).
 #
 #   make lint    — static analysis: AST self-lint over paddle_tpu + bench.py
-#                  (analysis/ast_rules) and graph-lint over every shipped
-#                  demo config (tests/configs/).  Zero findings = pass.
+#                  (analysis/ast_rules), graph-lint over every shipped
+#                  demo config (tests/configs/), and the T106 buffer-
+#                  donation audit over the step builders (incl. the
+#                  whole-pass epoch program).  Zero findings = pass.
 #   make test    — fast tier: lint, then every test not marked `slow`;
 #                  < 6 min on the virtual 8-device CPU mesh.  The CI gate.
-#   make verify  — the full suite, then a bench smoke (one metric) and the
-#                  8-device multichip dry-run compile.
+#   make verify  — the full suite, then a bench smoke (one metric), the
+#                  AOT-cache warm-boot record (cold/warm compile counts +
+#                  wall time, dispatches-per-epoch) and the 8-device
+#                  multichip dry-run compile.
 #   make bench   — the full benchmark set (one JSON line per metric).
 #   make tier1-check / tier1-update — diff (or re-snapshot) the tier-1
 #                  failing-test SET against tests/tier1_failures_baseline.txt
@@ -36,6 +40,7 @@ lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
 	$(CPU_ENV) $(PY) -m paddle_tpu lint \
 		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --donation
 
 test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
@@ -58,6 +63,7 @@ test-all:
 verify: test-all
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_allreduce_virtual8())"
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_scaling_virtual8())"
+	$(CPU_ENV) $(PY) -c "import bench; [print(r) for r in bench.bench_aot_warm_boot()]"
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 bench:

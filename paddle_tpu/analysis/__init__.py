@@ -31,6 +31,7 @@ from paddle_tpu.analysis.graph_lint import (
     lint_topology,
 )
 from paddle_tpu.analysis.trace_lint import (
+    donation_audit,
     lint_jaxpr,
     lint_step,
     recompile_audit,
@@ -43,6 +44,7 @@ __all__ = [
     "Severity",
     "attr_key_universe",
     "config_assert",
+    "donation_audit",
     "errors",
     "format_diagnostics",
     "lint_file",

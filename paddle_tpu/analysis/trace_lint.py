@@ -26,10 +26,15 @@ Rules (``T###``):
   T105 shape-explosion       distinct batch shapes exceed the ladder
                              budget: the step recompiles per batch instead
                              of per rung
+  T106 undonated-carry       a large input buffer (params / opt-state /
+                             any carried-state leaf) is returned updated
+                             but NOT donated — XLA double-buffers it: 2x
+                             HBM held and a device copy every step
 
 ``trace_step`` builds the jaxpr of a step function exactly as jit would see
 it; ``recompile_audit`` replays a reader's observed batch shapes against the
-``CompileShapeCache`` contract (core/compiler.py).
+``CompileShapeCache`` contract (core/compiler.py); ``donation_audit`` checks
+the train step / epoch program's carried buffers are donated (T106).
 """
 
 from __future__ import annotations
@@ -184,6 +189,105 @@ def lint_step(
         const_elem_threshold=const_elem_threshold,
         source=source,
     )
+
+
+# ---------------------------------------------------------------------------
+# buffer-donation audit (T106)
+# ---------------------------------------------------------------------------
+
+
+def donation_audit(
+    fn,
+    *example_args,
+    donate_argnums: Optional[Sequence[int]] = None,
+    carry_elem_threshold: int = DEFAULT_CONST_ELEMS,
+    source: Optional[str] = None,
+) -> List[Diagnostic]:
+    """T106: flag large CARRIED buffers that are copied instead of donated.
+
+    A train step / epoch program returns updated versions of its big
+    inputs (params, optimizer slots, carried state).  When such an input
+    is not donated, XLA cannot alias it into the matching output: the
+    program holds BOTH generations in HBM (2x the carry) and spends a
+    copy per dispatch.  The heuristic mirrors what XLA's aliasing pass
+    needs: a non-donated input leaf of ``carry_elem_threshold``+ elements
+    whose (shape, dtype) also appears among the outputs is a carried
+    buffer that will be double-buffered.
+
+    ``fn`` may be a jitted function — its own ``donate_argnums`` are read
+    back out of the traced pjit equation, so the audit checks what jit
+    will actually honor; for a plain function pass ``donate_argnums``
+    explicitly (the jit spelling the builder intends)."""
+    closed = trace_step(fn, *example_args)
+    jaxpr = closed.jaxpr
+    leaf_lists = [jax.tree_util.tree_leaves(a) for a in example_args]
+    counts = [len(leaves) for leaves in leaf_lists]
+    arg_of: List[int] = []
+    for argnum, cnt in enumerate(counts):
+        arg_of.extend([argnum] * cnt)
+    if len(arg_of) != len(jaxpr.invars):
+        return []  # kwargs/captured structure we can't map — stay silent
+
+    donated: Optional[List[bool]] = None
+    eqns = jaxpr.eqns
+    if (
+        len(eqns) == 1
+        and eqns[0].primitive.name == "pjit"
+        and "donated_invars" in eqns[0].params
+        and list(eqns[0].invars) == list(jaxpr.invars)
+    ):
+        # a jitted fn traces to one pjit eqn; its donated_invars are the
+        # flags jit will compile with — the ground truth
+        donated = list(eqns[0].params["donated_invars"])
+    if donated is None:
+        dset = set(donate_argnums or ())
+        donated = [argnum in dset for argnum in arg_of]
+
+    out_avals: set = set()
+    for v in jaxpr.outvars:
+        aval = getattr(v, "aval", None)
+        if aval is not None and getattr(aval, "shape", None) is not None:
+            out_avals.add((tuple(aval.shape), str(aval.dtype)))
+
+    per_arg: Dict[int, List[str]] = {}
+    per_arg_bytes: Dict[int, int] = {}
+    for i, v in enumerate(jaxpr.invars):
+        if donated[i]:
+            continue
+        aval = getattr(v, "aval", None)
+        if aval is None or not getattr(aval, "shape", None):
+            continue
+        size = int(np.prod(aval.shape))
+        if size < carry_elem_threshold:
+            continue
+        sig = (tuple(aval.shape), str(aval.dtype))
+        if sig not in out_avals:
+            continue  # read-only input (batch data): no copy to save
+        per_arg.setdefault(arg_of[i], []).append(
+            f"{sig[0]} {sig[1]}"
+        )
+        per_arg_bytes[arg_of[i]] = per_arg_bytes.get(arg_of[i], 0) + (
+            size * np.dtype(aval.dtype).itemsize
+        )
+
+    diags: List[Diagnostic] = []
+    for argnum in sorted(per_arg):
+        shapes = per_arg[argnum]
+        mb = per_arg_bytes[argnum] / 1e6
+        diags.append(Diagnostic(
+            rule="T106", severity=Severity.WARNING, source=source,
+            message=(
+                f"argument {argnum} carries {len(shapes)} large buffer(s) "
+                f"({mb:.1f} MB) returned updated but NOT donated: "
+                f"{shapes[:4]}"
+                + (f" (+{len(shapes) - 4} more)" if len(shapes) > 4 else "")
+            ),
+            hint="add donate_argnums for carried state (params/opt-state/"
+            "scan carries) so XLA aliases the buffers — an undonated "
+            "carry is double-buffered: 2x HBM held and one device copy "
+            "per dispatch",
+        ))
+    return diags
 
 
 # ---------------------------------------------------------------------------

@@ -86,6 +86,20 @@ def _build_train_parser() -> argparse.ArgumentParser:
         "its host->device transfer; needs the pass cache enabled",
     )
     ap.add_argument(
+        "--aot_cache_dir", default=None,
+        help="persistent AOT executable cache (core/aot_cache.py): warm "
+        "boots deserialize compiled train-step/epoch-program executables "
+        "from here instead of retracing; prewarm with `paddle-tpu cache "
+        "warm`",
+    )
+    ap.add_argument(
+        "--whole_pass_program", type=_flag_bool, default=False, nargs="?",
+        const=True,
+        help="run cached epochs >= 2 as ONE on-device lax.scan program "
+        "over the stacked pass cache (O(1) host dispatches per epoch, "
+        "bit-exact vs stepwise); needs --cache_pass_in_mem",
+    )
+    ap.add_argument(
         "--checkpoint_dir", default=None,
         help="fault-tolerance plane (robustness/): write full-state "
         "checkpoints (params + optimizer state + RNG + pass/batch "
@@ -279,6 +293,10 @@ def cmd_train(argv: List[str]) -> int:
         _flags.set_flag("cache_pass_in_mem", True)
     if args.data_echo_factor is not None:
         _flags.set_flag("data_echo_factor", args.data_echo_factor)
+    if args.aot_cache_dir:
+        _flags.set_flag("aot_cache_dir", args.aot_cache_dir)
+    if args.whole_pass_program:
+        _flags.set_flag("whole_pass_program", True)
     if args.chaos:
         from paddle_tpu.robustness import chaos as _chaos
 
@@ -764,6 +782,178 @@ def cmd_master(argv: List[str]) -> int:
     return 0
 
 
+def _donation_audit_builders():
+    """T106 over the shipped step builders: trace make_train_step,
+    make_multi_train_step, and the whole-pass epoch program on a probe MLP
+    and audit that every large carried buffer (params/opt-state/carry) is
+    donated.  Pure host-side tracing — no compile, no FLOPs."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis.trace_lint import donation_audit
+    from paddle_tpu.core.batch import SeqTensor
+    from paddle_tpu.core.compiler import CompiledNetwork
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+    from paddle_tpu.trainer.step import (
+        make_epoch_program,
+        make_multi_train_step,
+        make_train_carry,
+        make_train_step,
+    )
+
+    reset_auto_names()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(64))
+    h = paddle.layer.fc(x, size=256, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(h, size=10, act=paddle.activation.Softmax())
+    y = paddle.layer.data("y", paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    net = CompiledNetwork(Topology([cost]))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2)
+    params, state = net.init(jax.random.PRNGKey(0))
+    opt_state = opt.init(params)
+    batch = {
+        "x": SeqTensor(jnp.zeros((8, 64), jnp.float32)),
+        "y": SeqTensor(jnp.zeros((8,), jnp.int32)),
+    }
+    rng = jax.random.PRNGKey(0)
+    k = 4
+    stacked = jax.tree_util.tree_map(
+        lambda v: jnp.stack([v] * k), batch
+    )
+    carry = make_train_carry(params, state, opt_state, rng)
+    diags = []
+    diags += donation_audit(
+        make_train_step(net, opt, mesh=None),
+        params, state, opt_state, batch, rng,
+        source="trainer/step.py:make_train_step",
+    )
+    diags += donation_audit(
+        make_multi_train_step(net, opt, k, mesh=None),
+        params, state, opt_state, stacked, rng,
+        source="trainer/step.py:make_multi_train_step",
+    )
+    diags += donation_audit(
+        make_epoch_program(net, opt, mesh=None),
+        carry, stacked, jnp.arange(k),
+        source="trainer/step.py:make_epoch_program",
+    )
+    print(
+        f"donation audit: 3 step builders traced, {len(diags)} T106 "
+        "finding(s)"
+    )
+    return diags
+
+
+def cmd_cache(argv: List[str]) -> int:
+    """``paddle-tpu cache`` — the persistent AOT executable cache
+    (core/aot_cache.py) maintenance face:
+
+    * ``ls``               — entries with size + full key provenance;
+    * ``warm``             — prewarm: parse a config, stage its feed, and
+                             compile-or-load the train-step executable for
+                             every distinct batch shape the ladder realizes
+                             (fleet boots then deserialize, not retrace);
+    * ``prune --max-mb N`` — drop oldest entries until the store fits;
+    * ``clear``            — drop everything.
+
+    Each run closes with one JSON summary line (the warm-boot bench and the
+    StatSet counters aot_cache/{hit,miss,stale,corrupt} read it)."""
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu cache",
+        description="persistent AOT executable cache maintenance "
+        "(core/aot_cache.py)",
+    )
+    ap.add_argument("action", choices=["ls", "warm", "prune", "clear"])
+    ap.add_argument("--dir", required=True, help="cache directory")
+    ap.add_argument("--config", default=None,
+                    help="warm: v1 config file whose train step to prewarm")
+    ap.add_argument("--config_args", default="")
+    ap.add_argument("--batch_size", type=int, default=0,
+                    help="warm: override the config's batch size")
+    ap.add_argument("--max-shapes", type=int, default=16,
+                    help="warm: stop after this many distinct batch shapes")
+    ap.add_argument("--max-mb", type=float, default=None,
+                    help="prune: keep the store under this many MB")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.aot_cache import AOTCache
+
+    cache = AOTCache(args.dir)
+    if args.action == "ls":
+        for e in cache.entries():
+            key = e.get("key", {})
+            prov = ", ".join(
+                f"{k}={key[k]}" for k in
+                ("kind", "n_steps", "batch", "topology", "jax", "backend")
+                if key.get(k) is not None
+            )
+            print(
+                f"{e['file']}  {e['bytes'] / 1e6:8.2f} MB  "
+                + (f"CORRUPT: {e['corrupt']}" if "corrupt" in e else prov)
+            )
+        print(json.dumps(cache.summary()))
+        return 0
+    if args.action == "clear":
+        n = cache.clear()
+        print(json.dumps({**cache.summary(), "removed": n}))
+        return 0
+    if args.action == "prune":
+        if args.max_mb is None:
+            print("error: prune needs --max-mb", file=sys.stderr)
+            return 2
+        removed = cache.prune(int(args.max_mb * 1e6))
+        print(json.dumps({**cache.summary(), "removed": removed}))
+        return 0
+
+    # warm: compile-or-load every distinct shape the config's feed realizes
+    if not args.config:
+        print("error: warm needs --config", file=sys.stderr)
+        return 2
+    from paddle_tpu.core.batch import batch_shape_key
+    from paddle_tpu.parallel.mesh import shard_batch
+    from paddle_tpu.utils import flags as _flags
+    from paddle_tpu.v1_compat import make_batched_reader, parse_config
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
+    )
+    _flags.set_flag("aot_cache_dir", args.dir)
+    config_path = os.path.abspath(args.config)
+    parsed = parse_config(config_path, args.config_args)
+    if args.batch_size:
+        parsed.settings.batch_size = args.batch_size
+    trainer = _make_trainer(parsed, _flags.get_flag("seed"))
+    reader = make_batched_reader(
+        parsed, os.path.dirname(config_path), parsed.settings.batch_size,
+        train=True,
+    )
+    feeder = trainer._make_feeder(parsed.feeding)
+    seen = set()
+    t0 = time.time()
+    for raw in reader():
+        # shape-dedup on the HOST feeder batch: staging is shape-preserving
+        # and the scan must not pay a full-dataset H2D transfer to discover
+        # a handful of rungs — only the first batch of each new shape ever
+        # touches the device
+        fed = feeder(raw)
+        key = batch_shape_key(fed)
+        if key in seen:
+            continue
+        seen.add(key)
+        trainer.warm_compile(shard_batch(fed, trainer.mesh))
+        if len(seen) >= args.max_shapes:
+            break
+    summary = {
+        **trainer._aot_cache.summary(),
+        "config": args.config,
+        "shapes": len(seen),
+        "warm_s": round(time.time() - t0, 3),
+    }
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_lint(argv: List[str]) -> int:
     """``paddle-tpu lint`` — static analysis (analysis/):
 
@@ -773,7 +963,11 @@ def cmd_lint(argv: List[str]) -> int:
       (rules G###) with layer + config provenance;
     * --journal=master_journal-000001.log: verify a master journal file —
       framing/CRC (J001), unknown record types (J002, the version-skew
-      hard error), sequence monotonicity (J003), torn tail (J004).
+      hard error), sequence monotonicity (J003), torn tail (J004);
+    * --donation: buffer-donation audit (rule T106) over the shipped step
+      builders — trace make_train_step / make_multi_train_step / the
+      whole-pass epoch program on a probe network and flag any large
+      carried buffer that would be copied instead of donated.
 
     Exit 0 only when no diagnostics fire (``make lint``'s contract)."""
     ap = argparse.ArgumentParser(
@@ -791,6 +985,9 @@ def cmd_lint(argv: List[str]) -> int:
     ap.add_argument("--journal", action="append", default=[],
                     help="master journal file to verify (repeatable; "
                     "rules J###; skips the self-lint)")
+    ap.add_argument("--donation", action="store_true",
+                    help="audit the shipped step builders' buffer donation "
+                    "(rule T106; skips the self-lint)")
     ap.add_argument("--min-severity", default=None,
                     choices=["info", "warning", "error"],
                     help="only report findings at or above this severity")
@@ -810,6 +1007,8 @@ def cmd_lint(argv: List[str]) -> int:
                     message=f["message"],
                     source=jpath,
                 ))
+    if args.donation:
+        diags.extend(_donation_audit_builders())
     if args.config:
         from paddle_tpu.v1_compat import parse_config
 
@@ -830,7 +1029,7 @@ def cmd_lint(argv: List[str]) -> int:
                 )
                 continue
             diags.extend(analysis.lint_parsed(parsed))
-    if not args.config and not args.journal:
+    if not args.config and not args.journal and not args.donation:
         diags = analysis.lint_package(extra_paths=args.extra)
 
     if args.min_severity:
@@ -849,6 +1048,7 @@ _COMMANDS = {
     "merge_model": cmd_merge_model,
     "plotcurve": cmd_plotcurve,
     "lint": cmd_lint,
+    "cache": cmd_cache,
     "worker": cmd_worker,
     "master": cmd_master,
 }
@@ -867,6 +1067,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    plotcurve         plot training curves from a log")
         print("    lint              static analysis: graph-lint a config, or")
         print("                      self-lint the package source")
+        print("    cache             AOT executable cache: ls / warm / prune /")
+        print("                      clear a persistent compile cache dir")
         print("    master            run an HA master candidate (elastic")
         print("                      scale-out: registry + shard leases)")
         print("    worker            run one elastic trainer process against")

@@ -180,6 +180,17 @@ class Optimizer:
         samples_per_step: float = 1.0,
     ):
         self.learning_rate = learning_rate
+        # the schedule itself is a closure; keep its constructor args as
+        # primitives so the optimizer's identity is fingerprintable (the
+        # AOT executable cache keys compiled steps by it — two optimizers
+        # that bake different schedule constants must never share an entry)
+        self._schedule_args = (
+            learning_rate_schedule,
+            learning_rate_decay_a,
+            learning_rate_decay_b,
+            learning_rate_max_steps,
+            learning_rate_args,
+        )
         self.schedule = make_schedule(
             learning_rate_schedule,
             learning_rate_decay_a,

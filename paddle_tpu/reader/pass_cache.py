@@ -139,6 +139,7 @@ class PassCache:
         self.nbytes = 0
         self._batches: List[Any] = []
         self._bucket_counts: Dict[tuple, int] = {}
+        self._stacked = None  # capture-order stack (whole-pass program)
 
     @classmethod
     def from_flags(cls, reader=None, seed: Optional[int] = None,
@@ -222,6 +223,7 @@ class PassCache:
         self.ready = False
         self._batches = []
         self._bucket_counts = {}
+        self._stacked = None
         self.nbytes = 0
 
     def seal(self) -> None:
@@ -280,24 +282,59 @@ class PassCache:
             yield from self.epoch(p)
             p += 1
 
+    def sample_batch(self):
+        """One cached batch (capture order), for shape-keying the compiled
+        programs that will consume this pass."""
+        assert self.ready, "pass cache not sealed"
+        return self._batches[0]
+
+    def fits_stacked(self) -> bool:
+        """Whether holding the stacked capture-order copy IN ADDITION to
+        the per-batch cache fits the HBM budget — the whole-pass program
+        costs a second copy of the pass, and a pass captured just under
+        the budget must not silently double past it (the feed switch falls
+        back to stepwise replay instead)."""
+        return self.budget is None or 2 * self.nbytes <= self.budget
+
+    def stacked(self):
+        """The cached pass stacked on a leading [N, ...] axis in CAPTURE
+        order — built once, held for the cache's lifetime, and reused by
+        every epoch of the whole-pass program (the per-epoch shuffle rides
+        as a permutation argument INSIDE the program, so replaying an
+        epoch is one dispatch, not a restack).  Single-bucket only; costs
+        one extra copy of the pass in HBM — callers gate on
+        :meth:`fits_stacked` (SGD's feed switch does)."""
+        assert self.ready, "pass cache not sealed; nothing to stack"
+        assert self.n_buckets <= 1, (
+            "stacked() needs a single shape bucket; this cache holds "
+            f"{self.n_buckets} (use epoch() for bucketed replay)"
+        )
+        if self._stacked is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *self._batches
+            )
+        return self._stacked
+
+    def epoch_perm(self, pass_id: int):
+        """This epoch's replay order as a device int32 vector — the
+        permutation argument of the whole-pass epoch program."""
+        import jax.numpy as jnp
+
+        return jnp.asarray(self.epoch_order(pass_id), jnp.int32)
+
     def stacked_pass(self, pass_id: int):
         """The whole cached pass stacked on a leading [N, ...] axis in this
         epoch's shuffled order — ready for ``make_multi_train_step`` so a
         full cached epoch (or several, concatenated) runs in ONE dispatch.
         Requires a single shape bucket (stacking is shape-homogeneous; the
         bucketed feed replays via :meth:`epoch` instead)."""
-        assert self.ready, "pass cache not sealed; nothing to replay"
-        assert self.n_buckets <= 1, (
-            "stacked_pass needs a single shape bucket; this cache holds "
-            f"{self.n_buckets} (use epoch() for bucketed replay)"
-        )
         import jax
-        import jax.numpy as jnp
 
-        order = self.epoch_order(pass_id)
-        return jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *[self._batches[i] for i in order]
-        )
+        perm = self.epoch_perm(pass_id)
+        return jax.tree_util.tree_map(lambda x: x[perm], self.stacked())
 
     # -- introspection ---------------------------------------------------
     def summary(self) -> Dict[str, Any]:

@@ -138,6 +138,29 @@ define_flag("pass_cache_hbm_budget_mb", 4096,
             "in wire form / data-axis size (uint8 224x224x3 ~ 0.15 "
             "MB/image; a batch sharded over n chips counts its largest "
             "per-device shard)")
+define_flag("aot_cache_dir", "",
+            "persistent AOT executable cache directory (core/aot_cache.py): "
+            "every train-step/epoch-program variant the shape ladder "
+            "realizes is serialized to disk after its first compile, and a "
+            "later process boot DESERIALIZES instead of paying the full XLA "
+            "retrace (warm boot).  Entries are keyed by topology "
+            "fingerprint, ladder rung, mesh, dtype/donation signature and "
+            "jax+backend version — stale or foreign entries are detected "
+            "and retraced, never loaded wrong.  Prewarm the full rung set "
+            "offline with `paddle-tpu cache warm`; empty = off (today's "
+            "retrace path).  jax builds without executable serialization "
+            "degrade gracefully to retracing")
+define_flag("whole_pass_program", False,
+            "whole-pass on-device epoch program: when the device-resident "
+            "pass cache holds a sealed single-bucket pass, epochs >= 2 run "
+            "as ONE jitted lax.scan over the stacked cache (trainer/step."
+            "py make_epoch_program) — O(1) host dispatches per epoch "
+            "instead of one per batch, bit-exact against the stepwise "
+            "path (sentinel skip semantics included).  Requires "
+            "cache_pass_in_mem; falls back to stepwise replay for "
+            "bucketed (multi-shape) passes, sample_shuffle, or runs with "
+            "a checkpoint/rollback plane (per-step anchors need the host "
+            "loop).  Costs one extra stacked copy of the pass in HBM")
 define_flag("divergence_sentinel", True,
             "fold a device-side finiteness check of loss + gradient global-"
             "norm into the jitted train step (robustness/): one fused "

@@ -212,3 +212,104 @@ def test_generator_params_as_argument_no_t102():
         const_elem_threshold=256,
     )
     assert "T102" in rules(bad)
+
+
+# ---------------------------------------------------------------------------
+# T106: buffer-donation audit
+# ---------------------------------------------------------------------------
+
+
+def _mlp_step_parts():
+    import paddle_tpu.optimizer as O
+    from paddle_tpu.core.compiler import CompiledNetwork
+
+    reset_auto_names()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(64))
+    h = paddle.layer.fc(x, size=256, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(h, size=10, act=paddle.activation.Softmax())
+    y = paddle.layer.data("y", paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(input=pred, label=y)
+    net = CompiledNetwork(Topology([cost]))
+    opt = O.Adam(learning_rate=1e-3)
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "x": SeqTensor(jnp.zeros((8, 64), jnp.float32)),
+        "y": SeqTensor(jnp.zeros((8,), jnp.int32)),
+    }
+    return net, opt, (params, state, opt.init(params), batch,
+                      jax.random.PRNGKey(1))
+
+
+def test_t106_undonated_carry_fires():
+    """A jitted train step WITHOUT donate_argnums double-buffers params and
+    Adam slots — T106 names the copied argnums."""
+    from paddle_tpu.analysis import donation_audit
+    from paddle_tpu.trainer.step import _train_step_body
+
+    net, opt, args = _mlp_step_parts()
+    undonated = jax.jit(_train_step_body(net, opt))
+    d = donation_audit(undonated, *args)
+    assert "T106" in rules(d)
+    # params (argnum 0) and opt slots (argnum 2) both carry large buffers
+    assert any("argument 0" in x.message for x in d), format_diagnostics(d)
+    assert any("argument 2" in x.message for x in d), format_diagnostics(d)
+
+
+def test_t106_explicit_donate_argnums_on_plain_fn():
+    """For an un-jitted fn the audit takes the donation the builder intends
+    as an argument — same rule, no pjit eqn to introspect."""
+    from paddle_tpu.analysis import donation_audit
+    from paddle_tpu.trainer.step import _train_step_body
+
+    net, opt, args = _mlp_step_parts()
+    body = _train_step_body(net, opt)
+    assert "T106" in rules(donation_audit(body, *args))
+    d = donation_audit(body, *args, donate_argnums=(0, 1, 2))
+    assert d == [], format_diagnostics(d)
+
+
+def test_t106_shipped_builders_are_clean():
+    """The shipped step builders donate their carried state: make_train_step
+    (params/state/opt-state), make_multi_train_step, and the whole-pass
+    epoch program (the carry pytree) all audit clean — the `make lint`
+    --donation gate."""
+    from paddle_tpu.analysis import donation_audit
+    from paddle_tpu.trainer.step import (
+        make_epoch_program,
+        make_multi_train_step,
+        make_train_carry,
+        make_train_step,
+    )
+
+    net, opt, args = _mlp_step_parts()
+    params, state, opt_state, batch, rng = args
+    d = donation_audit(make_train_step(net, opt, mesh=None), *args)
+    assert d == [], format_diagnostics(d)
+    k = 4
+    stacked = jax.tree_util.tree_map(lambda v: jnp.stack([v] * k), batch)
+    d = donation_audit(
+        make_multi_train_step(net, opt, k, mesh=None),
+        params, state, opt_state, stacked, rng,
+    )
+    assert d == [], format_diagnostics(d)
+    carry = make_train_carry(params, state, opt_state, rng)
+    d = donation_audit(
+        make_epoch_program(net, opt, mesh=None),
+        carry, stacked, jnp.arange(k),
+    )
+    assert d == [], format_diagnostics(d)
+
+
+def test_t106_read_only_inputs_never_flag():
+    """A large input that is NOT returned (batch data) has no copy to save
+    — the audit must not demand donating the feed."""
+    from paddle_tpu.analysis import donation_audit
+
+    def fn(w, big_batch):
+        return w + big_batch.sum()
+
+    d = donation_audit(
+        fn, jnp.zeros((256, 256)), jnp.zeros((512, 512)), donate_argnums=()
+    )
+    # w IS returned updated (matching aval) -> flagged; batch is not
+    assert all("argument 1" not in x.message for x in d)
