@@ -3,9 +3,11 @@
 #
 #   make lint    — static analysis: AST self-lint over paddle_tpu + bench.py
 #                  (analysis/ast_rules), graph-lint over every shipped
-#                  demo config (tests/configs/), and the T106 buffer-
+#                  demo config (tests/configs/), the T106 buffer-
 #                  donation audit over the step builders (incl. the
-#                  whole-pass epoch program).  Zero findings = pass.
+#                  whole-pass epoch program), and the C-rules lock-
+#                  discipline lint over the threaded planes
+#                  (analysis/concurrency_lint).  Zero findings = pass.
 #   make test    — fast tier: lint, then every test not marked `slow`;
 #                  < 6 min on the virtual 8-device CPU mesh.  The CI gate.
 #   make verify  — the full suite, then a bench smoke (one metric), the
@@ -41,6 +43,7 @@ lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint \
 		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --donation
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --concurrency
 
 test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
@@ -52,10 +55,14 @@ tier1-check:
 tier1-update:
 	$(CPU_ENV) $(PY) scripts/tier1_failset.py --update
 
+# chaos drills run SANITIZER-ARMED: every lock constructed through the
+# analysis/lock_sanitizer factories is instrumented, so each failover /
+# kill-one-of-N fleet drill doubles as a runtime lock-order race detector
+# (a cycle raises DeadlockReport and fails the drill)
 chaos:
-	$(CPU_ENV) $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
-	$(CPU_ENV) $(PY) -m pytest tests/test_elastic_e2e.py -q
-	$(CPU_ENV) $(PY) -m pytest tests/test_master_failover_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_elastic_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -1,6 +1,6 @@
 """Static analysis — config-time diagnostics and jaxpr-level TPU hazard
 checks (the reference ``config_parser.py`` config_assert plane, grown into
-three passes over the trace-time graph stack):
+five passes over the trace-time graph stack):
 
   * :mod:`~paddle_tpu.analysis.graph_lint` — abstract shape/dtype/arity
     propagation over the Topology IR before any trace (rules ``G###``);
@@ -8,52 +8,65 @@ three passes over the trace-time graph stack):
     compiled step for TPU hazards: f64 leaks, closure-captured weights,
     host callbacks, recompile churn (rules ``T###``);
   * :mod:`~paddle_tpu.analysis.ast_rules` — self-lint of paddle_tpu's own
-    source for trace-time discipline (rules ``A###``).
+    source for trace-time discipline (rules ``A###``);
+  * :mod:`~paddle_tpu.analysis.concurrency_lint` — lock-discipline lint
+    over the package's own threaded planes (rules ``C###``);
+  * :mod:`~paddle_tpu.analysis.lock_sanitizer` — the RUNTIME leg of the
+    concurrency plane: instrumented locks (``PADDLE_TPU_LOCK_SANITIZER=1``)
+    that detect lock-order cycles while the chaos drills run.
 
 All passes share one diagnostic model (rule id, severity, layer/file
 provenance, fix hint — :mod:`~paddle_tpu.analysis.diagnostics`) and are
 wired into the CLI as ``paddle-tpu lint`` / ``make lint``.
+
+Submodules import lazily (PEP 562): ``trace_lint``/``graph_lint`` pull jax
+and the core IR, which the jax-free consumers of ``lock_sanitizer`` and
+``diagnostics`` (master.py, the reader plane) must not pay for — the
+``paddle-tpu master`` process stays jax-import-free.
 """
 
-from paddle_tpu.analysis.ast_rules import lint_file, lint_package
-from paddle_tpu.analysis.diagnostics import (
-    Diagnostic,
-    DiagnosticError,
-    Severity,
-    config_assert,
-    errors,
-    format_diagnostics,
-    raise_if_errors,
-)
-from paddle_tpu.analysis.graph_lint import (
-    attr_key_universe,
-    lint_parsed,
-    lint_topology,
-)
-from paddle_tpu.analysis.trace_lint import (
-    donation_audit,
-    lint_jaxpr,
-    lint_step,
-    recompile_audit,
-    trace_step,
-)
+import importlib
+from typing import List
 
-__all__ = [
-    "Diagnostic",
-    "DiagnosticError",
-    "Severity",
-    "attr_key_universe",
-    "config_assert",
-    "donation_audit",
-    "errors",
-    "format_diagnostics",
-    "lint_file",
-    "lint_jaxpr",
-    "lint_package",
-    "lint_parsed",
-    "lint_step",
-    "lint_topology",
-    "raise_if_errors",
-    "recompile_audit",
-    "trace_step",
-]
+# public name -> defining submodule (resolved on first attribute access)
+_EXPORTS = {
+    "Diagnostic": "diagnostics",
+    "DiagnosticError": "diagnostics",
+    "Severity": "diagnostics",
+    "config_assert": "diagnostics",
+    "errors": "diagnostics",
+    "format_diagnostics": "diagnostics",
+    "raise_if_errors": "diagnostics",
+    "lint_file": "ast_rules",
+    "lint_package": "ast_rules",
+    "attr_key_universe": "graph_lint",
+    "lint_parsed": "graph_lint",
+    "lint_topology": "graph_lint",
+    "donation_audit": "trace_lint",
+    "lint_jaxpr": "trace_lint",
+    "lint_step": "trace_lint",
+    "recompile_audit": "trace_lint",
+    "trace_step": "trace_lint",
+    "lint_concurrency_file": "concurrency_lint",
+    "lint_concurrency_package": "concurrency_lint",
+    "DeadlockReport": "lock_sanitizer",
+    "make_lock": "lock_sanitizer",
+    "make_rlock": "lock_sanitizer",
+    "sanitizer_enabled": "lock_sanitizer",
+}
+
+__all__: List[str] = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod_name = _EXPORTS.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    mod = importlib.import_module(f"{__name__}.{mod_name}")
+    value = getattr(mod, name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

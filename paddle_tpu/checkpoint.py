@@ -171,7 +171,8 @@ class CheckpointManager:
 
             # Non-daemon: interpreter exit joins it, so a checkpoint started
             # at the end of a script is never silently truncated.
-            t = threading.Thread(target=run, daemon=False)
+            t = threading.Thread(target=run, name="paddle-ckpt-write",
+                                 daemon=False)
             t.start()
             self._pending = t
         else:
@@ -242,7 +243,8 @@ class CheckpointManager:
                 except BaseException as exc:  # surfaced by the next wait()
                     self._pending_error = exc
 
-            t = threading.Thread(target=run, daemon=False)
+            t = threading.Thread(target=run, name="paddle-ckpt-shard",
+                                 daemon=False)
             t.start()
             self._pending = t
         else:

@@ -777,7 +777,7 @@ def cmd_master(argv: List[str]) -> int:
             announced = True
         elif not ha.is_leader.is_set():
             announced = False
-        time.sleep(0.2)
+        time.sleep(0.2)  # lock: allow[C306] CLI supervision loop: wall-clock by design, driven end-to-end by the failover drills
     ha.stop()
     return 0
 
@@ -967,7 +967,12 @@ def cmd_lint(argv: List[str]) -> int:
     * --donation: buffer-donation audit (rule T106) over the shipped step
       builders — trace make_train_step / make_multi_train_step / the
       whole-pass epoch program on a probe network and flag any large
-      carried buffer that would be copied instead of donated.
+      carried buffer that would be copied instead of donated;
+    * --concurrency: lock-discipline lint (rules C###) over the package
+      source — guarded-field consistency, static lock-order cycles,
+      blocking-under-lock, thread-leak and injectable-clock checks
+      (the static leg of the concurrency plane; the runtime leg is
+      PADDLE_TPU_LOCK_SANITIZER=1 on the chaos drills).
 
     Exit 0 only when no diagnostics fire (``make lint``'s contract)."""
     ap = argparse.ArgumentParser(
@@ -988,6 +993,9 @@ def cmd_lint(argv: List[str]) -> int:
     ap.add_argument("--donation", action="store_true",
                     help="audit the shipped step builders' buffer donation "
                     "(rule T106; skips the self-lint)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="lock-discipline lint (rules C###) over the "
+                    "package source (skips the self-lint)")
     ap.add_argument("--min-severity", default=None,
                     choices=["info", "warning", "error"],
                     help="only report findings at or above this severity")
@@ -1009,6 +1017,12 @@ def cmd_lint(argv: List[str]) -> int:
                 ))
     if args.donation:
         diags.extend(_donation_audit_builders())
+    if args.concurrency:
+        from paddle_tpu.analysis.concurrency_lint import (
+            lint_concurrency_package,
+        )
+
+        diags.extend(lint_concurrency_package(extra_paths=args.extra))
     if args.config:
         from paddle_tpu.v1_compat import parse_config
 
@@ -1029,7 +1043,8 @@ def cmd_lint(argv: List[str]) -> int:
                 )
                 continue
             diags.extend(analysis.lint_parsed(parsed))
-    if not args.config and not args.journal and not args.donation:
+    if not (args.config or args.journal or args.donation
+            or args.concurrency):
         diags = analysis.lint_package(extra_paths=args.extra)
 
     if args.min_severity:

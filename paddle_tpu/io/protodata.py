@@ -202,7 +202,9 @@ _NATIVE_SRC = os.path.join(_PKG_ROOT, "native", "protodata.cc")
 _NATIVE_SO = os.path.join(_PKG_ROOT, "native", "build", "libpaddle_tpu_protodata.so")
 _native_lib = None
 _native_tried = False
-_native_lock = threading.Lock()
+from paddle_tpu.analysis.lock_sanitizer import make_lock
+
+_native_lock = make_lock("io.protodata._native_lock")
 
 
 def _load_native():
@@ -224,7 +226,7 @@ def _load_native():
                 # (pytest workers, multi-process launch) must never CDLL a
                 # half-written .so
                 tmp = f"{_NATIVE_SO}.{os.getpid()}.tmp"
-                subprocess.run(
+                subprocess.run(  # lock: allow[C304] one-time lazy native build; the lock exists to serialize exactly this compile
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      _NATIVE_SRC, "-o", tmp],
                     check=True, capture_output=True,

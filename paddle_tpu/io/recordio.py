@@ -21,6 +21,7 @@ import os
 import struct
 import subprocess
 import threading
+import time
 import queue as _queue
 import zlib
 from typing import Iterable, List, Optional, Sequence
@@ -36,7 +37,10 @@ _SO = os.path.join(_BUILD_DIR, "libpaddle_tpu_io.so")
 
 _lib = None
 _lib_tried = False
-_lib_lock = threading.Lock()
+from paddle_tpu.analysis.lock_sanitizer import make_lock
+from paddle_tpu.utils.queues import bounded_put as _bounded_put
+
+_lib_lock = make_lock("io.recordio._lib_lock")
 
 
 def _load_native():
@@ -57,7 +61,7 @@ def _load_native():
                 # per-pid temp + rename: concurrent processes must never
                 # CDLL a half-written .so
                 tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
+                subprocess.run(  # lock: allow[C304] one-time lazy native build; the lock exists to serialize exactly this compile
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      "-pthread", _SRC, "-o", tmp],
                     check=True, capture_output=True,
@@ -313,7 +317,7 @@ class Prefetcher:
         # Guards the native (pointer, copy) pair: the C side reuses one
         # internal record buffer per prefetcher, so the pointer must be
         # copied out before another consumer can advance it.
-        self._next_lock = threading.Lock()
+        self._next_lock = make_lock("io.recordio.Prefetcher._next_lock")
         self._worker_error: Optional[BaseException] = None
         if self._lib is not None:
             arr = (ctypes.c_char_p * len(self._paths))(
@@ -328,14 +332,19 @@ class Prefetcher:
             self._n_workers = max(1, min(n_threads, len(self._paths)))
             per = (len(self._paths) + self._n_workers - 1) // self._n_workers
             self._done = 0
-            self._done_lock = threading.Lock()
+            self._done_lock = make_lock("io.recordio.Prefetcher._done_lock")
+            self._threads: List[threading.Thread] = []
             for t in range(self._n_workers):
                 part = self._paths[t * per : (t + 1) * per]
-                threading.Thread(
-                    target=self._worker, args=(part,), daemon=True
-                ).start()
+                th = threading.Thread(
+                    target=self._worker, args=(part,),
+                    name=f"paddle-recordio-prefetch-{t}", daemon=True,
+                )
+                self._threads.append(th)
+                th.start()
 
     def _worker(self, paths):
+        stopped = lambda: self._stopped  # noqa: E731 — the shared teardown contract
         try:
             for p in paths:
                 with Reader(p) as r:
@@ -343,14 +352,8 @@ class Prefetcher:
                         # bounded put that notices close(): don't block
                         # forever (leaking the thread + fd) when the
                         # consumer stops early
-                        while True:
-                            if self._stopped:
-                                return
-                            try:
-                                self._q.put(rec, timeout=0.1)
-                                break
-                            except _queue.Full:
-                                continue
+                        if not _bounded_put(self._q, rec, stopped):
+                            return
         except BaseException as exc:  # surfaced to the consumer in next()
             self._worker_error = exc
         finally:
@@ -360,12 +363,7 @@ class Prefetcher:
             if last:
                 # the sentinel must reach a live consumer even if the queue
                 # is momentarily full; only a close() may drop it
-                while not self._stopped:
-                    try:
-                        self._q.put(None, timeout=0.1)
-                        break
-                    except _queue.Full:
-                        continue
+                _bounded_put(self._q, None, stopped)
 
     def next(self) -> Optional[bytes]:
         if self._lib is not None:
@@ -403,12 +401,21 @@ class Prefetcher:
                 self._h = None
             return
         self._stopped = True
-        # unblock any worker waiting on a full queue
-        while True:
-            try:
-                self._q.get_nowait()
-            except _queue.Empty:
-                break
+        # unblock any worker waiting on a full queue, then JOIN them: a
+        # worker's puts are bounded polls against _stopped, so every thread
+        # (and its open Reader fd) is gone when close() returns — the
+        # teardown-leak contract thread_report() checks.  The join is
+        # DEADLINED: a worker wedged inside file i/o (hung NFS read never
+        # reaches a _stopped check) must degrade to leaking one daemon
+        # thread, not hang every `with Prefetcher(...)` exit forever
+        deadline = time.monotonic() + 5.0
+        for th in self._threads:
+            while th.is_alive() and time.monotonic() < deadline:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    pass
+                th.join(timeout=0.2)
 
     def __enter__(self):
         return self

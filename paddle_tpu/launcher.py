@@ -122,6 +122,7 @@ def launch(
     elastic: bool = False,
     extra_env: Optional[Dict[int, Dict[str, str]]] = None,
     exit_codes: Optional[List[int]] = None,
+    sleep=time.sleep,
 ) -> int:
     """Start every worker and wait.
 
@@ -159,7 +160,7 @@ def launch(
                     (p.poll() for p in procs if p.poll() not in (None, 0)), 0
                 )
                 if rc == 0:
-                    time.sleep(poll_interval)
+                    sleep(poll_interval)
             if rc:  # tear the job down on first failure
                 for p in procs:
                     if p.poll() is None:

@@ -60,6 +60,7 @@ from multiprocessing.connection import Client as _ConnClient, Listener
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu import master_journal as _mj
+from paddle_tpu.analysis.lock_sanitizer import make_lock, make_rlock
 from paddle_tpu.io import recordio
 from paddle_tpu.robustness import chaos as _chaos
 
@@ -153,7 +154,7 @@ class Service:
         journal — keeping task leases, results, registry and fences warm
         across a master death.  ``journal=False`` keeps the legacy
         debounced-snapshot behavior byte-for-byte."""
-        self._lock = threading.RLock()
+        self._lock = make_rlock("master.Service._lock")
         self._clock = clock  # injectable for deterministic lease tests
         self.chunks_per_task = chunks_per_task
         self.timeout_s = timeout_s
@@ -974,7 +975,7 @@ class Service:
                 # the old generation is swept.  Legacy mode stays the
                 # best-effort debounced write it always was.
                 f.flush()
-                os.fsync(f.fileno())
+                os.fsync(f.fileno())  # lock: allow[C304] compaction publish: the snapshot must be durable before the old journal generation is swept — fsync-before-ack IS the durability contract
         os.replace(tmp, self.snapshot_path)
 
     def load_state(self, state: Dict[str, Any], warm: bool = True) -> None:
@@ -1243,7 +1244,7 @@ def _dial_with_deadline(address, authkey: bytes, timeout: Optional[float]):
     box: Dict[str, Any] = {}
     done = threading.Event()
     abandoned = threading.Event()
-    lock = threading.Lock()  # serializes the store-vs-abandon handoff
+    lock = make_lock("master._dial_handoff")  # serializes the store-vs-abandon handoff
 
     def _dial():
         try:
@@ -1258,7 +1259,8 @@ def _dial_with_deadline(address, authkey: bytes, timeout: Optional[float]):
         finally:
             done.set()
 
-    t = threading.Thread(target=_dial, daemon=True)
+    t = threading.Thread(target=_dial, name="paddle-master-dial",
+                         daemon=True)
     t.start()
     if not done.wait(timeout):
         # the helper may complete the dial concurrently with this timeout:
@@ -1327,15 +1329,19 @@ class Server:
     """Serve a Service over multiprocessing.connection — the process/network
     boundary of the Go master's net/rpc server."""
 
-    def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu"):
+    def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu",
+                 sleep=time.sleep):
         self.service = service
         self._authkey = authkey
+        self._sleep = sleep  # injectable: tests drive the accept-loop backoff
         self._listener = Listener(address, authkey=authkey)
         self.address = self._listener.address
         self._stop = False
         self._conns: List = []
-        self._conns_lock = threading.Lock()
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._conns_lock = make_lock("master.Server._conns_lock")
+        self._thread = threading.Thread(
+            target=self._serve, name="paddle-master-accept", daemon=True
+        )
         self._thread.start()
 
     def _serve(self) -> None:
@@ -1359,7 +1365,7 @@ class Server:
                     # connect.  The LISTENER is fine — bailing out here
                     # would leave the port bound-but-dead with clients
                     # queueing in the backlog until their dial deadlines
-                    time.sleep(0.05)
+                    self._sleep(0.05)
                     continue
                 # the listening socket itself is broken: close it so
                 # clients get RST (fail fast into their retry loops)
@@ -1388,7 +1394,8 @@ class Server:
                     pass
                 return
             threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
+                target=self._handle, args=(conn,),
+                name="paddle-master-conn", daemon=True,
             ).start()
 
     def _handle(self, conn) -> None:
@@ -1455,6 +1462,7 @@ class Client:
         reconnect_tries: int = 5,
         reconnect_backoff: float = 0.1,
         call_timeout_s: Optional[float] = 60.0,
+        sleep=time.sleep,
     ):
         """``call_timeout_s`` is the per-RPC deadline (dial + reply): a
         call against a half-open socket — a master that bounced without an
@@ -1463,6 +1471,7 @@ class Client:
         self.call_timeout_s = (
             None if call_timeout_s is None else float(call_timeout_s)
         )
+        self._sleep = sleep  # injectable: reconnect backoff + lease polls
         if isinstance(master, Service):
             self._service = master
             self._conn = None
@@ -1473,7 +1482,7 @@ class Client:
             self._conn = _dial_with_deadline(
                 self._address, authkey, self.call_timeout_s
             )
-            self._conn_lock = threading.Lock()
+            self._conn_lock = make_lock("master.Client._conn_lock")
         self.reconnect_tries = max(int(reconnect_tries), 1)
         self.reconnect_backoff = float(reconnect_backoff)
         self.trainer_id = trainer_id
@@ -1523,7 +1532,7 @@ class Client:
                             self._address, self._authkey, self.call_timeout_s
                         )
                     try:
-                        self._conn.send((method, args))
+                        self._conn.send((method, args))  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
                     except BlockingIOError as exc:
                         # SO_SNDTIMEO fired: the peer stopped draining its
                         # socket mid-request (frozen master, full buffer)
@@ -1540,7 +1549,7 @@ class Client:
                             f"frozen master); the call may have executed"
                         )
                     try:
-                        ok, result = self._conn.recv()
+                        ok, result = self._conn.recv()  # lock: allow[C304] same intentional hold: one in-flight RPC per connection, bounded by SO_RCVTIMEO
                     except BlockingIOError as exc:
                         # SO_RCVTIMEO fired mid-message: the peer froze
                         # after sending a PARTIAL reply — past poll()'s
@@ -1566,7 +1575,10 @@ class Client:
                             f"master RPC {method}: transport failed after "
                             f"{self.reconnect_tries} attempt(s): {exc!r}"
                         ) from exc
-                    time.sleep(self.reconnect_backoff * (2 ** attempt))
+                    # backoff keeps _conn_lock deliberately: a second
+                    # caller dialing concurrently would race the fresh
+                    # connection (injected sleep: tests drive it)
+                    self._sleep(self.reconnect_backoff * (2 ** attempt))
         if not ok:
             raise MasterRPCError(f"master RPC {method} failed: {result}")
         return result
@@ -1620,7 +1632,7 @@ class Client:
             if got is None:
                 return None
             if got == "wait":  # other workers hold the remaining leases
-                time.sleep(0.01)
+                self._sleep(0.01)
                 continue
             fetched: List[bytes] = []
             try:

@@ -216,7 +216,9 @@ class HAMaster:
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="paddle-ha-campaign", daemon=True
+        )
         self._thread.start()
 
     def wait_leader(self, timeout: Optional[float] = None) -> bool:
@@ -468,9 +470,11 @@ class HAClient:
     re-resolves + reconnects when the master fails over (the reference
     client watches etcd and reconnects, client.go)."""
 
-    def __init__(self, dir_: str, timeout: float = 30.0, **client_kw):
+    def __init__(self, dir_: str, timeout: float = 30.0,
+                 sleep=time.sleep, **client_kw):
         self.dir = dir_
         self.timeout = timeout
+        self._sleep = sleep  # injectable: discovery/re-dial poll loops
         self._client_kw = client_kw
         self._client: Optional[Client] = None
         self._endpoint = None
@@ -482,12 +486,12 @@ class HAClient:
             ep = discover_endpoint(self.dir)
             if ep is not None:
                 try:
-                    c = Client(ep, **self._client_kw)
+                    c = Client(ep, sleep=self._sleep, **self._client_kw)
                     self._endpoint = ep
                     return c
                 except (ConnectionError, OSError) as e:
                     last_err = e
-            time.sleep(0.1)
+            self._sleep(0.1)
         raise TimeoutError(f"no master leader in {self.dir}: {last_err}")
 
     def _call(self, method, *args):
@@ -508,7 +512,7 @@ class HAClient:
                 self._client = None
                 if time.time() > deadline:
                     raise
-                time.sleep(0.2)
+                self._sleep(0.2)
 
     # -- surface (the Client subset trainers use) ------------------------
     def set_dataset(self, patterns):

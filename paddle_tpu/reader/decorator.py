@@ -11,6 +11,8 @@ import random as _random
 import threading
 from typing import Any, Callable, Iterable, List
 
+from paddle_tpu.utils.queues import bounded_put
+
 Reader = Callable[[], Iterable[Any]]
 
 
@@ -94,28 +96,54 @@ def compose(*readers: Reader, check_alignment: bool = True) -> Reader:
 def buffered(reader: Reader, size: int) -> Reader:
     """Background-thread prefetch queue (decorator.py:160) — the host-side
     double-buffering that replaces the reference DataProvider's async load
-    thread (paddle/gserver/dataproviders/DataProvider.h DoubleBuffer)."""
+    thread (paddle/gserver/dataproviders/DataProvider.h DoubleBuffer).
+
+    Teardown contract: abandoning the iteration early (``break``, GC,
+    ``.close()`` on the generator) stops and JOINS the fill thread — the
+    worker's puts are bounded polls against a stop flag, so it can never
+    stay parked forever on a full queue (the leak class the lock
+    sanitizer's thread_report drills check for).  A reader that raises on
+    the fill thread re-raises on the CONSUMING thread (the DevicePrefetcher
+    discipline) instead of silently truncating the stream."""
 
     class _End:
         pass
 
     def buffered_reader():
         q: queue.Queue = queue.Queue(maxsize=size)
+        stop = threading.Event()
+        error: List[BaseException] = []
 
         def fill():
             try:
                 for d in reader():
-                    q.put(d)
+                    if not bounded_put(q, d, stop.is_set):
+                        return
+            except BaseException as e:  # re-raised by the consumer
+                error.append(e)
             finally:
-                q.put(_End)
+                bounded_put(q, _End, stop.is_set)
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(
+            target=fill, name="paddle-buffered-fill", daemon=True
+        )
         t.start()
-        while True:
-            e = q.get()
-            if e is _End:
-                return
-            yield e
+        try:
+            while True:
+                e = q.get()
+                if e is _End:
+                    if error:
+                        raise error[0]
+                    return
+                yield e
+        finally:
+            stop.set()
+            while True:  # wake a worker parked on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)
 
     return buffered_reader
 
@@ -150,7 +178,15 @@ def cache(reader: Reader) -> Reader:
 
 
 def xmap_readers(mapper, reader: Reader, process_num: int, buffer_size: int, order: bool = False) -> Reader:
-    """Parallel map over a thread pool (decorator.py:230)."""
+    """Parallel map over a thread pool (decorator.py:230).
+
+    Same teardown contract as :func:`buffered`: a consumer that abandons
+    the loop early stops, wakes, and joins the feed + worker threads —
+    every queue op in the pool is a bounded poll against the stop flag.
+    A mapper (or source reader) that raises re-raises on the CONSUMING
+    thread: the dying thread still delivers its end sentinel, so the
+    consumer drains, learns the error, and tears the pool down instead of
+    blocking forever on a stream that will never finish."""
 
     class _End:
         pass
@@ -158,44 +194,88 @@ def xmap_readers(mapper, reader: Reader, process_num: int, buffer_size: int, ord
     def xreader():
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
+        stop = threading.Event()
+        errors: List[BaseException] = []
+
+        def _get(q: queue.Queue):
+            while not stop.is_set():
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+            return _End
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, sample in enumerate(reader()):
+                    if not bounded_put(in_q, (i, sample), stop.is_set):
+                        return
+            except BaseException as e:  # surfaced by the consumer
+                errors.append(e)
+            finally:
+                # always hand every worker its sentinel — a dead feed must
+                # not strand the pool waiting on in_q
+                for _ in range(process_num):
+                    if not bounded_put(in_q, _End, stop.is_set):
+                        return
 
         def work():
-            while True:
-                item = in_q.get()
+            try:
+                while True:
+                    item = _get(in_q)
+                    if item is _End:
+                        return
+                    i, sample = item
+                    if not bounded_put(out_q, (i, mapper(sample)),
+                                       stop.is_set):
+                        return
+            except BaseException as e:  # surfaced by the consumer
+                errors.append(e)
+            finally:
+                bounded_put(out_q, _End, stop.is_set)
+
+        threads = [threading.Thread(
+            target=feed, name="paddle-xmap-feed", daemon=True
+        )]
+        threads.extend(
+            threading.Thread(
+                target=work, name=f"paddle-xmap-work-{n}", daemon=True
+            )
+            for n in range(process_num)
+        )
+        for t in threads:
+            t.start()
+
+        try:
+            finished = 0
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
                 if item is _End:
-                    out_q.put(_End)
-                    return
-                i, sample = item
-                out_q.put((i, mapper(sample)))
-
-        threading.Thread(target=feed, daemon=True).start()
-        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
-        for w in workers:
-            w.start()
-
-        finished = 0
-        pending = {}
-        next_i = 0
-        while finished < process_num:
-            item = out_q.get()
-            if item is _End:
-                finished += 1
-                continue
-            if not order:
-                yield item[1]
-            else:
-                pending[item[0]] = item[1]
-                while next_i in pending:
-                    yield pending.pop(next_i)
-                    next_i += 1
-        if order:
-            for i in sorted(pending):
-                yield pending[i]
+                    finished += 1
+                    continue
+                if not order:
+                    yield item[1]
+                else:
+                    pending[item[0]] = item[1]
+                    while next_i in pending:
+                        yield pending.pop(next_i)
+                        next_i += 1
+            if errors:
+                raise errors[0]
+            if order:
+                for i in sorted(pending):
+                    yield pending[i]
+        finally:
+            stop.set()
+            for q in (in_q, out_q):
+                while True:  # wake workers parked on full queues
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+            for t in threads:
+                t.join(timeout=5.0)
 
     return xreader

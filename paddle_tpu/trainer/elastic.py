@@ -240,7 +240,8 @@ class ElasticWorker:
             return
         interval = self._hb_interval or max(worker_timeout_s / 3.0, 0.05)
         self._hb_thread = threading.Thread(
-            target=self._hb_loop, args=(interval,), daemon=True
+            target=self._hb_loop, args=(interval,),
+            name="paddle-elastic-heartbeat", daemon=True,
         )
         self._hb_thread.start()
 

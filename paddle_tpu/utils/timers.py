@@ -32,6 +32,13 @@ class _Stat:
 
 
 class StatSet:
+    """Thread-safe: every mutation (timer/incr/observe) and every read
+    (count/summary) takes ``_lock``, so concurrent counters never lose an
+    increment (stress-tested in test_lock_sanitizer.py).  The lock stays a
+    RAW ``threading.Lock`` deliberately: the lock sanitizer
+    (analysis/lock_sanitizer.py) reports held-time stats INTO this class on
+    every release — a sanitized StatSet lock would recurse."""
+
     def __init__(self) -> None:
         self._stats: Dict[str, _Stat] = {}
         self._lock = threading.Lock()

@@ -790,7 +790,9 @@ import contextlib
 # background feeder resolving relative paths mid-parse) — those windows are
 # only narrowed by keeping each chdir scope as short as possible.  Provider
 # code that must be robust should open paths relative to its own __file__.
-_chdir_lock = threading.RLock()
+from paddle_tpu.analysis.lock_sanitizer import make_rlock
+
+_chdir_lock = make_rlock("v1_compat._chdir_lock")
 
 
 @contextlib.contextmanager

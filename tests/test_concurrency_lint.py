@@ -1,0 +1,646 @@
+"""Concurrency lint (analysis/concurrency_lint.py): the package gate —
+paddle_tpu's own threaded planes produce zero C-findings after triage —
+plus one firing mutation fixture per rule (the test_graph_lint.py
+discipline: seed exactly the violation, assert exactly the rule)."""
+
+import os
+import textwrap
+
+from paddle_tpu.analysis import format_diagnostics
+from paddle_tpu.analysis.concurrency_lint import (
+    lint_concurrency_file,
+    lint_concurrency_package,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules(diags):
+    return sorted(d.rule for d in diags)
+
+
+def _lint_src(tmp_path, src, relname="mod.py"):
+    p = tmp_path / relname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return lint_concurrency_file(str(p), root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the repo gate: the shipped package is clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_concurrency_lint_is_clean():
+    diags = lint_concurrency_package()
+    assert diags == [], format_diagnostics(diags)
+
+
+# ---------------------------------------------------------------------------
+# C301 mixed-guard write
+# ---------------------------------------------------------------------------
+
+
+def test_c301_write_outside_guarding_lock(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def push(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def reset(self):
+                self.items = []          # C301: no lock
+    """)
+    assert rules(d) == ["C301"]
+    assert "items" in d[0].message and d[0].line == 14
+
+
+def test_c301_guarded_helper_via_call_site_propagation(tmp_path):
+    # _drain is only called under the lock: analyzed as guarded, no C301
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def push(self, x):
+                with self._lock:
+                    self.items.append(x)
+                    self._drain()
+
+            def _drain(self):
+                self.items = []
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c301_init_writes_are_exempt(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []          # single-threaded by construction
+
+            def push(self, x):
+                with self._lock:
+                    self.items.append(x)
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c301_module_global_written_without_module_lock(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = None
+
+        def load():
+            global _cache
+            with _lock:
+                _cache = 1
+
+        def clear():
+            global _cache
+            _cache = None            # C301: other writes hold _lock
+    """)
+    assert rules(d) == ["C301"]
+
+
+# ---------------------------------------------------------------------------
+# C302 unguarded read on a thread-entry path
+# ---------------------------------------------------------------------------
+
+
+def test_c302_thread_entry_reads_guarded_field_unlocked(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.jobs = []
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def add(self, j):
+                with self._lock:
+                    self.jobs.append(j)
+
+            def _run(self):
+                while self.jobs:         # C302: unlocked read on the thread
+                    pass
+    """)
+    assert rules(d) == ["C302"]
+    assert "jobs" in d[0].message
+
+
+def test_c302_locked_thread_read_is_clean(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.jobs = []
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def add(self, j):
+                with self._lock:
+                    self.jobs.append(j)
+
+            def _run(self):
+                with self._lock:
+                    n = len(self.jobs)   # locked: fine
+                return n
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c302_nested_thread_body_closure(tmp_path):
+    # the thread body is a nested def: it holds NOTHING even though the
+    # spawning method might
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = {}
+
+            def set(self, k, v):
+                with self._lock:
+                    self.state[k] = v
+
+            def snapshot_async(self):
+                def run():
+                    return dict(self.state)   # C302: fresh thread, no lock
+                threading.Thread(target=run, daemon=True).start()
+    """)
+    assert rules(d) == ["C302"]
+
+
+# ---------------------------------------------------------------------------
+# C303 static lock-order inversion
+# ---------------------------------------------------------------------------
+
+
+def test_c303_abba_cycle_across_classes(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self, b):
+                self._a_lock = threading.Lock()
+                self.b = b
+
+            def hit(self):
+                with self._a_lock:
+                    with self.b._b_lock:
+                        pass
+
+        class B:
+            def __init__(self, a):
+                self._b_lock = threading.Lock()
+                self.a = a
+
+            def hit(self):
+                with self._b_lock:
+                    with self.a._a_lock:
+                        pass
+    """)
+    assert rules(d) == ["C303"]
+    assert "_a_lock" in d[0].message and "_b_lock" in d[0].message
+
+
+def test_c303_consistent_order_is_clean(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self, b):
+                self._a_lock = threading.Lock()
+                self.b = b
+
+            def one(self):
+                with self._a_lock:
+                    with self.b._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self.b._b_lock:
+                        pass
+
+        class B:
+            def __init__(self):
+                self._b_lock = threading.Lock()
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c303_cycle_via_method_call_under_lock(tmp_path):
+    # A holds its lock and CALLS into B, which locks then calls back into
+    # a lock-acquiring A method — the interprocedural edge set closes
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class A:
+            def __init__(self, b):
+                self._a_lock = threading.Lock()
+                self.b = b
+
+            def outer(self):
+                with self._a_lock:
+                    self.b.poke()
+
+            def reenter(self):
+                with self._a_lock:
+                    pass
+
+        class B:
+            def __init__(self, a):
+                self._b_lock = threading.Lock()
+                self.a = a
+
+            def poke(self):
+                with self._b_lock:
+                    self.a.reenter()
+    """)
+    assert rules(d) == ["C303"]
+
+
+def test_c303_reentrant_same_lock_is_not_a_cycle(tmp_path):
+    # Service-style RLock: methods call each other, both take self._lock
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.incr()
+
+            def incr(self):
+                with self._lock:
+                    self.n += 1
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+# ---------------------------------------------------------------------------
+# C304 blocking call under a lock (+ the allowlist pragma)
+# ---------------------------------------------------------------------------
+
+
+def test_c304_fsync_under_lock(tmp_path):
+    d = _lint_src(tmp_path, """
+        import os
+        import threading
+
+        class J:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def append(self, f):
+                with self._lock:
+                    os.fsync(f.fileno())
+    """)
+    assert rules(d) == ["C304"]
+    assert "os.fsync" in d[0].message
+
+
+def test_c304_sleep_and_socket_under_lock(tmp_path):
+    d = _lint_src(tmp_path, """
+        import time
+        import threading
+
+        class C:
+            def __init__(self, conn):
+                self._lock = threading.Lock()
+                self.conn = conn
+
+            def call(self):
+                with self._lock:
+                    self.conn.send(b"x")
+                    time.sleep(0.1)
+                    return self.conn.recv()
+    """)
+    assert rules(d) == ["C304", "C304", "C304"]
+
+
+def test_c304_pragma_with_justification_suppresses(tmp_path):
+    d = _lint_src(tmp_path, """
+        import os
+        import threading
+
+        class J:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def append(self, f):
+                with self._lock:
+                    os.fsync(f.fileno())  # lock: allow[C304] fsync-before-ack is the durability contract
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c300_pragma_without_justification_is_its_own_finding(tmp_path):
+    d = _lint_src(tmp_path, """
+        import os
+        import threading
+
+        class J:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def append(self, f):
+                with self._lock:
+                    os.fsync(f.fileno())  # lock: allow[C304]
+    """)
+    # the empty pragma is rejected AND does not suppress the hold
+    assert rules(d) == ["C300", "C304"]
+
+
+def test_c304_propagates_through_guarded_helper(tmp_path):
+    # the blocking op sits in a private method ONLY called under the lock —
+    # the entry-held propagation must still see the hold
+    d = _lint_src(tmp_path, """
+        import os
+        import threading
+
+        class J:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def publish(self, f):
+                with self._lock:
+                    self._write(f)
+
+            def _write(self, f):
+                os.fsync(f.fileno())
+    """)
+    assert rules(d) == ["C304"]
+
+
+# ---------------------------------------------------------------------------
+# C305 leaked thread / unbounded Event.wait loop
+# ---------------------------------------------------------------------------
+
+
+def test_c305_non_daemon_thread_without_join(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class R:
+            def start(self):
+                t = threading.Thread(target=self._run)
+                t.start()
+
+            def _run(self):
+                pass
+    """)
+    assert rules(d) == ["C305"]
+
+
+def test_c305_joined_or_daemon_threads_are_clean(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class R:
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+                u = threading.Thread(target=self._run)
+                u.start()
+                u.join()
+                self._w = threading.Thread(target=self._run)
+                self._w.start()
+
+            def stop(self):
+                self._w.join(timeout=5)
+
+            def _run(self):
+                pass
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c305_unbounded_event_wait_loop(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._ev = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while True:
+                    self._ev.wait()      # C305: no timeout, stop can't land
+    """)
+    assert rules(d) == ["C305"]
+
+
+def test_c305_timed_event_wait_loop_is_clean(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._ev = threading.Event()
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                while not self._ev.wait(0.5):
+                    pass
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+# ---------------------------------------------------------------------------
+# C306 time.sleep polling loop without an injectable clock
+# ---------------------------------------------------------------------------
+
+
+def test_c306_polling_loop_without_injectable_sleep(tmp_path):
+    d = _lint_src(tmp_path, """
+        import time
+
+        class Poller:
+            def __init__(self, path):
+                self.path = path
+
+            def wait_ready(self):
+                while True:
+                    time.sleep(0.1)      # C306
+    """)
+    assert rules(d) == ["C306"]
+
+
+def test_c306_injectable_sleep_param_is_clean(tmp_path):
+    # the LeaseFile discipline: sleep= in __init__ (or the function itself)
+    d = _lint_src(tmp_path, """
+        import time
+
+        class Poller:
+            def __init__(self, path, sleep=time.sleep):
+                self.path = path
+                self._sleep = sleep
+
+            def wait_ready(self):
+                while True:
+                    self._sleep(0.1)
+
+        def drive(deadline, sleep=time.sleep):
+            while True:
+                sleep(0.1)
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c306_single_sleep_outside_loop_is_clean(tmp_path):
+    d = _lint_src(tmp_path, """
+        import time
+
+        def settle():
+            time.sleep(0.2)   # one-shot settle, not a polling loop
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+# ---------------------------------------------------------------------------
+# resolution details
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_factory_locks_are_recognized(tmp_path):
+    d = _lint_src(tmp_path, """
+        from paddle_tpu.analysis.lock_sanitizer import make_lock
+
+        class Q:
+            def __init__(self):
+                self._lock = make_lock("Q._lock")
+                self.items = []
+
+            def push(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def reset(self):
+                self.items = []
+    """)
+    assert rules(d) == ["C301"]
+
+
+def test_subscript_store_counts_as_field_write(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.table = {}
+
+            def put(self, k, v):
+                with self._lock:
+                    self.table[k] = v
+
+            def evict(self, k):
+                del self.table[k]        # C301: unlocked delete
+    """)
+    assert rules(d) == ["C301"]
+
+
+def test_c304_in_dynamic_dispatch_exempt_method_uses_lexical_held(tmp_path):
+    # a no-visible-callsite private method is exempt from C301/C302 but its
+    # LEXICAL holds still fire C304 — and must not crash the formatter
+    d = _lint_src(tmp_path, """
+        import os
+        import threading
+
+        class J:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _apply_sync(self, f):
+                with self._lock:
+                    os.fsync(f.fileno())
+    """)
+    assert rules(d) == ["C304"]
+    assert "_lock" in d[0].message
+
+
+def test_c300_unused_pragma_is_reported(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1   # lock: allow[C304] nothing here blocks
+    """)
+    assert rules(d) == ["C300"]
+    assert "unused" in d[0].message
+
+
+def test_pragma_inside_string_literal_is_documentation(tmp_path):
+    d = _lint_src(tmp_path, '''
+        DOC = """annotate holds like this:
+        os.fsync(f)  # lock: allow[C304] fsync-before-ack is the contract
+        """
+        HINT = "# lock: allow[C304] <why>"
+    ''')
+    assert d == [], format_diagnostics(d)
+
+
+def test_lambda_body_is_not_analyzed_at_definition_site(tmp_path):
+    # a deferred callback must not fire C302 where it is DEFINED
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def _run(self):
+                cb = lambda: self.count   # deferred: runs elsewhere
+                return cb
+    """)
+    assert d == [], format_diagnostics(d)
+
+
+def test_c305_in_nested_def_reports_once(tmp_path):
+    d = _lint_src(tmp_path, """
+        import threading
+
+        class R:
+            def kick(self):
+                def go():
+                    t = threading.Thread(target=print)
+                    t.start()
+                go()
+    """)
+    assert rules(d) == ["C305"]

@@ -1,0 +1,338 @@
+"""Runtime lock-order sanitizer (analysis/lock_sanitizer.py): cycle
+detection with both stacks, RLock reentrancy, StatSet held-time stats, and
+the reader-teardown thread-leak contract the chaos drills rely on."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.analysis import lock_sanitizer as ls
+from paddle_tpu.utils.timers import StatSet, global_stats
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv(ls.ENV_FLAG, "1")
+    ls.reset()
+    yield
+    ls.reset()
+
+
+# ---------------------------------------------------------------------------
+# arming
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_factories_return_plain_primitives(monkeypatch):
+    monkeypatch.delenv(ls.ENV_FLAG, raising=False)
+    assert not ls.sanitizer_enabled()
+    lk = ls.make_lock("x")
+    rlk = ls.make_rlock("x")
+    assert not isinstance(lk, ls.SanitizedLock)
+    assert not isinstance(rlk, ls.SanitizedLock)
+    with lk:
+        pass
+    with rlk, rlk:  # reentrant
+        pass
+
+
+def test_armed_factories_instrument(armed):
+    assert ls.sanitizer_enabled()
+    lk = ls.make_lock("a")
+    assert isinstance(lk, ls.SanitizedLock)
+    with lk:
+        assert ls.held_report()  # this thread shows up holding 'a'
+    assert ls.held_report() == {}
+
+
+# ---------------------------------------------------------------------------
+# cycle detection
+# ---------------------------------------------------------------------------
+
+
+def test_abba_cycle_raises_deadlock_report_with_both_stacks(armed):
+    a = ls.make_lock("A")
+    b = ls.make_lock("B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    order_ab()  # records A -> B
+    with pytest.raises(ls.DeadlockReport) as ei:
+        with b:
+            with a:  # closes the cycle: report fires BEFORE blocking
+                pass
+    rep = ei.value
+    assert rep.cycle[0] == "B" and set(rep.cycle) == {"A", "B"}
+    # both acquisition stacks ride the report
+    assert "order_ab" in rep.other_stack
+    assert "test_abba_cycle" in rep.this_stack
+    assert "A -> B" in str(rep) or "B -> A" in str(rep)
+
+
+def test_cycle_detected_across_threads(armed):
+    a = ls.make_lock("A")
+    b = ls.make_lock("B")
+    err = []
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except ls.DeadlockReport as e:
+            err.append(e)
+
+    th = threading.Thread(target=t2)
+    th.start()
+    th.join()
+    assert len(err) == 1
+
+
+def test_transitive_cycle_three_locks(armed):
+    a, b, c = ls.make_lock("A"), ls.make_lock("B"), ls.make_lock("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(ls.DeadlockReport) as ei:
+        with c:
+            with a:
+                pass
+    assert set(ei.value.cycle) == {"A", "B", "C"}
+
+
+def test_consistent_order_never_reports(armed):
+    a = ls.make_lock("A")
+    b = ls.make_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert ("A", "B") in ls.edges()
+    assert ("B", "A") not in ls.edges()
+
+
+def test_reentrant_rlock_is_not_an_ordering_event(armed):
+    r = ls.make_rlock("R")
+    b = ls.make_lock("B")
+    with r:
+        with b:
+            with r:  # re-enter while holding B: must NOT record B -> R
+                pass
+    # only R -> B exists; no self-edge, no inversion
+    assert set(ls.edges()) == {("R", "B")}
+    # and a second nesting the same way is fine
+    with r, b:
+        pass
+
+
+def test_release_misuse_still_raises(armed):
+    lk = ls.make_lock("M")
+    with pytest.raises(RuntimeError):
+        lk.release()
+
+
+def test_acquire_timeout_false_does_not_push(armed):
+    lk = ls.make_lock("T")
+    grabbed = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            grabbed.set()
+            release.wait(5)
+
+    th = threading.Thread(target=holder)
+    th.start()
+    grabbed.wait(5)
+    assert lk.acquire(timeout=0.05) is False
+    # the failed acquire left no residue on THIS thread (the holder thread
+    # legitimately shows up until it releases)
+    me = threading.current_thread().name
+    assert me not in ls.held_report()
+    release.set()
+    th.join()
+    assert ls.held_report() == {}
+
+
+# ---------------------------------------------------------------------------
+# held-time stats ride the StatSet plane
+# ---------------------------------------------------------------------------
+
+
+def test_held_time_observed_into_global_stats(armed):
+    global_stats.reset()
+    lk = ls.make_lock("statsy")
+    with lk:
+        time.sleep(0.01)
+    summ = global_stats.summary()
+    assert "lock_held/statsy" in summ
+    assert summ["lock_held/statsy"]["count"] == 1
+    assert summ["lock_held/statsy"]["max"] >= 0.01
+    global_stats.reset()
+
+
+# ---------------------------------------------------------------------------
+# StatSet lock-consistency (the C-rule audit satellite): two threads
+# hammering incr/observe/timer must never lose a count
+# ---------------------------------------------------------------------------
+
+
+def test_statset_two_thread_increment_stress():
+    stats = StatSet()
+    N = 5000
+
+    def worker():
+        for _ in range(N):
+            stats.incr("hits")
+            stats.observe("vals", 1.0)
+
+    ts = [threading.Thread(target=worker) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert stats.count("hits") == 2 * N
+    summ = stats.summary()
+    assert summ["vals"]["count"] == 2 * N
+    assert summ["vals"]["total"] == pytest.approx(2 * N)
+
+
+# ---------------------------------------------------------------------------
+# thread_report: the reader-teardown leak contract
+# ---------------------------------------------------------------------------
+
+
+def _wait_clear(timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not ls.thread_report():
+            return []
+        time.sleep(0.02)
+    return ls.thread_report()
+
+
+def test_buffered_reader_abandoned_early_leaks_no_thread():
+    from paddle_tpu.reader.decorator import buffered
+
+    def slow_reader():
+        for i in range(10_000):
+            yield i
+
+    r = buffered(slow_reader, size=4)
+    it = r()
+    assert next(it) == 0
+    it.close()  # abandon mid-stream: fill thread must stop and join
+    assert _wait_clear() == []
+
+
+def test_xmap_reader_abandoned_early_leaks_no_thread():
+    from paddle_tpu.reader.decorator import xmap_readers
+
+    def src():
+        for i in range(10_000):
+            yield i
+
+    r = xmap_readers(lambda x: x * 2, src, process_num=3, buffer_size=2,
+                     order=True)
+    it = r()
+    assert next(it) == 0
+    it.close()
+    assert _wait_clear() == []
+
+
+def test_xmap_reader_full_drain_still_joins():
+    from paddle_tpu.reader.decorator import xmap_readers
+
+    def src():
+        for i in range(50):
+            yield i
+
+    r = xmap_readers(lambda x: x + 1, src, process_num=2, buffer_size=4,
+                     order=True)
+    assert list(r()) == list(range(1, 51))
+    assert _wait_clear() == []
+
+
+def test_recordio_prefetcher_close_joins_workers(tmp_path):
+    from paddle_tpu.io import recordio
+
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"f{i}.rio")
+        recordio.write_records(
+            p, [f"{i}-{j}".encode() for j in range(2000)],
+            max_chunk_records=100,
+        )
+        paths.append(p)
+
+    pf = recordio.Prefetcher(paths, n_threads=2, capacity=8)
+    assert pf.next() is not None  # workers alive, queue tiny: they park
+    pf.close()
+    if getattr(pf, "_lib", None) is None:  # python backend spawns threads
+        assert _wait_clear() == []
+    # close is idempotent
+    pf.close()
+
+
+def test_device_prefetcher_close_joins():
+    from paddle_tpu.reader.prefetch import DevicePrefetcher
+
+    pf = DevicePrefetcher(iter(range(10_000)), depth=2)
+    assert next(pf) == 0
+    pf.close()
+    assert _wait_clear() == []
+
+
+def test_same_named_distinct_locks_do_not_crash(armed):
+    # two instances of one class share a lock NAME (the Module.Class.attr
+    # convention): nesting them must neither crash nor fabricate an edge
+    a1 = ls.make_lock("Prefetcher._next_lock")
+    a2 = ls.make_lock("Prefetcher._next_lock")
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass
+    assert ("Prefetcher._next_lock", "Prefetcher._next_lock") not in ls.edges()
+
+
+def test_prefetcher_close_join_is_deadlined(tmp_path, monkeypatch):
+    # a worker wedged in file i/o (never reaching a _stopped check) must
+    # degrade to leaking one daemon thread, not hang close() forever
+    import time as _time
+    from paddle_tpu.io import recordio
+
+    p = str(tmp_path / "f.rio")
+    recordio.write_records(p, [b"x"] * 10)
+    pf = recordio.Prefetcher([p], n_threads=1, capacity=4)
+    if getattr(pf, "_lib", None) is not None:
+        pf.close()
+        return  # native backend: python join path not in play
+    wedged = threading.Event()
+
+    def hang():
+        wedged.wait(30)
+
+    pf._threads.append(threading.Thread(target=hang, daemon=True))
+    pf._threads[-1].start()
+    t0 = _time.monotonic()
+    pf.close()
+    assert _time.monotonic() - t0 < 10  # bounded, despite the wedged thread
+    wedged.set()

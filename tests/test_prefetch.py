@@ -223,3 +223,50 @@ def test_narrow_dtype_infer_matches_train_path():
     want = np.exp(logits - logits.max())
     want /= want.sum()
     np.testing.assert_allclose(np.asarray(probs)[0], want, rtol=2e-3, atol=2e-3)
+
+
+def test_buffered_reader_error_reraises_on_consumer():
+    from paddle_tpu.reader.decorator import buffered
+
+    def bad_reader():
+        yield 1
+        raise ValueError("bad sample")
+
+    it = buffered(bad_reader, size=2)()
+    assert next(it) == 1
+    import pytest
+
+    with pytest.raises(ValueError, match="bad sample"):
+        list(it)
+
+
+def test_xmap_mapper_error_reraises_instead_of_hanging():
+    from paddle_tpu.reader.decorator import xmap_readers
+
+    def src():
+        for i in range(100):
+            yield i
+
+    def mapper(x):
+        if x == 5:
+            raise ValueError("poison sample")
+        return x
+
+    import pytest
+
+    with pytest.raises(ValueError, match="poison sample"):
+        list(xmap_readers(mapper, src, process_num=2, buffer_size=4)())
+
+
+def test_xmap_source_error_reraises_instead_of_hanging():
+    from paddle_tpu.reader.decorator import xmap_readers
+
+    def bad_src():
+        yield 0
+        raise IOError("truncated input")
+
+    import pytest
+
+    with pytest.raises(IOError, match="truncated input"):
+        list(xmap_readers(lambda x: x, bad_src, process_num=3,
+                          buffer_size=2, order=True)())
