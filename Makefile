@@ -27,16 +27,20 @@
 #                  --resume), the elastic kill-one-of-N scenarios
 #                  (tests/test_elastic_e2e.py: 4 worker processes, one
 #                  SIGKILLed mid-pass holding a shard lease — leases
-#                  requeue, params stay bit-for-bit), and the master-
+#                  requeue, params stay bit-for-bit), the master-
 #                  failover drill (tests/test_master_failover_e2e.py:
 #                  kill -9 the LEADER mid-pass under a 4-worker fleet —
 #                  the standby takes over warm from the journal, zero
-#                  recomputed tasks, bit-for-bit params).
+#                  recomputed tasks, bit-for-bit params), and the serving
+#                  drills (tests/test_serving_e2e.py: open-loop load +
+#                  poisoned-request rejection + slow-client isolation,
+#                  lock-sanitizer armed).
+#   make serve-bench — the serving-plane headline (bench_serving).
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test verify bench test-all lint tier1-check tier1-update chaos
+.PHONY: test verify bench test-all lint tier1-check tier1-update chaos serve-bench
 
 lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
@@ -63,6 +67,14 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_elastic_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q
+
+# the serving-plane headline under the bench regression guard: continuous
+# batching + block-paged decode cache vs the one-shot path, open-loop load
+# (sustained req/s, p50/p99 per-token latency; bench.bench_serving)
+serve-bench:
+	$(CPU_ENV) $(PY) -c "import bench, json; \
+		[print(json.dumps(r)) for r in bench.bench_serving()]"
 
 test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

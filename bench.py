@@ -621,6 +621,195 @@ def bench_nmt_generate() -> dict:
     }
 
 
+def bench_serving() -> list:
+    """Serving-plane headline (ROADMAP item 1): continuous batching +
+    block-paged decode cache (paddle_tpu/serving/) vs the one-shot
+    Seq2SeqGenerator path, under OPEN-LOOP load (reader/loadgen.py — the
+    Gemma-on-TPU serving methodology, arXiv:2605.25645: arrivals follow a
+    fixed Poisson clock, queueing shows up in latency, not offered rate).
+
+    Three arms:
+      * one-shot EAGER — the pre-serving inference surface (per-request
+        ``generate_greedy``, retraced per call): the path this subsystem
+        replaces, and the acceptance baseline;
+      * one-shot JIT — per-request whole-decode jitted at B=1 (the
+        strongest single-request baseline, only reachable through the new
+        engine's reference path);
+      * serving — open-loop load through the continuous-batching
+        scheduler at ~90% of its saturation capacity.
+
+    Asserted in-run: sustained req/s >= 2x the one-shot path at no-worse
+    p99 per-token latency, outputs bit-identical per request, ZERO
+    compiles inside the measured window (the prewarmed ladder bound)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+    from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen
+    from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
+
+    reset_auto_names()
+    # container-sized flagship shape: on the 2-core CPU host every decode
+    # arm is equal-flops compute-bound (no HBM-bandwidth win to share), so
+    # the dims stay small enough that dispatch amortization — the part of
+    # the architecture the container CAN measure — is visible
+    vocab, word_dim, hidden, max_new = 1000, 128, 128, 24
+    n_requests, max_slots, k_steps = 64, 16, 8
+    cost, _ = seq2seq_cost(vocab, vocab, word_dim=word_dim, hidden_dim=hidden)
+    params = paddle.parameters.create(cost, seed=0)
+    gen = Seq2SeqGenerator(
+        params, vocab, vocab, word_dim=word_dim, hidden_dim=hidden,
+        bos_id=0, eos_id=1, max_length=max_new,
+    )
+    engine = ServingEngine(
+        gen, max_slots=max_slots, hbm_budget_mb=16, max_new_tokens=max_new,
+        block_steps=k_steps,
+    )
+    rng = np.random.RandomState(0)
+    srcs = [
+        rng.randint(2, vocab, size=rng.randint(4, 31)).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    # -- arm 1: the EAGER one-shot path (what inference looked like before
+    # this subsystem: per-request generate_greedy, retraced per call) -----
+    from paddle_tpu.reader.feeder import DataFeeder
+    from paddle_tpu.core.batch import DEFAULT_LADDER
+
+    feeder = DataFeeder(
+        gen._enc_net.topology.data_types(), ladder=DEFAULT_LADDER,
+        min_seq_len=1,
+    )
+    eager_tpot = []
+    t0 = time.perf_counter()
+    for s in srcs[:8]:  # 8 requests suffice: each pays a full retrace
+        r0 = time.perf_counter()
+        toks, lens = gen.generate_greedy(
+            feeder([(s,)]), max_new_tokens=max_new
+        )
+        n = int(np.asarray(lens)[0])
+        eager_tpot.append((time.perf_counter() - r0) / max(n, 1))
+    eager_rps = 8 / (time.perf_counter() - t0)
+
+    # -- arm 2: the JIT one-shot baseline (B=1 whole-decode executable per
+    # source rung; doubles as the bit-identity goldens) -------------------
+    for s in (min(srcs, key=len), max(srcs, key=len)):
+        engine.reference_decode(s, max_new)  # compile both rungs
+    refs, jit_tpot = [], []
+    t0 = time.perf_counter()
+    for s in srcs:
+        r0 = time.perf_counter()
+        toks = engine.reference_decode(s, max_new)
+        jit_tpot.append((time.perf_counter() - r0) / max(len(toks), 1))
+        refs.append(toks)
+    jit_rps = n_requests / (time.perf_counter() - t0)
+
+    # -- arm 3: serving.  Deterministic ladder prewarm (the `paddle-tpu
+    # cache warm` discipline) realizes every (slot-rung, page-rung) decode
+    # variant and every (group-rung, source-rung) prefill variant, then a
+    # saturation wave measures capacity, then the MEASURED open-loop run
+    # offers ~90% of that capacity — stable queue, honest p99 -------------
+    for gsz in (1, 2, 4, 8, 16):
+        for src_len in (5, 20):  # 1-page and 2-page rungs
+            engine.admit([Request([2] * src_len) for _ in range(gsz)])
+            while engine.n_live:
+                engine.step()
+
+    def run_serving(reqs, offered_rps=None, seed=2):
+        with ServingScheduler(engine) as sched:
+            t1 = time.perf_counter()
+            if offered_rps is None:  # saturation: all at once
+                for r in reqs:
+                    sched.submit(r)
+            else:
+                OpenLoopLoadGen(
+                    offered_rps, len(reqs), lambda i: reqs[i], seed=seed
+                ).run(sched.submit)
+            for r in reqs:
+                if not r.wait(300):
+                    raise RuntimeError(f"unserved request {r.req_id}")
+            return time.perf_counter() - t1
+
+    capacity_rps = n_requests / run_serving([Request(s) for s in srcs])
+    traces_before = dict(engine.trace_counts)
+    offered = 0.9 * capacity_rps
+    reqs = [Request(s) for s in srcs]
+    wall = run_serving(reqs, offered)
+    assert engine.trace_counts == traces_before, (
+        "continuous batching recompiled mid-run: "
+        f"{traces_before} -> {engine.trace_counts}"
+    )
+
+    bit_identical = all(
+        r.error is None and r.tokens == ref for r, ref in zip(reqs, refs)
+    )
+    assert bit_identical, "serving decode diverged from the one-shot path"
+    # ladder bound: decode variants <= |slot rungs| x |page rungs realized|
+    assert engine.trace_counts["decode"] <= 10, engine.summary()
+
+    tpots = sorted(
+        (r.t_done - r.t_admit) / max(len(r.tokens), 1) for r in reqs
+    )
+    queue_waits = sorted(r.t_admit - r.t_submit for r in reqs)
+    sustained = n_requests / wall
+    p99_serving, p99_eager = pct(tpots, 0.99), pct(sorted(eager_tpot), 0.99)
+    meets_2x = (
+        sustained >= 2.0 * eager_rps and p99_serving <= p99_eager * 1.05
+    )
+    assert meets_2x, (
+        f"serving gate: {sustained / eager_rps:.2f}x req/s vs one-shot, "
+        f"p99 tpot {p99_serving * 1e3:.2f} vs {p99_eager * 1e3:.2f} ms"
+    )
+    n_tokens = sum(len(r.tokens) for r in reqs)
+    return [
+        {
+            "metric": "serving_req_per_sec",
+            "value": round(sustained, 2),
+            "unit": "sustained req/s (open-loop)",
+            "oneshot_req_per_sec": round(eager_rps, 2),
+            "oneshot_jit_req_per_sec": round(jit_rps, 2),
+            "speedup_vs_oneshot": round(sustained / eager_rps, 2),
+            "speedup_vs_oneshot_jit": round(sustained / jit_rps, 2),
+            "offered_req_per_sec": round(offered, 2),
+            "capacity_req_per_sec": round(capacity_rps, 2),
+            "n_requests": n_requests,
+            "max_slots": max_slots,
+            "decode_block_steps": k_steps,
+            "tokens_per_sec": round(n_tokens / wall, 1),
+            "p50_token_ms": round(pct(tpots, 0.5) * 1e3, 3),
+            "p99_token_ms": round(p99_serving * 1e3, 3),
+            "oneshot_p99_token_ms": round(p99_eager * 1e3, 3),
+            "oneshot_jit_p99_token_ms": round(
+                pct(sorted(jit_tpot), 0.99) * 1e3, 3
+            ),
+            "p99_queue_wait_ms": round(pct(queue_waits, 0.99) * 1e3, 3),
+            "decode_compiles": engine.trace_counts["decode"],
+            "prefill_compiles": engine.trace_counts["prefill"],
+            "bit_identical_to_oneshot": bit_identical,
+            "meets_2x_at_equal_p99": meets_2x,
+            "pages": engine.pages.summary(),
+            "binds": "per-token p50/p99 = (done - admit)/tokens per "
+            "request; sustained = completed/(first submit -> last done) "
+            "under a Poisson arrival clock at 0.9x saturation capacity.  "
+            "The 2x gate scores against the pre-serving EAGER one-shot "
+            "path; the B=1 whole-decode JIT arm is reported alongside — "
+            "on this 2-core CPU every arm is equal-flops compute-bound, "
+            "so batched decode only amortizes dispatch (~parity with the "
+            "jit arm); on TPU the B=1 decode GEMV is HBM-bound and "
+            "in-flight batching is the multiplier (arXiv:2604.15464)",
+        },
+        {
+            "metric": "serving_p99_token_ms",
+            "value": round(p99_serving * 1e3, 3),
+            "unit": "ms",
+            "p50_token_ms": round(pct(tpots, 0.5) * 1e3, 3),
+            "oneshot_p99_token_ms": round(p99_eager * 1e3, 3),
+        },
+    ]
+
+
 def bench_resnet_pipeline() -> list:
     """ResNet-50 fed through the REAL IO plane: recordio file -> native
     threaded Prefetcher -> host decode/batching -> uint8 device transfer ->
@@ -2078,7 +2267,8 @@ def main() -> None:
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     prior = load_prior_bench(repo_dir)
     results = []
-    for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_allreduce,
+    for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_serving,
+               bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,
                bench_elastic_scaling, bench_master_failover,
                bench_aot_warm_boot,

@@ -669,6 +669,145 @@ def cmd_plotcurve(argv: List[str]) -> int:
     return plot_main(argv)
 
 
+def cmd_serve(argv: List[str]) -> int:
+    """``paddle-tpu serve`` — the TPU-native serving plane over the NMT
+    flagship (serving/): request queue + continuous batching + block-paged
+    decode cache.  Requests come from ``--requests`` (one line of
+    space-separated source token ids each) or ``--synthetic N``; arrivals
+    follow the open-loop generator at ``--rate`` req/s.  Prints one JSON
+    line per completed request and a final summary line (sustained req/s,
+    p50/p99 per-token latency — the Gemma-on-TPU serving metric set)."""
+    import json as _json
+    import time as _time
+
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu serve",
+        description="continuous-batching serving plane (serving/engine.py)",
+    )
+    ap.add_argument("--model", default="",
+                    help="trained parameter tar (paddle-tpu train "
+                    "--save_dir output); random seeded weights when empty")
+    ap.add_argument("--src-vocab", type=int, default=1000)
+    ap.add_argument("--trg-vocab", type=int, default=1000)
+    ap.add_argument("--word-dim", type=int, default=128)
+    ap.add_argument("--hidden-dim", type=int, default=128)
+    ap.add_argument("--max-length", type=int, default=32,
+                    help="compiled decode ceiling (Seq2SeqGenerator)")
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--max-slots", type=int, default=None)
+    ap.add_argument("--hbm-budget-mb", type=int, default=None)
+    ap.add_argument("--requests", default="",
+                    help="file of requests (space-separated src ids/line)")
+    ap.add_argument("--synthetic", type=int, default=16,
+                    help="generate N random requests when --requests is empty")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate (req/s); 0 = submit all "
+                    "immediately")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--stats-out", default="",
+                    help="write the summary JSON here too")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+    from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen
+    from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
+
+    reset_auto_names()
+    cost, _ = seq2seq_cost(
+        args.src_vocab, args.trg_vocab,
+        word_dim=args.word_dim, hidden_dim=args.hidden_dim,
+    )
+    params = paddle.parameters.create(cost, seed=args.seed)
+    if args.model:
+        with open(args.model, "rb") as f:
+            params.init_from_tar(f)
+    gen = Seq2SeqGenerator(
+        params, args.src_vocab, args.trg_vocab,
+        word_dim=args.word_dim, hidden_dim=args.hidden_dim,
+        max_length=args.max_length,
+    )
+    engine = ServingEngine(
+        gen,
+        max_slots=args.max_slots,
+        hbm_budget_mb=args.hbm_budget_mb,
+        max_new_tokens=args.max_new_tokens,
+    )
+
+    if args.requests:
+        with open(args.requests) as f:
+            sources = [
+                [int(t) for t in line.split()] for line in f if line.strip()
+            ]
+    else:
+        rng = np.random.RandomState(args.seed)
+        sources = [
+            rng.randint(2, args.src_vocab, size=rng.randint(3, 24)).tolist()
+            for _ in range(args.synthetic)
+        ]
+
+    done = []
+
+    def on_done(r):
+        done.append(r)
+        print(_json.dumps({
+            "req": r.req_id,
+            "tokens": r.tokens,
+            "error": r.error,
+            "latency_ms": round((r.t_done - r.t_submit) * 1e3, 3),
+        }), flush=True)
+
+    reqs = [Request(src, callback=on_done) for src in sources]
+    t0 = _time.perf_counter()
+    with ServingScheduler(engine) as sched:
+        if args.rate > 0:
+            OpenLoopLoadGen(
+                args.rate, len(reqs), lambda i: reqs[i], seed=args.seed
+            ).run(sched.submit)
+        else:
+            for r in reqs:
+                sched.submit(r)
+        deadline = _time.perf_counter() + args.timeout_s
+        for r in reqs:
+            r.wait(max(0.0, deadline - _time.perf_counter()))
+    # categories are judged AFTER close() (which finalizes every
+    # outstanding request), so they are disjoint and sum to the total:
+    # served / rejected-by-validation / unfinished-at-shutdown
+    wall = _time.perf_counter() - t0
+    ok = [r for r in reqs if r.error is None]
+    pending = sum(1 for r in reqs if r.error and "closed" in r.error)
+    tpots = sorted(
+        (r.t_done - r.t_admit) / len(r.tokens)
+        for r in ok if r.tokens and r.t_admit is not None
+    )
+
+    def pct(xs, p):
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 3) if xs else None
+
+    summary = {
+        "served": len(ok),
+        "rejected": sum(
+            1 for r in reqs if r.error and "closed" not in r.error
+        ),
+        "unfinished": pending,
+        "wall_s": round(wall, 3),
+        "sustained_req_per_sec": round(len(ok) / wall, 3) if wall > 0 else None,
+        "p50_token_ms": pct(tpots, 0.50),
+        "p99_token_ms": pct(tpots, 0.99),
+        "engine": engine.summary(),
+    }
+    line = _json.dumps(summary)
+    print(line, flush=True)
+    if args.stats_out:
+        with open(args.stats_out, "w") as f:
+            f.write(line + "\n")
+    return 0 if (ok and not pending) else 1
+
+
 def cmd_worker(argv: List[str]) -> int:
     """``paddle-tpu worker`` — one elastic trainer process (scale-out
     plane, trainer/elastic.py): leases data-shard tasks from the master,
@@ -1064,6 +1203,7 @@ _COMMANDS = {
     "plotcurve": cmd_plotcurve,
     "lint": cmd_lint,
     "cache": cmd_cache,
+    "serve": cmd_serve,
     "worker": cmd_worker,
     "master": cmd_master,
 }
@@ -1084,6 +1224,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("                      self-lint the package source")
         print("    cache             AOT executable cache: ls / warm / prune /")
         print("                      clear a persistent compile cache dir")
+        print("    serve             continuous-batching serving plane over")
+        print("                      the NMT flagship (request queue + paged")
+        print("                      decode cache)")
         print("    master            run an HA master candidate (elastic")
         print("                      scale-out: registry + shard leases)")
         print("    worker            run one elastic trainer process against")

@@ -127,6 +127,14 @@ DEFAULT_LADDER: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 # A 4-based ladder bounds the shape set just as well without the blowup.
 DEFAULT_SUB_LADDER: Tuple[int, ...] = (4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
+# The BATCH axis is a compiled extent exactly like the padded time axis: a
+# caller feeding ragged batch sizes (inference.Inference, the serving plane's
+# ragged live-slot set) retraces per distinct B unless B rides a ladder too.
+# Batches start at 1 (single-request decode) so the ladder is 2^k, not 16·2^k.
+DEFAULT_BATCH_LADDER: Tuple[int, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+)
+
 
 def shape_ladder(base: int = 16, rungs: int = 9) -> Tuple[int, ...]:
     """Geometric shape ladder base·2^k, k in [0, rungs)."""
@@ -206,6 +214,60 @@ def canonicalize_batch(
             data, t.lengths, sub_lengths, sparse_ids=t.sparse_ids
         )
     return out
+
+
+def pad_batch_rows(batch: Batch, to_b: int) -> Batch:
+    """Pad every slot's BATCH axis (axis 0) up to ``to_b`` dead rows.
+
+    The batch-axis half of the ladder contract (DEFAULT_BATCH_LADDER):
+    callers with ragged batch sizes pad B to a rung, dispatch ONE compiled
+    program, and slice the leading ``b`` rows back out of every output
+    (:func:`slice_batch_rows`).  Padded rows are all-zero data; sequence
+    lengths pad with 1 — one valid zero timestep — so per-row normalizers
+    (mean pooling's divide-by-length) never see 0/0 in the dead rows.  Row
+    independence of the forward pass keeps the live rows bit-identical."""
+    out: Batch = {}
+    for name, t in batch.items():
+        if not hasattr(t, "data"):
+            out[name] = t
+            continue
+        b = t.data.shape[0]
+        if b >= to_b:
+            out[name] = t
+            continue
+        data = _pad_axis(t.data, 0, to_b)
+        lengths = t.lengths
+        sub_lengths = t.sub_lengths
+        import numpy as np
+
+        mod = jnp if isinstance(t.data, jax.Array) else np
+        if lengths is not None:
+            pad_len = mod.ones((to_b - b,), dtype=lengths.dtype)
+            lengths = mod.concatenate([lengths, pad_len], axis=0)
+        if sub_lengths is not None:
+            pad_sub = mod.ones(
+                (to_b - b,) + tuple(sub_lengths.shape[1:]), dtype=sub_lengths.dtype
+            )
+            sub_lengths = mod.concatenate([sub_lengths, pad_sub], axis=0)
+        out[name] = SeqTensor(data, lengths, sub_lengths, sparse_ids=t.sparse_ids)
+    return out
+
+
+def slice_batch_rows(outs: Dict[str, SeqTensor], b: int) -> Dict[str, SeqTensor]:
+    """Undo :func:`pad_batch_rows` on a dict of output SeqTensors: keep the
+    first ``b`` rows of data/lengths/sub_lengths."""
+    sliced: Dict[str, SeqTensor] = {}
+    for name, t in outs.items():
+        if not hasattr(t, "data"):
+            sliced[name] = t
+            continue
+        sliced[name] = SeqTensor(
+            t.data[:b],
+            None if t.lengths is None else t.lengths[:b],
+            None if t.sub_lengths is None else t.sub_lengths[:b],
+            sparse_ids=t.sparse_ids,
+        )
+    return sliced
 
 
 def non_seq(data) -> SeqTensor:

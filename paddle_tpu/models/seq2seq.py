@@ -249,6 +249,40 @@ class Seq2SeqGenerator:
         )
         return outs
 
+    def fused_decode_weights(self, gp):
+        """Device-ready weight bundle of the fused attention-GRU decode
+        step, or None when the decoder step did not match the fused idiom.
+        Shared by the beam/greedy stepping here AND the serving plane's
+        block-paged decode step (serving/engine.py) — one extraction, one
+        numerical contract.  ``gp`` must already be materialized
+        (``self.net.materialize_shared``)."""
+        if self._match is None:
+            return None
+        mt = self._match
+        sub_params = gp["decoder"]
+        lp = lambda n: self._subnet.layer_params(sub_params, n)
+        p_in = lp(mt.in_proj)
+        p_gru = lp(mt.gru)
+        p_sp = lp(mt.state_proj)
+        p_head = lp("dec_out")
+        bias = sum(p["b"] for p in (p_in, p_gru) if "b" in p)
+        return {
+            "emb_w": gp["trg_emb"]["w"],
+            "w_emb": p_in[f"w{mt.scan_slots[0][0]}"],
+            # target-side gate bias (in_proj + gru biases folded); None when
+            # both layers are bias-free
+            "xg_bias": None if isinstance(bias, int) else bias,
+            "w1": jnp.concatenate([p_sp["w0"], p_gru["w_h"]], axis=1),
+            "v": lp(mt.scores)["w0"][:, 0],
+            "w_ctx": p_in[f"w{mt.ctx_slot}"],
+            "w_c": p_gru["w_c"],
+            "head_w": p_head["w0"],
+            "head_b": p_head.get("b"),
+            # state-projection bias folds into the prefill-time score keys
+            # (ep = enc_proj + sp_b), NOT into the per-step chain
+            "sp_b": p_sp.get("b"),
+        }
+
     def _step_fn(self, statics, gp):
         """Build step_fn(ids, carry) for beam/greedy: embeds ids with the
         trained trg_emb table, runs the decoder sub-network once — through
@@ -263,33 +297,25 @@ class Seq2SeqGenerator:
             from paddle_tpu.ops.rnn import attention_gru_step
 
             mt = self._match
-            lp = lambda n: self._subnet.layer_params(sub_params, n)
-            p_in = lp(mt.in_proj)
-            p_gru = lp(mt.gru)
-            p_sp = lp(mt.state_proj)
-            p_head = lp("dec_out")
-            w1 = jnp.concatenate([p_sp["w0"], p_gru["w_h"]], axis=1)
-            v = lp(mt.scores)["w0"][:, 0]
-            w_emb = p_in[f"w{mt.scan_slots[0][0]}"]
-            bias = sum(p["b"] for p in (p_in, p_gru) if "b" in p)
+            w = self.fused_decode_weights(gp)
             enc_t = statics[mt.enc_name]
             ep = statics[mt.ep_name].data
-            if "b" in p_sp:
-                ep = ep + p_sp["b"]
+            if w["sp_b"] is not None:
+                ep = ep + w["sp_b"]
             emask = enc_t.mask(bool) if enc_t.lengths is not None else None
 
             def step_fn(ids, carry):
-                xg = jnp.take(emb_w, ids, axis=0) @ w_emb
-                if not isinstance(bias, int):
-                    xg = xg + bias
+                xg = jnp.take(w["emb_w"], ids, axis=0) @ w["w_emb"]
+                if w["xg_bias"] is not None:
+                    xg = xg + w["xg_bias"]
                 h_t = attention_gru_step(
-                    xg, carry[m0.name], enc_t.data, ep, emask, w1, v,
-                    p_in[f"w{mt.ctx_slot}"], p_gru["w_c"],
+                    xg, carry[m0.name], enc_t.data, ep, emask, w["w1"],
+                    w["v"], w["w_ctx"], w["w_c"],
                     gate_act=mt.gate_act, act=mt.act, att_act=mt.att_act,
                 )
-                logits = h_t @ p_head["w0"]
-                if "b" in p_head:
-                    logits = logits + p_head["b"]
+                logits = h_t @ w["head_w"]
+                if w["head_b"] is not None:
+                    logits = logits + w["head_b"]
                 prob = jax.nn.softmax(logits, axis=-1)
                 return jnp.log(jnp.maximum(prob, 1e-9)), {m0.name: h_t}
 
@@ -364,7 +390,20 @@ class Seq2SeqGenerator:
             norm_fn=self.norm_fn,
         )
 
-    def generate_greedy(self, batch, *, params=None):
+    def generate_greedy(
+        self, batch, *, params=None,
+        max_new_tokens: Optional[int] = None, early_exit: bool = True,
+    ):
+        """Greedy decode; returns ([B, L] ids, [B] lengths) with
+        ``L = min(max_length, max_new_tokens)``.
+
+        ``max_new_tokens`` caps the decode per CALL (the constructor's
+        ``max_length`` stays the compiled ceiling); ``early_exit`` stops
+        stepping once every row has emitted EOS instead of always running
+        the full unroll.  Both are BIT-IDENTICAL to the full run truncated:
+        finished rows only ever re-emit EOS, and the early-exit buffer is
+        EOS-filled, so the [B, L] output arrays match exactly
+        (tests/test_seq2seq.py pins this)."""
         from paddle_tpu.ops.beam import greedy_search
 
         statics, carry, b, gp = self._prepare(batch, params)
@@ -375,4 +414,6 @@ class Seq2SeqGenerator:
             bos_id=self.bos_id,
             eos_id=self.eos_id,
             max_len=self.max_length,
+            max_new_tokens=max_new_tokens,
+            early_exit=early_exit,
         )

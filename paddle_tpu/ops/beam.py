@@ -135,26 +135,68 @@ def greedy_search(
     bos_id: int,
     eos_id: int,
     max_len: int,
+    max_new_tokens: Optional[int] = None,
+    early_exit: bool = False,
 ):
-    """Greedy decode: argmax each step; returns ([B, T] ids, [B] lengths)."""
+    """Greedy decode: argmax each step; returns ([B, L] ids, [B] lengths)
+    with ``L = min(max_len, max_new_tokens)``.
 
+    ``early_exit`` replaces the fixed-trip scan with a ``lax.while_loop``
+    that stops once every row has emitted EOS.  The output is BIT-IDENTICAL
+    to the full unroll: a finished row only ever re-emits EOS (the
+    ``where(finished, eos, ...)`` clamp), and the early-exit token buffer
+    is pre-filled with EOS, so the steps the loop skips would have written
+    exactly what the buffer already holds."""
+    length = max_len if max_new_tokens is None else max(
+        0, min(int(max_new_tokens), max_len)
+    )
+    if length == 0:
+        return (
+            jnp.zeros((batch_size, 0), jnp.int32),
+            jnp.zeros((batch_size,), jnp.int32),
+        )
     ids0 = jnp.full((batch_size,), bos_id, jnp.int32)
     finished0 = jnp.zeros((batch_size,), bool)
 
-    def body(state, _):
-        ids, finished, carry = state
+    def step(ids, finished, carry):
         logp, new_carry = step_fn(ids, carry)
         nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
         nxt = jnp.where(finished, eos_id, nxt)
-        new_finished = finished | (nxt == eos_id)
-        return (nxt, new_finished, new_carry), nxt
+        return nxt, finished | (nxt == eos_id), new_carry
 
-    (_, finished, _), toks = jax.lax.scan(
-        body, (ids0, finished0, init_carry), None, length=max_len
-    )
-    toks = jnp.swapaxes(toks, 0, 1)  # [B, T]
+    if early_exit:
+        toks0 = jnp.full((batch_size, length), eos_id, jnp.int32)
+
+        def cond(state):
+            t, _, finished, _, _ = state
+            return (t < length) & ~jnp.all(finished)
+
+        def body(state):
+            t, ids, finished, carry, toks = state
+            nxt, new_finished, new_carry = step(ids, finished, carry)
+            return (
+                t + 1, nxt, new_finished, new_carry,
+                toks.at[:, t].set(nxt),
+            )
+
+        _, _, finished, _, toks = jax.lax.while_loop(
+            cond,
+            body,
+            (jnp.asarray(0, jnp.int32), ids0, finished0, init_carry, toks0),
+        )
+    else:
+
+        def scan_body(state, _):
+            ids, finished, carry = state
+            nxt, new_finished, new_carry = step(ids, finished, carry)
+            return (nxt, new_finished, new_carry), nxt
+
+        (_, finished, _), toks = jax.lax.scan(
+            scan_body, (ids0, finished0, init_carry), None, length=length
+        )
+        toks = jnp.swapaxes(toks, 0, 1)  # [B, L]
     is_eos = toks == eos_id
     any_eos = jnp.any(is_eos, axis=1)
     first_eos = jnp.argmax(is_eos.astype(jnp.int32), axis=1)
-    lengths = jnp.where(any_eos, first_eos, max_len).astype(jnp.int32)
+    lengths = jnp.where(any_eos, first_eos, length).astype(jnp.int32)
     return toks, lengths

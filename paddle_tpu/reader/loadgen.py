@@ -1,0 +1,87 @@
+"""Open-loop load generator — arrival-clock request injection.
+
+Closed-loop load tests (submit, wait, submit) measure the server's best
+case: the client throttles itself to the service rate and queueing delay
+never appears.  The serving comparison methodology (the Gemma-on-TPU
+paper, arXiv:2605.25645) uses OPEN-LOOP load instead: arrivals follow a
+fixed stochastic process independent of completions, so an overloaded
+server shows up as growing queueing delay in the latency percentiles
+rather than as a silently reduced offered rate.  This module is that
+arrival clock for the serving plane's bench/chaos drills (bench.py
+``bench_serving``, tests/test_serving_e2e.py).
+
+Determinism: inter-arrival gaps precompute from a seeded RNG at
+construction, so a drill replays the identical arrival schedule; ``clock``
+and ``sleep`` are injectable (the C306 discipline — tests drive virtual
+time, production uses the wall clock).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["OpenLoopLoadGen"]
+
+
+class OpenLoopLoadGen:
+    """Submit ``n_requests`` at ``rate_rps`` on an open-loop arrival clock.
+
+    ``make_request(i)`` builds the i-th request object;
+    :meth:`run`\\ ``(submit)`` blocks the calling thread, sleeping until
+    each precomputed arrival time and then calling ``submit(request)``
+    regardless of how many earlier requests have completed.
+
+    ``process``: ``"poisson"`` (exponential gaps — bursty, the realistic
+    default) or ``"uniform"`` (evenly spaced — the reproducible floor).
+    """
+
+    def __init__(
+        self,
+        rate_rps: float,
+        n_requests: int,
+        make_request: Callable[[int], Any],
+        *,
+        process: str = "poisson",
+        seed: int = 0,
+        clock=time.perf_counter,
+        sleep=time.sleep,
+    ):
+        if rate_rps <= 0:
+            raise ValueError("rate_rps must be > 0")
+        if process not in ("poisson", "uniform"):
+            raise ValueError(f"unknown arrival process {process!r}")
+        self.rate_rps = float(rate_rps)
+        self.n_requests = int(n_requests)
+        self.make_request = make_request
+        self._clock = clock
+        self._sleep = sleep
+        rng = np.random.RandomState(seed)
+        if process == "poisson":
+            gaps = rng.exponential(1.0 / rate_rps, size=self.n_requests)
+        else:
+            gaps = np.full(self.n_requests, 1.0 / rate_rps)
+        # arrival offsets from t0; the first request arrives after one gap
+        self.arrivals: List[float] = list(np.cumsum(gaps))
+
+    @property
+    def offered_duration_s(self) -> float:
+        """Span of the arrival schedule (last arrival offset)."""
+        return self.arrivals[-1] if self.arrivals else 0.0
+
+    def run(self, submit: Callable[[Any], Any]) -> List[Any]:
+        """Blocking open-loop injection; returns the submitted requests."""
+        submitted: List[Any] = []
+        t0 = self._clock()
+        for i, at in enumerate(self.arrivals):
+            # bounded-poll sleep toward the arrival time: stays responsive
+            # if a virtual clock jumps, never parks unbounded (C306)
+            while True:
+                delay = (t0 + at) - self._clock()
+                if delay <= 0:
+                    break
+                self._sleep(min(delay, 0.05))
+            submitted.append(submit(self.make_request(i)))
+        return submitted

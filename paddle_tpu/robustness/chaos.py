@@ -29,6 +29,16 @@ Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
                        must take over warm (bounded journal replay, zero
                        recomputed tasks) and absorb the worker's retried
                        ack (arm on the leader candidate's environment)
+    nan_request@3      poison the 3rd request submitted to the serving
+                       scheduler (a NaN token rides the source ids): the
+                       admission validator must REJECT it with an error
+                       result — it must never reach the shared decode
+                       batch or stall the sequences already in flight
+    serve_slow_client@2  the 2nd delivered result's client callback
+                       freezes for PADDLE_TPU_CHAOS_HANG_SECS: only the
+                       delivery thread stalls — Request.wait() and the
+                       decode loop must keep running (slow-consumer
+                       isolation drill)
 
 ``@occurrence`` counts *consultations* of that point (1-based); omitting it
 means "every time".  Each armed point fires at most once per occurrence —
@@ -66,7 +76,8 @@ _ENV = "PADDLE_TPU_CHAOS"
 # drill never silently tests nothing
 KNOWN_POINTS = frozenset(
     {"nan_batch", "torn_checkpoint", "kill", "stale_lease",
-     "kill_worker", "worker_hang", "kill_master"}
+     "kill_worker", "worker_hang", "kill_master",
+     "nan_request", "serve_slow_client"}
 )
 
 # point -> occurrence to fire at (None = every consultation)

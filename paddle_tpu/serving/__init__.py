@@ -1,0 +1,27 @@
+"""TPU-native serving plane — continuous (in-flight) batching over a
+block-paged decode-state cache.
+
+The reference serves inference through the one-shot C API
+(paddle/capi/gradient_machine.h forward + the gserver
+RecurrentGradientMachine beam path): one request, one forward, full
+recompile cost per new shape.  This package is the "serve millions of
+users" replacement (ROADMAP item 1; the Ragged Paged Attention kernel
+paper, arXiv:2604.15464, is the blueprint for sharing one compiled decode
+step across ragged in-flight sequences; the Gemma-on-TPU serving
+comparison, arXiv:2605.25645, sets the metric vocabulary):
+
+* :mod:`~paddle_tpu.serving.pages` — fixed-size HBM blocks + page table
+  under an explicit budget (the PR-3 pass-cache accounting discipline);
+* :mod:`~paddle_tpu.serving.engine` — prefill/decode split: prefill rides
+  the bucketed ``CompileShapeCache``/AOT-cache contract, decode is the
+  PR-2 fused attention-GRU step gathering encoder state through the page
+  table, ONE compiled step per (slot-rung, page-rung) pair;
+* :mod:`~paddle_tpu.serving.scheduler` — request queue + continuous
+  batching: sequences admit and retire every step, no recompiles.
+"""
+
+from paddle_tpu.serving.engine import ServingEngine
+from paddle_tpu.serving.pages import BlockPagedCache
+from paddle_tpu.serving.scheduler import Request, ServingScheduler
+
+__all__ = ["BlockPagedCache", "Request", "ServingEngine", "ServingScheduler"]

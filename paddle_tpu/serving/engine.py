@@ -1,0 +1,563 @@
+"""Paged decode engine — prefill/decode split over the block-paged cache.
+
+One engine serves many in-flight sequences through exactly TWO compiled
+program families, both bounded by the shape ladder:
+
+* **prefill** — the bucketed encoder forward (the same
+  ``CompileShapeCache`` contract training feeds ride: source tokens pad to
+  a ``DEFAULT_LADDER`` rung, admitted-group batch rows pad to a
+  ``DEFAULT_BATCH_LADDER`` rung) fused with the page scatter: encoder
+  memory splits into fixed-size blocks written at the allocator's page
+  ids, and the decoder boot state lands in the slot plane.  One compiled
+  variant per (batch-rung, source-rung) pair.
+* **decode** — ONE fused attention-GRU step (ops/rnn.attention_gru_step —
+  the PR-2 scan core's generation face) for EVERY live sequence at once,
+  rewired to gather the encoder memory through the page table:
+  ``pool[page_table]`` reshapes to the padded attention extent, ragged
+  true lengths ride as a mask.  One compiled variant per (slot-rung,
+  page-rung) pair; admission and retirement change page-table CONTENTS
+  and the live mask, never shapes — continuous batching without a single
+  recompile.
+
+Decode outputs are BIT-IDENTICAL per request to the one-shot
+``Seq2SeqGenerator.generate_greedy`` path (pinned in tests/test_serving.py):
+the gathered pages hold exactly the bytes prefill wrote, masked padding
+contributes exact zeros, and every per-row op is batch-row independent.
+
+With ``aot_cache_dir`` set (PR 8), both program families dispatch through
+the persistent serialized-executable cache, so a serving process boots
+warm: deserialize, don't retrace.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.batch import (
+    DEFAULT_BATCH_LADDER,
+    DEFAULT_LADDER,
+    batch_shape_key,
+    ladder_len,
+    pad_batch_rows,
+)
+from paddle_tpu.core.compiler import CompileShapeCache
+from paddle_tpu.ops.rnn import attention_gru_step
+from paddle_tpu.serving.pages import BlockPagedCache
+
+__all__ = ["ServingEngine"]
+
+
+class _Slot:
+    """One in-flight sequence: its page-table row + host-side decode state."""
+
+    __slots__ = (
+        "request", "pages", "enc_tokens", "last_id", "tokens", "max_new",
+        "admit_seq",
+    )
+
+    def __init__(self, request, pages, enc_tokens, last_id, tokens, max_new,
+                 admit_seq):
+        self.request = request
+        self.pages = pages
+        self.enc_tokens = enc_tokens
+        self.last_id = last_id
+        self.tokens = tokens
+        self.max_new = max_new
+        self.admit_seq = admit_seq
+
+
+class ServingEngine:
+    """Continuous-batching decode over a trained :class:`Seq2SeqGenerator`.
+
+    The engine is single-threaded by contract — exactly one thread (the
+    scheduler's step thread, or a test driving ``admit``/``step``
+    directly) owns it.  Cross-thread coordination lives in
+    :class:`~paddle_tpu.serving.scheduler.ServingScheduler`.
+
+    Requires the decoder to match the fused attention-GRU idiom (the same
+    structural matcher the training scan and beam stepping use); a
+    non-matching topology raises — the serving plane has no interpreted
+    fallback, by design.
+    """
+
+    def __init__(
+        self,
+        generator,
+        *,
+        max_slots: Optional[int] = None,
+        block_tokens: Optional[int] = None,
+        hbm_budget_mb: Optional[int] = None,
+        max_new_tokens: Optional[int] = None,
+        block_steps: Optional[int] = None,
+        aot_cache_dir: Optional[str] = None,
+        clock=time.perf_counter,
+        stats=None,
+    ):
+        from paddle_tpu.utils import flags as _flags
+        from paddle_tpu.utils.timers import global_stats
+
+        if generator._match is None or not _flags.get_flag("fused_attention_gru"):
+            raise ValueError(
+                "serving requires the fused attention-GRU decoder step "
+                "(the topology did not match, or fused_attention_gru is off)"
+            )
+        self._gen = generator
+        self._clock = clock
+        self._stats = stats if stats is not None else global_stats
+        self.max_slots = (
+            max_slots if max_slots is not None
+            else _flags.get_flag("serving_max_slots")
+        )
+        blk = (
+            block_tokens if block_tokens is not None
+            else _flags.get_flag("serving_block_tokens")
+        )
+        if DEFAULT_LADDER[0] % blk != 0:
+            raise ValueError(
+                f"serving_block_tokens={blk} must divide the base ladder "
+                f"rung {DEFAULT_LADDER[0]} so every padded source extent "
+                "splits into whole blocks"
+            )
+        budget_mb = (
+            hbm_budget_mb if hbm_budget_mb is not None
+            else _flags.get_flag("serving_hbm_budget_mb")
+        )
+        self.default_max_new_tokens = (
+            max_new_tokens if max_new_tokens is not None
+            else _flags.get_flag("serving_max_new_tokens")
+        )
+        # K tokens per dispatch: the make_multi_train_step amortization
+        # applied to decode (each dispatch's host sync covers K tokens for
+        # every live slot; finished rows clamp to EOS in-graph)
+        self.block_steps = max(1, int(
+            block_steps if block_steps is not None
+            else _flags.get_flag("serving_decode_block_steps")
+        ))
+
+        # weight bundle (PR-2 fused extraction, shared with beam stepping)
+        gp = generator.net.materialize_shared(generator.params.params)
+        self._gp = gp
+        self._state = generator.params.state
+        self._w = generator.fused_decode_weights(gp)
+        mt = generator._match
+        self._acts = {
+            "gate_act": mt.gate_act, "act": mt.act, "att_act": mt.att_act,
+        }
+        self.hidden_dim = int(self._w["w_c"].shape[0])
+        self.trg_vocab = int(self._w["head_w"].shape[1])
+        d_enc = int(self._w["w_ctx"].shape[0])
+        d_ep = int(self._w["v"].shape[0])
+        self._dtype = self._w["w_ctx"].dtype
+        # which encoder-subgraph outputs feed the two static placeholders
+        pmap = dict(zip(
+            [p for p, _ in generator._static_info], ["enc", "enc_proj"]
+        ))
+        self._enc_layer = pmap[mt.enc_name]
+        self._ep_layer = pmap[mt.ep_name]
+
+        # feeder over the pruned encoder graph's single source slot, on the
+        # canonical ladder (the prefill half of the shape contract)
+        from paddle_tpu.reader.feeder import DataFeeder
+
+        dts = generator._enc_net.topology.data_types()
+        seq_slots = [n for n, it in dts if it.seq.name != "NONE"]
+        if len(seq_slots) != 1:
+            raise ValueError(
+                f"serving expects one source sequence slot, got {seq_slots}"
+            )
+        self.src_slot = seq_slots[0]
+        self.src_vocab = int(dict(dts)[self.src_slot].dim)
+        self._feeder = DataFeeder(dts, ladder=DEFAULT_LADDER, min_seq_len=1)
+
+        # block-paged cache + device pools (+1 scratch row each; the slot
+        # plane gets a scratch row too, absorbing padded-lane writes)
+        self._pages = BlockPagedCache(
+            blk,
+            {"enc": d_enc, "ep": d_ep},
+            hbm_budget_bytes=int(float(budget_mb) * (1 << 20)),
+            dtype_bytes=jnp.dtype(self._dtype).itemsize,
+            stats=self._stats,
+        )
+        self.block_tokens = blk
+        self._enc_pool = jnp.zeros(
+            (self._pages.pool_rows, blk, d_enc), self._dtype
+        )
+        self._ep_pool = jnp.zeros(
+            (self._pages.pool_rows, blk, d_ep), self._dtype
+        )
+        self._h = jnp.zeros((self.max_slots + 1, self.hidden_dim), self._dtype)
+        self._scratch_slot = self.max_slots
+        # page-count rungs mirror the time ladder: P * block_tokens is
+        # always a DEFAULT_LADDER extent, so the gathered attention extent
+        # matches what the one-shot path pads to (bit-identity)
+        self._page_ladder = tuple(sorted({
+            max(1, r // blk) for r in DEFAULT_LADDER
+        }))
+
+        self._slots: Dict[int, _Slot] = {}
+        self._free_slots = list(range(self.max_slots - 1, -1, -1))
+        self._admit_seq = 0
+
+        # compile accounting: prefill batches observe the same shape-cache
+        # contract training feeds use; decode keys are (slot-rung,
+        # page-rung) pairs counted through the same StatSet surface
+        self.prefill_shapes = CompileShapeCache("serving_prefill", self._stats)
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        self._prefill_jit = self._make_prefill()
+        self._decode_table: Dict[Tuple[int, int], Any] = {}
+        self._prefill_table: Dict[tuple, Any] = {}
+        self._ref_table: Dict[tuple, Any] = {}
+
+        self._aot = None
+        if aot_cache_dir is None:
+            aot_cache_dir = _flags.get_flag("aot_cache_dir")
+        if aot_cache_dir:
+            from paddle_tpu.core.aot_cache import AOTCache
+
+            self._aot = AOTCache(aot_cache_dir, stats=self._stats)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_live(self) -> int:
+        return len(self._slots)
+
+    @property
+    def n_free_slots(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def pages(self) -> BlockPagedCache:
+        return self._pages
+
+    def max_src_tokens(self) -> int:
+        """Longest admissible source: its pages must fit the whole pool."""
+        return self._pages.n_blocks * self.block_tokens
+
+    # -- compiled program builders --------------------------------------
+    def _make_prefill(self):
+        enc_net = self._gen._enc_net
+        enc_l, ep_l = self._enc_layer, self._ep_layer
+        blk = self.block_tokens
+
+        def prefill(gp, state, batch, enc_pool, ep_pool, h_state,
+                    page_rows, slot_rows, boot_mask, h_override, sp_b):
+            self.trace_counts["prefill"] += 1
+            outs, _ = enc_net.apply(gp, batch, state=state, train=False)
+            enc = outs[enc_l].data  # [b, S, De]
+            ep = outs[ep_l].data
+            if sp_b is not None:
+                ep = ep + sp_b  # score-key bias folds in at prefill time
+            boot = outs["dec_boot"].data
+            b, s = enc.shape[0], enc.shape[1]
+            nb = s // blk
+            flat = page_rows.reshape(-1)
+            enc_pool = enc_pool.at[flat].set(
+                enc.reshape(b * nb, blk, enc.shape[-1])
+            )
+            ep_pool = ep_pool.at[flat].set(
+                ep.reshape(b * nb, blk, ep.shape[-1])
+            )
+            # resumed slots keep their saved GRU state instead of the boot
+            h_write = jnp.where(boot_mask[:, None], boot, h_override)
+            h_state = h_state.at[slot_rows].set(h_write)
+            return enc_pool, ep_pool, h_state
+
+        return jax.jit(prefill, donate_argnums=(3, 4, 5))
+
+    def _make_decode(self, b_rung: int, p_rung: int):
+        blk = self.block_tokens
+        eos = self._gen.eos_id
+        acts = self._acts
+
+        k_steps = self.block_steps
+
+        def decode(h_state, enc_pool, ep_pool, slot_idx, tables, enc_len,
+                   ids, live, w):
+            self.trace_counts["decode"] += 1
+            h = h_state[slot_idx]  # [B, H]
+            enc = enc_pool[tables].reshape(b_rung, p_rung * blk, -1)
+            ep = ep_pool[tables].reshape(b_rung, p_rung * blk, -1)
+            emask = (
+                jnp.arange(p_rung * blk, dtype=jnp.int32)[None, :]
+                < enc_len[:, None]
+            )
+
+            def inner(carry, _):
+                h_p, ids_p, fin = carry
+                xg = jnp.take(w["emb_w"], ids_p, axis=0) @ w["w_emb"]
+                if w["xg_bias"] is not None:
+                    xg = xg + w["xg_bias"]
+                h_t = attention_gru_step(
+                    xg, h_p, enc, ep, emask, w["w1"], w["v"], w["w_ctx"],
+                    w["w_c"], **acts,
+                )
+                logits = h_t @ w["head_w"]
+                if w["head_b"] is not None:
+                    logits = logits + w["head_b"]
+                # the exact ops/beam greedy chain, for bit-identity
+                prob = jax.nn.softmax(logits, axis=-1)
+                logp = jnp.log(jnp.maximum(prob, 1e-9))
+                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+                # dead lanes and finished rows only re-emit EOS, and a
+                # finished row's state freezes — the host reads tokens up
+                # to the FIRST eos, so every visible token rode the exact
+                # one-shot chain
+                dead = fin | ~live
+                nxt = jnp.where(dead, eos, nxt)
+                h_n = jnp.where(dead[:, None], h_p, h_t)
+                return (h_n, nxt, fin | (nxt == eos)), nxt
+
+            fin0 = jnp.zeros(ids.shape, bool)
+            (h_f, _, _), toks = jax.lax.scan(
+                inner, (h, ids, fin0), None, length=k_steps
+            )
+            h_state = h_state.at[slot_idx].set(h_f)
+            return h_state, jnp.swapaxes(toks, 0, 1)  # [B, K]
+
+        return jax.jit(decode, donate_argnums=(0,))
+
+    def _prefill_exe(self, batch, args):
+        if self._aot is None:
+            # jax.jit dispatches by shape itself; the table only earns its
+            # keep routing distinct shapes to deserialized AOT executables
+            return self._prefill_jit
+        key = batch_shape_key(batch)
+        exe = self._prefill_table.get(key)
+        if exe is None:
+            from paddle_tpu.core import aot_cache as _aot
+
+            exe = self._aot.get_or_compile(
+                self._prefill_jit, args,
+                {
+                    "kind": "serving_prefill",
+                    "topology": _aot.topology_fingerprint(self._gen.net),
+                    "batch": str(key),
+                    "pool_rows": self._pages.pool_rows,
+                    "block_tokens": self.block_tokens,
+                    "max_slots": self.max_slots,
+                },
+            )
+            self._prefill_table[key] = exe
+        return exe
+
+    def _decode_exe(self, b_rung: int, p_rung: int, args):
+        key = (b_rung, p_rung)
+        exe = self._decode_table.get(key)
+        if exe is None:
+            self._stats.incr("serving_decode/compile_miss")
+            exe = self._make_decode(b_rung, p_rung)
+            if self._aot is not None:
+                from paddle_tpu.core import aot_cache as _aot
+
+                exe = self._aot.get_or_compile(
+                    exe, args,
+                    {
+                        "kind": "serving_decode",
+                        "topology": _aot.topology_fingerprint(self._gen.net),
+                        "slot_rung": b_rung,
+                        "page_rung": p_rung,
+                        "pool_rows": self._pages.pool_rows,
+                        "block_tokens": self.block_tokens,
+                        "max_slots": self.max_slots,
+                    },
+                )
+            self._decode_table[key] = exe
+        else:
+            self._stats.incr("serving_decode/compile_hit")
+        return exe
+
+    # -- admission -------------------------------------------------------
+    def admit(self, requests: Sequence) -> List:
+        """Admit a FIFO prefix of ``requests`` (free slot + pages for each;
+        the first misfit stops admission — strict FCFS, no starvation) and
+        prefill them as ONE bucketed batch.  Returns the admitted list."""
+        group = []  # (slot_id, request, pages)
+        for r in requests:
+            if not self._free_slots:
+                break
+            src = r.src_ids
+            pages = self._pages.alloc(self._pages.pages_for_tokens(len(src)))
+            if pages is None:
+                break
+            sid = self._free_slots.pop()
+            resume = getattr(r, "_resume", None)
+            slot = _Slot(
+                request=r,
+                pages=pages,
+                enc_tokens=len(src),
+                last_id=(
+                    resume["last_id"] if resume is not None
+                    else self._gen.bos_id
+                ),
+                tokens=list(resume["tokens"]) if resume is not None else [],
+                max_new=min(
+                    r.max_new_tokens or self.default_max_new_tokens,
+                    self._gen.max_length,
+                ),
+                admit_seq=self._admit_seq,
+            )
+            self._admit_seq += 1
+            self._slots[sid] = slot
+            group.append((sid, r, pages))
+        if not group:
+            return []
+
+        batch = self._feeder([(list(r.src_ids),) for _, r, _ in group])
+        b_rung = ladder_len(len(group), DEFAULT_BATCH_LADDER)
+        batch = pad_batch_rows(batch, b_rung)
+        s_pad = batch[self.src_slot].data.shape[1]
+        nb = s_pad // self.block_tokens
+        scratch = self._pages.scratch
+        page_rows = np.full((b_rung, nb), scratch, np.int32)
+        slot_rows = np.full((b_rung,), self._scratch_slot, np.int32)
+        boot_mask = np.zeros((b_rung,), bool)
+        h_override = np.zeros((b_rung, self.hidden_dim), self._dtype)
+        for k, (sid, r, pages) in enumerate(group):
+            page_rows[k, : len(pages)] = pages
+            slot_rows[k] = sid
+            resume = getattr(r, "_resume", None)
+            if resume is None:
+                boot_mask[k] = True
+            else:
+                h_override[k] = resume["h"]
+                r._resume = None
+        args = (
+            self._gp, self._state, batch, self._enc_pool, self._ep_pool,
+            self._h, page_rows, slot_rows, boot_mask, h_override,
+            self._w["sp_b"],
+        )
+        self.prefill_shapes.observe(batch)
+        exe = self._prefill_exe(batch, args)
+        self._enc_pool, self._ep_pool, self._h = exe(*args)
+        now = self._clock()
+        for _, r, _ in group:
+            r.t_admit = now
+        self._stats.incr("serving/admitted", len(group))
+        return [r for _, r, _ in group]
+
+    # -- decode ----------------------------------------------------------
+    def step(self) -> List:
+        """One decode step for every live slot; returns the requests that
+        finished this step (EOS emitted or ``max_new_tokens`` reached),
+        their pages freed and slots recycled."""
+        if not self._slots:
+            return []
+        live_ids = sorted(self._slots)
+        b_rung = ladder_len(len(live_ids), DEFAULT_BATCH_LADDER)
+        max_pages = max(len(self._slots[s].pages) for s in live_ids)
+        p_rung = ladder_len(max_pages, self._page_ladder)
+        scratch = self._pages.scratch
+        slot_idx = np.full((b_rung,), self._scratch_slot, np.int32)
+        tables = np.full((b_rung, p_rung), scratch, np.int32)
+        enc_len = np.zeros((b_rung,), np.int32)
+        ids = np.full((b_rung,), self._gen.eos_id, np.int32)
+        live = np.zeros((b_rung,), bool)
+        for k, sid in enumerate(live_ids):
+            s = self._slots[sid]
+            slot_idx[k] = sid
+            tables[k, : len(s.pages)] = s.pages
+            enc_len[k] = s.enc_tokens
+            ids[k] = s.last_id
+            live[k] = True
+        args = (
+            self._h, self._enc_pool, self._ep_pool, slot_idx, tables,
+            enc_len, ids, live, self._w,
+        )
+        exe = self._decode_exe(b_rung, p_rung, args)
+        self._h, toks = exe(*args)
+        toks_host = np.asarray(toks)  # [B, K]: ONE host sync per K tokens
+        now = self._clock()
+        finished = []
+        for k, sid in enumerate(live_ids):
+            s = self._slots[sid]
+            r = s.request
+            if r.t_first_token is None:
+                r.t_first_token = now
+            done = False
+            for j in range(toks_host.shape[1]):
+                tok = int(toks_host[k, j])
+                if tok == self._gen.eos_id:
+                    done = True
+                    break
+                s.tokens.append(tok)
+                s.last_id = tok
+                r.token_times.append(now)
+                if len(s.tokens) >= s.max_new:
+                    done = True
+                    break
+            if done:
+                finished.append(self._retire(sid))
+        self._stats.incr("serving/decode_steps")
+        return finished
+
+    def _retire(self, sid: int):
+        s = self._slots.pop(sid)
+        self._pages.free(s.pages)
+        self._free_slots.append(sid)
+        s.request.tokens = s.tokens
+        self._stats.incr("serving/completed")
+        return s.request
+
+    # -- eviction / preemption -------------------------------------------
+    def preempt(self):
+        """Evict the NEWEST-admitted live sequence (least progress lost):
+        free its pages, save its tiny GRU state + generated prefix on the
+        request, and hand it back for re-queueing.  Re-admission re-runs
+        prefill (the paged encoder state recomputes deterministically) and
+        restores the saved state, so the final tokens stay bit-identical
+        to an uninterrupted decode.  Returns the request, or None when
+        nothing is live."""
+        if not self._slots:
+            return None
+        sid = max(self._slots, key=lambda s: self._slots[s].admit_seq)
+        s = self._slots.pop(sid)
+        self._pages.free(s.pages)
+        self._free_slots.append(sid)
+        s.request._resume = {
+            "h": np.asarray(self._h[sid]),
+            "last_id": s.last_id,
+            "tokens": list(s.tokens),
+        }
+        self._stats.incr("serving/preempted")
+        return s.request
+
+    # -- the one-shot reference path --------------------------------------
+    def reference_decode(self, src_ids, max_new_tokens: Optional[int] = None
+                         ) -> List[int]:
+        """The UNBATCHED one-shot ``Seq2SeqGenerator.generate_greedy`` path
+        for one request, through the same bucketed feeder and jitted per
+        source rung (the one-shot serving baseline done right, weights as
+        arguments per T102) — the bench's one-shot arm AND the golden the
+        serving output is bit-compared against."""
+        mx = (
+            max_new_tokens if max_new_tokens is not None
+            else self.default_max_new_tokens
+        )
+        batch = self._feeder([(list(src_ids),)])
+        key = (batch_shape_key(batch), mx)
+        exe = self._ref_table.get(key)
+        if exe is None:
+            exe = jax.jit(
+                lambda p, bt: self._gen.generate_greedy(
+                    bt, params=p, max_new_tokens=mx
+                )
+            )
+            self._ref_table[key] = exe
+        toks, lengths = exe(self._gen.params.params, batch)
+        n = int(np.asarray(lengths)[0])
+        return [int(t) for t in np.asarray(toks)[0, :n]]
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "live": self.n_live,
+            "free_slots": self.n_free_slots,
+            "pages": self._pages.summary(),
+            "prefill_shapes": self.prefill_shapes.n_shapes,
+            "decode_shapes": len(self._decode_table),
+            "trace_counts": dict(self.trace_counts),
+        }

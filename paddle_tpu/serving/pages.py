@@ -1,0 +1,142 @@
+"""Block-paged decode-state cache — fixed-size HBM blocks + page table.
+
+Ragged in-flight sequences must share ONE compiled decode step; the state
+that is ragged per sequence (the attended-over encoder memory — this
+model family's "KV cache") therefore lives in fixed-size blocks of a big
+HBM pool, and each live sequence owns a row of page ids (its page-table
+row).  The compiled step gathers ``pool[page_table]`` — physical layout is
+an argument, never a shape — so admitting or retiring a sequence changes
+page-table CONTENTS, not compiled shapes (the Ragged Paged Attention
+design, arXiv:2604.15464, on XLA gather/scatter instead of a custom
+kernel).
+
+Budget discipline is the PR-3 pass-cache rule (reader/pass_cache.py):
+capacity is derived up front from an explicit per-device HBM budget, every
+allocation is accounted in bytes, and exhaustion is a *refused admission*
+(the request waits in queue), never an OOM.  Block 0..n-1 are real; one
+extra SCRATCH block absorbs the writes/gathers of padded (dead) rows so
+ladder padding never corrupts live state.
+
+Counters ride the StatSet plane: ``serving/pages_alloc``,
+``serving/pages_free``, ``serving/alloc_refused``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["BlockPagedCache"]
+
+
+class BlockPagedCache:
+    """Host-side allocator + device pool layout for block-paged state.
+
+    ``feature_dims`` maps pool name -> per-token feature width (the NMT
+    engine stores two pools: ``enc`` [block, 2H] attention values and
+    ``ep`` [block, H] projected score keys).  The device arrays themselves
+    are owned by the engine (they are donated through jit every prefill);
+    this class owns the free list, the budget math and the page-table
+    bookkeeping.
+
+    Sizing rule (README "Serving"): with f32 pools,
+    ``bytes_per_block = block_tokens * sum(feature_dims) * 4`` and
+    ``n_blocks = budget_bytes // bytes_per_block``; a request of S source
+    tokens needs ``ceil(S / block_tokens)`` blocks while in flight.
+    """
+
+    def __init__(
+        self,
+        block_tokens: int,
+        feature_dims: Dict[str, int],
+        hbm_budget_bytes: Optional[int] = None,
+        n_blocks: Optional[int] = None,
+        dtype_bytes: int = 4,
+        stats=None,
+    ):
+        from paddle_tpu.utils.timers import global_stats
+
+        if block_tokens < 1:
+            raise ValueError("block_tokens must be >= 1")
+        self.block_tokens = int(block_tokens)
+        self.feature_dims = dict(feature_dims)
+        self.bytes_per_block = (
+            self.block_tokens * sum(self.feature_dims.values()) * dtype_bytes
+        )
+        if n_blocks is None:
+            if hbm_budget_bytes is None:
+                raise ValueError("need hbm_budget_bytes or n_blocks")
+            n_blocks = int(hbm_budget_bytes) // self.bytes_per_block
+        if n_blocks < 1:
+            raise ValueError(
+                f"HBM budget {hbm_budget_bytes} holds zero "
+                f"{self.bytes_per_block}-byte blocks; raise "
+                "serving_hbm_budget_mb or shrink block_tokens"
+            )
+        self.n_blocks = int(n_blocks)
+        self._stats = stats if stats is not None else global_stats
+        # LIFO free list: recently freed (still-warm) blocks re-allocate
+        # first.  Block ids are stable ints in [0, n_blocks); the shadow
+        # set keeps the per-retire double-free check O(1).
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._free_set = set(self._free)
+
+    # -- scratch ---------------------------------------------------------
+    @property
+    def scratch(self) -> int:
+        """The extra pool row (index ``n_blocks``) every padded page id
+        points at; its contents are garbage by design and every consumer
+        masks it out."""
+        return self.n_blocks
+
+    @property
+    def pool_rows(self) -> int:
+        """Rows each device pool must have: real blocks + the scratch row."""
+        return self.n_blocks + 1
+
+    # -- budget ----------------------------------------------------------
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        return self.n_used * self.bytes_per_block
+
+    def pages_for_tokens(self, n_tokens: int) -> int:
+        """Blocks a sequence of ``n_tokens`` source tokens occupies."""
+        return max(1, -(-int(n_tokens) // self.block_tokens))
+
+    # -- alloc / free ----------------------------------------------------
+    def alloc(self, n_pages: int) -> Optional[List[int]]:
+        """``n_pages`` block ids, or None when the budget can't cover them
+        (admission control: the caller keeps the request queued)."""
+        if n_pages > len(self._free):
+            self._stats.incr("serving/alloc_refused")
+            return None
+        pages = [self._free.pop() for _ in range(n_pages)]
+        self._free_set.difference_update(pages)
+        self._stats.incr("serving/pages_alloc", n_pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if not (0 <= p < self.n_blocks):
+                raise ValueError(f"freeing foreign block id {p}")
+            if p in self._free_set:
+                raise ValueError(f"double free of block {p}")
+        self._free.extend(pages)
+        self._free_set.update(pages)
+        self._stats.incr("serving/pages_free", len(pages))
+
+    def summary(self) -> Dict[str, int]:
+        return {
+            "n_blocks": self.n_blocks,
+            "block_tokens": self.block_tokens,
+            "bytes_per_block": self.bytes_per_block,
+            "n_free": self.n_free,
+            "used_bytes": self.used_bytes,
+        }

@@ -1,0 +1,318 @@
+"""Continuous-batching scheduler — request queue + step/delivery threads.
+
+The serving loop the reference never had (its capi inference is
+call-and-wait, paddle/capi/gradient_machine.h): clients ``submit()``
+requests from any thread; ONE step thread owns the
+:class:`~paddle_tpu.serving.engine.ServingEngine` and, every iteration,
+(1) drains newly submitted requests, validates them (a poisoned request is
+REJECTED with an error result — it never reaches the batch), (2) admits a
+FIFO prefix into free slots/pages (prefill), and (3) runs one decode step
+for every live sequence — sequences admit and retire mid-flight with zero
+recompiles (continuous batching).
+
+Completion is two-phase so a slow client can never stall decoding:
+``Request.wait()`` unblocks the moment the STEP thread finalizes the
+request; user callbacks run on a separate delivery thread (a slow
+callback delays only later callbacks, never the batch).  Chaos points
+``nan_request`` (poison an incoming request at submit) and
+``serve_slow_client`` (freeze the delivery thread mid-callback) drill
+exactly these two isolation boundaries (robustness/chaos.py;
+tests/test_serving_e2e.py proves the batch keeps stepping).
+
+Concurrency discipline: both threads are daemon ``paddle-serve-*``
+threads joined by :meth:`ServingScheduler.close`; the one shared lock is
+built by the :mod:`~paddle_tpu.analysis.lock_sanitizer` factory (armed
+drills watch it); every blocking wait is a bounded-timeout poll; clocks
+and sleeps are injectable (the C-rules, analysis/concurrency_lint.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.analysis.lock_sanitizer import THREAD_PREFIX, make_lock
+from paddle_tpu.robustness import chaos
+
+__all__ = ["Request", "ServingScheduler"]
+
+_log = logging.getLogger("paddle_tpu.serving")
+
+_req_counter = itertools.count()
+
+
+class Request:
+    """One generation request and its result/latency record.
+
+    ``src_ids``: source token ids; ``max_new_tokens``: per-request decode
+    cap (None = the engine's default); ``callback(request)`` runs on the
+    delivery thread after completion.  Timing fields (``t_submit``,
+    ``t_admit``, ``t_first_token``, ``t_done``, per-token ``token_times``)
+    are stamped by the scheduler/engine clock — the raw material of the
+    bench's sustained-req/s and p50/p99 per-token metrics."""
+
+    def __init__(
+        self,
+        src_ids: Sequence,
+        max_new_tokens: Optional[int] = None,
+        req_id: Optional[str] = None,
+        callback: Optional[Callable[["Request"], Any]] = None,
+    ):
+        self.req_id = req_id if req_id is not None else f"r{next(_req_counter)}"
+        self.src_ids = list(src_ids)
+        self.max_new_tokens = max_new_tokens
+        self.callback = callback
+        self.tokens: Optional[List[int]] = None
+        self.error: Optional[str] = None
+        self.t_submit: Optional[float] = None
+        self.t_admit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.token_times: List[float] = []
+        self._resume = None  # engine preemption save-state
+        self._event = threading.Event()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until finalized (bounded); True when done."""
+        return self._event.wait(timeout)
+
+    def result(self) -> List[int]:
+        """Generated tokens; raises on a rejected/failed request."""
+        if not self._event.is_set():
+            raise RuntimeError(f"request {self.req_id} not finished")
+        if self.error is not None:
+            raise RuntimeError(f"request {self.req_id}: {self.error}")
+        return list(self.tokens or [])
+
+    def __repr__(self) -> str:  # pragma: no cover
+        state = "done" if self.done() else "pending"
+        return f"Request({self.req_id}, {state}, err={self.error!r})"
+
+
+class ServingScheduler:
+    """Request queue + continuous-batching step loop over one engine."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        clock=time.perf_counter,
+        sleep=time.sleep,
+        idle_poll_s: float = 0.02,
+        stats=None,
+    ):
+        from paddle_tpu.utils.timers import global_stats
+
+        self._engine = engine
+        self._clock = clock
+        self._sleep = sleep  # injectable per the C306 discipline
+        self._idle_poll_s = idle_poll_s
+        self._stats = stats if stats is not None else global_stats
+        self._q: "queue.Queue[Request]" = queue.Queue()
+        self._deliver_q: "queue.Queue[Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._lock = make_lock("serving-scheduler")
+        self._closed = False  # guarded by _lock
+        self._step_thread = threading.Thread(
+            target=self._loop, name=THREAD_PREFIX + "serve-step", daemon=True
+        )
+        self._deliver_thread = threading.Thread(
+            target=self._delivery_loop,
+            name=THREAD_PREFIX + "serve-deliver",
+            daemon=True,
+        )
+        self._step_thread.start()
+        self._deliver_thread.start()
+
+    # -- client surface --------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        """Enqueue a request (any thread).  The ``nan_request`` chaos point
+        fires here — a poisoned submission must be caught by validation on
+        the step thread, not crash the batch."""
+        if chaos.fire("nan_request"):
+            request.src_ids = list(request.src_ids) + [float("nan")]
+        request.t_submit = self._clock()
+        # the put rides INSIDE the closed-check critical section so close()
+        # (which sets _closed under this lock, then stops and drains) can
+        # never miss a request that passed the check — an unbounded
+        # queue.Queue.put never blocks, so nothing sleeps under the lock
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            self._q.put(request)  # lock: allow[C304] UNBOUNDED queue — put never blocks; the hold closes the submit-vs-close race (close sets _closed and drains under the same lock ordering)
+        self._stats.incr("serving/submitted")
+        return request
+
+    def generate(self, src_ids, max_new_tokens: Optional[int] = None,
+                 timeout: float = 60.0) -> List[int]:
+        """Submit-and-wait convenience: tokens, or raises on reject/timeout."""
+        r = self.submit(Request(src_ids, max_new_tokens))
+        if not r.wait(timeout):
+            raise TimeoutError(f"request {r.req_id} not served in {timeout}s")
+        return r.result()
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop both threads; outstanding requests finalize with an error so
+        no client waits forever.  Safe to call repeatedly."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        self._step_thread.join(timeout)
+        self._deliver_thread.join(timeout)
+        # a submit that raced past the closed check lands here: finalize it
+        # (callback inline — the delivery thread is gone) so no client hangs
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if r._event.is_set():
+                continue
+            r.error = "scheduler closed"
+            r.tokens = []
+            r.t_done = self._clock()
+            r._event.set()
+            if r.callback is not None:
+                try:
+                    r.callback(r)
+                except Exception:
+                    self._stats.incr("serving/callback_errors")
+
+    def __enter__(self) -> "ServingScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- validation ------------------------------------------------------
+    def _validate(self, r: Request) -> Optional[str]:
+        """Admission-time request validation: a malformed/poisoned request
+        is rejected (error result) instead of poisoning the shared batch."""
+        eng = self._engine
+        if not r.src_ids:
+            return "empty source"
+        if len(r.src_ids) > eng.max_src_tokens():
+            return (
+                f"source length {len(r.src_ids)} exceeds the page budget "
+                f"({eng.max_src_tokens()} tokens)"
+            )
+        for t in r.src_ids:
+            f = float(t) if isinstance(t, (int, float, np.floating, np.integer)) else None
+            if f is None or not np.isfinite(f) or f != int(f):
+                return f"non-integral source token {t!r}"
+            if not 0 <= int(f) < eng.src_vocab:
+                return f"source token {int(f)} outside vocab [0, {eng.src_vocab})"
+        if r.max_new_tokens is not None:
+            m = r.max_new_tokens
+            f = (
+                float(m)
+                if isinstance(m, (int, float, np.floating, np.integer))
+                else None
+            )
+            if f is None or not np.isfinite(f) or f != int(f) or int(f) < 1:
+                return f"max_new_tokens must be a positive integer, got {m!r}"
+        return None
+
+    # -- step thread -----------------------------------------------------
+    def _finalize(self, r: Request, error: Optional[str] = None) -> None:
+        # idempotent: a crash between engine registration and the waiting-
+        # list trim can surface one request on BOTH shutdown paths — it
+        # must finalize (and deliver its callback) exactly once
+        if r._event.is_set():
+            return
+        r.t_done = self._clock()
+        if error is not None:
+            r.error = error
+            self._stats.incr("serving/rejected")
+        if r.tokens is None:
+            r.tokens = []
+        r._event.set()  # wait() unblocks NOW, before any callback runs
+        if r.callback is not None:
+            self._deliver_q.put(r)
+
+    def _drain_submissions(self, waiting: List[Request],
+                           block_s: float = 0.0) -> None:
+        try:
+            got = self._q.get(timeout=block_s) if block_s > 0 else (
+                self._q.get_nowait()
+            )
+        except queue.Empty:
+            return
+        while True:
+            err = self._validate(got)
+            if err is not None:
+                self._finalize(got, error=err)
+            else:
+                got.src_ids = [int(t) for t in got.src_ids]
+                if got.max_new_tokens is not None:
+                    got.max_new_tokens = int(got.max_new_tokens)
+                waiting.append(got)
+            try:
+                got = self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    def _loop(self) -> None:
+        waiting: List[Request] = []  # validated, awaiting slot/pages
+        crash: Optional[str] = None
+        try:
+            while not self._stop.is_set():
+                # idle (nothing live, nothing waiting): block briefly on
+                # the queue instead of spinning
+                idle = not waiting and self._engine.n_live == 0
+                self._drain_submissions(
+                    waiting, block_s=self._idle_poll_s if idle else 0.0
+                )
+                if waiting:
+                    admitted = self._engine.admit(waiting)
+                    if admitted:
+                        del waiting[: len(admitted)]
+                if self._engine.n_live:
+                    for r in self._engine.step():
+                        self._finalize(r)
+        except Exception as e:  # engine bug: fail loudly, strand NO client
+            _log.exception("serving step loop crashed; scheduler closes")
+            crash = f"serving loop crashed: {e!r}"
+            with self._lock:
+                self._closed = True  # further submits raise, not hang
+            self._stop.set()
+            self._stats.incr("serving/loop_crashes")
+        # shutdown: nothing new executes; unblock every outstanding client
+        error = crash or "scheduler closed"
+        self._drain_submissions(waiting)
+        for r in waiting:
+            self._finalize(r, error=error)
+        try:
+            while self._engine.n_live:
+                r = self._engine.preempt()
+                if r is None:
+                    break
+                r._resume = None
+                self._finalize(r, error=error)
+        except Exception:  # a corrupted engine can't block the unblocking
+            _log.exception("engine teardown failed; finalizing live slots")
+            for s in list(self._engine._slots.values()):
+                self._finalize(s.request, error=error)
+
+    # -- delivery thread -------------------------------------------------
+    def _delivery_loop(self) -> None:
+        while not (self._stop.is_set() and self._deliver_q.empty()):
+            try:
+                r = self._deliver_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if chaos.fire("serve_slow_client"):
+                chaos.hang()  # the slow-consumer drill: only callbacks stall
+            try:
+                r.callback(r)
+            except Exception:  # client bug must not kill delivery
+                self._stats.incr("serving/callback_errors")

@@ -202,6 +202,38 @@ define_flag("chaos", "",
             "chaos fault-point spec, e.g. 'nan_batch@5,kill@12' "
             "(robustness/chaos.py; env PADDLE_TPU_CHAOS reaches "
             "subprocesses) — NEVER set in production")
+define_flag("serving_max_slots", 8,
+            "in-flight sequence capacity of the serving plane "
+            "(serving/engine.py): the continuous-batching decode step is "
+            "compiled per slot-count LADDER RUNG up to this many live "
+            "sequences; requests beyond it queue")
+define_flag("serving_block_tokens", 16,
+            "tokens per HBM block of the block-paged decode-state cache "
+            "(serving/pages.py).  Must divide the base shape-ladder rung "
+            "(16) so every padded source extent splits into whole blocks "
+            "and the gathered attention extent stays a ladder rung "
+            "(decode outputs bit-identical to the one-shot path)")
+define_flag("serving_hbm_budget_mb", 64,
+            "PER-DEVICE HBM budget for the block-paged serving cache — "
+            "the PR-3 pass-cache accounting discipline applied to decode "
+            "state: capacity = budget // bytes_per_block, exhaustion is a "
+            "REFUSED admission (request waits in queue), never an OOM.  "
+            "Sizing rule: bytes_per_block = block_tokens x (enc 2H + "
+            "proj H) x dtype_bytes; a request of S source tokens holds "
+            "ceil(S/block_tokens) blocks while in flight")
+define_flag("serving_decode_block_steps", 4,
+            "tokens decoded per compiled dispatch in the serving plane — "
+            "the K-steps-per-dispatch amortization (trainer "
+            "make_multi_train_step discipline) applied to decode: an "
+            "inner lax.scan emits K tokens per host sync, multiplying "
+            "dispatch-bound throughput ~K-fold; admission/retirement "
+            "quantize to K-token boundaries (finished rows clamp to EOS "
+            "in-graph, so outputs stay bit-identical to the one-shot "
+            "path).  1 = sync every token (lowest time-to-first-token)")
+define_flag("serving_max_new_tokens", 32,
+            "default per-request decode cap of the serving plane (a "
+            "request's own max_new_tokens overrides; the generator's "
+            "max_length stays the compiled ceiling)")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

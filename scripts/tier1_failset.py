@@ -54,6 +54,7 @@ _LINE = re.compile(r"^(FAILED|ERROR)\s+(.+)$")
 SLOW_ONLY_FILES = [
     "tests/test_elastic_e2e.py",
     "tests/test_master_failover_e2e.py",
+    "tests/test_serving_e2e.py",
 ]
 
 

@@ -99,6 +99,59 @@ def test_infer_mnist_lenet():
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-3)
 
 
+def _untrained_classifier(n_cls=3, dim=4):
+    x = layers.data("x", paddle.data_type.dense_vector(dim))
+    hidden = layers.fc(x, size=16, act=paddle.activation.Tanh())
+    pred = layers.fc(hidden, size=n_cls, act=paddle.activation.Softmax())
+    return pred, paddle.parameters.create(pred)
+
+
+def test_infer_ragged_batch_sizes_hit_jit_cache():
+    """Repeated infer() with varying batch sizes must NOT retrace per size:
+    the batch axis pads to a DEFAULT_BATCH_LADDER rung (compile-count
+    regression for the pre-serving behavior, where every distinct B was a
+    fresh XLA compile)."""
+    reset_auto_names()
+    pred, params = _untrained_classifier()
+    inferer = paddle.Inference(output_layer=pred, parameters=params)
+    rng = np.random.RandomState(0)
+    samples = [(rng.rand(4).astype(np.float32),) for _ in range(8)]
+    outs = {}
+    for bs in (5, 6, 7, 8, 5):  # all land on the B=8 rung
+        outs[bs] = inferer.infer(input=samples[:bs])
+        assert outs[bs].shape == (bs, 3)
+    assert inferer.trace_count == 1
+    inferer.infer(input=samples[:3])  # B=4 rung: exactly one more trace
+    inferer.infer(input=samples[:4])
+    assert inferer.trace_count == 2
+    # dead padding rows don't perturb the live rows
+    np.testing.assert_array_equal(outs[8][:5], outs[5])
+    # and the chunked path reuses the same rungs
+    inferer.infer(input=samples, batch_size=4)  # chunks of 4, 4
+    assert inferer.trace_count == 2
+
+
+def test_infer_ragged_seq_lengths_hit_jit_cache():
+    """Sequence inputs additionally round T onto the canonical shape
+    ladder, so ragged lengths share compiled variants too."""
+    reset_auto_names()
+    x = layers.data("x", paddle.data_type.dense_vector_sequence(2))
+    proj = layers.fc(x, size=5, act=paddle.activation.Tanh())
+    params = paddle.parameters.create(proj)
+    inferer = paddle.Inference(output_layer=proj, parameters=params)
+    rng = np.random.RandomState(1)
+
+    def sample(n):
+        return (rng.rand(n, 2).astype(np.float32).tolist(),)
+
+    for lens in ((3, 5), (9, 2), (16, 1)):  # all pad to T=16, B=2
+        vals = inferer.infer(input=[sample(n) for n in lens])
+        assert vals.shape == (sum(lens), 5)  # unpadded CSR rows intact
+    assert inferer.trace_count == 1
+    inferer.infer(input=[sample(20), sample(4)])  # T=32 rung
+    assert inferer.trace_count == 2
+
+
 # ---------------------------------------------------------------------------
 # generation through paddle.infer
 # ---------------------------------------------------------------------------

@@ -117,6 +117,32 @@ def test_beam_search_generation(trained):
 # beam-search user hooks (reference BeamSearchControlCallbacks,
 # RecurrentGradientMachine.h:70-120 + diy_beam_search_prob_so .cpp:27):
 # candidate-adjust / drop / norm as restricted in-graph functions
+def test_greedy_early_exit_matches_full_unroll(trained):
+    """The generate_greedy max_new_tokens/EOS-early-exit contract on a
+    TRAINED model (real per-row eos times): early exit and per-call caps
+    are bit-identical to the full unroll truncated."""
+    trainer, _ = trained
+    gen = Seq2SeqGenerator(
+        trainer.parameters, VOCAB, VOCAB, word_dim=24, hidden_dim=32,
+        bos_id=BOS, eos_id=EOS, max_length=10,
+    )
+    samples = list(copy_task_reader(n=24, seed=13)())
+    batch = _gen_batch(trainer, samples)
+    full_t, full_l = gen.generate_greedy(batch, early_exit=False)
+    early_t, early_l = gen.generate_greedy(batch)  # early exit is the default
+    np.testing.assert_array_equal(np.asarray(full_t), np.asarray(early_t))
+    np.testing.assert_array_equal(np.asarray(full_l), np.asarray(early_l))
+    for cap in (1, 4, 10, 64):  # caps beyond max_length clamp to it
+        cap_t, cap_l = gen.generate_greedy(batch, max_new_tokens=cap)
+        k = min(cap, 10)
+        np.testing.assert_array_equal(
+            np.asarray(cap_t), np.asarray(full_t)[:, :k]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cap_l), np.minimum(np.asarray(full_l), k)
+        )
+
+
 # ---------------------------------------------------------------------------
 
 

@@ -1,0 +1,435 @@
+"""Serving plane unit tests — block-paged cache, paged decode engine,
+continuous-batching scheduler (paddle_tpu/serving/).
+
+The load-bearing guarantees pinned here:
+
+* decode through the page table is BIT-IDENTICAL per request to the
+  one-shot ``Seq2SeqGenerator.generate_greedy`` path, under staggered
+  admission/retirement and after preemption;
+* compile counts stay bounded by the shape ladder (counter-asserted);
+* the HBM budget refuses admission instead of OOMing, and freed pages
+  re-admit the waiters;
+* greedy early-exit / ``max_new_tokens`` are bit-identical to the full
+  unroll truncated (the ops/beam contract the engine's step relies on).
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.batch import SeqTensor, pad_batch_rows, slice_batch_rows
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+from paddle_tpu.ops.beam import greedy_search
+from paddle_tpu.reader.loadgen import OpenLoopLoadGen
+from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
+from paddle_tpu.serving.pages import BlockPagedCache
+
+V, E, H = 20, 8, 12
+BOS, EOS = 0, 1
+MAXLEN = 8
+
+
+@pytest.fixture(scope="module")
+def small_gen():
+    """Seeded (untrained) tiny NMT generator — argmax decode over random
+    weights is deterministic, which is all bit-identity tests need."""
+    reset_auto_names()
+    cost, _ = seq2seq_cost(V, V, word_dim=E, hidden_dim=H)
+    params = paddle.parameters.create(cost, seed=5)
+    return Seq2SeqGenerator(
+        params, V, V, word_dim=E, hidden_dim=H,
+        bos_id=BOS, eos_id=EOS, max_length=MAXLEN,
+    )
+
+
+def make_engine(small_gen, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("hbm_budget_mb", 1)
+    kw.setdefault("max_new_tokens", MAXLEN)
+    return ServingEngine(small_gen, **kw)
+
+
+def srcs_of(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(2, V, size=n).tolist() for n in lengths]
+
+
+# ---------------------------------------------------------------------------
+# block-paged cache
+# ---------------------------------------------------------------------------
+
+
+def test_pages_budget_derives_capacity():
+    c = BlockPagedCache(16, {"enc": 24, "ep": 12}, hbm_budget_bytes=16 * 36 * 4 * 10)
+    assert c.bytes_per_block == 16 * 36 * 4
+    assert c.n_blocks == 10
+    assert c.scratch == 10 and c.pool_rows == 11
+    assert c.pages_for_tokens(1) == 1
+    assert c.pages_for_tokens(16) == 1
+    assert c.pages_for_tokens(17) == 2
+
+
+def test_pages_alloc_free_and_refusal():
+    c = BlockPagedCache(16, {"x": 1}, n_blocks=4)
+    a = c.alloc(3)
+    assert a is not None and len(a) == 3 and c.n_free == 1
+    assert c.alloc(2) is None  # refused, not partial
+    assert c.n_free == 1
+    c.free(a)
+    assert c.n_free == 4
+    b = c.alloc(4)
+    assert sorted(b) == [0, 1, 2, 3]
+    with pytest.raises(ValueError):
+        c.free([7])  # foreign id
+    c.free(b)
+    with pytest.raises(ValueError):
+        c.free([b[0]])  # double free
+
+
+def test_pages_zero_capacity_budget_raises():
+    with pytest.raises(ValueError):
+        BlockPagedCache(16, {"x": 1024}, hbm_budget_bytes=10)
+
+
+# ---------------------------------------------------------------------------
+# engine: bit-identity under continuous batching
+# ---------------------------------------------------------------------------
+
+
+def test_engine_requires_fused_match(small_gen, monkeypatch):
+    monkeypatch.setattr(small_gen, "_match", None)
+    with pytest.raises(ValueError, match="fused attention-GRU"):
+        make_engine(small_gen)
+
+
+def test_engine_staggered_bit_identical(small_gen):
+    eng = make_engine(small_gen)
+    reqs = [Request(s) for s in srcs_of(0, (3, 5, 9, 2, 17, 4))]
+    # continuous batching: admit mid-flight, retire mid-flight
+    assert len(eng.admit(reqs[:2])) == 2
+    fin = eng.step() + eng.step()
+    eng.admit(reqs[2:4])
+    for _ in range(40):
+        if len(fin) >= 4:
+            break
+        fin += eng.step()
+    eng.admit(reqs[4:])
+    for _ in range(40):
+        if not eng.n_live:
+            break
+        fin += eng.step()
+    assert len(fin) == 6 and eng.n_live == 0
+    for r in reqs:
+        assert r.tokens == eng.reference_decode(r.src_ids, MAXLEN), r.req_id
+    # every page and slot returned to the free pool
+    assert eng.pages.n_free == eng.pages.n_blocks
+    assert eng.n_free_slots == eng.max_slots
+
+
+def test_engine_compile_bounded_by_ladder(small_gen):
+    eng = make_engine(small_gen)
+    # two full rounds over the same length/slot-count mix: round 2 must
+    # add ZERO compiled variants — the continuous-batching contract
+    for seed in (0, 1):
+        reqs = [Request(s) for s in srcs_of(seed, (3, 5, 9, 2, 17, 4))]
+        eng.admit(reqs[:4])
+        done = []
+        while len(done) < 6:
+            done += eng.step()
+            if eng.n_free_slots and len(done) + eng.n_live < 6:
+                eng.admit(reqs[4:])
+        if seed == 0:
+            first = dict(eng.trace_counts)
+            # realized rungs: slot counts {1..4} -> B rungs {1,2,4};
+            # page counts {1,2} -> P rungs {1,2}; never one per shape mix
+            assert first["decode"] <= 6
+            assert first["prefill"] <= 4
+    assert eng.trace_counts == first  # round 2: all cache hits
+    assert len(eng._decode_table) == first["decode"]
+
+
+def test_engine_admission_refused_until_pages_free(small_gen):
+    # pool of 2 blocks: one 17-token request (2 pages) fills it
+    blk = 16 * (2 * H + H) * 4
+    eng = make_engine(small_gen, hbm_budget_mb=2 * blk / (1 << 20))
+    assert eng.pages.n_blocks == 2
+    big = Request(srcs_of(2, (17,))[0])
+    small = Request(srcs_of(3, (4,))[0])
+    assert eng.admit([big, small]) == [big]  # strict FCFS: small waits
+    assert eng.admit([small]) == []
+    while eng.n_live:
+        eng.step()
+    assert eng.admit([small]) == [small]
+    while eng.n_live:
+        eng.step()
+    assert small.tokens == eng.reference_decode(small.src_ids, MAXLEN)
+
+
+def test_engine_preemption_bit_identical(small_gen):
+    # block_steps=1 so two steps leave every request genuinely mid-decode
+    eng = make_engine(small_gen, block_steps=1)
+    reqs = [Request(s) for s in srcs_of(1, (4, 6, 3))]
+    eng.admit(reqs)
+    fin = eng.step() + eng.step()
+    victim = eng.preempt()
+    assert victim is not None and victim._resume is not None
+    assert victim._resume["tokens"] == victim._resume["tokens"]
+    # pages came back; re-admission restores the saved GRU state
+    eng.admit([victim])
+    for _ in range(40):
+        if not eng.n_live:
+            break
+        fin += eng.step()
+    for r in reqs:
+        assert r.tokens == eng.reference_decode(r.src_ids, MAXLEN), r.req_id
+
+
+def test_engine_block_steps_bit_identical(small_gen):
+    """K tokens per dispatch (odd K, forcing mid-block eos/cap crossings)
+    must not change a single output token vs K=1 vs the one-shot path."""
+    eng1 = make_engine(small_gen, block_steps=1)
+    eng3 = make_engine(small_gen, block_steps=3)
+    srcs = srcs_of(7, (3, 5, 9, 2, 17, 4, 6, 8))
+    outs = {}
+    for eng in (eng1, eng3):
+        reqs = [Request(s) for s in srcs]
+        eng.admit(reqs[:4])
+        done = []
+        for _ in range(100):
+            if len(done) == len(reqs):
+                break
+            done += eng.step()
+            if eng.n_free_slots:
+                eng.admit(reqs[len(done) + eng.n_live:])
+        outs[eng.block_steps] = [r.tokens for r in reqs]
+    assert outs[1] == outs[3]
+    for r_tokens, s in zip(outs[3], srcs):
+        assert r_tokens == eng3.reference_decode(s, MAXLEN)
+
+
+def test_engine_max_new_tokens_cap(small_gen):
+    eng = make_engine(small_gen)
+    r = Request(srcs_of(4, (6,))[0], max_new_tokens=2)
+    eng.admit([r])
+    fin = []
+    for _ in range(10):
+        if fin:
+            break
+        fin += eng.step()
+    assert fin == [r]
+    assert r.tokens == eng.reference_decode(r.src_ids, 2)
+    assert len(r.tokens) <= 2
+
+
+# ---------------------------------------------------------------------------
+# scheduler (threaded) — fast smoke; chaos/load drills live in
+# tests/test_serving_e2e.py (slow, `make chaos`)
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_serves_and_rejects(small_gen):
+    eng = make_engine(small_gen)
+    with ServingScheduler(eng) as sched:
+        good = [sched.submit(Request(s)) for s in srcs_of(5, (3, 7, 2))]
+        bad = [
+            sched.submit(Request([])),  # empty
+            sched.submit(Request([2, V + 5])),  # out of vocab
+            sched.submit(Request([2, 3], max_new_tokens=0)),  # bad cap
+            sched.submit(Request([2, 3], max_new_tokens="5")),  # non-numeric
+            sched.submit(Request([2, 3], max_new_tokens=float("nan"))),
+            sched.submit(Request([2, float("nan"), 3])),  # poisoned
+            sched.submit(Request(list(range(2, 2 + 10_000)) * 2)),  # too long
+        ]
+        for r in good + bad:
+            assert r.wait(60), r
+        for r in good:
+            assert r.error is None
+            assert r.result() == eng.reference_decode(r.src_ids, MAXLEN)
+            assert r.t_submit <= r.t_admit <= r.t_done
+        for r in bad:
+            assert r.error is not None
+            with pytest.raises(RuntimeError):
+                r.result()
+    # closed: no thread leaks, further submits refused
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("paddle-serve")
+    ]
+    with pytest.raises(RuntimeError):
+        sched.submit(Request([2, 3]))
+    sched.close()  # idempotent
+
+
+def test_scheduler_loop_crash_strands_no_client(small_gen, monkeypatch):
+    """An engine bug must fail LOUDLY: every outstanding request finalizes
+    with the crash error (wait() unblocks) and further submits raise —
+    never a silently dead daemon thread with clients parked forever."""
+    eng = make_engine(small_gen)
+    monkeypatch.setattr(
+        eng, "step", lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    sched = ServingScheduler(eng)
+    try:
+        r = sched.submit(Request([2, 3, 4]))
+        assert r.wait(30), "client stranded by a crashed step loop"
+        assert r.error is not None and "crashed" in r.error
+        for _ in range(200):  # the loop marks itself closed
+            try:
+                sched.submit(Request([2, 3]))
+                threading.Event().wait(0.01)
+            except RuntimeError:
+                break
+        else:
+            pytest.fail("submit still accepted after loop crash")
+    finally:
+        sched.close()
+
+
+def test_scheduler_callback_runs_off_step_thread(small_gen):
+    eng = make_engine(small_gen)
+    seen = []
+
+    def cb(r):
+        seen.append((r.req_id, threading.current_thread().name))
+
+    with ServingScheduler(eng) as sched:
+        r = sched.submit(Request([2, 3, 4], callback=cb))
+        assert r.wait(60)
+        # wait() unblocked by the STEP thread; the callback lands on the
+        # delivery thread shortly after
+        for _ in range(200):
+            if seen:
+                break
+            threading.Event().wait(0.01)
+    assert seen and seen[0][1] == "paddle-serve-deliver"
+
+
+# ---------------------------------------------------------------------------
+# greedy early-exit / max_new_tokens (ops/beam contract)
+# ---------------------------------------------------------------------------
+
+
+def _toy_step_fn(vocab=6, eos=1):
+    """Deterministic step_fn: row b emits token (2+b+t) % vocab until step
+    3+b, then eos — exercises per-row finish times."""
+
+    def step_fn(ids, carry):
+        t = carry["t"]
+        b = ids.shape[0]
+        row = jnp.arange(b, dtype=jnp.int32)
+        tok = jnp.where(t < 3 + row, (2 + row + t) % vocab, eos)
+        logp = jnp.full((b, vocab), -20.0).at[row, tok].set(0.0)
+        return logp, {"t": t + 1}
+
+    return step_fn, {"t": jnp.asarray(0, jnp.int32)}
+
+
+def test_greedy_early_exit_bit_identical_toy():
+    step_fn, carry = _toy_step_fn()
+    full = greedy_search(step_fn, carry, 3, 0, 1, 12)
+    early = greedy_search(step_fn, carry, 3, 0, 1, 12, early_exit=True)
+    np.testing.assert_array_equal(np.asarray(full[0]), np.asarray(early[0]))
+    np.testing.assert_array_equal(np.asarray(full[1]), np.asarray(early[1]))
+    # truncation: capped run == full run's first k columns
+    for k in (1, 4, 12, 99):
+        capped = greedy_search(
+            step_fn, carry, 3, 0, 1, 12, max_new_tokens=k, early_exit=True
+        )
+        kk = min(k, 12)
+        np.testing.assert_array_equal(
+            np.asarray(capped[0]), np.asarray(full[0])[:, :kk]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(capped[1]), np.minimum(np.asarray(full[1]), kk)
+        )
+    zero = greedy_search(step_fn, carry, 3, 0, 1, 12, max_new_tokens=0)
+    assert np.asarray(zero[0]).shape == (3, 0)
+
+
+def test_generate_greedy_early_exit_bit_identical(small_gen):
+    from paddle_tpu.reader.feeder import DataFeeder
+
+    feeder = DataFeeder(small_gen._enc_net.topology.data_types())
+    batch = feeder([(s,) for s in srcs_of(6, (3, 5, 4))])
+    full_t, full_l = small_gen.generate_greedy(batch, early_exit=False)
+    early_t, early_l = small_gen.generate_greedy(batch)  # default early exit
+    np.testing.assert_array_equal(np.asarray(full_t), np.asarray(early_t))
+    np.testing.assert_array_equal(np.asarray(full_l), np.asarray(early_l))
+    cap_t, cap_l = small_gen.generate_greedy(batch, max_new_tokens=3)
+    np.testing.assert_array_equal(np.asarray(cap_t), np.asarray(full_t)[:, :3])
+    np.testing.assert_array_equal(
+        np.asarray(cap_l), np.minimum(np.asarray(full_l), 3)
+    )
+
+
+# ---------------------------------------------------------------------------
+# batch-row canonicalization helpers (core/batch.py)
+# ---------------------------------------------------------------------------
+
+
+def test_pad_and_slice_batch_rows():
+    b = {
+        "x": SeqTensor(np.ones((3, 5, 2), np.float32),
+                       np.asarray([5, 2, 4], np.int32)),
+        "y": SeqTensor(np.ones((3, 7), np.float32)),
+    }
+    p = pad_batch_rows(b, 8)
+    assert p["x"].data.shape == (8, 5, 2)
+    assert p["y"].data.shape == (8, 7)
+    # dead rows: zero data, length 1 (never 0 — mean-pool safe)
+    assert p["x"].data[3:].sum() == 0
+    assert list(np.asarray(p["x"].lengths)) == [5, 2, 4, 1, 1, 1, 1, 1]
+    s = slice_batch_rows(p, 3)
+    np.testing.assert_array_equal(np.asarray(s["x"].data), b["x"].data)
+    np.testing.assert_array_equal(np.asarray(s["x"].lengths), b["x"].lengths)
+    # already at the rung: no-op
+    assert pad_batch_rows(b, 3)["x"] is b["x"]
+
+
+# ---------------------------------------------------------------------------
+# open-loop load generator
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_open_loop_arrivals_independent_of_completion():
+    # virtual clock: sleep() advances it; submit() takes 0.4s of "service
+    # time" — open loop means arrival TIMES still follow the schedule
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        now[0] += s
+
+    gen = OpenLoopLoadGen(
+        10.0, 5, lambda i: i, process="uniform", clock=clock, sleep=sleep
+    )
+    times = []
+
+    def submit(i):
+        times.append((i, clock()))
+        now[0] += 0.4  # a slow server mustn't throttle the arrival clock
+        return i
+
+    gen.run(submit)
+    assert [i for i, _ in times] == [0, 1, 2, 3, 4]
+    # uniform at 10 req/s: scheduled arrivals at 0.1, 0.2, ...; service
+    # time pushes the clock PAST later arrivals, which then fire with no
+    # extra wait (the queueing shows up at the server, not the generator)
+    assert times[0][1] == pytest.approx(0.1, abs=1e-6)
+    assert times[1][1] == pytest.approx(0.5, abs=1e-6)
+
+
+def test_loadgen_deterministic_schedule():
+    a = OpenLoopLoadGen(5.0, 8, lambda i: i, seed=3).arrivals
+    b = OpenLoopLoadGen(5.0, 8, lambda i: i, seed=3).arrivals
+    c = OpenLoopLoadGen(5.0, 8, lambda i: i, seed=4).arrivals
+    assert a == b != c
+    with pytest.raises(ValueError):
+        OpenLoopLoadGen(0.0, 1, lambda i: i)
+    with pytest.raises(ValueError):
+        OpenLoopLoadGen(1.0, 1, lambda i: i, process="bursty")
