@@ -31,16 +31,25 @@
 #                  failover drill (tests/test_master_failover_e2e.py:
 #                  kill -9 the LEADER mid-pass under a 4-worker fleet —
 #                  the standby takes over warm from the journal, zero
-#                  recomputed tasks, bit-for-bit params), and the serving
+#                  recomputed tasks, bit-for-bit params), the serving
 #                  drills (tests/test_serving_e2e.py: open-loop load +
 #                  poisoned-request rejection + slow-client isolation,
-#                  lock-sanitizer armed).
+#                  lock-sanitizer armed), and the production-gate fleet
+#                  scenarios (tests/test_scenarios_e2e.py: kill a worker
+#                  AND bounce the master under LIVE train+serve traffic;
+#                  SIGTERM graceful drain of `paddle-tpu serve`).
+#   make scenarios — the fast production-gate scenario subset
+#                  (robustness/scenarios.py via `paddle-tpu scenario
+#                  --all-fast`), sanitizer-armed: overload shed-not-
+#                  collapse, burst arrivals, chaos-under-load recovery,
+#                  mixed train+serve.  Runs as the last step of `make
+#                  test`, so the fast tier reports the SLO gates too.
 #   make serve-bench — the serving-plane headline (bench_serving).
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test verify bench test-all lint tier1-check tier1-update chaos serve-bench
+.PHONY: test verify bench test-all lint tier1-check tier1-update chaos serve-bench scenarios
 
 lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
@@ -51,6 +60,13 @@ lint:
 
 test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
+	$(MAKE) scenarios
+
+# the fast production-gate scenario subset, SANITIZER-ARMED (each measured
+# window doubles as a runtime lock-order drill on the scheduler's new
+# shed/cancel/drain paths); one JSON metrics line per scenario
+scenarios:
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m paddle_tpu scenario --all-fast
 
 tier1-check:
 	$(CPU_ENV) $(PY) scripts/tier1_failset.py --slow-guard
@@ -68,6 +84,7 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_elastic_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_scenarios_e2e.py -q
 
 # the serving-plane headline under the bench regression guard: continuous
 # batching + block-paged decode cache vs the one-shot path, open-loop load

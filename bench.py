@@ -810,6 +810,84 @@ def bench_serving() -> list:
     ]
 
 
+def bench_scenarios() -> list:
+    """Production-gate scenario record (ROADMAP item 5): the scenario
+    harness (robustness/scenarios.py) run under the bench regression
+    guard.  Three fast scenarios, gates ASSERTED in-run:
+
+      * overload — the shed-not-collapse gate: at 2x the measured
+        saturation rate, goodput (completed within the SLO) must hold
+        >= 80% of the saturation-rate goodput AND the p99 of served
+        requests must stay inside the SLO (deadline-aware shedding
+        degrades to the feasible subset; pre-SLO FCFS collapses here);
+      * nan_request_under_load — a poisoned request fired mid-traffic:
+        exactly one victim, recovery-time-after-fault reported;
+      * mixed_train_serve — train + serve concurrently in one process:
+        training stays bit-identical to the solo run.
+
+    The committed round artifact is SCENARIO_r12.json; load_prior_bench
+    reads SCENARIO_r*.json into the same best_prior history BENCH_r*.json
+    feeds."""
+    from paddle_tpu.robustness import scenarios
+
+    ov = scenarios.scenario_overload()
+    assert ov["passed"], (
+        "shed-not-collapse gate failed: "
+        f"goodput 2x/1x {ov['goodput_2x_over_1x']} "
+        f"(gate_goodput={ov['gate_goodput_2x_ge_80pct']}, "
+        f"gate_p99={ov['gate_p99_within_slo']})"
+    )
+    nan = scenarios.scenario_chaos_under_load(point="nan_request")
+    assert nan["passed"], f"nan_request_under_load failed: {nan}"
+    mixed = scenarios.scenario_mixed_train_serve()
+    assert mixed["passed"], f"mixed_train_serve failed: {mixed}"
+    return [
+        {
+            "metric": "scenario_goodput_2x_frac",
+            "value": ov["goodput_2x_over_1x"],
+            "unit": "goodput@2x-saturation / goodput@saturation "
+            "(completed-within-SLO rate; gate >= 0.8)",
+            "slo_ms": ov["slo_ms"],
+            "saturation_rps": ov["saturation_rps"],
+            "statuses_2x": ov["at_2x"]["statuses"],
+            "statuses_1x": ov["at_1x"]["statuses"],
+            "p99_ms_2x_served": ov["at_2x"]["p99_ms"],
+            "gate_goodput_2x_ge_80pct": ov["gate_goodput_2x_ge_80pct"],
+            "gate_p99_within_slo": ov["gate_p99_within_slo"],
+            "binds": "open-loop Poisson arrivals with per-request "
+            "deadlines = SLO; saturation derived as slots/mean-service "
+            "from an all-at-once wave; shed = deadline-infeasible at "
+            "admission (EWMA queue-wait predictor), timeout = canceled "
+            "mid-decode at deadline (slot+pages freed)",
+        },
+        {
+            "metric": "scenario_served_p99_ms_at_saturation",
+            "value": ov["at_1x"]["p99_ms"],
+            "unit": "ms end-to-end at 1x saturation (cpu container)",
+            "p50_ms": ov["at_1x"]["p50_ms"],
+            "p95_ms": ov["at_1x"]["p95_ms"],
+        },
+        {
+            "metric": "scenario_chaos_recovery_ms",
+            "value": nan["recovery_after_fault_ms"],
+            "unit": "ms fault-to-next-completion under live load "
+            "(nan_request mid-traffic)",
+            "n_chaos_victims": nan["n_chaos_victims"],
+            "goodput_frac": nan["goodput_frac"],
+        },
+        {
+            "metric": "scenario_mixed_train_serve_goodput",
+            "value": mixed["goodput_frac"],
+            "unit": "fraction of requests completed within SLO while a "
+            "training loop shares the process",
+            "train_bit_identical_to_solo":
+                mixed["train_bit_identical_to_solo"],
+            "train_steps_per_s_solo": mixed["train_steps_per_s_solo"],
+            "train_steps_per_s_mixed": mixed["train_steps_per_s_mixed"],
+        },
+    ]
+
+
 def bench_resnet_pipeline() -> list:
     """ResNet-50 fed through the REAL IO plane: recordio file -> native
     threaded Prefetcher -> host decode/batching -> uint8 device transfer ->
@@ -2200,8 +2278,11 @@ def load_prior_bench(repo_dir: str) -> dict:
     import re
 
     prior: dict = {}
-    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
-        rnd = os.path.basename(path)[len("BENCH_"):-len(".json")]
+    paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    # scenario-gate rounds ride the same guard (SCENARIO_r12.json+)
+    paths += sorted(glob.glob(os.path.join(repo_dir, "SCENARIO_r*.json")))
+    for path in paths:
+        rnd = os.path.basename(path).split("_", 1)[1][:-len(".json")]
         try:
             with open(path) as f:
                 d = json.load(f)
@@ -2268,6 +2349,7 @@ def main() -> None:
     prior = load_prior_bench(repo_dir)
     results = []
     for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_serving,
+               bench_scenarios,
                bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,
                bench_elastic_scaling, bench_master_failover,

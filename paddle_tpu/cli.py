@@ -672,11 +672,16 @@ def cmd_plotcurve(argv: List[str]) -> int:
 def cmd_serve(argv: List[str]) -> int:
     """``paddle-tpu serve`` — the TPU-native serving plane over the NMT
     flagship (serving/): request queue + continuous batching + block-paged
-    decode cache.  Requests come from ``--requests`` (one line of
-    space-separated source token ids each) or ``--synthetic N``; arrivals
-    follow the open-loop generator at ``--rate`` req/s.  Prints one JSON
-    line per completed request and a final summary line (sustained req/s,
-    p50/p99 per-token latency — the Gemma-on-TPU serving metric set)."""
+    decode cache, with the production SLO surface (deadlines, bounded
+    queue, shedding, chunked prefill).  Requests come from ``--requests``
+    (one line of space-separated source token ids each) or ``--synthetic
+    N``; arrivals follow the open-loop generator at ``--rate`` req/s.
+    Prints one JSON line per completed request and a final summary line
+    with the DISJOINT status ledger (served / shed / rejected / timeout /
+    unfinished — the Gemma-on-TPU serving metric set plus the overload
+    taxonomy).  SIGTERM drains gracefully: stop admitting, finish every
+    in-flight request, exit 0 (the PreemptionGuard contract the trainer
+    already honors); a second signal still kills."""
     import json as _json
     import time as _time
 
@@ -696,6 +701,20 @@ def cmd_serve(argv: List[str]) -> int:
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--max-slots", type=int, default=None)
     ap.add_argument("--hbm-budget-mb", type=int, default=None)
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="per-request end-to-end deadline; infeasible "
+                    "requests are SHED at admission (default: the "
+                    "serving_default_deadline_s flag; 0 = none)")
+    ap.add_argument("--queue-limit", type=int, default=None,
+                    help="bound on queued-ahead-of-admission requests; "
+                    "beyond it submits are REJECTED immediately (default: "
+                    "the serving_queue_limit flag; 0 = unbounded)")
+    ap.add_argument("--prefill-chunk-tokens", type=int, default=None,
+                    help="chunked prefill bound (default: the "
+                    "serving_prefill_chunk_tokens flag; 0 = whole-prompt "
+                    "prefill)")
+    ap.add_argument("--drain-timeout-s", type=float, default=60.0,
+                    help="graceful-drain budget after SIGTERM/SIGINT")
     ap.add_argument("--requests", default="",
                     help="file of requests (space-separated src ids/line)")
     ap.add_argument("--synthetic", type=int, default=16,
@@ -703,6 +722,9 @@ def cmd_serve(argv: List[str]) -> int:
     ap.add_argument("--rate", type=float, default=0.0,
                     help="open-loop arrival rate (req/s); 0 = submit all "
                     "immediately")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=["poisson", "uniform", "burst"],
+                    help="open-loop arrival process (reader/loadgen.py)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--stats-out", default="",
@@ -715,6 +737,7 @@ def cmd_serve(argv: List[str]) -> int:
     from paddle_tpu.core.topology import reset_auto_names
     from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
     from paddle_tpu.reader.loadgen import OpenLoopLoadGen
+    from paddle_tpu.robustness.preemption import PreemptionGuard
     from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
 
     reset_auto_names()
@@ -736,6 +759,7 @@ def cmd_serve(argv: List[str]) -> int:
         max_slots=args.max_slots,
         hbm_budget_mb=args.hbm_budget_mb,
         max_new_tokens=args.max_new_tokens,
+        prefill_chunk_tokens=args.prefill_chunk_tokens,
     )
 
     if args.requests:
@@ -756,44 +780,86 @@ def cmd_serve(argv: List[str]) -> int:
         done.append(r)
         print(_json.dumps({
             "req": r.req_id,
+            "status": r.status,
             "tokens": r.tokens,
             "error": r.error,
             "latency_ms": round((r.t_done - r.t_submit) * 1e3, 3),
         }), flush=True)
 
-    reqs = [Request(src, callback=on_done) for src in sources]
+    deadline_s = args.deadline_s
+    reqs = [
+        Request(src, callback=on_done, deadline_s=deadline_s)
+        for src in sources
+    ]
+    drained_clean = None
     t0 = _time.perf_counter()
-    with ServingScheduler(engine) as sched:
-        if args.rate > 0:
-            OpenLoopLoadGen(
-                args.rate, len(reqs), lambda i: reqs[i], seed=args.seed
-            ).run(sched.submit)
-        else:
-            for r in reqs:
-                sched.submit(r)
-        deadline = _time.perf_counter() + args.timeout_s
-        for r in reqs:
-            r.wait(max(0.0, deadline - _time.perf_counter()))
-    # categories are judged AFTER close() (which finalizes every
-    # outstanding request), so they are disjoint and sum to the total:
-    # served / rejected-by-validation / unfinished-at-shutdown
+    with PreemptionGuard() as guard:
+        sched = ServingScheduler(
+            engine, queue_limit=args.queue_limit,
+            default_deadline_s=(
+                args.deadline_s if args.deadline_s is not None else None
+            ),
+        )
+        try:
+            submitted = []
+            if args.rate > 0:
+                submitted = OpenLoopLoadGen(
+                    args.rate, len(reqs), lambda i: reqs[i],
+                    seed=args.seed, process=args.arrival,
+                ).run(sched.submit, stop=lambda: guard.triggered)
+            else:
+                for r in reqs:
+                    if guard.triggered:
+                        break
+                    sched.submit(r)
+                    submitted.append(r)
+            if guard.triggered:
+                # graceful drain: stop admitting, finish what's in flight,
+                # leave the untransmitted tail of the schedule unsubmitted
+                _echo("draining: SIGTERM/SIGINT — finishing in-flight "
+                      f"requests ({len(submitted)} submitted)")
+                drained_clean = sched.drain(args.drain_timeout_s)
+                reqs = list(submitted)
+            else:
+                wait_deadline = _time.perf_counter() + args.timeout_s
+                for r in reqs:
+                    # bounded poll; past the deadline, done() costs zero per
+                    # remaining request instead of a full wait() quantum
+                    while not r.done():
+                        if guard.triggered or (
+                            _time.perf_counter() > wait_deadline
+                        ):
+                            break
+                        r.wait(0.2)
+                    if guard.triggered:
+                        break
+                if guard.triggered:
+                    drained_clean = sched.drain(args.drain_timeout_s)
+        finally:
+            sched.close()
+    from paddle_tpu.serving import percentile, status_counts
+
+    # the status ledger is judged AFTER close() (which finalizes every
+    # outstanding request), so categories are DISJOINT and sum to total
     wall = _time.perf_counter() - t0
-    ok = [r for r in reqs if r.error is None]
-    pending = sum(1 for r in reqs if r.error and "closed" in r.error)
-    tpots = sorted(
+    by_status = status_counts(reqs)
+    ok = [r for r in reqs if r.status == "served"]
+    tpots = [
         (r.t_done - r.t_admit) / len(r.tokens)
         for r in ok if r.tokens and r.t_admit is not None
-    )
+    ]
 
     def pct(xs, p):
-        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 3) if xs else None
+        v = percentile(xs, p)
+        return None if v is None else round(v * 1e3, 3)
 
     summary = {
-        "served": len(ok),
-        "rejected": sum(
-            1 for r in reqs if r.error and "closed" not in r.error
-        ),
-        "unfinished": pending,
+        "served": by_status["served"],
+        "shed": by_status["shed"],
+        "rejected": by_status["rejected"],
+        "timeout": by_status["timeout"],
+        "unfinished": by_status["closed"],
+        "drained_clean": drained_clean,
         "wall_s": round(wall, 3),
         "sustained_req_per_sec": round(len(ok) / wall, 3) if wall > 0 else None,
         "p50_token_ms": pct(tpots, 0.50),
@@ -805,7 +871,79 @@ def cmd_serve(argv: List[str]) -> int:
     if args.stats_out:
         with open(args.stats_out, "w") as f:
             f.write(line + "\n")
-    return 0 if (ok and not pending) else 1
+    if drained_clean is not None:
+        # SIGTERM path: exit 0 iff the drain finished every in-flight
+        # request (no 'closed' stragglers) — the graceful-exit contract
+        return 0 if (drained_clean and not by_status["closed"]) else 1
+    return 0 if (ok and not by_status["closed"]) else 1
+
+
+def cmd_scenario(argv: List[str]) -> int:
+    """``paddle-tpu scenario`` — the production-gate scenario harness
+    (robustness/scenarios.py): run named mixed-traffic/chaos scenarios
+    and print one JSON metrics line each (p50/p95/p99, goodput under the
+    SLO, shed/reject/timeout counts, recovery-time-after-fault).  Exit 0
+    only when every requested scenario passed its gates."""
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu scenario",
+        description="mixed-traffic SLO + chaos scenario harness "
+        "(robustness/scenarios.py)",
+    )
+    ap.add_argument("--name", action="append", default=[],
+                    help="scenario to run (repeatable); see --list")
+    ap.add_argument("--all-fast", action="store_true",
+                    help="run every fast (in-process) scenario")
+    ap.add_argument("--list", action="store_true", dest="list_",
+                    help="list known scenarios and exit")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="end-to-end SLO override (default: the "
+                    "scenario_slo_ms flag, else derived from measurement)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None,
+                    help="scratch dir for fleet scenarios (default: a "
+                    "temp dir)")
+    ap.add_argument("--out", default="",
+                    help="append one JSON line per scenario here too")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.robustness import scenarios as _sc
+
+    if args.list_:
+        for n in sorted(_sc.FAST_SCENARIOS):
+            print(f"{n}  (fast)")
+        for n in sorted(_sc.SLOW_SCENARIOS):
+            print(f"{n}  (slow: spawns a worker fleet)")
+        return 0
+    names = list(args.name)
+    if args.all_fast:
+        names.extend(n for n in _sc.FAST_SCENARIOS if n not in names)
+    if not names:
+        print("error: give --name (repeatable), --all-fast, or --list",
+              file=sys.stderr)
+        return 2
+    failed = []
+    for name in names:
+        kw = {"seed": args.seed}
+        if args.slo_ms is not None:
+            kw["slo_ms"] = args.slo_ms
+        if name in _sc.SLOW_SCENARIOS:
+            import tempfile
+
+            kw["workdir"] = args.workdir or tempfile.mkdtemp(
+                prefix=f"paddle-tpu-scenario-{name}-"
+            )
+        res = _sc.run_scenario(name, **kw)
+        res.pop("_requests", None)
+        line = json.dumps(res)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "a") as f:
+                f.write(line + "\n")
+        if not res.get("passed"):
+            failed.append(name)
+    if failed:
+        print(f"SCENARIO FAILURES: {failed}", file=sys.stderr)
+    return 1 if failed else 0
 
 
 def cmd_worker(argv: List[str]) -> int:
@@ -1204,6 +1342,7 @@ _COMMANDS = {
     "lint": cmd_lint,
     "cache": cmd_cache,
     "serve": cmd_serve,
+    "scenario": cmd_scenario,
     "worker": cmd_worker,
     "master": cmd_master,
 }
@@ -1226,7 +1365,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("                      clear a persistent compile cache dir")
         print("    serve             continuous-batching serving plane over")
         print("                      the NMT flagship (request queue + paged")
-        print("                      decode cache)")
+        print("                      decode cache, SLO admission/shedding,")
+        print("                      SIGTERM graceful drain)")
+        print("    scenario          production-gate scenario harness: mixed")
+        print("                      traffic + chaos under load, SLO metrics")
         print("    master            run an HA master candidate (elastic")
         print("                      scale-out: registry + shard leases)")
         print("    worker            run one elastic trainer process against")

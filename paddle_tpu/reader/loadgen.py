@@ -8,7 +8,8 @@ fixed stochastic process independent of completions, so an overloaded
 server shows up as growing queueing delay in the latency percentiles
 rather than as a silently reduced offered rate.  This module is that
 arrival clock for the serving plane's bench/chaos drills (bench.py
-``bench_serving``, tests/test_serving_e2e.py).
+``bench_serving``, tests/test_serving_e2e.py) and the production-gate
+scenario harness (robustness/scenarios.py).
 
 Determinism: inter-arrival gaps precompute from a seeded RNG at
 construction, so a drill replays the identical arrival schedule; ``clock``
@@ -35,7 +36,16 @@ class OpenLoopLoadGen:
     regardless of how many earlier requests have completed.
 
     ``process``: ``"poisson"`` (exponential gaps — bursty, the realistic
-    default) or ``"uniform"`` (evenly spaced — the reproducible floor).
+    default), ``"uniform"`` (evenly spaced — the reproducible floor), or
+    ``"burst"`` (Poisson bursts riding a quiet base rate — the two-state
+    modulated Poisson process that makes tail-latency SLOs earn their
+    keep: long-run mean stays ``rate_rps``, but ``burst_factor``-times
+    that rate arrives during burst episodes covering ``burst_fraction``
+    of the schedule's span).
+
+    ``deadline_s``: when set, every built request is stamped with this
+    per-request end-to-end deadline (``request.deadline_s``) before
+    submission — the SLO input the scheduler's admission shedding reads.
     """
 
     def __init__(
@@ -46,42 +56,101 @@ class OpenLoopLoadGen:
         *,
         process: str = "poisson",
         seed: int = 0,
+        deadline_s: Optional[float] = None,
+        burst_factor: float = 3.0,
+        burst_fraction: float = 0.2,
         clock=time.perf_counter,
         sleep=time.sleep,
     ):
         if rate_rps <= 0:
             raise ValueError("rate_rps must be > 0")
-        if process not in ("poisson", "uniform"):
+        if process not in ("poisson", "uniform", "burst"):
             raise ValueError(f"unknown arrival process {process!r}")
         self.rate_rps = float(rate_rps)
         self.n_requests = int(n_requests)
         self.make_request = make_request
+        self.deadline_s = deadline_s
         self._clock = clock
         self._sleep = sleep
         rng = np.random.RandomState(seed)
         if process == "poisson":
             gaps = rng.exponential(1.0 / rate_rps, size=self.n_requests)
-        else:
+        elif process == "uniform":
             gaps = np.full(self.n_requests, 1.0 / rate_rps)
+        else:
+            gaps = self._burst_gaps(rng, burst_factor, burst_fraction)
         # arrival offsets from t0; the first request arrives after one gap
         self.arrivals: List[float] = list(np.cumsum(gaps))
+
+    def _burst_gaps(self, rng, burst_factor: float, burst_fraction: float):
+        """Two-state modulated Poisson gaps: each arrival draws its gap at
+        the burst rate (``burst_factor * rate_rps``) or the quiet rate,
+        with state residency exponential in TIME so bursts cover
+        ``burst_fraction`` of the span and the long-run mean rate solves
+        back to ``rate_rps`` exactly."""
+        if burst_factor <= 1.0:
+            raise ValueError("burst_factor must be > 1")
+        if not 0.0 < burst_fraction < 1.0:
+            raise ValueError("burst_fraction must be in (0, 1)")
+        burst_rate = burst_factor * self.rate_rps
+        # mean = f*burst + (1-f)*quiet  =>  quiet carries the remainder
+        quiet_rate = (
+            self.rate_rps * (1.0 - burst_fraction * burst_factor)
+            / (1.0 - burst_fraction)
+        )
+        if quiet_rate <= 0:
+            raise ValueError(
+                f"burst_factor {burst_factor} x burst_fraction "
+                f"{burst_fraction} leaves no quiet-rate remainder; lower one"
+            )
+        # state episodes long enough to hold several arrivals each (the
+        # point of a burst is queue build-up, not a lone early packet)
+        mean_quiet_s = 8.0 / quiet_rate
+        mean_burst_s = mean_quiet_s * burst_fraction / (1.0 - burst_fraction)
+        gaps = np.empty(self.n_requests)
+        in_burst = False
+        state_left = rng.exponential(mean_quiet_s)
+        for i in range(self.n_requests):
+            g = rng.exponential(
+                1.0 / (burst_rate if in_burst else quiet_rate)
+            )
+            gaps[i] = g
+            state_left -= g
+            if state_left <= 0:
+                in_burst = not in_burst
+                state_left = rng.exponential(
+                    mean_burst_s if in_burst else mean_quiet_s
+                )
+        return gaps
 
     @property
     def offered_duration_s(self) -> float:
         """Span of the arrival schedule (last arrival offset)."""
         return self.arrivals[-1] if self.arrivals else 0.0
 
-    def run(self, submit: Callable[[Any], Any]) -> List[Any]:
-        """Blocking open-loop injection; returns the submitted requests."""
+    def run(
+        self,
+        submit: Callable[[Any], Any],
+        stop: Optional[Callable[[], bool]] = None,
+    ) -> List[Any]:
+        """Blocking open-loop injection; returns the submitted requests.
+        ``stop()`` is polled before each arrival — a graceful drain (the
+        `paddle-tpu serve` SIGTERM path) truncates the schedule instead of
+        offering load to a server that stopped admitting."""
         submitted: List[Any] = []
         t0 = self._clock()
         for i, at in enumerate(self.arrivals):
             # bounded-poll sleep toward the arrival time: stays responsive
             # if a virtual clock jumps, never parks unbounded (C306)
             while True:
+                if stop is not None and stop():
+                    return submitted
                 delay = (t0 + at) - self._clock()
                 if delay <= 0:
                     break
                 self._sleep(min(delay, 0.05))
-            submitted.append(submit(self.make_request(i)))
+            req = self.make_request(i)
+            if self.deadline_s is not None:
+                req.deadline_s = self.deadline_s
+            submitted.append(submit(req))
         return submitted

@@ -40,6 +40,14 @@ Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
                        decode loop must keep running (slow-consumer
                        isolation drill)
 
+Every point can also fire *under live mixed traffic*: the scenario
+harness (robustness/scenarios.py, ``paddle-tpu scenario``) arms
+``nan_request``/``serve_slow_client`` mid-open-loop-load and
+``kill_worker``/``kill_master`` under a training fleet that is serving
+concurrently, and reports recovery-time-after-fault — faults-at-rest and
+faults-under-load are different drills, and production only ever sees
+the second kind.
+
 ``@occurrence`` counts *consultations* of that point (1-based); omitting it
 means "every time".  Each armed point fires at most once per occurrence —
 ``fire()`` is exact-match, not ">=", so ``kill@12`` kills exactly at the

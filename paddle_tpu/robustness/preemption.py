@@ -22,6 +22,12 @@ non-blocking health-signal model of arXiv:1605.08695 §4.4 — no mid-step
 interruption, no torn device state); a SECOND signal falls through to the
 previously-installed handler, so a stuck run can still be killed with two
 Ctrl-Cs.
+
+The SERVING plane rides the same guard (`paddle-tpu serve`, cli.py): the
+first SIGTERM triggers ``ServingScheduler.drain()`` — stop admitting,
+finish every in-flight request, exit 0 — instead of a checkpoint; the
+second-signal escape hatch is identical (tests/test_scenarios_e2e.py
+drills both).
 """
 
 from __future__ import annotations

@@ -17,11 +17,25 @@ comparison, arXiv:2605.25645, sets the metric vocabulary):
   PR-2 fused attention-GRU step gathering encoder state through the page
   table, ONE compiled step per (slot-rung, page-rung) pair;
 * :mod:`~paddle_tpu.serving.scheduler` — request queue + continuous
-  batching: sequences admit and retire every step, no recompiles.
+  batching: sequences admit and retire every step, no recompiles; plus
+  the production SLO plane (ISSUE 12): per-request deadlines, bounded-
+  queue backpressure, deadline-aware shedding, ``cancel``/``drain``.
 """
 
 from paddle_tpu.serving.engine import ServingEngine
 from paddle_tpu.serving.pages import BlockPagedCache
-from paddle_tpu.serving.scheduler import Request, ServingScheduler
+from paddle_tpu.serving.scheduler import (
+    Request,
+    ServingScheduler,
+    percentile,
+    status_counts,
+)
 
-__all__ = ["BlockPagedCache", "Request", "ServingEngine", "ServingScheduler"]
+__all__ = [
+    "BlockPagedCache",
+    "Request",
+    "ServingEngine",
+    "ServingScheduler",
+    "percentile",
+    "status_counts",
+]

@@ -27,6 +27,21 @@ contributes exact zeros, and every per-row op is batch-row independent.
 With ``aot_cache_dir`` set (PR 8), both program families dispatch through
 the persistent serialized-executable cache, so a serving process boots
 warm: deserialize, don't retrace.
+
+**Chunked prefill** (``serving_prefill_chunk_tokens``): a prompt whose
+padded source extent exceeds the chunk bound no longer prefills as one
+monolithic encoder dispatch that stalls every decoding sequence for its
+whole duration.  Instead the bi-GRU encoder runs in ladder-rung chunks
+with carried recurrent state — a forward pass of chunk scans left to
+right, a backward pass right to left, each chunk one bounded dispatch,
+page-scattered as the backward pass completes each span — and
+:meth:`ServingEngine.step` advances ONE chunk per call before decoding,
+so decode stalls are bounded by a chunk, not a prompt.  Bit-identity
+holds because a ``lax.scan`` split at chunk boundaries with carried state
+executes the identical per-step op sequence as the unsplit scan (pinned
+in tests/test_serving.py against the one-shot path).  The chunk programs
+are four fixed-shape jits (fw scan, bw scan, scatter+project, boot
+write) counted under ``trace_counts['prefill_chunk']``.
 """
 
 from __future__ import annotations
@@ -71,6 +86,36 @@ class _Slot:
         self.admit_seq = admit_seq
 
 
+class _PendingPrefill:
+    """One long prompt mid-chunked-prefill: its slot/pages are held, the
+    carried bi-GRU state and per-chunk forward activations live here until
+    the backward pass finishes scattering every span, then the slot goes
+    live for decode."""
+
+    __slots__ = (
+        "request", "pages", "enc_tokens", "max_new", "admit_seq", "ids",
+        "length", "rows", "n_chunks", "phase", "cursor", "h", "fw_chunks",
+        "resume",
+    )
+
+    def __init__(self, request, pages, enc_tokens, max_new, admit_seq,
+                 ids, length, rows, n_chunks, h0, resume):
+        self.request = request
+        self.pages = pages
+        self.enc_tokens = enc_tokens
+        self.max_new = max_new
+        self.admit_seq = admit_seq
+        self.ids = ids          # [1, S_pad] int32, host
+        self.length = length    # [1] int32, host
+        self.rows = rows        # [S_pad // block_tokens] page ids, host
+        self.n_chunks = n_chunks
+        self.phase = "fw"       # "fw" then "bw"
+        self.cursor = 0         # next chunk index (fw ascends, bw descends)
+        self.h = h0             # carried GRU state [1, H], device
+        self.fw_chunks = [None] * n_chunks  # [1, C, H] forward activations
+        self.resume = resume    # preemption save-state or None
+
+
 class ServingEngine:
     """Continuous-batching decode over a trained :class:`Seq2SeqGenerator`.
 
@@ -94,6 +139,7 @@ class ServingEngine:
         hbm_budget_mb: Optional[int] = None,
         max_new_tokens: Optional[int] = None,
         block_steps: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
         aot_cache_dir: Optional[str] = None,
         clock=time.perf_counter,
         stats=None,
@@ -200,18 +246,47 @@ class ServingEngine:
         }))
 
         self._slots: Dict[int, _Slot] = {}
+        self._prefilling: Dict[int, _PendingPrefill] = {}
         self._free_slots = list(range(self.max_slots - 1, -1, -1))
         self._admit_seq = 0
+
+        # chunked prefill: validate the chunk bound against the block size
+        # and the ladder (every taller rung must split into whole chunks),
+        # then extract the encoder weight bundle — an unmatched topology
+        # fails HERE, not mid-request
+        pc = (
+            prefill_chunk_tokens if prefill_chunk_tokens is not None
+            else _flags.get_flag("serving_prefill_chunk_tokens")
+        )
+        self.prefill_chunk_tokens = max(0, int(pc))
+        self._enc_w = None
+        if self.prefill_chunk_tokens:
+            c = self.prefill_chunk_tokens
+            if c % blk != 0:
+                raise ValueError(
+                    f"serving_prefill_chunk_tokens={c} must be a multiple "
+                    f"of serving_block_tokens={blk}"
+                )
+            bad = [r for r in DEFAULT_LADDER if r > c and r % c != 0]
+            if bad:
+                raise ValueError(
+                    f"serving_prefill_chunk_tokens={c} must divide every "
+                    f"taller shape-ladder rung; {bad} are not multiples"
+                )
+            self._enc_w = self._extract_encoder_weights()
 
         # compile accounting: prefill batches observe the same shape-cache
         # contract training feeds use; decode keys are (slot-rung,
         # page-rung) pairs counted through the same StatSet surface
         self.prefill_shapes = CompileShapeCache("serving_prefill", self._stats)
-        self.trace_counts = {"prefill": 0, "decode": 0}
+        self.trace_counts = {"prefill": 0, "decode": 0, "prefill_chunk": 0}
         self._prefill_jit = self._make_prefill()
         self._decode_table: Dict[Tuple[int, int], Any] = {}
         self._prefill_table: Dict[tuple, Any] = {}
         self._ref_table: Dict[tuple, Any] = {}
+        self._chunk_jits: Optional[Dict[str, Any]] = (
+            self._make_chunk_programs() if self.prefill_chunk_tokens else None
+        )
 
         self._aot = None
         if aot_cache_dir is None:
@@ -227,6 +302,11 @@ class ServingEngine:
         return len(self._slots)
 
     @property
+    def n_prefilling(self) -> int:
+        """Slots held by chunked prefills still scanning their prompt."""
+        return len(self._prefilling)
+
+    @property
     def n_free_slots(self) -> int:
         return len(self._free_slots)
 
@@ -237,6 +317,221 @@ class ServingEngine:
     def max_src_tokens(self) -> int:
         """Longest admissible source: its pages must fit the whole pool."""
         return self._pages.n_blocks * self.block_tokens
+
+    def outstanding_requests(self) -> List:
+        """Every request holding a slot (live decode or chunked prefill)."""
+        return (
+            [s.request for s in self._slots.values()]
+            + [p.request for p in self._prefilling.values()]
+        )
+
+    # -- cancellation ----------------------------------------------------
+    def cancel(self, request) -> bool:
+        """Release ``request``'s slot and pages WITHOUT finishing it (the
+        scheduler's timeout/deadline path): decoding for a client that
+        gave up is the orphaned-slot leak this closes.  True when the
+        request held a slot here."""
+        for sid, s in self._slots.items():
+            if s.request is request:
+                self._slots.pop(sid)
+                self._pages.free(s.pages)
+                self._free_slots.append(sid)
+                self._stats.incr("serving/canceled")
+                return True
+        for sid, p in self._prefilling.items():
+            if p.request is request:
+                self._prefilling.pop(sid)
+                self._pages.free(p.pages)
+                self._free_slots.append(sid)
+                self._stats.incr("serving/canceled")
+                return True
+        return False
+
+    def cancel_by_id(self, req_id: str):
+        """Cancel by ``req_id``; returns the released request, or None."""
+        for s in list(self._slots.values()):
+            if s.request.req_id == req_id:
+                self.cancel(s.request)
+                return s.request
+        for p in list(self._prefilling.values()):
+            if p.request.req_id == req_id:
+                self.cancel(p.request)
+                return p.request
+        return None
+
+    # -- chunked-prefill weight extraction --------------------------------
+    def _extract_encoder_weights(self):
+        """Weight bundle + activation names of the bi-GRU encoder idiom
+        (embedding -> per-direction gate fc -> gru / reversed gru ->
+        concat -> identity projection fc; boot = fc over first_seq(enc)):
+        the chunk programs re-run exactly this chain with carried state.
+        A topology outside the idiom raises — chunked prefill has no
+        interpreted fallback, matching the decode-side contract."""
+        topo = self._gen._enc_net.topology
+        gp_sub = self._gp
+
+        def conf(name):
+            return topo.layers[name]
+
+        enc_c = conf(self._enc_layer)
+        if enc_c.type != "concat" or len(enc_c.inputs) != 2:
+            raise ValueError(
+                "chunked prefill requires enc = concat(fwd GRU, bwd GRU); "
+                f"got {enc_c.type} over {enc_c.inputs}"
+            )
+        dirs = {}
+        emb_name = None
+        for gname in enc_c.inputs:
+            g = conf(gname)
+            if g.type != "gru":
+                raise ValueError(
+                    f"chunked prefill: encoder branch {gname} is {g.type}, "
+                    "expected a fused grumemory"
+                )
+            t = conf(g.inputs[0])
+            if t.type != "fc" or len(t.inputs) != 1:
+                raise ValueError(
+                    f"chunked prefill: gate projection {g.inputs[0]} must "
+                    "be a single-input fc"
+                )
+            e = conf(t.inputs[0])
+            if e.type != "embedding":
+                raise ValueError(
+                    f"chunked prefill: encoder input {t.inputs[0]} must be "
+                    "an embedding"
+                )
+            if emb_name is None:
+                emb_name = e.name
+            elif emb_name != e.name:
+                raise ValueError(
+                    "chunked prefill: both GRU directions must share one "
+                    "source embedding"
+                )
+            key = "bw" if g.attr("reverse", False) else "fw"
+            if key in dirs:
+                raise ValueError(
+                    "chunked prefill: expected one forward and one "
+                    "reversed GRU direction"
+                )
+            dirs[key] = (gname, t.name, g)
+        if set(dirs) != {"fw", "bw"}:
+            raise ValueError(
+                "chunked prefill: encoder must pair a forward and a "
+                "reversed GRU"
+            )
+        ep_c = conf(self._ep_layer)
+        if (ep_c.type != "fc" or ep_c.inputs != (enc_c.name,)
+                or ep_c.act not in ("identity", "linear", "")):
+            raise ValueError(
+                "chunked prefill: encoded projection must be an identity "
+                f"fc over {enc_c.name}"
+            )
+        boot_names = [
+            n for n in topo.output_names
+            if n not in (self._enc_layer, self._ep_layer)
+        ]
+        if len(boot_names) != 1:
+            raise ValueError(
+                f"chunked prefill: expected one boot output, got {boot_names}"
+            )
+        boot_c = conf(boot_names[0])
+        first_c = conf(boot_c.inputs[0]) if boot_c.inputs else None
+        if (boot_c.type != "fc" or first_c is None
+                or first_c.type != "seqlastins"
+                or not first_c.attr("select_first", False)
+                or first_c.inputs != (enc_c.name,)):
+            raise ValueError(
+                "chunked prefill: decoder boot must be fc(first_seq(enc))"
+            )
+
+        net = self._gen._enc_net
+        lp = lambda n: net.layer_params(gp_sub, n)
+        out = {"emb_w": lp(emb_name)["w"]}
+        for key in ("fw", "bw"):
+            gname, tname, g = dirs[key]
+            tp, gpr = lp(tname), lp(gname)
+            out[f"{key}_gates_w"] = tp["w0"]
+            out[f"{key}_gates_b"] = tp.get("b")
+            out[f"{key}_w_h"] = gpr["w_h"]
+            out[f"{key}_w_c"] = gpr["w_c"]
+            out[f"{key}_b"] = gpr.get("b")
+        pp, bp = lp(ep_c.name), lp(boot_c.name)
+        out["proj_w"] = pp["w0"]
+        out["proj_b"] = pp.get("b")
+        out["boot_w"] = bp["w0"]
+        out["boot_b"] = bp.get("b")
+        gf, gb = dirs["fw"][2], dirs["bw"][2]
+        self._enc_acts = {
+            "fw": (gf.attr("gate_act", "sigmoid"),
+                   gf.attr("active_type", gf.act or "tanh")),
+            "bw": (gb.attr("gate_act", "sigmoid"),
+                   gb.attr("active_type", gb.act or "tanh")),
+            "boot": boot_c.act or "identity",
+        }
+        return out
+
+    def _make_chunk_programs(self):
+        """The four fixed-shape chunk jits.  Scan splitting preserves
+        bit-identity: each chunk executes the identical per-step ops the
+        unsplit encoder scan would, from the carried state."""
+        from paddle_tpu.layers.base import take_rows_or_zero
+        from paddle_tpu.ops.activations import get_activation
+        from paddle_tpu.ops.rnn import gru_scan
+
+        acts = self._enc_acts
+        blk = self.block_tokens
+        c_tokens = self.prefill_chunk_tokens
+
+        def chunk_dir(key, reverse):
+            gate_act, act = acts[key]
+
+            def run(w, ids, lk, h):
+                self.trace_counts["prefill_chunk"] += 1
+                emb = take_rows_or_zero(w["emb_w"], ids)
+                gates = jnp.matmul(emb, w[f"{key}_gates_w"])
+                if w[f"{key}_gates_b"] is not None:
+                    gates = gates + w[f"{key}_gates_b"]
+                return gru_scan(
+                    gates, w[f"{key}_w_h"], w[f"{key}_w_c"], w[f"{key}_b"],
+                    lk, gate_act=gate_act, act=act, reverse=reverse, h0=h,
+                )
+
+            return jax.jit(run)
+
+        def scatter(enc_pool, ep_pool, fw_hs, bw_hs, rows, w, sp_b):
+            self.trace_counts["prefill_chunk"] += 1
+            enc = jnp.concatenate([fw_hs, bw_hs], axis=-1)  # [1, C, 2H]
+            ep = jnp.matmul(enc, w["proj_w"])
+            if w["proj_b"] is not None:
+                ep = ep + w["proj_b"]
+            if sp_b is not None:
+                ep = ep + sp_b  # score-key bias folds in at prefill time
+            nb = c_tokens // blk
+            enc_pool = enc_pool.at[rows].set(
+                enc.reshape(nb, blk, enc.shape[-1])
+            )
+            ep_pool = ep_pool.at[rows].set(ep.reshape(nb, blk, ep.shape[-1]))
+            return enc_pool, ep_pool
+
+        boot_act = get_activation(acts["boot"])
+
+        def boot_write(h_state, slot_rows, fw0, bw0, boot_mask, h_override,
+                       w):
+            self.trace_counts["prefill_chunk"] += 1
+            enc0 = jnp.concatenate([fw0, bw0], axis=-1)  # [1, 2H]
+            boot = jnp.matmul(enc0, w["boot_w"])
+            if w["boot_b"] is not None:
+                boot = boot + w["boot_b"]
+            boot = boot_act(boot)
+            h_write = jnp.where(boot_mask[:, None], boot, h_override)
+            return h_state.at[slot_rows].set(h_write)
+
+        return {
+            "fw": chunk_dir("fw", False),
+            "bw": chunk_dir("bw", True),
+            "scatter": jax.jit(scatter, donate_argnums=(0, 1)),
+            "boot": jax.jit(boot_write, donate_argnums=(0,)),
+        }
 
     # -- compiled program builders --------------------------------------
     def _make_prefill(self):
@@ -372,11 +667,60 @@ class ServingEngine:
         return exe
 
     # -- admission -------------------------------------------------------
+    def _chunked_extent(self, src_len: int) -> Optional[int]:
+        """Padded extent when ``src_len`` takes the chunked-prefill path
+        (its rung exceeds the chunk bound), else None (one-shot batch
+        prefill — short prompts keep the fused group dispatch)."""
+        if not self.prefill_chunk_tokens:
+            return None
+        s_pad = ladder_len(src_len, DEFAULT_LADDER)
+        return s_pad if s_pad > self.prefill_chunk_tokens else None
+
+    def _admit_chunked(self, r, sid: int, pages, s_pad: int) -> None:
+        """Register one long prompt for chunk-at-a-time prefill: pad its
+        ids through the same feeder contract the batch path uses, lay out
+        its page rows over the padded extent (scratch past its real
+        pages), and queue it behind any prefill already in flight."""
+        batch = self._feeder([(list(r.src_ids),)])
+        ids = np.asarray(batch[self.src_slot].data, np.int32)
+        if ids.ndim >= 2 and ids.shape[-1] == 1:
+            ids = ids[..., 0]
+        length = np.asarray(batch[self.src_slot].lengths, np.int32)
+        rows = np.full((s_pad // self.block_tokens,), self._pages.scratch,
+                       np.int32)
+        rows[: len(pages)] = pages
+        resume = getattr(r, "_resume", None)
+        if resume is not None:
+            r._resume = None
+        self._prefilling[sid] = _PendingPrefill(
+            request=r,
+            pages=pages,
+            enc_tokens=len(r.src_ids),
+            max_new=min(
+                r.max_new_tokens or self.default_max_new_tokens,
+                self._gen.max_length,
+            ),
+            admit_seq=self._admit_seq,
+            ids=ids,
+            length=length,
+            rows=rows,
+            n_chunks=s_pad // self.prefill_chunk_tokens,
+            h0=jnp.zeros(
+                (1, self._enc_w["fw_w_h"].shape[0]), self._dtype
+            ),
+            resume=resume,
+        )
+        self._admit_seq += 1
+        self._stats.incr("serving/chunked_prefills")
+
     def admit(self, requests: Sequence) -> List:
         """Admit a FIFO prefix of ``requests`` (free slot + pages for each;
-        the first misfit stops admission — strict FCFS, no starvation) and
-        prefill them as ONE bucketed batch.  Returns the admitted list."""
+        the first misfit stops admission — strict FCFS, no starvation):
+        short prompts prefill as ONE bucketed batch; prompts past the
+        chunked-prefill bound register for chunk-at-a-time prefill
+        instead.  Returns the admitted list, submission order."""
         group = []  # (slot_id, request, pages)
+        admitted = []
         for r in requests:
             if not self._free_slots:
                 break
@@ -385,6 +729,12 @@ class ServingEngine:
             if pages is None:
                 break
             sid = self._free_slots.pop()
+            admitted.append(r)
+            chunk_extent = self._chunked_extent(len(src))
+            if chunk_extent is not None:
+                self._admit_chunked(r, sid, pages, chunk_extent)
+                r.t_admit = self._clock()
+                continue
             resume = getattr(r, "_resume", None)
             slot = _Slot(
                 request=r,
@@ -404,8 +754,10 @@ class ServingEngine:
             self._admit_seq += 1
             self._slots[sid] = slot
             group.append((sid, r, pages))
+        if admitted:
+            self._stats.incr("serving/admitted", len(admitted))
         if not group:
-            return []
+            return admitted
 
         batch = self._feeder([(list(r.src_ids),) for _, r, _ in group])
         b_rung = ladder_len(len(group), DEFAULT_BATCH_LADDER)
@@ -437,14 +789,77 @@ class ServingEngine:
         now = self._clock()
         for _, r, _ in group:
             r.t_admit = now
-        self._stats.incr("serving/admitted", len(group))
-        return [r for _, r, _ in group]
+        return admitted
+
+    # -- chunked prefill advance ------------------------------------------
+    def _advance_prefill(self) -> None:
+        """Run ONE chunk dispatch of the oldest pending chunked prefill:
+        the forward pass ascends the chunks carrying fwd GRU state; the
+        backward pass descends carrying bwd state, scattering each
+        completed span's pages as it goes; the final (leftmost) backward
+        chunk writes the decoder boot state and the slot goes live."""
+        sid, p = next(iter(self._prefilling.items()))
+        jits = self._chunk_jits
+        w = self._enc_w
+        C = self.prefill_chunk_tokens
+        k = p.cursor
+        ids = jnp.asarray(p.ids[:, k * C:(k + 1) * C])
+        lk = jnp.asarray(np.clip(p.length - k * C, 0, C).astype(np.int32))
+        if p.phase == "fw":
+            hs, h = jits["fw"](w, ids, lk, p.h)
+            p.fw_chunks[k] = hs
+            p.h = h
+            p.cursor += 1
+            if p.cursor == p.n_chunks:
+                p.phase = "bw"
+                p.cursor = p.n_chunks - 1
+                p.h = jnp.zeros_like(h)
+            return
+        hs, h = jits["bw"](w, ids, lk, p.h)
+        nb = C // self.block_tokens
+        rows = jnp.asarray(p.rows[k * nb:(k + 1) * nb])
+        self._enc_pool, self._ep_pool = jits["scatter"](
+            self._enc_pool, self._ep_pool, p.fw_chunks[k], hs, rows, w,
+            self._w["sp_b"],
+        )
+        if k > 0:
+            p.h = h
+            p.cursor -= 1
+            return
+        # leftmost span scattered: write the boot state (or the saved GRU
+        # state of a resumed preemption victim) and promote to decode
+        boot_mask = np.asarray([p.resume is None])
+        h_override = np.zeros((1, self.hidden_dim), self._dtype)
+        if p.resume is not None:
+            h_override[0] = p.resume["h"]
+        self._h = jits["boot"](
+            self._h, np.asarray([sid], np.int32), p.fw_chunks[0][:, 0],
+            hs[:, 0], jnp.asarray(boot_mask), jnp.asarray(h_override), w,
+        )
+        self._prefilling.pop(sid)
+        self._slots[sid] = _Slot(
+            request=p.request,
+            pages=p.pages,
+            enc_tokens=p.enc_tokens,
+            last_id=(
+                p.resume["last_id"] if p.resume is not None
+                else self._gen.bos_id
+            ),
+            tokens=list(p.resume["tokens"]) if p.resume is not None else [],
+            max_new=p.max_new,
+            admit_seq=p.admit_seq,
+        )
 
     # -- decode ----------------------------------------------------------
     def step(self) -> List:
-        """One decode step for every live slot; returns the requests that
-        finished this step (EOS emitted or ``max_new_tokens`` reached),
-        their pages freed and slots recycled."""
+        """Advance one chunked-prefill dispatch (if any long prompt is mid-
+        prefill — the decode interleave that bounds its head-of-line
+        stall), then one decode step for every live slot; returns the
+        requests that finished this step (EOS emitted or
+        ``max_new_tokens`` reached), their pages freed and slots
+        recycled."""
+        if self._prefilling:
+            self._advance_prefill()
         if not self._slots:
             return []
         live_ids = sorted(self._slots)
@@ -555,9 +970,11 @@ class ServingEngine:
     def summary(self) -> Dict[str, Any]:
         return {
             "live": self.n_live,
+            "prefilling": self.n_prefilling,
             "free_slots": self.n_free_slots,
             "pages": self._pages.summary(),
             "prefill_shapes": self.prefill_shapes.n_shapes,
             "decode_shapes": len(self._decode_table),
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "trace_counts": dict(self.trace_counts),
         }

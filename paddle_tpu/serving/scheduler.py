@@ -10,6 +10,30 @@ FIFO prefix into free slots/pages (prefill), and (3) runs one decode step
 for every live sequence — sequences admit and retire mid-flight with zero
 recompiles (continuous batching).
 
+Overload is a first-class input (ROADMAP item 5), not an accident:
+
+* every request may carry a **deadline** (``Request(deadline_s=...)``,
+  or the ``serving_default_deadline_s`` flag); its absolute form
+  ``t_deadline = t_submit + deadline_s`` is the SLO the scheduler honors;
+* the pre-admission queue is **bounded** (``serving_queue_limit``): a
+  submit beyond the bound is REJECTED immediately (``rejected`` status —
+  backpressure the client sees now, not a timeout it sees later);
+* admission is **deadline-aware**: a request whose predicted queue wait
+  (EWMA of recent per-token step time x queued-token depth / slot
+  concurrency, plus its own expected service time) already blows its
+  deadline is finalized immediately with the distinct ``shed`` status —
+  at 2x saturation the plane sheds the infeasible excess and keeps
+  serving the SLO-feasible subset instead of collapsing into universal
+  timeouts (the shed-not-collapse gate, robustness/scenarios.py);
+* abandoned work is **canceled**: ``cancel(req_id)`` (and a timed-out
+  ``generate()``) frees the request's slot and pages instead of decoding
+  to ``max_new_tokens`` for nobody, and a live request whose deadline
+  passes mid-decode is canceled the same way;
+* shutdown can be **graceful**: :meth:`drain` stops admitting, finishes
+  everything in flight, then closes — the `paddle-tpu serve` SIGTERM
+  path; :meth:`close` (the kill path) still finalizes every outstanding
+  request with an error so no client waits forever.
+
 Completion is two-phase so a slow client can never stall decoding:
 ``Request.wait()`` unblocks the moment the STEP thread finalizes the
 request; user callbacks run on a separate delivery thread (a slow
@@ -33,29 +57,64 @@ import logging
 import queue
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
 from paddle_tpu.analysis.lock_sanitizer import THREAD_PREFIX, make_lock
 from paddle_tpu.robustness import chaos
 
-__all__ = ["Request", "ServingScheduler"]
+__all__ = ["Request", "ServingScheduler", "percentile", "status_counts"]
 
 _log = logging.getLogger("paddle_tpu.serving")
 
 _req_counter = itertools.count()
 
+# terminal request statuses (the disjoint categories every summary/scenario
+# reports): served | rejected (validation or queue backpressure) | shed
+# (deadline-infeasible before admission) | timeout (canceled: client
+# timeout, explicit cancel, or deadline exceeded mid-decode) | closed
+# (scheduler shut down underneath it)
+_EWMA_DECAY = 0.8  # weight of history in the step-time/token-count EWMAs
+# admission headroom on the request's own expected service: service times
+# are token-count ragged (p95 runs 2-3x the mean), and admitting a request
+# that then times out mid-decode WASTES a slot for its whole residency —
+# worse for goodput than shedding it up front
+_SERVICE_SAFETY = 1.5
+
+
+def percentile(xs, p: float):
+    """Nearest-rank percentile (None when empty) — the ONE indexing rule
+    every serving/bench/scenario latency metric shares, so p50/p95/p99
+    never drift between the CLI summary, the bench and the harness."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def status_counts(requests) -> dict:
+    """The disjoint status ledger over finalized requests (every summary
+    reports exactly these keys, zero-filled)."""
+    out = {"served": 0, "shed": 0, "rejected": 0, "timeout": 0, "closed": 0}
+    for r in requests:
+        out[r.status] = out.get(r.status, 0) + 1
+    return out
+
 
 class Request:
-    """One generation request and its result/latency record.
+    """One generation request and its result/latency/SLO record.
 
     ``src_ids``: source token ids; ``max_new_tokens``: per-request decode
-    cap (None = the engine's default); ``callback(request)`` runs on the
-    delivery thread after completion.  Timing fields (``t_submit``,
+    cap (None = the engine's default); ``deadline_s``: end-to-end SLO in
+    seconds from submit (None = the ``serving_default_deadline_s`` flag;
+    0/unset = no deadline); ``callback(request)`` runs on the delivery
+    thread after completion.  ``status`` lands on exactly one of
+    served/rejected/shed/timeout/closed.  Timing fields (``t_submit``,
     ``t_admit``, ``t_first_token``, ``t_done``, per-token ``token_times``)
     are stamped by the scheduler/engine clock — the raw material of the
-    bench's sustained-req/s and p50/p99 per-token metrics."""
+    bench's sustained-req/s and p50/p99 per-token metrics and the
+    scenario harness's goodput-under-SLO."""
 
     def __init__(
         self,
@@ -63,14 +122,18 @@ class Request:
         max_new_tokens: Optional[int] = None,
         req_id: Optional[str] = None,
         callback: Optional[Callable[["Request"], Any]] = None,
+        deadline_s: Optional[float] = None,
     ):
         self.req_id = req_id if req_id is not None else f"r{next(_req_counter)}"
         self.src_ids = list(src_ids)
         self.max_new_tokens = max_new_tokens
         self.callback = callback
+        self.deadline_s = deadline_s
+        self.status = "pending"
         self.tokens: Optional[List[int]] = None
         self.error: Optional[str] = None
         self.t_submit: Optional[float] = None
+        self.t_deadline: Optional[float] = None  # absolute, set at submit
         self.t_admit: Optional[float] = None
         self.t_first_token: Optional[float] = None
         self.t_done: Optional[float] = None
@@ -86,7 +149,7 @@ class Request:
         return self._event.wait(timeout)
 
     def result(self) -> List[int]:
-        """Generated tokens; raises on a rejected/failed request."""
+        """Generated tokens; raises on a rejected/shed/failed request."""
         if not self._event.is_set():
             raise RuntimeError(f"request {self.req_id} not finished")
         if self.error is not None:
@@ -94,7 +157,7 @@ class Request:
         return list(self.tokens or [])
 
     def __repr__(self) -> str:  # pragma: no cover
-        state = "done" if self.done() else "pending"
+        state = self.status if self.done() else "pending"
         return f"Request({self.req_id}, {state}, err={self.error!r})"
 
 
@@ -108,8 +171,11 @@ class ServingScheduler:
         clock=time.perf_counter,
         sleep=time.sleep,
         idle_poll_s: float = 0.02,
+        queue_limit: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
         stats=None,
     ):
+        from paddle_tpu.utils import flags as _flags
         from paddle_tpu.utils.timers import global_stats
 
         self._engine = engine
@@ -117,11 +183,26 @@ class ServingScheduler:
         self._sleep = sleep  # injectable per the C306 discipline
         self._idle_poll_s = idle_poll_s
         self._stats = stats if stats is not None else global_stats
+        self.queue_limit = int(
+            queue_limit if queue_limit is not None
+            else _flags.get_flag("serving_queue_limit")
+        )
+        self.default_deadline_s = float(
+            default_deadline_s if default_deadline_s is not None
+            else _flags.get_flag("serving_default_deadline_s")
+        )
         self._q: "queue.Queue[Request]" = queue.Queue()
         self._deliver_q: "queue.Queue[Request]" = queue.Queue()
+        self._cancel_q: "queue.Queue[tuple]" = queue.Queue()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._lock = make_lock("serving-scheduler")
         self._closed = False  # guarded by _lock
+        self._depth = 0  # pre-admission queue depth; guarded by _lock
+        # step-thread-only SLO predictor state (never shared, no lock):
+        self._ewma_token_s: Optional[float] = None
+        self._ewma_tokens: Optional[float] = None
+        self._pending_cancels: dict = {}  # req_id -> (reason, ttl)
         self._step_thread = threading.Thread(
             target=self._loop, name=THREAD_PREFIX + "serve-step", daemon=True
         )
@@ -137,10 +218,17 @@ class ServingScheduler:
     def submit(self, request: Request) -> Request:
         """Enqueue a request (any thread).  The ``nan_request`` chaos point
         fires here — a poisoned submission must be caught by validation on
-        the step thread, not crash the batch."""
+        the step thread, not crash the batch.  Backpressure fires here
+        too: beyond ``queue_limit`` (or while draining) the request
+        finalizes immediately as ``rejected`` instead of queueing."""
         if chaos.fire("nan_request"):
             request.src_ids = list(request.src_ids) + [float("nan")]
         request.t_submit = self._clock()
+        if request.deadline_s is None and self.default_deadline_s > 0:
+            request.deadline_s = self.default_deadline_s
+        if request.deadline_s is not None and request.deadline_s > 0:
+            request.t_deadline = request.t_submit + float(request.deadline_s)
+        refuse = None
         # the put rides INSIDE the closed-check critical section so close()
         # (which sets _closed under this lock, then stops and drains) can
         # never miss a request that passed the check — an unbounded
@@ -148,17 +236,70 @@ class ServingScheduler:
         with self._lock:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
-            self._q.put(request)  # lock: allow[C304] UNBOUNDED queue — put never blocks; the hold closes the submit-vs-close race (close sets _closed and drains under the same lock ordering)
+            if self._draining.is_set():
+                refuse = "rejected: scheduler draining"
+            elif self.queue_limit and self._depth >= self.queue_limit:
+                refuse = (
+                    f"rejected: queue full ({self._depth} >= "
+                    f"queue_limit {self.queue_limit})"
+                )
+            else:
+                self._depth += 1
+                self._q.put(request)  # lock: allow[C304] UNBOUNDED queue — put never blocks; the hold closes the submit-vs-close race (close sets _closed and drains under the same lock ordering)
         self._stats.incr("serving/submitted")
+        if refuse is not None:
+            self._finalize(request, error=refuse, status="rejected")
         return request
 
     def generate(self, src_ids, max_new_tokens: Optional[int] = None,
-                 timeout: float = 60.0) -> List[int]:
-        """Submit-and-wait convenience: tokens, or raises on reject/timeout."""
-        r = self.submit(Request(src_ids, max_new_tokens))
+                 timeout: float = 60.0,
+                 deadline_s: Optional[float] = None) -> List[int]:
+        """Submit-and-wait convenience: tokens, or raises on
+        reject/shed/timeout.  A timed-out wait CANCELS the in-flight
+        request — its slot and pages free immediately instead of decoding
+        to ``max_new_tokens`` for a client that already gave up."""
+        r = self.submit(Request(src_ids, max_new_tokens,
+                                deadline_s=deadline_s))
         if not r.wait(timeout):
+            self.cancel(r, reason=f"timeout: client gave up after {timeout}s")
+            # bounded grace: the step loop processes the cancel on its next
+            # iteration and finalizes the request (frees slot + pages)
+            r.wait(10.0)
             raise TimeoutError(f"request {r.req_id} not served in {timeout}s")
         return r.result()
+
+    def cancel(self, request: Union[Request, str],
+               reason: str = "timeout: canceled") -> None:
+        """Cancel a submitted request by object or ``req_id`` (any
+        thread).  The step thread frees its slot/pages and finalizes it
+        with ``timeout`` status on its next iteration; already-finished
+        requests are untouched."""
+        req_id = request.req_id if isinstance(request, Request) else request
+        self._cancel_q.put((req_id, reason))
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Graceful shutdown: stop admitting (further submits are
+        rejected), let every already-submitted request finish, then
+        close.  Returns True when everything in flight completed within
+        ``timeout`` (the `paddle-tpu serve` SIGTERM contract: drain clean
+        -> exit 0); on False the close path finalized the stragglers with
+        errors."""
+        self._draining.set()
+        deadline = self._clock() + timeout
+        clean = False
+        while self._clock() < deadline:
+            with self._lock:
+                depth = self._depth
+            if (depth == 0 and self._engine.n_live == 0
+                    and getattr(self._engine, "n_prefilling", 0) == 0
+                    and self._deliver_q.empty()):
+                clean = True
+                break
+            if self._stop.is_set():  # crashed loop: close() reports the rest
+                break
+            self._sleep(0.02)
+        self.close()
+        return clean
 
     def close(self, timeout: float = 10.0) -> None:
         """Stop both threads; outstanding requests finalize with an error so
@@ -178,6 +319,7 @@ class ServingScheduler:
             if r._event.is_set():
                 continue
             r.error = "scheduler closed"
+            r.status = "closed"
             r.tokens = []
             r.t_done = self._clock()
             r._event.set()
@@ -222,8 +364,57 @@ class ServingScheduler:
                 return f"max_new_tokens must be a positive integer, got {m!r}"
         return None
 
+    # -- SLO predictor (step thread only) --------------------------------
+    def _est_service_s(self) -> Optional[float]:
+        """Expected wall service time of one request once admitted: EWMA
+        generated-token count x EWMA per-token step time.  None until the
+        first decode dispatch calibrates the EWMAs (no shedding blind)."""
+        if self._ewma_token_s is None:
+            return None
+        est_tokens = (
+            self._ewma_tokens if self._ewma_tokens is not None
+            else float(self._engine.default_max_new_tokens)
+        )
+        return max(est_tokens, 1.0) * self._ewma_token_s
+
+    def _predicted_wait_s(self, n_ahead: int) -> Optional[float]:
+        """Predicted queue wait for a request with ``n_ahead`` requests
+        queued before it: the backlog drains one admission per service
+        completion, ``max_slots`` of which run concurrently."""
+        per_req = self._est_service_s()
+        if per_req is None:
+            return None
+        backlog = n_ahead
+        if self._engine.n_free_slots == 0:
+            # a full house drains first — including slots still held by
+            # chunked prefills (occupied but not yet decoding)
+            backlog += self._engine.n_live + self._engine.n_prefilling
+        return per_req * backlog / max(1, self._engine.max_slots)
+
+    def _shed_verdict(self, r: Request, n_ahead: int,
+                      now: float) -> Optional[str]:
+        """The deadline-aware admission decision: shed when the predicted
+        queue wait plus the request's own expected service already lands
+        past its deadline."""
+        if r.t_deadline is None:
+            return None
+        wait = self._predicted_wait_s(n_ahead)
+        if wait is None:
+            return None
+        per_req = (self._est_service_s() or 0.0) * _SERVICE_SAFETY
+        eta = now + wait + per_req
+        if eta > r.t_deadline:
+            return (
+                f"shed: predicted completion {eta - r.t_submit:.3f}s after "
+                f"submit blows the {r.deadline_s:.3f}s deadline "
+                f"(queue wait ~{wait * 1e3:.0f} ms ahead of "
+                f"{n_ahead} queued)"
+            )
+        return None
+
     # -- step thread -----------------------------------------------------
-    def _finalize(self, r: Request, error: Optional[str] = None) -> None:
+    def _finalize(self, r: Request, error: Optional[str] = None,
+                  status: Optional[str] = None) -> None:
         # idempotent: a crash between engine registration and the waiting-
         # list trim can surface one request on BOTH shutdown paths — it
         # must finalize (and deliver its callback) exactly once
@@ -232,12 +423,20 @@ class ServingScheduler:
         r.t_done = self._clock()
         if error is not None:
             r.error = error
-            self._stats.incr("serving/rejected")
+        r.status = status if status is not None else (
+            "served" if r.error is None else "rejected"
+        )
+        if r.status != "served":
+            self._stats.incr("serving/" + r.status)
         if r.tokens is None:
             r.tokens = []
         r._event.set()  # wait() unblocks NOW, before any callback runs
         if r.callback is not None:
             self._deliver_q.put(r)
+
+    def _dec_depth(self, n: int = 1) -> None:
+        with self._lock:
+            self._depth -= n
 
     def _drain_submissions(self, waiting: List[Request],
                            block_s: float = 0.0) -> None:
@@ -247,10 +446,18 @@ class ServingScheduler:
             )
         except queue.Empty:
             return
+        now = self._clock()
         while True:
             err = self._validate(got)
+            shed = None if err is not None else self._shed_verdict(
+                got, len(waiting), now
+            )
             if err is not None:
-                self._finalize(got, error=err)
+                self._finalize(got, error=err, status="rejected")
+                self._dec_depth()
+            elif shed is not None:
+                self._finalize(got, error=shed, status="shed")
+                self._dec_depth()
             else:
                 got.src_ids = [int(t) for t in got.src_ids]
                 if got.max_new_tokens is not None:
@@ -261,6 +468,87 @@ class ServingScheduler:
             except queue.Empty:
                 return
 
+    def _process_cancels(self, waiting: List[Request]) -> None:
+        """Resolve queued cancellations (step thread): waiting requests
+        finalize in place; live/prefilling ones release their slot and
+        pages through the engine.  A cancel racing its own submit retries
+        for a bounded number of iterations."""
+        while True:
+            try:
+                req_id, reason = self._cancel_q.get_nowait()
+            except queue.Empty:
+                break
+            self._pending_cancels[req_id] = (reason, 200)
+        if not self._pending_cancels:
+            return
+        resolved = []
+        for req_id, (reason, ttl) in self._pending_cancels.items():
+            hit = None
+            for r in waiting:
+                if r.req_id == req_id:
+                    hit = r
+                    waiting.remove(r)
+                    self._dec_depth()
+                    break
+            if hit is None:
+                hit = self._engine.cancel_by_id(req_id)
+            if hit is not None:
+                self._finalize(hit, error=reason, status="timeout")
+                resolved.append(req_id)
+            elif ttl <= 1:
+                resolved.append(req_id)  # unknown/finished id: drop
+            else:
+                self._pending_cancels[req_id] = (reason, ttl - 1)
+        for req_id in resolved:
+            self._pending_cancels.pop(req_id, None)
+
+    def _sweep_deadlines(self, waiting: List[Request]) -> None:
+        """Expire deadlines: a QUEUED request whose remaining budget can no
+        longer cover its expected service is shed before it burns a slot
+        (the arrival-time prediction re-checked against reality — queues
+        drain slower than predicted under overload); a LIVE request past
+        its deadline is canceled — slot and pages free for feasible
+        work."""
+        now = self._clock()
+        floor = (self._est_service_s() or 0.0) * _SERVICE_SAFETY
+        expired = [
+            r for r in waiting
+            if r.t_deadline is not None and now + floor > r.t_deadline
+        ]
+        for r in expired:
+            waiting.remove(r)
+            self._dec_depth()
+            self._finalize(
+                r, error=(
+                    "shed: remaining deadline budget "
+                    f"{max(0.0, (r.t_deadline - now)) * 1e3:.0f} ms below "
+                    "the expected service time"
+                    if now <= r.t_deadline
+                    else "shed: deadline expired while queued"
+                ),
+                status="shed",
+            )
+        for r in list(self._engine.outstanding_requests()):
+            if r.t_deadline is not None and now > r.t_deadline:
+                if self._engine.cancel(r):
+                    self._finalize(
+                        r, error="timeout: deadline exceeded mid-decode",
+                        status="timeout",
+                    )
+
+    def _observe_step(self, dt: float, finished) -> None:
+        """Feed the SLO predictor: per-token step time from this dispatch,
+        generated-token counts from the requests it finished."""
+        per_token = dt / max(1, getattr(self._engine, "block_steps", 1))
+        self._ewma_token_s = per_token if self._ewma_token_s is None else (
+            _EWMA_DECAY * self._ewma_token_s + (1 - _EWMA_DECAY) * per_token
+        )
+        for r in finished:
+            n = float(len(r.tokens or [])) or 1.0
+            self._ewma_tokens = n if self._ewma_tokens is None else (
+                _EWMA_DECAY * self._ewma_tokens + (1 - _EWMA_DECAY) * n
+            )
+
     def _loop(self) -> None:
         waiting: List[Request] = []  # validated, awaiting slot/pages
         crash: Optional[str] = None
@@ -268,16 +556,36 @@ class ServingScheduler:
             while not self._stop.is_set():
                 # idle (nothing live, nothing waiting): block briefly on
                 # the queue instead of spinning
-                idle = not waiting and self._engine.n_live == 0
+                idle = (
+                    not waiting and self._engine.n_live == 0
+                    and self._engine.n_prefilling == 0
+                    and self._cancel_q.empty()
+                    and not self._pending_cancels
+                )
                 self._drain_submissions(
                     waiting, block_s=self._idle_poll_s if idle else 0.0
                 )
+                self._process_cancels(waiting)
+                self._sweep_deadlines(waiting)
                 if waiting:
                     admitted = self._engine.admit(waiting)
                     if admitted:
                         del waiting[: len(admitted)]
-                if self._engine.n_live:
-                    for r in self._engine.step():
+                        self._dec_depth(len(admitted))
+                if self._engine.n_live or self._engine.n_prefilling:
+                    traces0 = dict(self._engine.trace_counts)
+                    # a step that advanced a chunked-prefill dispatch, or
+                    # traced a new compiled variant, spent its wall time on
+                    # something other than decode — feeding it to the EWMA
+                    # would poison the shed predictor into shedding
+                    # feasible requests until the outlier washes out
+                    clean_sample = self._engine.n_prefilling == 0
+                    t0 = self._clock()
+                    finished = self._engine.step()
+                    dt = self._clock() - t0
+                    if clean_sample and self._engine.trace_counts == traces0:
+                        self._observe_step(dt, finished)
+                    for r in finished:
                         self._finalize(r)
         except Exception as e:  # engine bug: fail loudly, strand NO client
             _log.exception("serving step loop crashed; scheduler closes")
@@ -288,20 +596,24 @@ class ServingScheduler:
             self._stats.incr("serving/loop_crashes")
         # shutdown: nothing new executes; unblock every outstanding client
         error = crash or "scheduler closed"
+        status = "closed"
         self._drain_submissions(waiting)
         for r in waiting:
-            self._finalize(r, error=error)
+            self._finalize(r, error=error, status=status)
         try:
             while self._engine.n_live:
                 r = self._engine.preempt()
                 if r is None:
                     break
                 r._resume = None
-                self._finalize(r, error=error)
+                self._finalize(r, error=error, status=status)
+            for r in list(self._engine.outstanding_requests()):
+                self._engine.cancel(r)
+                self._finalize(r, error=error, status=status)
         except Exception:  # a corrupted engine can't block the unblocking
             _log.exception("engine teardown failed; finalizing live slots")
-            for s in list(self._engine._slots.values()):
-                self._finalize(s.request, error=error)
+            for r in list(self._engine.outstanding_requests()):
+                self._finalize(r, error=error, status=status)
 
     # -- delivery thread -------------------------------------------------
     def _delivery_loop(self) -> None:

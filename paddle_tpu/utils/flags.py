@@ -230,6 +230,38 @@ define_flag("serving_decode_block_steps", 4,
             "quantize to K-token boundaries (finished rows clamp to EOS "
             "in-graph, so outputs stay bit-identical to the one-shot "
             "path).  1 = sync every token (lowest time-to-first-token)")
+define_flag("serving_default_deadline_s", 0.0,
+            "default end-to-end deadline (seconds from submit) stamped on "
+            "serving requests that carry none of their own; the scheduler "
+            "SHEDS a request whose predicted queue wait already blows its "
+            "deadline (distinct 'shed' status — at overload the plane "
+            "degrades to its SLO-feasible subset instead of collapsing "
+            "into universal timeouts) and cancels a live request once its "
+            "deadline passes (pages free immediately).  0 = no deadline "
+            "(pre-SLO behavior)")
+define_flag("serving_queue_limit", 0,
+            "bound on requests queued ahead of admission (submitted + "
+            "validated-waiting) in the serving scheduler: a submit beyond "
+            "it is REJECTED immediately ('rejected: queue full' — open-"
+            "loop backpressure, the client retries elsewhere) instead of "
+            "growing an unbounded queue whose every occupant times out.  "
+            "0 = unbounded (pre-SLO behavior)")
+define_flag("serving_prefill_chunk_tokens", 0,
+            "chunked prefill: a prompt whose padded source extent exceeds "
+            "this many tokens prefills in ladder-rung chunks (carried "
+            "bi-GRU state, one bounded dispatch per chunk) interleaved "
+            "with decode steps, so a long prompt no longer stalls every "
+            "decoding sequence for its whole encoder forward (head-of-"
+            "line isolation; outputs stay bit-identical to the one-shot "
+            "path).  Must be a multiple of serving_block_tokens and "
+            "divide every larger shape-ladder rung.  0 = off (whole-"
+            "prompt prefill)")
+define_flag("scenario_slo_ms", 0.0,
+            "end-to-end latency SLO for the scenario harness "
+            "(robustness/scenarios.py): goodput counts requests completed "
+            "within this many ms of submit, and per-request deadlines "
+            "default to it.  0 = derive from the measured saturation "
+            "wave (2.5x its p95 service time, floored at 50 ms)")
 define_flag("serving_max_new_tokens", 32,
             "default per-request decode cap of the serving plane (a "
             "request's own max_new_tokens overrides; the generator's "

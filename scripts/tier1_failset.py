@@ -55,6 +55,7 @@ SLOW_ONLY_FILES = [
     "tests/test_elastic_e2e.py",
     "tests/test_master_failover_e2e.py",
     "tests/test_serving_e2e.py",
+    "tests/test_scenarios_e2e.py",
 ]
 
 

@@ -1,0 +1,82 @@
+"""Scenario-harness unit tests (fast tier) — registry, SLO resolution,
+status ledger helpers, CLI surface.  The scenarios themselves EXECUTE in
+`make scenarios` (paddle-tpu scenario --all-fast, sanitizer-armed) and in
+tests/test_scenarios_e2e.py (slow, `make chaos`) — running a full
+measured window here would blow the fast tier's time budget."""
+
+import pytest
+
+from paddle_tpu.robustness import scenarios
+from paddle_tpu.utils import flags as _flags
+
+
+def test_new_flags_registered_with_defaults():
+    assert _flags.get_flag("serving_default_deadline_s") == 0.0
+    assert _flags.get_flag("serving_queue_limit") == 0
+    assert _flags.get_flag("serving_prefill_chunk_tokens") == 0
+    assert _flags.get_flag("scenario_slo_ms") == 0.0
+
+
+def test_registry_names_and_unknown():
+    assert set(scenarios.FAST_SCENARIOS) == {
+        "overload", "burst_overload", "nan_request_under_load",
+        "slow_client_under_load", "mixed_train_serve",
+    }
+    assert set(scenarios.SLOW_SCENARIOS) == {
+        "fleet_kill_worker", "fleet_kill_master",
+    }
+    with pytest.raises(KeyError):
+        scenarios.run_scenario("frobnicate")
+
+
+def test_resolve_slo_precedence():
+    wave = {"p95_service_ms": 40.0, "mean_service_ms": 12.0}
+    # explicit beats everything
+    assert scenarios._resolve_slo_s(200.0, wave) == pytest.approx(0.2)
+    # flag beats derivation
+    _flags.set_flag("scenario_slo_ms", 120.0)
+    try:
+        assert scenarios._resolve_slo_s(None, wave) == pytest.approx(0.12)
+    finally:
+        _flags.reset_flags()
+    # derived: 2.5x p95 service, floored at 50 ms
+    assert scenarios._resolve_slo_s(None, wave) == pytest.approx(0.1)
+    assert scenarios._resolve_slo_s(None, {"p95_service_ms": 1.0}) == 0.05
+
+
+def test_status_counts_and_percentiles():
+    class R:
+        def __init__(self, status):
+            self.status = status
+
+    counts = scenarios._status_counts(
+        [R("served"), R("served"), R("shed"), R("timeout")]
+    )
+    assert counts["served"] == 2 and counts["shed"] == 1
+    assert counts["rejected"] == 0 and counts["timeout"] == 1
+    assert scenarios._pct([], 0.5) is None
+    assert scenarios._pct([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert scenarios._ms(None) is None
+    assert scenarios._ms(0.0123) == 12.3
+
+
+def test_chaos_scenario_rejects_calibration_clobbering_occurrence():
+    with pytest.raises(ValueError, match="occurrence"):
+        scenarios.scenario_chaos_under_load(occurrence=2)
+    with pytest.raises(ValueError, match="serving chaos point"):
+        scenarios.scenario_chaos_under_load(point="kill")
+
+
+def test_fleet_chaos_rejects_unknown_fault(tmp_path):
+    with pytest.raises(ValueError, match="unknown fleet fault"):
+        scenarios.run_fleet_chaos(str(tmp_path), kill="kill_everything")
+
+
+def test_cli_scenario_list_and_arg_validation(capsys):
+    from paddle_tpu.cli import cmd_scenario
+
+    assert cmd_scenario(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in ("overload", "fleet_kill_master", "mixed_train_serve"):
+        assert name in out
+    assert cmd_scenario([]) == 2  # no names given

@@ -308,6 +308,186 @@ def test_scheduler_callback_runs_off_step_thread(small_gen):
 
 
 # ---------------------------------------------------------------------------
+# SLO plane: deadlines, shedding, backpressure, cancel, drain
+# ---------------------------------------------------------------------------
+
+
+def test_generate_timeout_cancels_and_frees_pages(small_gen):
+    """The orphaned-slot regression: a timed-out ``generate()`` must CANCEL
+    its in-flight request — pages_in_use returns to 0 instead of the slot
+    decoding to max_new_tokens for nobody."""
+    import time as _time
+
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng)
+    try:
+        with pytest.raises(TimeoutError):
+            sched.generate([2, 3, 4], timeout=0.0)
+        deadline = _time.perf_counter() + 10.0
+        while _time.perf_counter() < deadline:
+            if (eng.pages.n_used == 0 and eng.n_live == 0
+                    and eng.n_free_slots == eng.max_slots):
+                break
+            threading.Event().wait(0.01)
+        assert eng.pages.n_used == 0, eng.pages.summary()
+        assert eng.n_live == 0 and eng.n_free_slots == eng.max_slots
+        # the canceled request burned nothing and the plane still serves
+        assert sched.generate([2, 3, 4], timeout=60.0) == (
+            eng.reference_decode([2, 3, 4], MAXLEN)
+        )
+    finally:
+        sched.close()
+
+
+def test_cancel_by_req_id(small_gen):
+    eng = make_engine(small_gen)
+    with ServingScheduler(eng) as sched:
+        r = sched.submit(Request(srcs_of(11, (6,))[0]))
+        sched.cancel(r.req_id, reason="timeout: operator cancel")
+        assert r.wait(10)
+        assert r.status in ("timeout", "served")  # raced completion is fine
+    assert eng.pages.n_used == 0
+
+
+def test_queue_limit_backpressure_rejects_immediately(small_gen):
+    eng = make_engine(small_gen)
+    with ServingScheduler(eng, queue_limit=2) as sched:
+        reqs = [sched.submit(Request(s)) for s in srcs_of(12, (5,) * 30)]
+        for r in reqs:
+            assert r.wait(60)
+        statuses = [r.status for r in reqs]
+        assert statuses.count("rejected") > 0
+        assert set(statuses) <= {"served", "rejected"}
+        for r in reqs:
+            if r.status == "rejected":
+                assert "queue full" in r.error
+                assert r.tokens == []
+
+
+def test_deadline_stamped_and_shed_statuses_disjoint(small_gen):
+    """An effectively-zero deadline sheds everything the sweep sees; the
+    ledger stays disjoint over served/shed/timeout."""
+    eng = make_engine(small_gen)
+    with ServingScheduler(eng) as sched:
+        # calibrate the EWMA so the shed predictor is live
+        sched.generate([2, 3, 4])
+        reqs = [
+            sched.submit(Request(s, deadline_s=1e-4))
+            for s in srcs_of(13, (5,) * 12)
+        ]
+        for r in reqs:
+            assert r.wait(60)
+            assert r.t_deadline is not None
+        assert all(r.status in ("shed", "timeout") for r in reqs), [
+            r.status for r in reqs
+        ]
+        assert any(r.status == "shed" for r in reqs)
+    assert eng.pages.n_used == 0
+
+
+def test_scheduler_shed_verdict_uses_predictor(small_gen):
+    """Deterministic predictor unit: with a calibrated EWMA, an infeasible
+    deadline sheds and a generous one admits."""
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng)
+    sched.close()  # predictor methods are pure reads after close
+    sched._ewma_token_s = 0.01  # 10 ms/token
+    sched._ewma_tokens = 8.0    # 80 ms expected service
+    now = 1000.0
+    tight = Request([2, 3], deadline_s=0.05)
+    tight.t_submit, tight.t_deadline = now, now + 0.05
+    verdict = sched._shed_verdict(tight, n_ahead=4, now=now)
+    assert verdict is not None and verdict.startswith("shed:")
+    wide = Request([2, 3], deadline_s=10.0)
+    wide.t_submit, wide.t_deadline = now, now + 10.0
+    assert sched._shed_verdict(wide, n_ahead=4, now=now) is None
+    # uncalibrated predictor never sheds blind
+    sched._ewma_token_s = None
+    assert sched._shed_verdict(tight, n_ahead=100, now=now) is None
+
+
+def test_drain_finishes_in_flight_and_refuses_new(small_gen):
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng)
+    reqs = [sched.submit(Request(s)) for s in srcs_of(14, (4,) * 6)]
+    assert sched.drain(60.0) is True
+    assert all(r.status == "served" for r in reqs)
+    for r in reqs:
+        assert r.result() == eng.reference_decode(r.src_ids, MAXLEN)
+    with pytest.raises(RuntimeError):
+        sched.submit(Request([2, 3]))
+    assert not [
+        t for t in threading.enumerate() if t.name.startswith("paddle-serve")
+    ]
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill (serving_prefill_chunk_tokens)
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_prefill_bit_identical_interleaved(small_gen):
+    """A long prompt prefilling in ladder-rung chunks, interleaved with
+    short prompts decoding live, changes NOTHING in any request's output
+    vs the one-shot path — and the chunk programs stay a bounded set."""
+    eng = make_engine(small_gen, prefill_chunk_tokens=16, hbm_budget_mb=4)
+    long_srcs = srcs_of(20, (40, 70))
+    short_srcs = srcs_of(21, (3, 5))
+    reqs = [Request(s) for s in long_srcs + short_srcs]
+    eng.admit(reqs)
+    assert eng.n_prefilling == 2 and eng.n_live == 2
+    done = []
+    for _ in range(300):
+        done += eng.step()
+        if len(done) == len(reqs):
+            break
+    assert len(done) == len(reqs)
+    for r in reqs:
+        assert r.tokens == eng.reference_decode(r.src_ids, MAXLEN), r.req_id
+    # fw + bw + scatter + boot: exactly four traced chunk programs
+    assert eng.trace_counts["prefill_chunk"] == 4, eng.trace_counts
+    assert eng.pages.n_used == 0 and eng.n_free_slots == eng.max_slots
+    # a second long round re-uses every chunk program (zero new traces)
+    before = dict(eng.trace_counts)
+    reqs2 = [Request(s) for s in srcs_of(22, (33, 50))]
+    eng.admit(reqs2)
+    while eng.n_live or eng.n_prefilling:
+        eng.step()
+    assert eng.trace_counts == before
+    for r in reqs2:
+        assert r.tokens == eng.reference_decode(r.src_ids, MAXLEN)
+
+
+def test_chunked_prefill_through_scheduler(small_gen):
+    eng = make_engine(small_gen, prefill_chunk_tokens=16, hbm_budget_mb=4)
+    srcs = srcs_of(23, (40, 4, 25, 6))
+    with ServingScheduler(eng) as sched:
+        reqs = [sched.submit(Request(s)) for s in srcs]
+        for r in reqs:
+            assert r.wait(120), r
+        for r in reqs:
+            assert r.result() == eng.reference_decode(r.src_ids, MAXLEN)
+
+
+def test_chunked_prefill_flag_validation(small_gen):
+    with pytest.raises(ValueError, match="multiple"):
+        make_engine(small_gen, prefill_chunk_tokens=24)  # not a blk multiple
+    with pytest.raises(ValueError, match="divide"):
+        make_engine(small_gen, prefill_chunk_tokens=48)  # 64-rung misfit
+
+
+def test_chunked_prefill_cancel_mid_prefill(small_gen):
+    eng = make_engine(small_gen, prefill_chunk_tokens=16, hbm_budget_mb=4)
+    r = Request(srcs_of(24, (70,))[0])
+    eng.admit([r])
+    eng.step()  # one fw chunk in
+    assert eng.n_prefilling == 1
+    assert eng.cancel(r) is True
+    assert eng.pages.n_used == 0 and eng.n_free_slots == eng.max_slots
+    assert eng.cancel(r) is False  # idempotent miss
+
+
+# ---------------------------------------------------------------------------
 # greedy early-exit / max_new_tokens (ops/beam contract)
 # ---------------------------------------------------------------------------
 
@@ -433,3 +613,42 @@ def test_loadgen_deterministic_schedule():
         OpenLoopLoadGen(0.0, 1, lambda i: i)
     with pytest.raises(ValueError):
         OpenLoopLoadGen(1.0, 1, lambda i: i, process="bursty")
+
+
+def test_loadgen_burst_process_mean_rate_and_burstiness():
+    """Burst arrivals: seeded-deterministic, long-run mean close to the
+    nominal rate, and gap dispersion strictly above the plain-Poisson
+    floor (the bursts are real, not relabeled exponentials)."""
+    n, rate = 4000, 20.0
+    g1 = OpenLoopLoadGen(rate, n, lambda i: i, process="burst", seed=7)
+    g2 = OpenLoopLoadGen(rate, n, lambda i: i, process="burst", seed=7)
+    assert g1.arrivals == g2.arrivals
+    mean_rate = n / g1.arrivals[-1]
+    assert 0.7 * rate < mean_rate < 1.4 * rate, mean_rate
+    gaps = np.diff([0.0] + g1.arrivals)
+    pois = np.diff(
+        [0.0] + OpenLoopLoadGen(rate, n, lambda i: i, seed=7).arrivals
+    )
+    # exponential gaps have CV ~= 1; a two-state modulated process is
+    # overdispersed
+    cv_burst = gaps.std() / gaps.mean()
+    cv_pois = pois.std() / pois.mean()
+    assert cv_burst > cv_pois * 1.1, (cv_burst, cv_pois)
+    with pytest.raises(ValueError):
+        OpenLoopLoadGen(1.0, 4, lambda i: i, process="burst",
+                        burst_factor=5.0, burst_fraction=0.5)
+
+
+def test_loadgen_stamps_deadlines_and_honors_stop():
+    class Req:
+        deadline_s = None
+
+    now = [0.0]
+    gen = OpenLoopLoadGen(
+        10.0, 6, lambda i: Req(), process="uniform", deadline_s=1.5,
+        clock=lambda: now[0], sleep=lambda s: now.__setitem__(0, now[0] + s),
+    )
+    seen = []
+    out = gen.run(seen.append, stop=lambda: len(seen) >= 3)
+    assert len(out) == len(seen) == 3  # stop truncated the schedule
+    assert all(r.deadline_s == 1.5 for r in seen)
