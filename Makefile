@@ -45,11 +45,18 @@
 #                  mixed train+serve.  Runs as the last step of `make
 #                  test`, so the fast tier reports the SLO gates too.
 #   make serve-bench — the serving-plane headline (bench_serving).
+#   make trace-demo — the obs-plane acceptance drill: run the fast
+#                  mixed_train_serve scenario with span tracing armed
+#                  (`paddle-tpu scenario mixed_train_serve --trace`) and
+#                  assert ONE merged, schema-valid Chrome-trace timeline
+#                  lands, correlating spans from >= 2 processes and >= 3
+#                  planes (serving request lifecycle, trainer step,
+#                  master RPC) — tests/test_obs_e2e.py.
 
 PY ?= python
 CPU_ENV = XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
 
-.PHONY: test verify bench test-all lint tier1-check tier1-update chaos serve-bench scenarios
+.PHONY: test verify bench test-all lint tier1-check tier1-update chaos serve-bench scenarios trace-demo
 
 lint:
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --extra bench.py
@@ -85,6 +92,12 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_scenarios_e2e.py -q
+	$(MAKE) trace-demo
+
+# the obs-plane acceptance drill (sanitizer-armed: the traced scenario
+# doubles as a lock-order drill on the instrumented scheduler/master paths)
+trace-demo:
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_obs_e2e.py -q
 
 # the serving-plane headline under the bench regression guard: continuous
 # batching + block-paged decode cache vs the one-shot path, open-loop load

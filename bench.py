@@ -888,6 +888,168 @@ def bench_scenarios() -> list:
     ]
 
 
+def bench_tracing_overhead() -> list:
+    """Obs-plane overhead gate (ISSUE 13): the span tracer's ring recorder
+    (paddle_tpu/obs) must cost <= 3% throughput with the flight recorder
+    ARMED, on both instrumented hot paths — ASSERTED in-run:
+
+      * the LSTM flagship training step driven through the REAL
+        ``SGD.train`` loop (feed span on the stage path, train_step span
+        per dispatch, block_fetch span on the host sync — exactly the
+        production instrumentation, not a synthetic emit loop);
+      * the serving saturation arm: an all-at-once request wave through
+        the fully-instrumented ``ServingScheduler`` (submit/queued/admit
+        instants, decode_step spans, delivery spans, terminal ledger
+        instants per request).
+
+    Methodology for a noisy 2-core container: R alternating
+    recorder-off / recorder-on reps per arm, scored on the MIN wall of
+    each arm (the noise floor), so a scheduler hiccup in one rep cannot
+    fake a 3% regression.  The committed round artifact is OBS_r13.json
+    (load_prior_bench reads OBS_r*.json into the same best_prior
+    history)."""
+    from paddle_tpu import obs
+    from paddle_tpu.utils import flags as _flags
+
+    results = []
+
+    # -- arm 1: LSTM flagship step through SGD.train ----------------------
+    # the rnn-benchmark idiom (embedding -> simple_lstm -> last_seq -> fc
+    # softmax) built via the DSL — the staged reference config needs the
+    # /root/reference mount this container lacks, and the overhead gate
+    # measures the INSTRUMENTED LOOP, not the model zoo
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+
+    batch_size, seq_len, n_batches, reps = 64, 32, 8, 6
+    vocab, emb_dim, hidden = 10000, 128, 128
+    reset_auto_names()
+    words = paddle.layer.data(
+        "word", paddle.data_type.integer_value_sequence(vocab)
+    )
+    emb = paddle.layer.embedding(input=words, size=emb_dim)
+    lstm = paddle.layer.networks.simple_lstm(input=emb, size=hidden)
+    last = paddle.layer.last_seq(input=lstm)
+    pred = paddle.layer.fc(
+        last, size=2, act=paddle.activation.Softmax()
+    )
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    trainer = paddle.trainer.SGD(
+        cost=cost,
+        parameters=paddle.parameters.create(cost, seed=0),
+        update_equation=paddle.optimizer.Adam(learning_rate=1e-3),
+    )
+    rng = np.random.RandomState(0)
+    row_batches = [
+        [
+            (rng.randint(2, vocab, size=seq_len).tolist(), int(i % 2))
+            for i in range(batch_size)
+        ]
+        for _ in range(n_batches)
+    ]
+
+    def one_pass():
+        t0 = time.perf_counter()
+        trainer.train(
+            reader=lambda: iter(row_batches), num_passes=1,
+            async_load_data=False,
+        )
+        return time.perf_counter() - t0
+
+    one_pass()  # compile warmup (outside every measured rep)
+    walls = {False: [], True: []}
+    for rep in range(reps):
+        # the arm ORDER flips each rep: a monotonic machine drift (turbo
+        # ramp, background load) otherwise favors whichever arm always
+        # samples first and fakes a systematic overhead
+        for armed in ((False, True) if rep % 2 == 0 else (True, False)):
+            obs.tracer.set_recording(armed)
+            obs.tracer.reset()
+            walls[armed].append(one_pass())
+    obs.tracer.set_recording(bool(_flags.get_flag("flight_recorder")))
+    off_ms = min(walls[False]) / n_batches * 1e3
+    on_ms = min(walls[True]) / n_batches * 1e3
+    train_overhead_pct = (on_ms - off_ms) / off_ms * 100.0
+    assert train_overhead_pct <= 3.0, (
+        f"tracing overhead gate (train): {train_overhead_pct:.2f}% > 3% "
+        f"({off_ms:.2f} -> {on_ms:.2f} ms/batch)"
+    )
+    results.append({
+        "metric": "tracing_overhead_lstm_step_ms",
+        "value": round(on_ms, 3),
+        "unit": "ms/batch, recorder ARMED (LSTM-128 flagship-idiom step "
+        "via SGD.train)",
+        "recorder_off_ms": round(off_ms, 3),
+        "overhead_pct": round(train_overhead_pct, 3),
+        "gate_overhead_le_3pct": True,
+        "reps": reps,
+        "binds": "per-step cost = 2 spans + 1 feed span (~1-2 us each, "
+        "one short lock hold into a bounded deque) against a "
+        "multi-ms jitted dispatch — min-of-reps over alternating "
+        "off/on passes",
+    })
+
+    # -- arm 2: serving saturation wave -----------------------------------
+    from paddle_tpu.robustness.scenarios import make_serving_engine
+    from paddle_tpu.serving import Request, ServingScheduler
+
+    # production-shaped dispatch amortization (serving_decode_block_steps'
+    # K-tokens-per-dispatch default): the gate measures the instrumented
+    # scheduler at the dispatch granularity serving actually runs, not the
+    # scenario harness's K=1 worst case
+    engine = make_serving_engine(seed=0, max_slots=4, block_steps=4)
+    n_requests = 48
+    rng = np.random.RandomState(0)
+    srcs = [
+        rng.randint(2, 60, size=rng.randint(3, 24)).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def one_wave():
+        reqs = [Request(s) for s in srcs]
+        with ServingScheduler(engine) as sched:
+            t0 = time.perf_counter()
+            for r in reqs:
+                sched.submit(r)
+            for r in reqs:
+                if not r.wait(300):
+                    raise RuntimeError(f"unserved {r.req_id}")
+            wall = time.perf_counter() - t0
+        assert all(r.status == "served" for r in reqs)
+        return wall
+
+    one_wave()  # warmup (prewarmed engine; first wave pays queue ramp)
+    walls = {False: [], True: []}
+    for rep in range(reps):
+        for armed in ((False, True) if rep % 2 == 0 else (True, False)):
+            obs.tracer.set_recording(armed)
+            obs.tracer.reset()
+            walls[armed].append(one_wave())
+    obs.tracer.set_recording(bool(_flags.get_flag("flight_recorder")))
+    off_s, on_s = min(walls[False]), min(walls[True])
+    serve_overhead_pct = (on_s - off_s) / off_s * 100.0
+    assert serve_overhead_pct <= 3.0, (
+        f"tracing overhead gate (serving): {serve_overhead_pct:.2f}% > 3% "
+        f"({off_s * 1e3:.1f} -> {on_s * 1e3:.1f} ms/wave)"
+    )
+    results.append({
+        "metric": "tracing_overhead_serving_wave_ms",
+        "value": round(on_s * 1e3, 3),
+        "unit": f"ms to serve a {n_requests}-request saturation wave, "
+        "recorder ARMED",
+        "recorder_off_ms": round(off_s * 1e3, 3),
+        "overhead_pct": round(serve_overhead_pct, 3),
+        "gate_overhead_le_3pct": True,
+        "req_per_sec_armed": round(n_requests / on_s, 2),
+        "reps": reps,
+        "binds": "~6 instants + 2 spans per request lifecycle against "
+        "multi-ms decode dispatches; min-of-reps over alternating "
+        "off/on waves through the instrumented scheduler",
+    })
+    return results
+
+
 def bench_resnet_pipeline() -> list:
     """ResNet-50 fed through the REAL IO plane: recordio file -> native
     threaded Prefetcher -> host decode/batching -> uint8 device transfer ->
@@ -2279,8 +2441,10 @@ def load_prior_bench(repo_dir: str) -> dict:
 
     prior: dict = {}
     paths = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
-    # scenario-gate rounds ride the same guard (SCENARIO_r12.json+)
+    # scenario-gate rounds ride the same guard (SCENARIO_r12.json+), and
+    # the obs-plane overhead rounds (OBS_r13.json+)
     paths += sorted(glob.glob(os.path.join(repo_dir, "SCENARIO_r*.json")))
+    paths += sorted(glob.glob(os.path.join(repo_dir, "OBS_r*.json")))
     for path in paths:
         rnd = os.path.basename(path).split("_", 1)[1][:-len(".json")]
         try:
@@ -2349,7 +2513,7 @@ def main() -> None:
     prior = load_prior_bench(repo_dir)
     results = []
     for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_serving,
-               bench_scenarios,
+               bench_scenarios, bench_tracing_overhead,
                bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,
                bench_elastic_scaling, bench_master_failover,

@@ -22,6 +22,14 @@ Rules (``A###``):
   A204 duplicate-flag     the same flag name registered twice via
                           ``define_flag`` (the loser silently wins; see
                           utils/flags.py re-registration guard)
+  A205 wall-clock-in-obs  ``time.time()``/``time.time_ns()`` in an
+                          ``obs/`` module — span timestamps must come
+                          from the MONOTONIC, injectable tracer clock
+                          (an NTP step would fold a timeline backward).
+                          The one legitimate wall read (the merge
+                          anchor) carries the pragma
+                          ``# obs: allow-wall-clock <why>`` with a
+                          REQUIRED justification.
 
 Run via :func:`lint_package` (the ``paddle-tpu lint`` CLI / ``make lint``).
 """
@@ -45,6 +53,11 @@ _RNG_OK = frozenset({"RandomState", "default_rng", "Random", "seed", "SeedSequen
 
 # reader-plane modules for A203 (package-relative path prefixes)
 _READER_PREFIXES = ("reader" + os.sep, "dataset" + os.sep)
+
+# the wall-clock time.* calls A205 forbids in obs/ modules (monotonic /
+# perf_counter are exactly what spans SHOULD use, so they stay legal)
+_WALL_FNS = frozenset({"time", "time_ns"})
+_OBS_PRAGMA = "# obs: allow-wall-clock"
 
 
 def _name_of(node: ast.AST) -> Optional[str]:
@@ -213,6 +226,62 @@ def _scan_reader_rng(tree: ast.Module, relpath: str,
             ))
 
 
+def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
+                         diags: List[Diagnostic]) -> None:
+    """A205 over one obs/ module: wall-clock calls are forbidden unless
+    the LINE carries ``# obs: allow-wall-clock <justification>`` — and an
+    empty justification is itself a finding (the concurrency lint's C300
+    discipline applied here).  Alias-aware like the RNG rules: ``import
+    time as t; t.time()`` and ``from time import time`` must not slip
+    past the ban."""
+    time_mods = {"time"}
+    bare_wall: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _WALL_FNS:
+                    bare_wall.add(a.asname or a.name)
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _name_of(node.func)
+        if dotted is None:
+            continue
+        head, _, tail = dotted.rpartition(".")
+        if not (
+            (head in time_mods and tail in _WALL_FNS)
+            or (head == "" and tail in bare_wall)
+        ):
+            continue
+        line_src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _OBS_PRAGMA in line_src:
+            why = line_src.split(_OBS_PRAGMA, 1)[1].strip()
+            if why:
+                continue  # justified pragma: allowed
+            diags.append(Diagnostic(
+                rule="A205", severity=Severity.ERROR,
+                message="empty `# obs: allow-wall-clock` justification — "
+                "say WHY this wall read can never stamp a span",
+                source=relpath, line=node.lineno,
+            ))
+            continue
+        diags.append(Diagnostic(
+            rule="A205", severity=Severity.ERROR,
+            message=f"wall-clock `{dotted}()` in an obs/ module — span "
+            "timestamps must be monotonic (an NTP step folds the "
+            "timeline backward)",
+            source=relpath, line=node.lineno,
+            hint="use the tracer's injectable monotonic clock; a "
+            "genuinely-needed wall read (merge anchor) takes "
+            "`# obs: allow-wall-clock <why>`",
+        ))
+
+
 def _scan_flag_defs(tree: ast.Module, relpath: str,
                     defs: Dict[str, Tuple[str, int]],
                     diags: List[Diagnostic]) -> None:
@@ -269,6 +338,10 @@ def lint_file(path: str, root: Optional[str] = None,
         os.sep + "reader" + os.sep in relpath
     ):
         _scan_reader_rng(tree, relpath, diags, rng_heads)
+    if os.sep + "obs" + os.sep in relpath or relpath.replace(
+        "paddle_tpu" + os.sep, "", 1
+    ).startswith("obs" + os.sep):
+        _scan_obs_wall_clock(tree, src, relpath, diags)
     if _flag_defs is not None:
         _scan_flag_defs(tree, relpath, _flag_defs, diags)
     return diags

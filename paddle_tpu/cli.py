@@ -281,6 +281,9 @@ def cmd_train(argv: List[str]) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    from paddle_tpu import obs as _obs
+
+    _obs.tracer.configure(role="trainer")
     if args.log_period is not None:
         _flags.set_flag("log_period", args.log_period)
     if args.show_parameter_stats_period is not None:
@@ -729,11 +732,23 @@ def cmd_serve(argv: List[str]) -> int:
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--stats-out", default="",
                     help="write the summary JSON here too")
+    ap.add_argument("--trace-dir", default=None,
+                    help="arm Chrome-trace span export to this directory "
+                    "(default: the trace_dir flag / PADDLE_TPU_TRACE_DIR)")
+    ap.add_argument("--metrics-out", default=None,
+                    help="periodic Prometheus-text metrics snapshot file "
+                    "(obs/metrics.py; default: the metrics_out flag)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve /metrics on http://127.0.0.1:<port> "
+                    "(default: the metrics_port flag; 0 = off)")
     args = ap.parse_args(argv)
 
     import numpy as np
 
     import paddle_tpu as paddle
+    from paddle_tpu import obs as _obs
+
+    _obs.tracer.configure(role="serve", trace_dir=args.trace_dir)
     from paddle_tpu.core.topology import reset_auto_names
     from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
     from paddle_tpu.reader.loadgen import OpenLoopLoadGen
@@ -793,6 +808,26 @@ def cmd_serve(argv: List[str]) -> int:
     ]
     drained_clean = None
     t0 = _time.perf_counter()
+    # live metrics export (obs/metrics.py): the SLO gauges the scheduler
+    # registers (queue depth, pages in use, predicted wait) + the StatSet
+    # ledger, as Prometheus text — file snapshot and/or localhost endpoint
+    from paddle_tpu.obs.metrics import MetricsExporter
+    from paddle_tpu.utils import flags as _serve_flags
+
+    # --metrics-port 0 forces the endpoint OFF even when the metrics_port
+    # flag/env is set (the help's "0 = off"); unset falls through to the
+    # flag; a positive port wins outright
+    metrics = MetricsExporter(
+        path=args.metrics_out,
+        port=(None if args.metrics_port is None
+              else (args.metrics_port if args.metrics_port > 0 else -1)),
+    ) if (
+        args.metrics_out or args.metrics_port
+        or _serve_flags.get_flag("metrics_out")
+        or _serve_flags.get_flag("metrics_port")
+    ) else None
+    if metrics is not None and metrics.port:
+        _echo(f"metrics: http://127.0.0.1:{metrics.port}/metrics")
     with PreemptionGuard() as guard:
         sched = ServingScheduler(
             engine, queue_limit=args.queue_limit,
@@ -837,6 +872,8 @@ def cmd_serve(argv: List[str]) -> int:
                     drained_clean = sched.drain(args.drain_timeout_s)
         finally:
             sched.close()
+            if metrics is not None:
+                metrics.close()
     from paddle_tpu.serving import percentile, status_counts
 
     # the status ledger is judged AFTER close() (which finalizes every
@@ -866,11 +903,10 @@ def cmd_serve(argv: List[str]) -> int:
         "p99_token_ms": pct(tpots, 0.99),
         "engine": engine.summary(),
     }
-    line = _json.dumps(summary)
-    print(line, flush=True)
+    print(_json.dumps(summary), flush=True)
     if args.stats_out:
-        with open(args.stats_out, "w") as f:
-            f.write(line + "\n")
+        _obs.write_stats_json(args.stats_out, summary)
+    _obs.tracer.dump()  # per-process trace file (no-op without trace_dir)
     if drained_clean is not None:
         # SIGTERM path: exit 0 iff the drain finished every in-flight
         # request (no 'closed' stragglers) — the graceful-exit contract
@@ -904,6 +940,14 @@ def cmd_scenario(argv: List[str]) -> int:
                     "temp dir)")
     ap.add_argument("--out", default="",
                     help="append one JSON line per scenario here too")
+    ap.add_argument("--trace", action="store_true",
+                    help="run with span tracing armed and merge every "
+                    "process's trace file into ONE Perfetto-loadable "
+                    "timeline per scenario (obs/; subprocess fleets "
+                    "inherit the trace dir through the environment)")
+    ap.add_argument("--trace-dir", default=None,
+                    help="where the per-process + merged trace files land "
+                    "(default: a temp dir; implies --trace)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.robustness import scenarios as _sc
@@ -921,6 +965,17 @@ def cmd_scenario(argv: List[str]) -> int:
         print("error: give --name (repeatable), --all-fast, or --list",
               file=sys.stderr)
         return 2
+    trace_dir = None
+    if args.trace or args.trace_dir:
+        import tempfile
+
+        from paddle_tpu import obs as _obs
+
+        trace_dir = args.trace_dir or tempfile.mkdtemp(
+            prefix="paddle-tpu-trace-"
+        )
+        os.makedirs(trace_dir, exist_ok=True)
+        os.environ.setdefault("PADDLE_TPU_TRACE_ID", _obs.tracer.trace_id)
     failed = []
     for name in names:
         kw = {"seed": args.seed}
@@ -932,8 +987,42 @@ def cmd_scenario(argv: List[str]) -> int:
             kw["workdir"] = args.workdir or tempfile.mkdtemp(
                 prefix=f"paddle-tpu-scenario-{name}-"
             )
+        if trace_dir is not None:
+            from paddle_tpu import obs as _obs
+            from paddle_tpu.utils import flags as _flags
+
+            # one subdirectory PER scenario, and the parent rings reset:
+            # otherwise scenario N's merged timeline would accumulate
+            # scenarios 1..N-1's events and dead workers' trace files
+            sdir = os.path.join(trace_dir, name)
+            os.makedirs(sdir, exist_ok=True)
+            _flags.set_flag("trace_dir", sdir)
+            # subprocess fleets (the elastic workers a scenario spawns)
+            # arm through the environment, sharing this trace id
+            os.environ["PADDLE_TPU_TRACE_DIR"] = sdir
+            _obs.tracer.reset()
+            _obs.tracer.configure(role="serve", trace_dir=sdir)
         res = _sc.run_scenario(name, **kw)
         res.pop("_requests", None)
+        if trace_dir is not None:
+            from paddle_tpu.obs.merge import merge_dir
+
+            _obs.tracer.dump()
+            merged, mpath = merge_dir(
+                os.path.join(trace_dir, name),
+                out_path=os.path.join(trace_dir, f"merged-{name}.json"),
+            )
+            res["trace"] = {
+                "merged": mpath,
+                "events": sum(
+                    1 for e in merged["traceEvents"] if e.get("ph") != "M"
+                ),
+                "pids": merged["otherData"]["merged_pids"],
+                "planes": sorted({
+                    e.get("cat") for e in merged["traceEvents"]
+                    if e.get("ph") != "M" and e.get("cat")
+                }),
+            }
         line = json.dumps(res)
         print(line, flush=True)
         if args.out:
@@ -944,6 +1033,78 @@ def cmd_scenario(argv: List[str]) -> int:
     if failed:
         print(f"SCENARIO FAILURES: {failed}", file=sys.stderr)
     return 1 if failed else 0
+
+
+def cmd_trace(argv: List[str]) -> int:
+    """``paddle-tpu trace`` — the span-timeline tooling (obs/):
+
+    * ``merge --dir D [--out F]`` — zip the per-process
+      ``trace-<role>-<pid>.json`` files a launcher/scenario run left
+      behind into ONE Chrome-trace timeline (opens directly in Perfetto),
+      clock-skew aligned via the RPC plane's request/response pairs
+      (wall-anchor fallback for processes that never talked);
+    * ``validate F`` — schema-check a trace file (required event keys,
+      begin/end pairing, well-formed args); exit 0 iff valid.
+
+    One JSON summary line per run (event counts, pids, planes, applied
+    per-process clock offsets)."""
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu trace",
+        description="merge/validate span-timeline files (paddle_tpu/obs)",
+    )
+    ap.add_argument("action", choices=["merge", "validate"])
+    ap.add_argument("paths", nargs="*",
+                    help="validate: trace file(s); merge: explicit trace "
+                    "files instead of --dir")
+    ap.add_argument("--dir", default=None,
+                    help="merge: directory of trace-*.json files")
+    ap.add_argument("--out", default=None,
+                    help="merge: merged timeline path "
+                    "(default <dir>/merged.json)")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs import merge as _merge
+
+    if args.action == "validate":
+        if not args.paths:
+            print("error: validate needs trace file path(s)",
+                  file=sys.stderr)
+            return 2
+        bad = 0
+        for p in args.paths:
+            problems = _merge.validate_trace(_merge.load_trace(p))
+            print(json.dumps({
+                "file": p, "valid": not problems,
+                "problems": problems[:20],
+            }))
+            bad += bool(problems)
+        return 1 if bad else 0
+
+    if args.paths:
+        merged = _merge.merge_traces(
+            [_merge.load_trace(p) for p in args.paths]
+        )
+        out = args.out or "merged.json"
+        with open(out, "w") as f:
+            json.dump(merged, f)
+    elif args.dir:
+        merged, out = _merge.merge_dir(args.dir, out_path=args.out)
+    else:
+        print("error: merge needs --dir or trace file paths",
+              file=sys.stderr)
+        return 2
+    other = merged["otherData"]
+    print(json.dumps({
+        "merged": out,
+        "events": sum(
+            1 for e in merged["traceEvents"] if e.get("ph") != "M"
+        ),
+        "pids": other["merged_pids"],
+        "roles": other["roles"],
+        "offsets_us": other["offsets_us"],
+        "rpc_pair_edges": other["rpc_pair_edges"],
+    }))
+    return 0
 
 
 def cmd_worker(argv: List[str]) -> int:
@@ -997,11 +1158,13 @@ def cmd_master(argv: List[str]) -> int:
                     "'kill_master@8' (env PADDLE_TPU_CHAOS also works)")
     args = ap.parse_args(argv)
 
+    from paddle_tpu import obs as _obs
     from paddle_tpu.master_ha import HAMaster
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    _obs.tracer.configure(role="master")
     if args.chaos:
         from paddle_tpu.robustness import chaos as _chaos
 
@@ -1041,16 +1204,15 @@ def cmd_master(argv: List[str]) -> int:
             host, port = srv.address
             _echo(f"LEADER {host}:{port}")
             if args.stats_out and ha.last_takeover is not None:
-                try:
-                    with open(args.stats_out, "a") as f:
-                        f.write(json.dumps(
-                            {"owner": ha.owner_id, **ha.last_takeover}
-                        ) + "\n")
-                except OSError as exc:
-                    # the stats line is advisory: an unwritable path must
-                    # not crash the just-elected leader (every candidate
-                    # shares the flag, so it would crash-loop the cluster)
-                    _echo(f"stats-out {args.stats_out} unwritable: {exc}")
+                # advisory (obs.write_stats_json warns instead of raising):
+                # an unwritable path must not crash the just-elected leader
+                # — every candidate shares the flag, so it would crash-loop
+                # the cluster
+                _obs.write_stats_json(
+                    args.stats_out,
+                    {"owner": ha.owner_id, **ha.last_takeover},
+                    append=True,
+                )
             announced = True
         elif not ha.is_leader.is_set():
             announced = False
@@ -1343,6 +1505,7 @@ _COMMANDS = {
     "cache": cmd_cache,
     "serve": cmd_serve,
     "scenario": cmd_scenario,
+    "trace": cmd_trace,
     "worker": cmd_worker,
     "master": cmd_master,
 }
@@ -1369,6 +1532,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("                      SIGTERM graceful drain)")
         print("    scenario          production-gate scenario harness: mixed")
         print("                      traffic + chaos under load, SLO metrics")
+        print("    trace             merge/validate span-timeline files: zip")
+        print("                      per-process traces into one Perfetto")
+        print("                      timeline (clock-skew aligned via RPC)")
         print("    master            run an HA master candidate (elastic")
         print("                      scale-out: registry + shard leases)")
         print("    worker            run one elastic trainer process against")

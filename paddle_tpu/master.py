@@ -60,6 +60,7 @@ from multiprocessing.connection import Client as _ConnClient, Listener
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu import master_journal as _mj
+from paddle_tpu import obs as _obs
 from paddle_tpu.analysis.lock_sanitizer import make_lock, make_rlock
 from paddle_tpu.io import recordio
 from paddle_tpu.robustness import chaos as _chaos
@@ -581,6 +582,10 @@ class Service:
                 f["arrived"].add(worker_id)
                 if meta:
                     f["meta"][worker_id] = dict(meta)
+                _obs.instant(
+                    "fence_arrive", cat="master",
+                    fence=fence_id, worker=worker_id,
+                )
                 self._journal({
                     "t": "farrive", "fence": fence_id, "worker": worker_id,
                     "meta": dict(meta) if meta else None,
@@ -639,6 +644,10 @@ class Service:
                     "n_done": len(self.done),
                     "pass_id": self.pass_id,
                 }
+                _obs.instant(
+                    "fence_release", cat="master", fence=fence_id,
+                    workers=members,
+                )
                 # the frozen membership view is durable state: a standby
                 # taking over mid-barrier must release the SAME view, not
                 # re-evaluate membership it never observed
@@ -1401,16 +1410,30 @@ class Server:
     def _handle(self, conn) -> None:
         try:
             while not self._stop:  # deposed leader: stop serving stale state
-                method, args = conn.recv()
+                msg = conn.recv()
+                # 3-tuple form carries the obs trace meta (client rpc id);
+                # the 2-tuple form stays accepted (recording disarmed, or
+                # an older client)
+                method, args = msg[0], msg[1]
+                meta = msg[2] if len(msg) > 2 else None
                 if method == "__close__":
                     return
                 if method not in _METHODS:
                     conn.send((False, f"no such method {method}"))
                     continue
-                try:
-                    conn.send((True, getattr(self.service, method)(*args)))
-                except Exception as exc:  # noqa: BLE001 — RPC boundary
-                    conn.send((False, repr(exc)))
+                # the server-side half of the skew-alignment pair: span
+                # `rpc:<method>` with the CLIENT's correlation id — `trace
+                # merge` pins its midpoint to the client span's midpoint
+                with _obs.span(
+                    "rpc:" + method, cat="master",
+                    rpc=(meta or {}).get("rpc"),
+                ):
+                    try:
+                        conn.send(
+                            (True, getattr(self.service, method)(*args))
+                        )
+                    except Exception as exc:  # noqa: BLE001 — RPC boundary
+                        conn.send((False, repr(exc)))
         except (EOFError, OSError, TypeError, AttributeError):
             # TypeError/AttributeError: Server.close() closed this conn while
             # recv() was blocked (multiprocessing nulls the handle mid-read)
@@ -1522,8 +1545,16 @@ class Client:
         leader instead).  The abandoned call may still execute
         server-side, which the idempotent surface absorbs on retry."""
         if self._service is not None:
-            return getattr(self._service, method)(*args)
+            with _obs.span("rpc_call:" + method, cat="rpc"):
+                return getattr(self._service, method)(*args)
         last_err: Optional[Exception] = None
+        # the client-side half of the skew-alignment pair: the rpc id rides
+        # the wire as a third tuple element so the server span carries the
+        # SAME correlation id (recording off = classic 2-tuple, zero cost)
+        rpc_id = _obs.next_rpc_id() if _obs.tracer.recording else None
+        wire = (method, args) if rpc_id is None else (
+            method, args, {"rpc": rpc_id}
+        )
         with self._conn_lock:
             for attempt in range(self.reconnect_tries):
                 try:
@@ -1531,34 +1562,44 @@ class Client:
                         self._conn = _dial_with_deadline(
                             self._address, self._authkey, self.call_timeout_s
                         )
-                    try:
-                        self._conn.send((method, args))  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
-                    except BlockingIOError as exc:
-                        # SO_SNDTIMEO fired: the peer stopped draining its
-                        # socket mid-request (frozen master, full buffer)
-                        raise self._timeout(
-                            f"master RPC {method}: request stalled "
-                            f"mid-send (frozen master)"
-                        ) from exc
-                    if self.call_timeout_s is not None and not self._conn.poll(
-                        self.call_timeout_s
+                    # the span covers ONLY the send->recv exchange (not
+                    # the lock-queue wait or dial retries above): its
+                    # midpoint is what `trace merge` pins the server
+                    # handling span to, and client-side-only latencies
+                    # would bias the skew estimate
+                    with _obs.span(
+                        "rpc_call:" + method, cat="rpc", rpc=rpc_id,
                     ):
-                        raise self._timeout(
-                            f"master RPC {method}: no reply in "
-                            f"{self.call_timeout_s}s (half-open socket or "
-                            f"frozen master); the call may have executed"
-                        )
-                    try:
-                        ok, result = self._conn.recv()  # lock: allow[C304] same intentional hold: one in-flight RPC per connection, bounded by SO_RCVTIMEO
-                    except BlockingIOError as exc:
-                        # SO_RCVTIMEO fired mid-message: the peer froze
-                        # after sending a PARTIAL reply — past poll()'s
-                        # first-byte deadline, so surface the same way
-                        raise self._timeout(
-                            f"master RPC {method}: reply stalled "
-                            f"mid-message (frozen master); the call may "
-                            f"have executed"
-                        ) from exc
+                        try:
+                            self._conn.send(wire)  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
+                        except BlockingIOError as exc:
+                            # SO_SNDTIMEO fired: the peer stopped draining
+                            # its socket mid-request (frozen master, full
+                            # buffer)
+                            raise self._timeout(
+                                f"master RPC {method}: request stalled "
+                                f"mid-send (frozen master)"
+                            ) from exc
+                        if self.call_timeout_s is not None and not (
+                            self._conn.poll(self.call_timeout_s)
+                        ):
+                            raise self._timeout(
+                                f"master RPC {method}: no reply in "
+                                f"{self.call_timeout_s}s (half-open socket "
+                                f"or frozen master); the call may have "
+                                f"executed"
+                            )
+                        try:
+                            ok, result = self._conn.recv()  # lock: allow[C304] same intentional hold: one in-flight RPC per connection, bounded by SO_RCVTIMEO
+                        except BlockingIOError as exc:
+                            # SO_RCVTIMEO fired mid-message: the peer froze
+                            # after sending a PARTIAL reply — past poll()'s
+                            # first-byte deadline, so surface the same way
+                            raise self._timeout(
+                                f"master RPC {method}: reply stalled "
+                                f"mid-message (frozen master); the call "
+                                f"may have executed"
+                            ) from exc
                     break
                 except MasterTimeoutError:
                     raise

@@ -113,10 +113,18 @@ class JournalWriter:
 
     def append(self, seq: int, record: Dict[str, Any],
                sync: bool = True) -> int:
-        frame = encode_frame(seq, record)
-        self._f.write(frame)
-        if sync:
-            self.sync()
+        from paddle_tpu import obs as _obs
+
+        # the fsync here is what every RPC ack's durability stands on —
+        # exactly the hold a merged timeline must show when a drill asks
+        # "where did the ack latency go"
+        with _obs.span(
+            "journal_append", cat="master", seq=seq, t=record.get("t"),
+        ):
+            frame = encode_frame(seq, record)
+            self._f.write(frame)
+            if sync:
+                self.sync()
         return len(frame)
 
     def sync(self) -> None:

@@ -92,6 +92,9 @@ KNOWN_POINTS = frozenset(
 _armed: Dict[str, Optional[int]] = {}
 # point -> how many times it has been consulted
 _counts: Dict[str, int] = {}
+# points that already dumped a flight-recorder postmortem (an unoccurrenced
+# point fires every consultation; one postmortem per arming is the record)
+_flight_dumped: set = set()
 _env_loaded = False
 
 
@@ -116,6 +119,7 @@ def arm(spec: str) -> None:
     _env_loaded = True  # an explicit arm overrides the environment
     _armed.clear()
     _counts.clear()
+    _flight_dumped.clear()
     _armed.update(_parse(spec))
     if _armed:
         _log.warning("chaos armed: %s", spec)
@@ -125,6 +129,7 @@ def disarm() -> None:
     global _env_loaded
     _armed.clear()
     _counts.clear()
+    _flight_dumped.clear()
     _env_loaded = True  # stay disarmed even if the env var is set
 
 
@@ -167,6 +172,16 @@ def fire(point: str) -> bool:
         _log.warning(
             "chaos point %r firing (consultation %d)", point, _counts[point]
         )
+        # flight recorder (obs plane): a firing fault point dumps the last
+        # N span events BEFORE the fault lands — kill@N's SIGKILL follows
+        # this consultation immediately, so the postmortem timeline is the
+        # only record the dead process leaves.  Once per arming: an
+        # unoccurrenced point fires every consultation.
+        if point not in _flight_dumped:
+            _flight_dumped.add(point)
+            from paddle_tpu import obs as _obs
+
+            _obs.flight_dump(f"chaos:{point}@{_counts[point]}")
     return hit
 
 

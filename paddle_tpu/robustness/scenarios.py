@@ -95,7 +95,8 @@ def _ms(x: Optional[float]) -> Optional[float]:
 
 def make_serving_engine(seed: int = 0, max_slots: int = 2,
                         hbm_budget_mb: int = 2,
-                        prefill_chunk_tokens: int = 0):
+                        prefill_chunk_tokens: int = 0,
+                        block_steps: int = 1):
     """A prewarmed tiny-flagship serving engine (the bench's cache-warm
     discipline: every slot/page rung the scenarios realize is compiled
     before any measured window, so EWMAs and percentiles see dispatch,
@@ -114,7 +115,7 @@ def make_serving_engine(seed: int = 0, max_slots: int = 2,
     )
     eng = ServingEngine(
         gen, max_slots=max_slots, hbm_budget_mb=hbm_budget_mb,
-        max_new_tokens=_MAXLEN, block_steps=1,
+        max_new_tokens=_MAXLEN, block_steps=block_steps,
         prefill_chunk_tokens=prefill_chunk_tokens,
     )
     rungs, g = [], 1
@@ -350,14 +351,17 @@ def _train_linear(n_steps: int, dim: int = 8, seed: int = 1,
             np.concatenate([x, [np.float32(x @ w_true)]])
             .astype(np.float32).tobytes()
         )
+    from paddle_tpu import obs as _obs
+
     model = NumpyLinearModel(dim, lr=0.2)
     t0 = time.perf_counter()
     for step in range(n_steps):
         lo = (step * 8) % len(records)
-        grads, _cost, _n = model.task_grad(
-            records[lo:lo + 8], pass_id=0, task_id=step
-        )
-        model.apply(grads)
+        with _obs.span("train_step", cat="trainer", b=step):
+            grads, _cost, _n = model.task_grad(
+                records[lo:lo + 8], pass_id=0, task_id=step
+            )
+            model.apply(grads)
     wall = time.perf_counter() - t0
     res = {
         "w": model.w.copy(), "b": model.b.copy(),
@@ -368,6 +372,45 @@ def _train_linear(n_steps: int, dim: int = 8, seed: int = 1,
     return res
 
 
+def _traced_fleet_leg(seed: int) -> Optional[Dict[str, Any]]:
+    """Only when span EXPORT is armed (``paddle-tpu scenario --trace``):
+    run a one-worker elastic mini-pass over an in-process HA master so
+    the merged timeline spans >= 2 PROCESSES and carries the master RPC
+    plane — the parent contributes serving + master spans (the Server
+    handles the worker's RPCs here), the worker subprocess contributes
+    its lease→compute→ack spans, and the RPC request/response pairs give
+    `trace merge` its clock-skew anchors.  Runs BEFORE the measured
+    serving windows, so the SLO gates never pay its CPU."""
+    from paddle_tpu import obs
+
+    if not obs.tracer.exporting:
+        return None
+    import tempfile
+
+    from paddle_tpu.master_ha import HAMaster
+
+    d = tempfile.mkdtemp(prefix="paddle-tpu-trace-fleet-")
+    data = os.path.join(d, "data.rio")
+    _write_linear_dataset(data, n=24, seed=seed)
+    ha = HAMaster(os.path.join(d, "ha"), [data], owner_id="trace-master",
+                  **_MASTER_KW)
+    ha.start()
+    try:
+        if not ha.wait_leader(30):
+            raise RuntimeError("trace-leg master never took leadership")
+        rcs, errs, stats, _ = _collect_workers(
+            d, 1, _spawn_workers(d, 1, 1), timeout=120
+        )
+        if rcs != [0]:
+            raise RuntimeError(f"trace-leg worker failed: {rcs} {errs}")
+    finally:
+        ha.stop()
+    return {
+        "worker_rc": rcs[0],
+        "tasks_done": stats.get(0, {}).get("tasks_done"),
+    }
+
+
 def scenario_mixed_train_serve(slo_ms: Optional[float] = None,
                                n_requests: int = 48, train_steps: int = 400,
                                seed: int = 0,
@@ -376,9 +419,12 @@ def scenario_mixed_train_serve(slo_ms: Optional[float] = None,
     on a side thread while the serving plane takes open-loop traffic with
     ``nan_request`` fired mid-stream.  Gates: training params bit-equal
     to the solo run (zero divergence), only the poisoned request fails,
-    goodput holds."""
+    goodput holds.  Under ``--trace`` a one-worker fleet leg runs first
+    (:func:`_traced_fleet_leg`) so the merged timeline is genuinely
+    cross-process."""
     from paddle_tpu.robustness import chaos
 
+    traced_fleet = _traced_fleet_leg(seed)
     engine = engine if engine is not None else make_serving_engine(seed)
     solo = _train_linear(train_steps)
     wave = _serve_window(engine, _srcs(seed, 24), None, 0.0, seed)
@@ -410,10 +456,14 @@ def scenario_mixed_train_serve(slo_ms: Optional[float] = None,
         r.status in ("served", "shed", "timeout") for r in reqs
         if r not in poisoned
     )
+    out_trace = (
+        {} if traced_fleet is None else {"traced_fleet": traced_fleet}
+    )
     return {
         "scenario": "mixed_train_serve",
         "slo_ms": round(slo_s * 1e3, 3),
         **win,
+        **out_trace,
         "train_steps": train_steps,
         "train_steps_per_s_solo": round(solo["steps_per_s"], 1),
         "train_steps_per_s_mixed": (

@@ -97,7 +97,7 @@ class DivergenceSentinel:
                 "(streak %d/%d)", self._skip_streak, self.skip_limit,
             )
             if self._skip_streak >= self.skip_limit:
-                return "diverged"
+                return self._diverged(f"skip streak {self._skip_streak}")
             return "skip"
         self._skip_streak = 0
         if not math.isfinite(cost):
@@ -106,7 +106,7 @@ class DivergenceSentinel:
             # that DID apply (no select protected the params)
             self.total_skipped += 1
             self._stats.incr("robustness.skipped_steps")
-            return "diverged"
+            return self._diverged("non-finite cost with device half off")
         if (
             self.ema is not None
             and self._n_obs > self.warmup_steps
@@ -122,7 +122,9 @@ class DivergenceSentinel:
                 self._spike_streak, self.spike_patience,
             )
             if self._spike_streak >= self.spike_patience:
-                return "diverged"
+                return self._diverged(
+                    f"loss spike streak {self._spike_streak}"
+                )
             # a spiking cost must not drag the EMA up toward itself —
             # the baseline stays the pre-spike trajectory
             return "ok"
@@ -134,6 +136,16 @@ class DivergenceSentinel:
         )
         self._stats.observe("robustness.loss_ema", self.ema)
         return "ok"
+
+    def _diverged(self, why: str) -> str:
+        """Declare divergence; the flight recorder (obs plane) dumps the
+        last N span events first — the postmortem shows which batches and
+        dispatches led into the incident before rollback erases the
+        in-memory evidence."""
+        from paddle_tpu import obs as _obs
+
+        _obs.flight_dump(f"sentinel-divergence: {why}")
+        return "diverged"
 
     # ------------------------------------------------------------------
     @classmethod

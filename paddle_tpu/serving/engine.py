@@ -60,6 +60,7 @@ from paddle_tpu.core.batch import (
     ladder_len,
     pad_batch_rows,
 )
+from paddle_tpu import obs as _obs
 from paddle_tpu.core.compiler import CompileShapeCache
 from paddle_tpu.ops.rnn import attention_gru_step
 from paddle_tpu.serving.pages import BlockPagedCache
@@ -785,7 +786,11 @@ class ServingEngine:
         )
         self.prefill_shapes.observe(batch)
         exe = self._prefill_exe(batch, args)
-        self._enc_pool, self._ep_pool, self._h = exe(*args)
+        with _obs.span(
+            "prefill", cat="serving", n=len(group), src_pad=int(s_pad),
+            reqs=[r.req_id for _, r, _ in group],
+        ):
+            self._enc_pool, self._ep_pool, self._h = exe(*args)
         now = self._clock()
         for _, r, _ in group:
             r.t_admit = now
@@ -803,6 +808,10 @@ class ServingEngine:
         w = self._enc_w
         C = self.prefill_chunk_tokens
         k = p.cursor
+        _obs.instant(
+            "prefill_chunk", cat="serving", req=p.request.req_id,
+            phase=p.phase, chunk=k, n_chunks=p.n_chunks,
+        )
         ids = jnp.asarray(p.ids[:, k * C:(k + 1) * C])
         lk = jnp.asarray(np.clip(p.length - k * C, 0, C).astype(np.int32))
         if p.phase == "fw":

@@ -61,6 +61,7 @@ from typing import Any, Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from paddle_tpu import obs as _obs
 from paddle_tpu.analysis.lock_sanitizer import THREAD_PREFIX, make_lock
 from paddle_tpu.robustness import chaos
 
@@ -211,6 +212,33 @@ class ServingScheduler:
             name=THREAD_PREFIX + "serve-deliver",
             daemon=True,
         )
+        # live SLO gauges (obs/metrics.py): the PR-12 gated quantities,
+        # observable while the run is still going.  Reads are advisory
+        # snapshots of step-thread state (int/float loads) — stale by at
+        # most one scrape period, never blocking the step loop.  The
+        # callbacks are retained so close() unregisters only gauges THIS
+        # instance still owns (a newer scheduler may have taken the names).
+        from paddle_tpu.obs.metrics import register_gauge
+
+        self._gauges = {
+            "paddle_tpu_serving_queue_depth": (
+                lambda: self._depth,
+                "requests queued ahead of admission (serving_queue_limit "
+                "rejects past the bound)",
+            ),
+            "paddle_tpu_serving_pages_in_use": (
+                lambda: self._engine.pages.n_used,
+                "HBM blocks held by in-flight sequences "
+                "(serving_hbm_budget_mb bounds the pool)",
+            ),
+            "paddle_tpu_serving_predicted_wait_seconds": (
+                lambda: self._predicted_wait_s(self._depth) or 0.0,
+                "EWMA-predicted queue wait of a request arriving now — "
+                "the shed predictor's own estimate",
+            ),
+        }
+        for name, (fn, help_) in self._gauges.items():
+            register_gauge(name, fn, help_)
         self._step_thread.start()
         self._deliver_thread.start()
 
@@ -228,6 +256,12 @@ class ServingScheduler:
             request.deadline_s = self.default_deadline_s
         if request.deadline_s is not None and request.deadline_s > 0:
             request.t_deadline = request.t_submit + float(request.deadline_s)
+        # AFTER deadline defaulting: the timeline must show the EFFECTIVE
+        # deadline the shed/timeout decisions below will be judged against
+        _obs.instant(
+            "serving/submit", cat="serving", req=request.req_id,
+            src_tokens=len(request.src_ids), deadline_s=request.deadline_s,
+        )
         refuse = None
         # the put rides INSIDE the closed-check critical section so close()
         # (which sets _closed under this lock, then stops and drains) can
@@ -304,6 +338,10 @@ class ServingScheduler:
     def close(self, timeout: float = 10.0) -> None:
         """Stop both threads; outstanding requests finalize with an error so
         no client waits forever.  Safe to call repeatedly."""
+        from paddle_tpu.obs.metrics import unregister_gauge
+
+        for name, (fn, _help) in self._gauges.items():
+            unregister_gauge(name, fn)
         with self._lock:
             self._closed = True
         self._stop.set()
@@ -404,6 +442,17 @@ class ServingScheduler:
         per_req = (self._est_service_s() or 0.0) * _SERVICE_SAFETY
         eta = now + wait + per_req
         if eta > r.t_deadline:
+            # the predictor's INPUTS ride the shed instant: a merged
+            # timeline answers "why was this request shed" without a repro
+            _obs.instant(
+                "serving/shed", cat="serving", req=r.req_id,
+                predicted_wait_s=round(wait, 6),
+                est_service_s=round(per_req, 6),
+                n_ahead=n_ahead,
+                ewma_token_s=self._ewma_token_s,
+                ewma_tokens=self._ewma_tokens,
+                deadline_s=r.deadline_s,
+            )
             return (
                 f"shed: predicted completion {eta - r.t_submit:.3f}s after "
                 f"submit blows the {r.deadline_s:.3f}s deadline "
@@ -430,6 +479,11 @@ class ServingScheduler:
             self._stats.incr("serving/" + r.status)
         if r.tokens is None:
             r.tokens = []
+        _obs.instant(
+            "serving/" + ("done" if r.status == "served" else r.status),
+            cat="serving", req=r.req_id, status=r.status,
+            tokens=len(r.tokens), error=r.error,
+        )
         r._event.set()  # wait() unblocks NOW, before any callback runs
         if r.callback is not None:
             self._deliver_q.put(r)
@@ -462,6 +516,10 @@ class ServingScheduler:
                 got.src_ids = [int(t) for t in got.src_ids]
                 if got.max_new_tokens is not None:
                     got.max_new_tokens = int(got.max_new_tokens)
+                _obs.instant(
+                    "serving/queued", cat="serving", req=got.req_id,
+                    n_ahead=len(waiting),
+                )
                 waiting.append(got)
             try:
                 got = self._q.get_nowait()
@@ -518,6 +576,12 @@ class ServingScheduler:
         for r in expired:
             waiting.remove(r)
             self._dec_depth()
+            _obs.instant(
+                "serving/shed", cat="serving", req=r.req_id,
+                est_service_s=round(floor, 6),
+                remaining_budget_s=round(r.t_deadline - now, 6),
+                deadline_s=r.deadline_s,
+            )
             self._finalize(
                 r, error=(
                     "shed: remaining deadline budget "
@@ -570,6 +634,10 @@ class ServingScheduler:
                 if waiting:
                     admitted = self._engine.admit(waiting)
                     if admitted:
+                        for r in admitted:
+                            _obs.instant(
+                                "serving/admit", cat="serving", req=r.req_id,
+                            )
                         del waiting[: len(admitted)]
                         self._dec_depth(len(admitted))
                 if self._engine.n_live or self._engine.n_prefilling:
@@ -581,7 +649,12 @@ class ServingScheduler:
                     # feasible requests until the outlier washes out
                     clean_sample = self._engine.n_prefilling == 0
                     t0 = self._clock()
-                    finished = self._engine.step()
+                    with _obs.span(
+                        "decode_step", cat="serving",
+                        live=self._engine.n_live,
+                        prefilling=self._engine.n_prefilling,
+                    ):
+                        finished = self._engine.step()
                     dt = self._clock() - t0
                     if clean_sample and self._engine.trace_counts == traces0:
                         self._observe_step(dt, finished)
@@ -589,6 +662,9 @@ class ServingScheduler:
                         self._finalize(r)
         except Exception as e:  # engine bug: fail loudly, strand NO client
             _log.exception("serving step loop crashed; scheduler closes")
+            # postmortem BEFORE the teardown below mutates anything: the
+            # last N events show what the step loop was doing when it died
+            _obs.flight_dump(f"serving-crash-guard: {e!r}")
             crash = f"serving loop crashed: {e!r}"
             with self._lock:
                 self._closed = True  # further submits raise, not hang
@@ -624,7 +700,8 @@ class ServingScheduler:
                 continue
             if chaos.fire("serve_slow_client"):
                 chaos.hang()  # the slow-consumer drill: only callbacks stall
-            try:
-                r.callback(r)
-            except Exception:  # client bug must not kill delivery
-                self._stats.incr("serving/callback_errors")
+            with _obs.span("deliver", cat="serving", req=r.req_id):
+                try:
+                    r.callback(r)
+                except Exception:  # client bug must not kill delivery
+                    self._stats.incr("serving/callback_errors")

@@ -45,7 +45,6 @@ Model protocol (duck-typed; see :class:`NumpyLinearModel` and
 from __future__ import annotations
 
 import argparse
-import json
 import logging
 import os
 import threading
@@ -54,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu import obs as _obs
 from paddle_tpu.io import recordio
 from paddle_tpu.robustness import chaos as _chaos
 
@@ -253,10 +253,13 @@ class ElasticWorker:
         meta declares whether this worker checkpoints, so the released
         view's ``writers`` roster covers exactly the shard writers."""
         meta = {"ckpt": self.manager is not None}
-        view = self._rpc("fence_arrive", fence_id, self.worker_id, meta)
-        while not view.get("released"):
-            self._sleep(self.poll_s)
+        with _obs.span("fence", cat="trainer", fence=fence_id):
             view = self._rpc("fence_arrive", fence_id, self.worker_id, meta)
+            while not view.get("released"):
+                self._sleep(self.poll_s)
+                view = self._rpc(
+                    "fence_arrive", fence_id, self.worker_id, meta
+                )
         return view
 
     # -- checkpoints ------------------------------------------------------
@@ -353,6 +356,13 @@ class ElasticWorker:
                 continue
             task, epoch = got["task"], got["epoch"]
             tid = task["task_id"]
+            # the elastic task lifecycle: lease → compute → ack, correlated
+            # by task id so `trace merge` lines this worker's span up with
+            # the master's rpc:get_task / rpc:task_finished handling
+            _obs.instant(
+                "elastic/lease", cat="trainer", task=tid, epoch=epoch,
+                p=pass_id,
+            )
             master_pass = int(got.get("pass_id", pass_id))
             if master_pass != pass_id:
                 # our params lag the fleet (it fenced and rotated between
@@ -375,16 +385,22 @@ class ElasticWorker:
                 self._rpc("task_failed", tid, epoch)
                 continue
             t0 = self._clock()
-            grads, cost_sum, rows = self.model.task_grad(
-                records, pass_id, tid
-            )
+            with _obs.span(
+                "elastic/compute", cat="trainer", task=tid, p=pass_id,
+            ):
+                grads, cost_sum, rows = self.model.task_grad(
+                    records, pass_id, tid
+                )
             self.busy_s += self._clock() - t0
             payload = {
                 "grads": grads, "cost": float(cost_sum), "rows": int(rows)
             }
             # the ack carries the lease's pass tag: a retry delayed past a
             # rotation is rejected instead of landing in the wrong pass
-            if self._rpc("task_finished", tid, epoch, payload, pass_id):
+            with _obs.span("elastic/ack", cat="trainer", task=tid):
+                acked = self._rpc("task_finished", tid, epoch, payload,
+                                  pass_id)
+            if acked:
                 self.tasks_done += 1
             else:
                 # zombie ack: the lease expired (we hung) and the task was
@@ -763,6 +779,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
     )
+    # obs trace context: one elastic trainer process of the fleet (export
+    # armed by the trace_dir flag / PADDLE_TPU_TRACE_DIR from the launcher)
+    _obs.tracer.configure(role="worker")
     if args.chaos:
         _chaos.arm(args.chaos)
     from paddle_tpu.master_ha import HAClient
@@ -805,11 +824,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     summary = worker.run(args.num_passes)
     if args.stats_out:
-        path = args.stats_out.replace("{worker}", worker_id)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(summary, f)
-        os.replace(tmp, path)
+        _obs.write_stats_json(
+            args.stats_out.replace("{worker}", worker_id), summary
+        )
+    _obs.tracer.dump()  # per-process trace file (no-op without trace_dir)
     for i, c in enumerate(summary["pass_costs"]):
         print(f"worker {worker_id} pass cost {c:.6f} (#{i})", flush=True)
     return 0

@@ -29,6 +29,7 @@ from paddle_tpu.trainer.evaluators import default_metrics_fn
 from paddle_tpu.trainer.step import make_eval_step, make_train_step
 
 _log = logging.getLogger("paddle_tpu.trainer")
+from paddle_tpu import obs as _obs
 from paddle_tpu.utils.timers import global_stats, stat_timer
 
 
@@ -372,7 +373,9 @@ class SGD:
                 "aot-cached" if self._aot_cache is not None else "jit",
             )
         carry = make_train_carry(params, state, opt_state, self._rng)
-        with stat_timer("epoch_program"):
+        with stat_timer("epoch_program"), _obs.span(
+            "epoch_program", cat="trainer", p=pass_id, n_steps=n,
+        ):
             carry, ms = prog(carry, stacked, perm)
         global_stats.incr("epoch_program/dispatches")
         global_stats.incr("epoch_program/steps", n)
@@ -462,7 +465,10 @@ class SGD:
         feeder = self._make_feeder(feeding)
 
         def _stage(data_batch):
-            with stat_timer("feed"):
+            # obs: the STAGE leg of the stage/dispatch/block triple — on the
+            # prefetch thread when async_load_data is on, so a merged
+            # timeline shows feed overlapping compute (or failing to)
+            with stat_timer("feed"), _obs.span("feed", cat="trainer"):
                 fed = feeder(data_batch)
                 if _chaos.fire("nan_batch"):
                     fed = _chaos.poison_batch(fed)
@@ -800,7 +806,12 @@ class SGD:
                     )
                 if is_live and recovery is not None:
                     recovery.record(pass_id, bid, batch)
-                with stat_timer("train_step"):
+                # obs: DISPATCH (issue the async jitted step) then BLOCK
+                # (the host sync on the fetched cost scalar) — the split
+                # that shows whether a slow step is compute or host-feed
+                with stat_timer("train_step"), _obs.span(
+                    "train_step", cat="trainer", p=pass_id, b=bid,
+                ):
                     self._rng, step_rng = jax.random.split(self._rng)
                     params, state, opt_state, metrics = self._run_train_step(
                         params, state, opt_state, batch, step_rng
@@ -808,7 +819,8 @@ class SGD:
                 self._step_count += 1
                 health = metrics.pop("health", None)
                 grad_norm = metrics.pop("grad_norm", None)
-                cost = float(metrics["cost"])
+                with _obs.span("block_fetch", cat="trainer", b=bid):
+                    cost = float(metrics["cost"])
                 if _chaos.fire("kill"):  # hard-preemption drill: no flush
                     _chaos.kill_self()
                 if (

@@ -266,6 +266,41 @@ define_flag("serving_max_new_tokens", 32,
             "default per-request decode cap of the serving plane (a "
             "request's own max_new_tokens overrides; the generator's "
             "max_length stays the compiled ceiling)")
+define_flag("trace_dir", "",
+            "obs plane (paddle_tpu/obs/): arm Chrome-trace export — every "
+            "process dumps its span timeline to trace-<role>-<pid>.json "
+            "under this directory at exit, and flight-recorder postmortems "
+            "land here too.  `paddle-tpu trace merge --dir D` zips the "
+            "per-process files into ONE Perfetto-loadable timeline "
+            "(clock-skew aligned via the RPC plane's request/response "
+            "pairs).  Env PADDLE_TPU_TRACE_DIR reaches subprocess fleets; "
+            "empty = no export (the flight-recorder ring still records)")
+define_flag("flight_recorder", True,
+            "keep the obs span recorder armed at bounded memory (per-"
+            "thread rings of trace_ring_events events): SIGUSR1, a firing "
+            "chaos point, the divergence sentinel, and the serving "
+            "scheduler's crash guard dump the last events to "
+            "flight-<pid>.json (under trace_dir, else the system temp "
+            "dir) — postmortem timelines survive a kill -9 fleet drill.  "
+            "Overhead is gated <= 3% by bench_tracing_overhead; off = "
+            "every emit is one attribute read")
+define_flag("trace_ring_events", 4096,
+            "bounded ring capacity (events) of each thread's obs span "
+            "buffer — the flight recorder's memory ceiling is "
+            "threads x this x ~100 bytes")
+define_flag("metrics_out", "",
+            "obs metrics export: periodically snapshot the StatSet plane "
+            "+ the registered SLO gauges (serving queue depth, pages in "
+            "use, EWMA predicted wait, served/shed/rejected/timeout "
+            "ledger) to this file in Prometheus text exposition format "
+            "(atomic replace).  Empty = off")
+define_flag("metrics_port", 0,
+            "serve the same Prometheus exposition on "
+            "http://127.0.0.1:<port>/metrics (0 = no endpoint; the "
+            "localhost bind is deliberate — this is a scrape surface, "
+            "not an API)")
+define_flag("metrics_period_s", 5.0,
+            "seconds between metrics_out snapshots")
 define_flag("use_pallas_attention", False,
             "fused flash-attention Pallas kernel for TPU self-attention: "
             "O(T*dh) attention memory instead of the [T,T] score matrix — "

@@ -40,15 +40,31 @@ def profile(logdir: Optional[str] = None) -> Iterator[None]:
             raise ValueError(
                 "no logdir given and the profile_dir flag is unset"
             )
+    # while the device profile is active, every obs host span nests under a
+    # jax.profiler.TraceAnnotation of the same name, so the host timeline
+    # (obs/tracer.py) and the XLA timeline share a vocabulary.  Injected
+    # here so the obs package itself stays jax-free (master.py imports it).
+    from paddle_tpu import obs as _obs
+
     with jax.profiler.trace(logdir):
-        yield
+        _obs.tracer.set_annotation_factory(jax.profiler.TraceAnnotation)
+        try:
+            yield
+        finally:
+            _obs.tracer.set_annotation_factory(None)
 
 
 def start(logdir: str) -> None:
+    from paddle_tpu import obs as _obs
+
     jax.profiler.start_trace(logdir)
+    _obs.tracer.set_annotation_factory(jax.profiler.TraceAnnotation)
 
 
 def stop() -> None:
+    from paddle_tpu import obs as _obs
+
+    _obs.tracer.set_annotation_factory(None)
     jax.profiler.stop_trace()
 
 

@@ -84,11 +84,19 @@ class StatSet:
             }
 
     def print_all_status(self) -> str:
-        """globalStat.printAllStatus() equivalent."""
-        lines = [f"{'name':<24}{'count':>8}{'total_s':>12}{'avg_ms':>10}{'max_ms':>10}"]
-        for k, s in sorted(self.summary().items()):
+        """globalStat.printAllStatus() equivalent.  The name column widens
+        to the longest stat name (floor 24): names past 24 chars — the
+        lock sanitizer's ``lock_held/<name>`` rows, the serving counters —
+        used to shear the numeric columns out of alignment."""
+        rows = sorted(self.summary().items())
+        w = max([24] + [len(k) for k, _ in rows]) + 1
+        lines = [
+            f"{'name':<{w}}{'count':>8}{'total_s':>12}{'avg_ms':>10}"
+            f"{'max_ms':>10}"
+        ]
+        for k, s in rows:
             lines.append(
-                f"{k:<24}{s['count']:>8}{s['total']:>12.3f}"
+                f"{k:<{w}}{s['count']:>8}{s['total']:>12.3f}"
                 f"{s['avg'] * 1e3:>10.3f}{s['max'] * 1e3:>10.3f}"
             )
         out = "\n".join(lines)

@@ -1,0 +1,149 @@
+"""Obs-plane acceptance drills (slow; `make trace-demo` / `make chaos`).
+
+The ISSUE-13 acceptance: `paddle-tpu scenario mixed_train_serve --trace`
+must produce ONE merged Chrome-trace JSON correlating spans from >= 2
+PROCESSES and >= 3 PLANES — the serving request lifecycle, the trainer
+step plane, and the master RPC plane — clock-skew aligned via the RPC
+request/response pairs.  Plus the kill -9 postmortem: a chaos ``kill``
+SIGKILL leaves a flight-recorder timeline from the dead process.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env(**extra):
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", OMP_NUM_THREADS="2",
+        PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.update(extra)
+    return env
+
+
+def test_traced_scenario_merges_cross_process_timeline(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "scenario",
+         "--name", "mixed_train_serve", "--trace", "--trace-dir", trace_dir],
+        env=_env(), cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout[-4000:]
+    result = json.loads(
+        [ln for ln in proc.stdout.splitlines()
+         if ln.startswith("{")][-1]
+    )
+    assert result["passed"] is True
+    assert result["traced_fleet"]["worker_rc"] == 0
+    mpath = result["trace"]["merged"]
+    assert os.path.exists(mpath)
+
+    from paddle_tpu.obs.merge import load_trace, validate_trace
+
+    merged = load_trace(mpath)
+    assert validate_trace(merged) == [], validate_trace(merged)[:10]
+    evs = [e for e in merged["traceEvents"] if e.get("ph") != "M"]
+
+    # >= 2 processes contributed real events
+    pids = {e["pid"] for e in evs}
+    assert len(pids) >= 2, pids
+    assert set(merged["otherData"]["merged_pids"]) == pids
+
+    # >= 3 planes: serving request lifecycle, trainer step, master RPC
+    cats = {e.get("cat") for e in evs}
+    assert {"serving", "trainer", "master"} <= cats, cats
+
+    # serving request lifecycle: one request id walks submit -> queued ->
+    # admit -> done, in order, on the unified clock
+    by_req = {}
+    for e in evs:
+        req = (e.get("args") or {}).get("req")
+        if req is not None:
+            by_req.setdefault(req, {}).setdefault(e["name"], e["ts"])
+    walked = [
+        d for d in by_req.values()
+        if {"serving/submit", "serving/queued", "serving/admit",
+            "serving/done"} <= set(d)
+    ]
+    assert walked, "no request completed a full traced lifecycle"
+    for d in walked[:5]:
+        assert (d["serving/submit"] <= d["serving/queued"]
+                <= d["serving/admit"] <= d["serving/done"])
+
+    # trainer plane: steps in the parent AND elastic task spans in the
+    # worker subprocess
+    assert any(e["name"] == "train_step" for e in evs)
+    worker_pids = {
+        e["pid"] for e in evs if e["name"].startswith("elastic/")
+    }
+    assert worker_pids and worker_pids < pids  # a strict subset: 2 procs
+
+    # master RPC plane, CORRELATED across processes: the same rpc id on a
+    # client span (worker) and a server span (parent)
+    call_pids = {}
+    handle_pids = {}
+    for e in evs:
+        rpc = (e.get("args") or {}).get("rpc")
+        if rpc is None:
+            continue
+        if e["name"].startswith("rpc_call:"):
+            call_pids[rpc] = e["pid"]
+        elif e["name"].startswith("rpc:"):
+            handle_pids[rpc] = e["pid"]
+    cross = [
+        r for r in set(call_pids) & set(handle_pids)
+        if call_pids[r] != handle_pids[r]
+    ]
+    assert cross, "no cross-process rpc correlation pairs in the timeline"
+    # and the merger used them for skew alignment
+    assert merged["otherData"]["rpc_pair_edges"], merged["otherData"]
+
+    # after alignment, each cross-process handling span's begin sits no
+    # earlier than its client span's begin (server handles AFTER dial)
+    b_ts = {}
+    for e in evs:
+        rpc = (e.get("args") or {}).get("rpc")
+        if rpc in cross and e["ph"] == "B":
+            b_ts.setdefault(rpc, {})[e["name"].split(":")[0]] = e["ts"]
+    aligned_ok = sum(
+        1 for d in b_ts.values()
+        if "rpc_call" in d and "rpc" in d and d["rpc"] >= d["rpc_call"] - 5e3
+    )
+    assert aligned_ok >= len(b_ts) * 0.8, b_ts
+
+
+def test_chaos_kill_sigkill_leaves_flight_postmortem(tmp_path):
+    """The kill -9 drill's postmortem: arming ``kill@1`` in a subprocess
+    dumps flight-<pid>.json at the firing consultation, BEFORE SIGKILL
+    lands — the dead process's only record."""
+    code = textwrap.dedent("""
+        from paddle_tpu import obs
+        from paddle_tpu.robustness import chaos
+        obs.instant("train_step", cat="trainer", b=12)
+        chaos.arm("kill@1")
+        if chaos.fire("kill"):
+            chaos.kill_self()
+        raise SystemExit("kill point did not fire")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=_env(PADDLE_TPU_TRACE_DIR=str(tmp_path)), cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=300,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stdout.decode()[-2000:]
+    flights = list(tmp_path.glob("flight-*.json"))
+    assert len(flights) == 1
+    obj = json.loads(flights[0].read_text())
+    assert obj["otherData"]["reason"].startswith("chaos:kill@")
+    assert any(e["name"] == "train_step" for e in obj["traceEvents"])

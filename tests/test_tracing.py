@@ -1,0 +1,655 @@
+"""Obs plane (ISSUE 13): span tracer, trace merge, flight recorder,
+Prometheus metrics export, the shared --stats-out writer, and the A205
+monotonic-clock self-lint rule.
+
+The cross-process acceptance drill (a traced scenario producing ONE
+merged timeline from >= 2 processes / >= 3 planes) lives in
+tests/test_obs_e2e.py (slow, `make trace-demo`)."""
+
+import json
+import os
+
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs import merge as obs_merge
+from paddle_tpu.obs.tracer import Tracer
+from paddle_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test sees a recording, export-less singleton and default
+    flags; nothing leaks between tests."""
+    obs.tracer.reset()
+    obs.tracer.set_recording(True)
+    obs.tracer._export_dir = None
+    obs.tracer.set_annotation_factory(None)
+    yield
+    obs.tracer.reset()
+    obs.tracer.set_recording(True)
+    obs.tracer._export_dir = None
+    obs.tracer.set_annotation_factory(None)
+    flags.reset_flags()
+
+
+class FakeClock:
+    def __init__(self, t0=100.0):
+        self.t = t0
+
+    def __call__(self):
+        self.t += 0.001
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+
+def test_trace_event_schema_roundtrip(tmp_path):
+    t = Tracer(clock=FakeClock(), ring_events=128)
+    with t.span("train_step", cat="trainer", p=0, b=3):
+        t.instant("serving/submit", cat="serving", req="r1", deadline_s=0.5)
+        with t.span("rpc_call:get_task", cat="rpc", rpc="a-1"):
+            pass
+    path = t.dump(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        obj = json.load(f)
+    assert obs_merge.validate_trace(obj) == []
+    evs = [e for e in obj["traceEvents"] if e["ph"] != "M"]
+    # required keys on every event
+    for ev in evs:
+        for k in ("ph", "ts", "pid", "tid", "name"):
+            assert k in ev, ev
+    # begin/end pairing, args well-formed, correlation ids intact
+    assert [e["ph"] for e in evs] == ["B", "i", "B", "E", "E"]
+    sub = next(e for e in evs if e["name"] == "serving/submit")
+    assert sub["args"] == {"req": "r1", "deadline_s": 0.5}
+    assert sub["cat"] == "serving"
+    rpc_b = next(e for e in evs if e["name"] == "rpc_call:get_task")
+    assert rpc_b["args"]["rpc"] == "a-1"
+    # timestamps are strictly increasing with the injected monotonic clock
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts) and ts[0] < ts[-1]
+    # trace context rides otherData
+    other = obj["otherData"]
+    assert other["pid"] == os.getpid()
+    assert other["role"] == "proc"
+    assert other["trace_id"]
+    assert "mono_us" in other["clock_anchor"]
+
+
+def test_ring_buffer_wraps_to_last_n():
+    t = Tracer(clock=FakeClock(), ring_events=8)
+    for i in range(50):
+        t.instant(f"ev{i}")
+    evs = [e for e in t.events() if e["ph"] != "M"]
+    assert len(evs) == 8  # bounded memory: capacity holds
+    assert [e["name"] for e in evs] == [f"ev{i}" for i in range(42, 50)]
+
+
+def test_disarmed_recorder_emits_nothing():
+    t = Tracer(clock=FakeClock(), ring_events=8)
+    t.set_recording(False)
+    with t.span("x"):
+        t.instant("y")
+    assert [e for e in t.events() if e["ph"] != "M"] == []
+    t.set_recording(True)
+    t.instant("z")
+    assert len([e for e in t.events() if e["ph"] != "M"]) == 1
+
+
+def test_annotation_factory_nests_spans():
+    entered = []
+
+    class Ann:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            entered.append(("in", self.name))
+
+        def __exit__(self, *a):
+            entered.append(("out", self.name))
+
+    t = Tracer(clock=FakeClock())
+    t.set_annotation_factory(Ann)
+    with t.span("step"):
+        pass
+    assert entered == [("in", "step"), ("out", "step")]
+    # disarmed recording skips the annotation too (zero-cost contract)
+    t.set_recording(False)
+    with t.span("step2"):
+        pass
+    assert len(entered) == 2
+
+
+def test_validate_catches_mispairing_and_missing_keys():
+    bad = {"traceEvents": [
+        {"ph": "B", "ts": 1, "pid": 1, "tid": 1, "name": "a"},
+        {"ph": "E", "ts": 2, "pid": 1, "tid": 1, "name": "b"},
+        {"ph": "i", "ts": 3, "pid": 1, "name": "c"},  # no tid
+        {"ph": "i", "ts": 4, "pid": 1, "tid": 1, "name": "d", "args": 7},
+    ]}
+    problems = obs_merge.validate_trace(bad)
+    assert any("closes B" in p for p in problems)
+    assert any("missing key 'tid'" in p for p in problems)
+    assert any("args is not an object" in p for p in problems)
+    assert obs_merge.validate_trace({"traceEvents": []}) == []
+
+
+def test_validate_tolerates_ring_wrap_and_mid_span_dump():
+    """The two EXPECTED pairing artifacts must not fail validation:
+    leading orphan Es (the ring dropped their Bs at wrap) and trailing
+    unclosed Bs (a flight dump fired mid-span)."""
+    t = Tracer(clock=FakeClock(), ring_events=3)
+    with t.span("outer"):
+        with t.span("inner"):
+            pass
+    # ring of 3 kept [E inner, ...]: B outer evicted -> leading orphan E
+    assert obs_merge.validate_trace(t.trace_object()) == []
+    t2 = Tracer(clock=FakeClock(), ring_events=64)
+    with t2.span("outer"):
+        with t2.span("inner"):
+            obj = t2.trace_object()  # dump mid-span: two unclosed Bs
+    assert obs_merge.validate_trace(obj) == []
+
+
+# ---------------------------------------------------------------------------
+# merge: clock-skew alignment
+# ---------------------------------------------------------------------------
+
+def _synthetic_process(pid, role, skew_us, rpc_ids, client, extra=()):
+    """A trace whose clock runs ``skew_us`` ahead of process 1's."""
+    base = 1_000_000.0 + skew_us
+    evs = []
+    for i, rid in enumerate(rpc_ids):
+        t0 = base + 1000 * i
+        if client:
+            evs.append({"ph": "B", "ts": t0, "pid": pid, "tid": 1,
+                        "name": "rpc_call:get_task", "cat": "rpc",
+                        "args": {"rpc": rid}})
+            evs.append({"ph": "E", "ts": t0 + 40, "pid": pid, "tid": 1,
+                        "name": "rpc_call:get_task", "cat": "rpc"})
+        else:
+            evs.append({"ph": "B", "ts": t0 + 15, "pid": pid, "tid": 1,
+                        "name": "rpc:get_task", "cat": "master",
+                        "args": {"rpc": rid}})
+            evs.append({"ph": "E", "ts": t0 + 25, "pid": pid, "tid": 1,
+                        "name": "rpc:get_task", "cat": "master"})
+    evs.extend(extra)
+    return {
+        "traceEvents": evs,
+        "otherData": {
+            "pid": pid, "role": role, "trace_id": "t0",
+            # wall anchors deliberately COARSE (500us off) so the test
+            # proves the rpc pairs refine past them
+            "clock_anchor": {"mono_us": base, "wall_us": 2_000_000.0 + 500},
+        },
+    }
+
+
+def test_merge_aligns_known_skew_via_rpc_pairs():
+    rpc_ids = [f"1-{i}" for i in range(9)]
+    skew = 123_456.0
+    a = _synthetic_process(1, "worker", 0.0, rpc_ids, client=True)
+    b = _synthetic_process(2, "master", skew, rpc_ids, client=False)
+    merged = obs_merge.merge_traces([a, b], reference_pid=1)
+    off = merged["otherData"]["offsets_us"]
+    assert off["1"] == 0.0
+    # recovered within a fraction of the (symmetric) exchange window
+    assert abs(off["2"] + skew) < 25.0
+    # after alignment every server-handling span sits INSIDE its client
+    # exchange span on the unified clock
+    evs = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    by_rpc = {}
+    for e in evs:
+        rid = (e.get("args") or {}).get("rpc")
+        if rid is not None:
+            by_rpc.setdefault(rid, {})[e["name"]] = e["ts"]
+    for rid, d in by_rpc.items():
+        assert d["rpc_call:get_task"] < d["rpc:get_task"]
+    assert merged["otherData"]["rpc_pair_edges"] == {"1->2": 9}
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_merge_wall_anchor_fallback_without_rpc_pairs():
+    # two processes that never talked: only the wall anchors align them
+    a = _synthetic_process(1, "serve", 0.0, [], client=True,
+                           extra=[{"ph": "i", "ts": 1_000_100.0, "pid": 1,
+                                   "tid": 1, "name": "x", "cat": "serving"}])
+    b = _synthetic_process(2, "trainer", 50_000.0, [], client=False,
+                           extra=[{"ph": "i", "ts": 1_050_100.0, "pid": 2,
+                                   "tid": 1, "name": "y", "cat": "trainer"}])
+    merged = obs_merge.merge_traces([a, b], reference_pid=1)
+    off = merged["otherData"]["offsets_us"]
+    # anchor math: dw_a = 2e6+500 - 1e6; dw_b = 2e6+500 - 1.05e6
+    assert abs(off["2"] + 50_000.0) < 1.0
+    evs = {e["name"]: e["ts"] for e in merged["traceEvents"]
+           if e["ph"] != "M"}
+    assert abs(evs["x"] - evs["y"]) < 1.0  # simultaneous events align
+
+
+def test_merge_dir_and_cli(tmp_path):
+    t1 = Tracer(clock=FakeClock(10.0), ring_events=64)
+    t1.role = "serve"
+    t1.instant("serving/submit", cat="serving", req="r1")
+    t1.dump(str(tmp_path / "trace-serve-1.json"))
+    t2 = Tracer(clock=FakeClock(20.0), ring_events=64)
+    t2.role = "worker"
+    t2.pid = t1.pid + 1  # distinct synthetic process
+    t2.instant("elastic/lease", cat="trainer", task=0)
+    t2.dump(str(tmp_path / "trace-worker-2.json"))
+    merged, out = obs_merge.merge_dir(str(tmp_path))
+    assert os.path.exists(out)
+    assert len(merged["otherData"]["merged_pids"]) == 2
+    # the CLI face over the same files
+    from paddle_tpu.cli import main as cli_main
+
+    rc = cli_main(["trace", "validate", out])
+    assert rc == 0
+    rc = cli_main(["trace", "merge", "--dir", str(tmp_path),
+                   "--out", str(tmp_path / "m2.json")])
+    assert rc == 0 and os.path.exists(tmp_path / "m2.json")
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_dump_on_scheduler_crash_guard(tmp_path):
+    from paddle_tpu.serving import Request, ServingScheduler
+
+    flags.set_flag("trace_dir", str(tmp_path))
+
+    class BrokenEngine:
+        max_slots = 2
+        n_prefilling = 0
+        n_free_slots = 2
+        src_vocab = 50
+        default_max_new_tokens = 4
+        trace_counts = {}
+
+        def __init__(self):
+            self._reqs = []
+
+        @property
+        def n_live(self):
+            return len(self._reqs)
+
+        def max_src_tokens(self):
+            return 64
+
+        def admit(self, waiting):
+            self._reqs.extend(waiting)
+            return list(waiting)
+
+        def step(self):
+            raise RuntimeError("boom: engine corrupted")
+
+        def outstanding_requests(self):
+            return list(self._reqs)
+
+        def preempt(self):
+            return self._reqs.pop() if self._reqs else None
+
+        def cancel(self, r):
+            if r in self._reqs:
+                self._reqs.remove(r)
+                return True
+            return False
+
+        def cancel_by_id(self, rid):
+            return None
+
+    sched = ServingScheduler(BrokenEngine(), queue_limit=0,
+                             default_deadline_s=0.0)
+    r = sched.submit(Request([1, 2, 3]))
+    assert r.wait(20.0), "crash guard must finalize the stranded request"
+    assert r.status == "closed" and "crashed" in (r.error or "")
+    sched.close()
+    flight = tmp_path / f"flight-{os.getpid()}.json"
+    assert flight.exists(), "crash guard must leave a postmortem"
+    obj = json.loads(flight.read_text())
+    assert "serving-crash-guard" in obj["otherData"]["reason"]
+    names = [e["name"] for e in obj["traceEvents"]]
+    assert "serving/submit" in names  # the last events show the lead-in
+
+
+def test_flight_dump_on_chaos_fire(tmp_path):
+    """A firing chaos point dumps the postmortem once per arming (the
+    kill -9 SIGKILL variant — the dump must land BEFORE the process dies
+    — is drilled in tests/test_obs_e2e.py with a real subprocess)."""
+    from paddle_tpu.robustness import chaos
+
+    flags.set_flag("trace_dir", str(tmp_path))
+    obs.instant("train_step", cat="trainer", b=1)
+    chaos.arm("nan_batch")
+    try:
+        assert chaos.fire("nan_batch")
+        assert chaos.fire("nan_batch")  # fires again, dumps only once
+    finally:
+        chaos.disarm()
+    flight = tmp_path / f"flight-{os.getpid()}.json"
+    assert flight.exists()
+    obj = json.loads(flight.read_text())
+    assert obj["otherData"]["reason"] == "chaos:nan_batch@1"
+    assert any(e["name"] == "train_step" for e in obj["traceEvents"])
+
+
+def test_flight_dump_on_sentinel_divergence(tmp_path):
+    from paddle_tpu.robustness.sentinel import DivergenceSentinel
+
+    flags.set_flag("trace_dir", str(tmp_path))
+    obs.instant("train_step", cat="trainer", b=0)
+    s = DivergenceSentinel(skip_limit=2)
+    assert s.observe(1.0, healthy=False) == "skip"
+    assert s.observe(1.0, healthy=False) == "diverged"
+    flight = tmp_path / f"flight-{os.getpid()}.json"
+    assert flight.exists()
+    obj = json.loads(flight.read_text())
+    assert "sentinel-divergence" in obj["otherData"]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# RPC correlation (client + server halves in one process)
+# ---------------------------------------------------------------------------
+
+def test_rpc_spans_share_correlation_id(tmp_path):
+    from paddle_tpu import master
+
+    d = str(tmp_path / "rio")
+    os.makedirs(d)
+    from paddle_tpu.io import recordio
+
+    recordio.write_records(
+        os.path.join(d, "a.rio"), iter([b"x"] * 4), max_chunk_records=2
+    )
+    svc = master.Service(chunks_per_task=2, snapshot_path=None)
+    srv = master.Server(svc)
+    try:
+        cli = master.Client(srv.address)
+        cli.set_dataset([os.path.join(d, "*.rio")])
+        assert cli._call("stats")["n_todo"] >= 1
+        cli.close()
+    finally:
+        srv.close()
+    evs = [e for e in obs.tracer.events() if e["ph"] == "B"]
+    calls = {
+        (e["args"] or {}).get("rpc")
+        for e in evs if e["name"].startswith("rpc_call:")
+    }
+    handles = {
+        (e["args"] or {}).get("rpc")
+        for e in evs if e["name"].startswith("rpc:") and e["args"]
+    }
+    shared = (calls & handles) - {None}
+    assert shared, (calls, handles)  # both halves carry the same rpc id
+
+
+# ---------------------------------------------------------------------------
+# metrics export
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = __import__("re").compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (-?(?:[0-9.eE+-]+|inf|nan))$"
+)
+
+
+def _parse_prometheus(text):
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        samples[m.group(1) + (m.group(2) or "")] = float(m.group(3))
+    return samples
+
+
+def test_prometheus_exposition_parses(tmp_path):
+    from paddle_tpu.obs.metrics import (
+        register_gauge, render_prometheus, unregister_gauge,
+    )
+    from paddle_tpu.utils.timers import StatSet
+
+    stats = StatSet()
+    stats.incr("serving/completed", 5)
+    stats.incr("serving/shed", 2)
+    stats.observe('lock_held/master.Service._lock "x"', 0.25)
+    register_gauge("paddle_tpu_serving_queue_depth", lambda: 3,
+                   "queued requests")
+    register_gauge("paddle_tpu_dead_gauge", lambda: 1 / 0, "must be skipped")
+    try:
+        text = render_prometheus(stats)
+    finally:
+        unregister_gauge("paddle_tpu_serving_queue_depth")
+        unregister_gauge("paddle_tpu_dead_gauge")
+    samples = _parse_prometheus(text)
+    assert samples["paddle_tpu_serving_queue_depth"] == 3.0
+    assert not any("dead_gauge" in k for k in samples)
+    assert samples[
+        'paddle_tpu_serving_requests_total{status="served"}'] == 5.0
+    assert samples[
+        'paddle_tpu_serving_requests_total{status="shed"}'] == 2.0
+    assert samples[
+        'paddle_tpu_serving_requests_total{status="timeout"}'] == 0.0
+    # label escaping: the quoted stat name survives
+    assert any("lock_held" in k and '\\"x\\"' in k for k in samples)
+    assert "# HELP paddle_tpu_serving_queue_depth queued requests" in text
+    assert "# TYPE paddle_tpu_serving_requests_total counter" in text
+
+
+def test_metrics_exporter_file_and_http(tmp_path):
+    import urllib.request
+
+    from paddle_tpu.obs.metrics import MetricsExporter
+    from paddle_tpu.utils.timers import StatSet
+
+    stats = StatSet()
+    stats.incr("serving/completed", 7)
+    out = tmp_path / "metrics.prom"
+    with MetricsExporter(path=str(out), port=0, period_s=30.0,
+                         stats=stats) as exp:
+        assert exp.write_once()
+        samples = _parse_prometheus(out.read_text())
+        assert samples[
+            'paddle_tpu_serving_requests_total{status="served"}'] == 7.0
+        assert exp.port and exp.port > 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=10
+        ) as resp:
+            assert resp.status == 200
+            body = resp.read().decode()
+        assert _parse_prometheus(body)[
+            'paddle_tpu_serving_requests_total{status="served"}'] == 7.0
+    # closed: the endpoint is gone
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=2
+        )
+
+
+def test_scheduler_registers_slo_gauges(tmp_path):
+    """The PR-12 SLO variables are live gauges while a scheduler exists,
+    and unregister on close."""
+    from paddle_tpu.obs.metrics import render_prometheus
+    from paddle_tpu.serving import ServingScheduler
+
+    class IdleEngine:
+        max_slots = 2
+        n_live = 0
+        n_prefilling = 0
+        n_free_slots = 2
+        src_vocab = 50
+        default_max_new_tokens = 4
+        trace_counts = {}
+
+        class pages:
+            n_used = 3
+
+        def max_src_tokens(self):
+            return 64
+
+        def admit(self, waiting):
+            return []
+
+        def step(self):
+            return []
+
+        def outstanding_requests(self):
+            return []
+
+        def cancel_by_id(self, rid):
+            return None
+
+    sched = ServingScheduler(IdleEngine(), queue_limit=0,
+                             default_deadline_s=0.0)
+    try:
+        samples = _parse_prometheus(render_prometheus())
+        assert samples["paddle_tpu_serving_queue_depth"] == 0.0
+        assert samples["paddle_tpu_serving_pages_in_use"] == 3.0
+        assert "paddle_tpu_serving_predicted_wait_seconds" in samples
+        # a SECOND scheduler takes the names over; closing the OLD one
+        # must not tear the new one's gauges down (ownership check)
+        eng2 = IdleEngine()
+        eng2.pages = type("P", (), {"n_used": 9})
+        sched2 = ServingScheduler(eng2, queue_limit=0,
+                                  default_deadline_s=0.0)
+        try:
+            assert _parse_prometheus(render_prometheus())[
+                "paddle_tpu_serving_pages_in_use"] == 9.0
+            sched.close()
+            assert _parse_prometheus(render_prometheus())[
+                "paddle_tpu_serving_pages_in_use"] == 9.0
+        finally:
+            sched2.close()
+    finally:
+        sched.close()
+    samples = _parse_prometheus(render_prometheus())
+    assert "paddle_tpu_serving_queue_depth" not in samples
+
+
+# ---------------------------------------------------------------------------
+# the shared --stats-out writer
+# ---------------------------------------------------------------------------
+
+def test_write_stats_json_atomic_append_and_unwritable(tmp_path, capsys):
+    p = tmp_path / "stats.json"
+    assert obs.write_stats_json(str(p), {"a": 1})
+    assert json.loads(p.read_text()) == {"a": 1}
+    assert obs.write_stats_json(str(p), {"a": 2})  # replace, not append
+    assert json.loads(p.read_text()) == {"a": 2}
+    ap = tmp_path / "log.jsonl"
+    obs.write_stats_json(str(ap), {"n": 1}, append=True)
+    obs.write_stats_json(str(ap), {"n": 2}, append=True)
+    assert [json.loads(l) for l in ap.read_text().splitlines()] == [
+        {"n": 1}, {"n": 2},
+    ]
+    # uniform unwritable-path behavior: warn + False, never raise
+    bad = str(tmp_path / "no" / "such" / "dir" / "s.json")
+    assert obs.write_stats_json(bad, {"a": 1}) is False
+    assert obs.write_stats_json(bad, {"a": 1}, append=True) is False
+    assert "unwritable" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# satellites: StatSet column alignment + A205 self-lint rule
+# ---------------------------------------------------------------------------
+
+def test_statset_print_aligns_long_names(capsys):
+    from paddle_tpu.utils.timers import StatSet
+
+    s = StatSet()
+    s.incr("feed")
+    s.observe("lock_held/master.Server._conns_lock-and-then-some", 0.5)
+    out = s.print_all_status()
+    capsys.readouterr()
+    lines = out.splitlines()
+    # every row (header included) lays the same columns: equal lengths
+    assert len({len(ln) for ln in lines}) == 1
+    assert lines[0].rstrip().endswith("max_ms")
+    # numeric columns still right-aligned after the longest name
+    for ln in lines[1:]:
+        assert not ln.startswith(" ")
+
+
+def _lint_obs_source(tmp_path, src):
+    from paddle_tpu.analysis.ast_rules import lint_file
+
+    d = tmp_path / "paddle_tpu" / "obs"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / "mod.py"
+    p.write_text(src)
+    return lint_file(str(p), root=str(tmp_path))
+
+
+def test_a205_flags_wall_clock_in_obs(tmp_path):
+    diags = _lint_obs_source(
+        tmp_path, "import time\nts = time.time()\n"
+    )
+    assert [d.rule for d in diags] == ["A205"]
+    diags = _lint_obs_source(
+        tmp_path, "import time\nts = time.time_ns()\n"
+    )
+    assert [d.rule for d in diags] == ["A205"]
+
+
+def test_a205_sees_through_aliases(tmp_path):
+    # `from time import time` and `import time as t` must not slip past
+    # the ban; `from time import monotonic` stays legal
+    diags = _lint_obs_source(
+        tmp_path, "from time import time\nts = time()\n"
+    )
+    assert [d.rule for d in diags] == ["A205"]
+    diags = _lint_obs_source(
+        tmp_path, "import time as t\nts = t.time()\n"
+    )
+    assert [d.rule for d in diags] == ["A205"]
+    assert _lint_obs_source(
+        tmp_path, "from time import monotonic\nts = monotonic()\n"
+    ) == []
+
+
+def test_a205_pragma_requires_justification(tmp_path):
+    ok = (
+        "import time\n"
+        "anchor = time.time()  # obs: allow-wall-clock merge anchor only\n"
+        "mono = time.monotonic()\n"
+    )
+    assert _lint_obs_source(tmp_path, ok) == []
+    empty = (
+        "import time\n"
+        "anchor = time.time()  # obs: allow-wall-clock\n"
+    )
+    diags = _lint_obs_source(tmp_path, empty)
+    assert [d.rule for d in diags] == ["A205"]
+    assert "justification" in diags[0].message
+
+
+def test_a205_does_not_fire_outside_obs(tmp_path):
+    from paddle_tpu.analysis.ast_rules import lint_file
+
+    d = tmp_path / "paddle_tpu" / "reader"
+    d.mkdir(parents=True)
+    p = d / "mod.py"
+    p.write_text("import time\nts = time.time()\n")
+    assert [x.rule for x in lint_file(str(p), root=str(tmp_path))] == []
+
+
+def test_obs_package_lints_clean():
+    """The new plane passes its own rules: A-rules (incl. A205) over
+    paddle_tpu/obs/ report nothing."""
+    import paddle_tpu
+    from paddle_tpu.analysis.ast_rules import lint_file
+
+    root = os.path.dirname(os.path.dirname(
+        os.path.abspath(paddle_tpu.__file__)
+    ))
+    obs_dir = os.path.join(root, "paddle_tpu", "obs")
+    diags = []
+    for fn in sorted(os.listdir(obs_dir)):
+        if fn.endswith(".py"):
+            diags.extend(lint_file(os.path.join(obs_dir, fn), root=root))
+    assert diags == [], [str(d) for d in diags]
