@@ -5,9 +5,17 @@
 #                  (analysis/ast_rules), graph-lint over every shipped
 #                  demo config (tests/configs/), the T106 buffer-
 #                  donation audit over the step builders (incl. the
-#                  whole-pass epoch program), and the C-rules lock-
+#                  whole-pass epoch program), the C-rules lock-
 #                  discipline lint over the threaded planes
-#                  (analysis/concurrency_lint).  Zero findings = pass.
+#                  (analysis/concurrency_lint), and the N-rules
+#                  precision-flow lint (analysis/numerics_lint) in
+#                  four legs: package probes at f32, the demo-config
+#                  corpus at f32, the flagship corpus at bf16, and the
+#                  package probes at bf16 — the last leg is the pragma-
+#                  hygiene pass (every `# num:` pragma must be justified
+#                  AND still suppressing something, package-wide).
+#                  Fixes + justified pragmas keep all four at zero.
+#                  Zero findings = pass.
 #   make test    — fast tier: lint, then every test not marked `slow`;
 #                  < 6 min on the virtual 8-device CPU mesh.  The CI gate.
 #   make verify  — the full suite, then a bench smoke (one metric), the
@@ -64,6 +72,12 @@ lint:
 		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --donation
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --concurrency
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics \
+		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics --compute-dtype bfloat16 \
+		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics --compute-dtype bfloat16
 
 test: lint
 	$(CPU_ENV) $(PY) -m pytest tests/ -q -m "not slow" --durations=20
@@ -86,8 +100,11 @@ tier1-update:
 # analysis/lock_sanitizer factories is instrumented, so each failover /
 # kill-one-of-N fleet drill doubles as a runtime lock-order race detector
 # (a cycle raises DeadlockReport and fails the drill)
+# the single-process drills also arm the NUMERICS sanitizer: the
+# nan_batch drill's flight-recorder postmortem must name the first
+# non-finite-producing eqn (analysis/num_sanitizer.py), not just skip
 chaos:
-	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 PADDLE_TPU_NUM_SANITIZER=1 $(PY) -m pytest tests/test_chaos_e2e.py tests/test_robustness.py tests/test_num_sanitizer.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_elastic_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q

@@ -57,6 +57,7 @@ degenerates to an on-device pass-through — see the devices field).
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 
@@ -2479,7 +2480,15 @@ def load_prior_bench(repo_dir: str) -> dict:
 def regression_fields(metric: str, value, unit, prior: dict) -> dict:
     """best_prior / regressed_vs_best fields for one fresh result.  Lower
     is better for ms metrics, higher for every rate; correctness-only
-    metrics (cpu-emulated bandwidth) are exempt — their value is noise."""
+    metrics (cpu-emulated bandwidth) are exempt — their value is noise.
+
+    A NON-FINITE value is a hard regression regardless of history: NaN
+    compares false against every threshold, so before this guard a bench
+    that started emitting NaN sailed through `delta > tolerance` as
+    "not regressed" — the exact silent-pass the numerics plane exists to
+    kill."""
+    if isinstance(value, (int, float)) and not math.isfinite(value):
+        return {"regressed_vs_best": True, "non_finite": True}
     hist = prior.get(metric)
     if not hist or not isinstance(value, (int, float)) or value <= 0:
         return {}
@@ -2497,6 +2506,35 @@ def regression_fields(metric: str, value, unit, prior: dict) -> dict:
         "best_prior_round": best_round,
         "delta_vs_best_pct": round(delta * 100.0, 2),
         "regressed_vs_best": bool(delta > REGRESSION_TOLERANCE),
+    }
+
+
+def build_guard(results: list) -> dict:
+    """The REGRESSION_GUARD summary line.  Non-finite metrics report in
+    their own `non_finite` list (hard regressions with no best_prior to
+    compare against) so a NaN bench is unmissable in the tail."""
+    regressed = [
+        {
+            "metric": r["metric"],
+            "value": r.get("value"),
+            "best_prior": r.get("best_prior"),
+            "best_prior_round": r.get("best_prior_round"),
+            "delta_vs_best_pct": r.get("delta_vs_best_pct"),
+        }
+        for r in results
+        if r.get("regressed_vs_best") and not r.get("non_finite")
+    ]
+    non_finite = [
+        {"metric": r["metric"], "value": repr(r.get("value"))}
+        for r in results
+        if r.get("non_finite")
+    ]
+    return {
+        "metric": "REGRESSION_GUARD",
+        "checked": sum(1 for r in results if "regressed_vs_best" in r),
+        "tolerance_pct": REGRESSION_TOLERANCE * 100.0,
+        "regressed": regressed,
+        "non_finite": non_finite,
     }
 
 
@@ -2537,25 +2575,8 @@ def main() -> None:
             )
             results.append(r)
             print(json.dumps(r), flush=True)
-    regressed = [
-        {
-            "metric": r["metric"],
-            "value": r.get("value"),
-            "best_prior": r.get("best_prior"),
-            "best_prior_round": r.get("best_prior_round"),
-            "delta_vs_best_pct": r.get("delta_vs_best_pct"),
-        }
-        for r in results
-        if r.get("regressed_vs_best")
-    ]
-    guard = {
-        "metric": "REGRESSION_GUARD",
-        "checked": sum(1 for r in results if "regressed_vs_best" in r),
-        "tolerance_pct": REGRESSION_TOLERANCE * 100.0,
-        "regressed": regressed,
-    }
-    results.append(guard)
-    print(json.dumps(guard), flush=True)
+    results.append(build_guard(results))
+    print(json.dumps(results[-1]), flush=True)
     with open(os.path.join(repo_dir, "bench_results.json"), "w") as f:
         json.dump(results, f, indent=1)
     # the tail-proof summary must fit inside the driver's 2000-char tail:
@@ -2567,6 +2588,9 @@ def main() -> None:
             compact.append({
                 "metric": "REGRESSION_GUARD",
                 "regressed": [g["metric"] for g in r["regressed"]],
+                # the tail is often the only surviving output — a NaN
+                # bench must be visible HERE, not only in the full log
+                "non_finite": [g["metric"] for g in r.get("non_finite", ())],
             })
             continue
         c = {"metric": r.get("metric")}

@@ -49,6 +49,14 @@ _EXPORTS = {
     "trace_step": "trace_lint",
     "lint_concurrency_file": "concurrency_lint",
     "lint_concurrency_package": "concurrency_lint",
+    "PrecisionCertificate": "numerics_lint",
+    "certify_precision_plan": "numerics_lint",
+    "lint_numerics_config": "numerics_lint",
+    "lint_numerics_jaxpr": "numerics_lint",
+    "lint_numerics_package": "numerics_lint",
+    "lint_numerics_step": "numerics_lint",
+    "NumericsSanitizer": "num_sanitizer",
+    "num_sanitizer_armed": "num_sanitizer",
     "DeadlockReport": "lock_sanitizer",
     "make_lock": "lock_sanitizer",
     "make_rlock": "lock_sanitizer",

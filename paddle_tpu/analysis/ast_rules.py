@@ -57,7 +57,6 @@ _READER_PREFIXES = ("reader" + os.sep, "dataset" + os.sep)
 # the wall-clock time.* calls A205 forbids in obs/ modules (monotonic /
 # perf_counter are exactly what spans SHOULD use, so they stay legal)
 _WALL_FNS = frozenset({"time", "time_ns"})
-_OBS_PRAGMA = "# obs: allow-wall-clock"
 
 
 def _name_of(node: ast.AST) -> Optional[str]:
@@ -229,11 +228,15 @@ def _scan_reader_rng(tree: ast.Module, relpath: str,
 def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
                          diags: List[Diagnostic]) -> None:
     """A205 over one obs/ module: wall-clock calls are forbidden unless
-    the LINE carries ``# obs: allow-wall-clock <justification>`` — and an
-    empty justification is itself a finding (the concurrency lint's C300
-    discipline applied here).  Alias-aware like the RNG rules: ``import
-    time as t; t.time()`` and ``from time import time`` must not slip
-    past the ban."""
+    the LINE carries ``# obs: allow-wall-clock <justification>``.  The
+    pragma parses through the shared plane parser (analysis.pragmas) —
+    comment tokens only, empty justification is its own finding, and a
+    stale pragma (suppressing nothing) reports uniformly with the
+    ``# lock:``/``# num:`` planes.  Alias-aware like the RNG rules:
+    ``import time as t; t.time()`` and ``from time import time`` must
+    not slip past the ban."""
+    from paddle_tpu.analysis import pragmas as _pragmas
+
     time_mods = {"time"}
     bare_wall: Set[str] = set()
     for node in ast.walk(tree):
@@ -245,7 +248,14 @@ def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
             for a in node.names:
                 if a.name in _WALL_FNS:
                     bare_wall.add(a.asname or a.name)
-    lines = src.splitlines()
+    pragma_diags: List[Diagnostic] = []
+    table = _pragmas.collect(src, "obs", relpath, pragma_diags)
+    diags.extend(pragma_diags)
+    # a malformed (empty-why) pragma already reported above — the wall
+    # read on its line must not double-report, but is NOT suppressed
+    # either in the sense that the pragma finding keeps the lint red
+    malformed = {d.line for d in pragma_diags if d.line is not None}
+    used: Set[int] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
@@ -258,17 +268,10 @@ def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
             or (head == "" and tail in bare_wall)
         ):
             continue
-        line_src = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _OBS_PRAGMA in line_src:
-            why = line_src.split(_OBS_PRAGMA, 1)[1].strip()
-            if why:
-                continue  # justified pragma: allowed
-            diags.append(Diagnostic(
-                rule="A205", severity=Severity.ERROR,
-                message="empty `# obs: allow-wall-clock` justification — "
-                "say WHY this wall read can never stamp a span",
-                source=relpath, line=node.lineno,
-            ))
+        if node.lineno in table:
+            used.add(node.lineno)
+            continue
+        if node.lineno in malformed:
             continue
         diags.append(Diagnostic(
             rule="A205", severity=Severity.ERROR,
@@ -280,6 +283,7 @@ def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
             "genuinely-needed wall read (merge anchor) takes "
             "`# obs: allow-wall-clock <why>`",
         ))
+    diags.extend(_pragmas.stale_findings(table, used, "obs", relpath))
 
 
 def _scan_flag_defs(tree: ast.Module, relpath: str,

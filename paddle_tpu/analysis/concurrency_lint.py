@@ -71,11 +71,11 @@ import os
 import re
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from paddle_tpu.analysis import pragmas as _pragmas
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
 
 __all__ = ["lint_concurrency_file", "lint_concurrency_package"]
 
-_PRAGMA_RE = re.compile(r"#\s*lock:\s*allow\[([A-Z0-9, ]*)\]\s*(.*)$")
 _LOCKNAME_RE = re.compile(r"lock|mutex|_mu$", re.IGNORECASE)
 
 _LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "make_lock", "make_rlock"})
@@ -271,35 +271,12 @@ def _ctor_kind(value: ast.AST) -> Optional[str]:
 
 def _collect_pragmas(src: str, relpath: str, diags: List[Diagnostic],
                      info: _ModuleInfo) -> None:
-    """Pragmas are COMMENT tokens only — a ``# lock: allow[...]`` spelled
-    inside a string literal (a docstring showing the syntax, a fix-hint
-    template) is documentation, not an annotation."""
-    import io
-    import tokenize
-
-    comments: List[Tuple[int, str]] = []
-    try:
-        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
-            if tok.type == tokenize.COMMENT:
-                comments.append((tok.start[0], tok.string))
-    except (tokenize.TokenError, IndentationError, SyntaxError):
-        return  # unparseable tail: the AST pass already reported C300
-    for i, comment in comments:
-        m = _PRAGMA_RE.search(comment)
-        if not m:
-            continue
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-        justification = m.group(2).strip()
-        if not rules or not justification:
-            diags.append(Diagnostic(
-                rule="C300", severity=Severity.ERROR,
-                message="allowlist pragma without a justification string "
-                "(every intentional hold must say WHY)",
-                source=relpath, line=i,
-                hint="# lock: allow[C304] <why this hold is intentional>",
-            ))
-            continue
-        info.pragmas[i] = (rules, justification)
+    """Pragmas parse through the shared plane parser (analysis.pragmas):
+    COMMENT tokens only — a ``# lock: allow[...]`` spelled inside a string
+    literal is documentation, not an annotation — and an empty
+    justification is its own C300 finding."""
+    for line, p in _pragmas.collect(src, "lock", relpath, diags).items():
+        info.pragmas[line] = (set(p.rules), p.justification)
 
 
 def _declared(tree: ast.Module, mod: str, relpath: str) -> _ModuleInfo:
@@ -1021,22 +998,16 @@ class _Linter:
     def check_unused_pragmas(self, modules) -> None:
         """A pragma that suppressed nothing is a stale annotation — the
         hold it justified moved or stopped being blocking.  Reported as
-        C300 so the allowlist stays an honest record of intentional
-        holds."""
+        C300 (the shared stale-pragma discipline, analysis.pragmas) so
+        the allowlist stays an honest record of intentional holds."""
         for m in modules:
-            for line in sorted(m.pragmas):
-                if line in m.pragma_used:
-                    continue
-                rules_, _just = m.pragmas[line]
-                self.diags.append(Diagnostic(
-                    rule="C300", severity=Severity.WARNING,
-                    message="unused allowlist pragma "
-                    f"allow[{','.join(sorted(rules_))}] — no finding on "
-                    "this line is suppressed by it (stale annotation)",
-                    source=m.relpath, line=line,
-                    hint="delete the pragma, or re-anchor it on the line "
-                    "that actually needs the exemption",
-                ))
+            table = {
+                line: _pragmas.Pragma(line, frozenset(rules_), just)
+                for line, (rules_, just) in m.pragmas.items()
+            }
+            self.diags.extend(_pragmas.stale_findings(
+                table, m.pragma_used, "lock", m.relpath,
+            ))
 
     # -- C303 cycle check (package-wide) ---------------------------------
     def check_cycles(self) -> None:

@@ -1411,7 +1411,17 @@ def cmd_lint(argv: List[str]) -> int:
       source — guarded-field consistency, static lock-order cycles,
       blocking-under-lock, thread-leak and injectable-clock checks
       (the static leg of the concurrency plane; the runtime leg is
-      PADDLE_TPU_LOCK_SANITIZER=1 on the chaos drills).
+      PADDLE_TPU_LOCK_SANITIZER=1 on the chaos drills);
+    * --numerics: precision-flow lint (rules N###) over the compiled
+      train-step jaxprs — low-precision accumulation, master-precision
+      escapes, unguarded domain hazards, overflowing mask literals,
+      sub-f32 psums, convert churn.  Alone it lints the package step
+      builders over probe topologies; with --config it lints each
+      config's REAL train step; --compute-dtype/--master-dtype pick the
+      precision plan (the bf16 flagship leg of ``make lint``), and
+      --certify prints the per-layer precision certificate
+      (analysis.certify_precision_plan — the ROADMAP item 2 gate; the
+      runtime leg is PADDLE_TPU_NUM_SANITIZER=1 on the chaos drills).
 
     Exit 0 only when no diagnostics fire (``make lint``'s contract)."""
     ap = argparse.ArgumentParser(
@@ -1435,6 +1445,19 @@ def cmd_lint(argv: List[str]) -> int:
     ap.add_argument("--concurrency", action="store_true",
                     help="lock-discipline lint (rules C###) over the "
                     "package source (skips the self-lint)")
+    ap.add_argument("--numerics", action="store_true",
+                    help="precision-flow lint (rules N###) over the "
+                    "compiled train-step jaxprs: package probes, or each "
+                    "--config's real step (skips the self-lint)")
+    ap.add_argument("--compute-dtype", default=None,
+                    help="numerics: compute dtype of the precision plan "
+                    "(e.g. bfloat16; default f32)")
+    ap.add_argument("--master-dtype", default=None,
+                    help="numerics: master/param dtype of the plan "
+                    "(default float32)")
+    ap.add_argument("--certify", action="store_true",
+                    help="numerics + --config: print the per-layer "
+                    "precision certificate for the dtype plan")
     ap.add_argument("--min-severity", default=None,
                     choices=["info", "warning", "error"],
                     help="only report findings at or above this severity")
@@ -1462,7 +1485,56 @@ def cmd_lint(argv: List[str]) -> int:
         )
 
         diags.extend(lint_concurrency_package(extra_paths=args.extra))
-    if args.config:
+    if args.numerics:
+        from paddle_tpu.analysis.numerics_lint import (
+            certify_precision_plan,
+            lint_numerics_config,
+            lint_numerics_package,
+        )
+
+        if args.certify and not args.config:
+            print("error: --certify needs --config (a certificate is "
+                  "per-topology; the package probes have none)",
+                  file=sys.stderr)
+            return 2
+        if args.config:
+            from paddle_tpu.v1_compat import parse_config
+
+            for cfg in args.config:
+                if len(args.config) > 1 or args.certify:
+                    print(f"numerics-lint {cfg} "
+                          f"(compute={args.compute_dtype or 'float32'})")
+                if args.certify:
+                    # ONE trace: the certificate already carries every
+                    # (pragma-filtered) N-rule finding for this plan, and
+                    # a REJECT must fail the exit-code contract
+                    parsed = parse_config(
+                        os.path.abspath(cfg), args.config_args
+                    )
+                    from paddle_tpu.v1_compat import make_optimizer
+
+                    try:
+                        opt = make_optimizer(parsed.settings)
+                    except Exception:  # exotic settings: the Adam probe
+                        opt = None
+                    cert = certify_precision_plan(parsed.topology, {
+                        "compute_dtype": args.compute_dtype,
+                        "master_dtype": args.master_dtype,
+                    }, optimizer=opt)
+                    print(cert.format())
+                    diags.extend(cert.diagnostics)
+                else:
+                    diags.extend(lint_numerics_config(
+                        cfg, args.config_args,
+                        compute_dtype=args.compute_dtype,
+                        master_dtype=args.master_dtype,
+                    ))
+        else:
+            diags.extend(lint_numerics_package(
+                compute_dtype=args.compute_dtype,
+                master_dtype=args.master_dtype,
+            ))
+    if args.config and not args.numerics:
         from paddle_tpu.v1_compat import parse_config
 
         for cfg in args.config:
@@ -1483,7 +1555,7 @@ def cmd_lint(argv: List[str]) -> int:
                 continue
             diags.extend(analysis.lint_parsed(parsed))
     if not (args.config or args.journal or args.donation
-            or args.concurrency):
+            or args.concurrency or args.numerics):
         diags = analysis.lint_package(extra_paths=args.extra)
 
     if args.min_severity:

@@ -70,7 +70,7 @@ def _cast_floats(tree, dtype):
     """Cast every floating leaf of a pytree to `dtype` (ints/bools pass)."""
     def cast(x):
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
-            return x.astype(dtype)
+            return x.astype(dtype)  # num: allow[N406] the mixed contract quantizes EVERY non-full-precision layer output at its boundary, even when an f32 consumer follows — downstream must see the same values a fully-bf16 pipeline produces
         return x
 
     return jax.tree_util.tree_map(cast, tree)

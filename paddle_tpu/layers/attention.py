@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from paddle_tpu.core import initializers as init
 from paddle_tpu.core.batch import SeqTensor
 from paddle_tpu.layers.base import register_layer
+from paddle_tpu.ops import acc_einsum, acc_matmul
 
 NEG_INF = -1e9
 
@@ -99,14 +100,14 @@ def mha_apply(conf, params, inputs, ctx):
     if same_input:
         # self-attention: one [D, 3D] GEMM instead of three [D, D] — wider
         # N keeps the MXU fuller and the param concat is trace-time cheap
-        qkv = q_in.data @ jnp.concatenate(
+        qkv = acc_matmul(q_in.data, jnp.concatenate(
             [params["wq"], params["wk"], params["wv"]], axis=1
-        )
+        ))
         q, k, v = jnp.split(qkv, 3, axis=-1)
     else:
-        q = q_in.data @ params["wq"]  # [B, Tq, D]
-        k = kv_in.data @ params["wk"]  # [B, Tk, D]
-        v = kv_in.data @ params["wv"]
+        q = acc_matmul(q_in.data, params["wq"])  # [B, Tq, D]
+        k = acc_matmul(kv_in.data, params["wk"])  # [B, Tk, D]
+        v = acc_matmul(kv_in.data, params["wv"])
     b, tq = q.shape[0], q.shape[1]
     tk = k.shape[1]
     q = q.reshape(b, tq, h, dh)
@@ -192,7 +193,7 @@ def mha_apply(conf, params, inputs, ctx):
         qh = q.transpose(0, 2, 1, 3)
         kh = k.transpose(0, 2, 1, 3)
         vh = v.transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(dh)
+        scores = acc_einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(dh)
         scores = scores.astype(jnp.float32)
         if kv_in.is_seq:
             key_mask = kv_in.mask(jnp.float32)  # [B, Tk]
@@ -202,12 +203,12 @@ def mha_apply(conf, params, inputs, ctx):
             scores = scores + (1.0 - cm)[None, None, :, :] * NEG_INF
         w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
         out = (
-            jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+            acc_einsum("bhqk,bhkd->bhqd", w, vh)
             .transpose(0, 2, 1, 3)
             .reshape(b, tq, d)
         )
 
-    out = out @ params["wo"]
+    out = acc_matmul(out, params["wo"])
     if "b" in params:
         out = out + params["b"]
     return SeqTensor(out, q_in.lengths, q_in.sub_lengths)

@@ -19,6 +19,7 @@ from paddle_tpu.core import initializers as init
 from paddle_tpu.core.batch import SeqTensor
 from paddle_tpu.core.topology import LayerConf
 from paddle_tpu.layers.base import ApplyContext, register_layer
+from paddle_tpu.ops import acc_matmul
 
 
 def _flat2d(x: jnp.ndarray) -> jnp.ndarray:
@@ -83,10 +84,10 @@ def fc_apply(conf, params, inputs: List[SeqTensor], ctx: ApplyContext) -> SeqTen
                 x = x.reshape(x.shape[0], x.shape[1], -1)
         else:
             x = _flat2d(x)
-        y = jnp.matmul(x, w)
+        y = acc_matmul(x, w)  # f32-accumulating under mixed precision
         acc = y if acc is None else acc + y
     if "b" in params:
-        acc = acc + params["b"]
+        acc = acc + params["b"]  # num: allow[N401] bias-grad batch reduce rides the compute dtype; the heavy weight-grad contractions accumulate f32 via acc_matmul and masters stay f32
     return SeqTensor(acc, lengths, sub_lengths)
 
 

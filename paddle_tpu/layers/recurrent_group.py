@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.batch import SeqTensor
 from paddle_tpu.core.topology import LayerConf, LayerOutput, Topology, auto_name
 from paddle_tpu.layers.base import ApplyContext, register_layer
+from paddle_tpu.ops import acc_einsum
 
 
 class StaticInput:
@@ -693,7 +694,7 @@ def recurrent_group_apply(conf, params, inputs, ctx: ApplyContext) -> SeqTensor:
     if fused_hs is not None:
         ys_stacked = (SeqTensor(fused_hs),)
     else:
-        (_, sub_state_out), ys_stacked = jax.lax.scan(
+        (_, sub_state_out), ys_stacked = jax.lax.scan(  # num: allow[N401] generic-group backward: weight cotangents accumulate at compute dtype across <=T ladder steps (PR-2 parity contract); f32 master updates + the bf16 convergence tests gate the loss
             body,
             (init_carry, sub_state0),
             scan_xs,
@@ -852,11 +853,11 @@ def _try_fused_attention_gru(
     xg = None
     for slot, pname in match.scan_slots:
         x = xs[scan_idx[pname]].data  # [T, B, D], already flipped if reverse
-        term = jnp.einsum("tbd,dg->tbg", x, p_in[f"w{slot}"])
+        term = acc_einsum("tbd,dg->tbg", x, p_in[f"w{slot}"])
         xg = term if xg is None else xg + term
     for p in (p_in, p_gru):
         if "b" in p:
-            xg = xg + p["b"]
+            xg = xg + p["b"]  # num: allow[N401] gate-bias grad sums over T at compute dtype; every weight grad in the fused core accumulates f32 post-scan
     ep = ep_t.data
     if "b" in p_sp:
         ep = ep + p_sp["b"]  # state-proj bias is step-invariant: fold here

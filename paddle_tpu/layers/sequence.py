@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from paddle_tpu.core import initializers as init
 from paddle_tpu.core.batch import SeqTensor
 from paddle_tpu.layers.base import register_layer
+from paddle_tpu.ops import acc_matmul
 from paddle_tpu.ops import rnn as rnn_ops
 
 
@@ -391,16 +392,16 @@ def gru_step_apply(conf, params, inputs, ctx):
     x_u, x_r, x_c = jnp.split(x, 3, axis=-1)
     if conf.attr("tied_weights", False):
         w = params["w"]
-        hw = h_p @ w
+        hw = acc_matmul(h_p, w)
         u_t = f_gate(x_u + hw)
         r_t = f_gate(x_r + hw)
         w_c = w
     else:
-        ur = h_p @ params["w_h"]
+        ur = acc_matmul(h_p, params["w_h"])
         u_t = f_gate(x_u + ur[:, :h])
         r_t = f_gate(x_r + ur[:, h:])
         w_c = params["w_c"]
-    c_t = f_act(x_c + (r_t * h_p) @ w_c)
+    c_t = f_act(x_c + acc_matmul(r_t * h_p, w_c))
     h_t = (1.0 - u_t) * h_p + u_t * c_t
     return SeqTensor(h_t)
 
@@ -426,7 +427,7 @@ def lstm_step_apply(conf, params, inputs, ctx):
     f_gate = get_activation(conf.attr("gate_act", "sigmoid"))
     f_act = get_activation(conf.attr("active_type", "tanh"))
     f_state = get_activation(conf.attr("state_act", "tanh"))
-    a = x + h_p @ params["w_h"] if "w_h" in params else x
+    a = x + acc_matmul(h_p, params["w_h"]) if "w_h" in params else x
     if "b" in params:
         a = a + params["b"]
     a_i, a_f, a_g, a_o = jnp.split(a, 4, axis=-1)

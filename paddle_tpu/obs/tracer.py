@@ -336,11 +336,14 @@ class Tracer:
             return None
         return path
 
-    def flight_dump(self, reason: str) -> Optional[str]:
+    def flight_dump(self, reason: str,
+                    extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
         """Postmortem: the ring buffers' last events to
         ``flight-<pid>.json`` (under the ``trace_dir`` flag when set,
         else the system temp dir — never the working directory).  Safe
-        from signal handlers and except blocks; never raises."""
+        from signal handlers and except blocks; never raises.  ``extra``
+        merges into ``otherData`` — the numerics sanitizer rides its
+        first-non-finite-eqn postmortem here (``otherData.numerics``)."""
         try:
             from paddle_tpu.utils import flags as _flags
 
@@ -351,6 +354,8 @@ class Tracer:
             )
             path = os.path.join(d, f"flight-{self.pid}.json")
             obj = self.trace_object(reason=reason)
+            if extra:
+                obj["otherData"].update(extra)
             os.makedirs(d, exist_ok=True)
             with open(path, "w") as f:
                 json.dump(obj, f, default=str)

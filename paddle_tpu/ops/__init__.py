@@ -1,0 +1,45 @@
+"""TPU-native op helpers shared by the layer impls and the fused cores.
+
+``acc_matmul``/``acc_einsum`` are the one sanctioned spelling of a GEMM
+under mixed precision: sub-f32 operands contract with an f32 accumulator
+(``preferred_element_type`` — the MXU gives f32 accumulation for free)
+and round ONCE to the compute dtype on the way out, instead of
+truncating every partial sum.  At f32 they are byte-for-byte
+``jnp.matmul``/``jnp.einsum`` — no behavior change on the default path.
+The numerics lint (analysis/numerics_lint.py, rule N401) flags any
+low-precision contraction that bypasses this discipline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["acc_matmul", "acc_einsum", "needs_f32_acc"]
+
+
+def needs_f32_acc(dtype) -> bool:
+    """True for sub-f32 float dtypes (bf16/f16/f8) — the dtypes whose
+    contractions must accumulate upward."""
+    return (
+        jnp.issubdtype(dtype, jnp.floating)
+        and jnp.finfo(dtype).bits < 32
+    )
+
+
+def acc_matmul(x, w):
+    """``x @ w`` accumulating in f32 for sub-f32 operands, result cast
+    back to the operand dtype; the plain matmul (bit-identical) at f32+."""
+    if needs_f32_acc(x.dtype):
+        y = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+        return y.astype(x.dtype)  # num: allow[N406] intentional single rounding: the f32-accumulated GEMM result quantizes ONCE to the compute dtype at the op boundary (a full-precision consumer may immediately re-promote)
+    return jnp.matmul(x, w)
+
+
+def acc_einsum(subscripts: str, *operands):
+    """``jnp.einsum`` with the same f32-accumulation discipline as
+    :func:`acc_matmul` (keyed on the first operand's dtype)."""
+    if operands and needs_f32_acc(operands[0].dtype):
+        y = jnp.einsum(subscripts, *operands,
+                       preferred_element_type=jnp.float32)
+        return y.astype(operands[0].dtype)
+    return jnp.einsum(subscripts, *operands)

@@ -61,7 +61,7 @@ def _sigmoid(x):
 
 @register_activation("softmax")
 def _softmax(x):
-    return jax.nn.softmax(x, axis=-1)
+    return jax.nn.softmax(x, axis=-1)  # num: allow[N401] softmax fwd sums in f32 inside jax.nn; the bwd [S]-sum rides the compute dtype (S bounded by the shape ladder)
 
 
 @register_activation("sequence_softmax")

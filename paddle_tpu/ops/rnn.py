@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from paddle_tpu.ops import acc_einsum, acc_matmul
 from paddle_tpu.ops.activations import get_activation
 
 # Step-body unroll factor.  All three cells use custom-VJP cores (chain
@@ -96,7 +97,7 @@ def _lstm_fwd_scan(acts, xs, w_h, w_ci, w_cf, w_co, h0, c0, mask):
     def step(carry, inp):
         h_p, c_p = carry
         x_t, m = inp
-        a = x_t + h_p @ w_h
+        a = x_t + acc_matmul(h_p, w_h)
         h_t, c_t = _lstm_elem(acts, a, c_p, h_p, m, w_ci, w_cf, w_co)
         return (h_t, c_t), (h_t, a, c_t)
 
@@ -142,7 +143,7 @@ def _lstm_core_bwd(acts, res, cts):
             a_t, c_p, h_p, w_ci, w_cf, w_co,
         )
         da, dc_p, dh_p_elem, dwci_t, dwcf_t, dwco_t = vjp_fn((dh, dc))
-        dh_p = da @ w_h_t + dh_p_elem  # the ONE backward-chain GEMM
+        dh_p = acc_matmul(da, w_h_t) + dh_p_elem  # the ONE backward-chain GEMM
         return (
             (
                 dh_p,
@@ -209,7 +210,7 @@ def lstm_scan(
 
     xs = _time_major(gates)
     if bias is not None:
-        xs = xs + bias  # folds into the producing projection GEMM's epilogue
+        xs = xs + bias  # num: allow[N401] LSTM gate-bias grad reduce rides the compute dtype (folds into the projection GEMM's epilogue); weight grads accumulate f32 post-scan
     if reverse:
         xs = jnp.flip(xs, axis=0)
     mask = _mask_seq(lengths, t, reverse)
@@ -258,7 +259,7 @@ def gru_scan(
 
     xs = _time_major(gates)
     if bias is not None:
-        xs = xs + bias
+        xs = xs + bias  # num: allow[N401] GRU gate-bias grad reduce rides the compute dtype; weight grads accumulate f32 post-scan
     if reverse:
         xs = jnp.flip(xs, axis=0)
     mask = _mask_seq(lengths, t, reverse)
@@ -304,10 +305,10 @@ def _gru_fwd_scan(acts, xs, w_h, w_c, h0, mask):
 
     def step(h_p, inp):
         x_t, m = inp
-        ur = h_p @ w_h
+        ur = acc_matmul(h_p, w_h)
         p_ur = x_t[:, : 2 * h] + ur
         rh = _gru_reset(acts, p_ur[:, h:], h_p)
-        p_c = x_t[:, 2 * h :] + rh @ w_c
+        p_c = x_t[:, 2 * h :] + acc_matmul(rh, w_c)
         h_t = _gru_final(acts, p_ur[:, :h], p_c, h_p, m)
         return h_t, (h_t, p_ur, p_c)
 
@@ -338,13 +339,13 @@ def _gru_core_bwd(acts, res, cts):
             p_ur[:, :h], p_c, h_p,
         )
         dp_u, dp_c, dh_p = vjp_final(dh)
-        drh = dp_c @ w_c_t
+        drh = acc_matmul(dp_c, w_c_t)
         rh, vjp_reset = jax.vjp(
             lambda pr, hp: _gru_reset(acts, pr, hp), p_ur[:, h:], h_p
         )
         dp_r, dh_p_r = vjp_reset(drh)
         dp_ur = jnp.concatenate([dp_u, dp_r], axis=-1)
-        dh_p = dh_p + dh_p_r + dp_ur @ w_h_t
+        dh_p = dh_p + dh_p_r + acc_matmul(dp_ur, w_h_t)
         return dh_p, (dp_ur, dp_c, rh)
 
     dh0, (dp_ur_seq, dp_c_seq, rh_seq) = lax.scan(
@@ -427,15 +428,15 @@ def _attgru_step(acts, xg_t, h_p, enc, ep, emask, w1, v, w_ctx, w_c, m):
     residuals the hand-written backward needs."""
     p_dim = ep.shape[-1]
     h = h_p.shape[-1]
-    a1 = h_p @ w1  # [B, P+2H]: state projection + GRU u/r gates fused
+    a1 = acc_matmul(h_p, w1)  # [B, P+2H]: state projection + GRU u/r gates fused
     sp, ur = a1[:, :p_dim], a1[:, p_dim:]
     alpha = _att_softmax(_att_scores(acts[2], ep, sp, v), emask)
-    ctxv = jnp.einsum("bs,bse->be", alpha, enc)
-    p = xg_t + ctxv @ w_ctx  # [B, 3H] in (u, r, c) slot order
+    ctxv = acc_einsum("bs,bse->be", alpha, enc)
+    p = xg_t + acc_matmul(ctxv, w_ctx)  # [B, 3H] in (u, r, c) slot order
     pu = p[:, :h] + ur[:, :h]
     pr = p[:, h : 2 * h] + ur[:, h:]
     rh = _gru_reset(acts, pr, h_p)
-    cpre = p[:, 2 * h :] + rh @ w_c
+    cpre = p[:, 2 * h :] + acc_matmul(rh, w_c)
     h_t = _gru_final(acts, pu, cpre, h_p, m)
     return h_t, (sp, alpha, ctxv, pu, pr, cpre)
 
@@ -539,14 +540,14 @@ def _attgru_core_bwd(opts, res, cts):
             lambda a, c, hp: _gru_final(acts, a, c, hp, m), pu, cpre, h_p
         )
         dpu, dcpre, dh_p = vjp_final(dh)
-        drh = dcpre @ w_c_t  # chain GEMM 1
+        drh = acc_matmul(dcpre, w_c_t)  # chain GEMM 1
         rh, vjp_reset = jax.vjp(
             lambda p_r, hp: _gru_reset(acts, p_r, hp), pr, h_p
         )
         dpr, dh_p_r = vjp_reset(drh)
         dxg = jnp.concatenate([dpu, dpr, dcpre], axis=-1)  # == dp
-        dctx = dxg @ w_ctx_t  # chain GEMM 2
-        dalpha = jnp.einsum("be,bse->bs", dctx, enc)
+        dctx = acc_matmul(dxg, w_ctx_t)  # chain GEMM 2
+        dalpha = acc_einsum("be,bse->bs", dctx, enc)
         # masked-softmax VJP: padding has alpha == 0, so it drops out
         dpre = alpha * (
             dalpha - jnp.sum(alpha * dalpha, axis=-1, keepdims=True)
@@ -555,9 +556,9 @@ def _attgru_core_bwd(opts, res, cts):
         # jvp so any registered activation works (elementwise, fuses)
         x_s = ep + sp[:, None, :]
         _, fp = jax.jvp(f_att, (x_s,), (jnp.ones_like(x_s),))
-        dsp = jnp.einsum("bs,bsp->bp", dpre, fp) * v
+        dsp = acc_einsum("bs,bsp->bp", dpre, fp) * v
         da1 = jnp.concatenate([dsp, dpu, dpr], axis=-1)
-        dh_p = dh_p + dh_p_r + da1 @ w1_t  # chain GEMM 3 (the h₋ link)
+        dh_p = dh_p + dh_p_r + acc_matmul(da1, w1_t)  # chain GEMM 3 (the h₋ link)
         return dh_p, (da1, dxg, dctx, dpre, rh)
 
     if early:
@@ -737,7 +738,7 @@ def _rnn_core(acts, xs, w_h, h0, mask):
 def _rnn_fwd_scan(acts, xs, w_h, h0, mask):
     def step(h_p, inp):
         x_t, m = inp
-        a = x_t + h_p @ w_h
+        a = x_t + acc_matmul(h_p, w_h)
         h_t = _rnn_act(acts, a, h_p, m)
         return h_t, (h_t, a)
 
@@ -761,7 +762,7 @@ def _rnn_core_bwd(acts, res, cts):
         dh = dh + dh_out
         _, vjp_fn = jax.vjp(lambda a, hp: _rnn_act(acts, a, hp, m), a_t, h_p)
         da, dh_p_elem = vjp_fn(dh)
-        return da @ w_h_t + dh_p_elem, da
+        return acc_matmul(da, w_h_t) + dh_p_elem, da
 
     dh0, da_seq = lax.scan(
         step,

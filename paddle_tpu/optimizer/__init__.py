@@ -461,8 +461,11 @@ class Adam(Optimizer):
         t = step.astype(jnp.float32) + 1.0
         m = self.beta1 * m + (1 - self.beta1) * g
         v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
-        mhat = m / (1 - jnp.power(self.beta1, t))
-        vhat = v / (1 - jnp.power(self.beta2, t))
+        # the floor keeps the bias-correction denominator provably
+        # positive (N403); for any t >= 1 it is >= 1-beta >> 1e-16, so
+        # the max is bit-identical to the unguarded form
+        mhat = m / jnp.maximum(1 - jnp.power(self.beta1, t), 1e-16)
+        vhat = v / jnp.maximum(1 - jnp.power(self.beta2, t), 1e-16)
         return p - lr * mhat / (jnp.sqrt(vhat) + self.epsilon), (m, v)
 
 
@@ -485,4 +488,6 @@ class AdaMax(Optimizer):
         t = step.astype(jnp.float32) + 1.0
         m = self.beta1 * m + (1 - self.beta1) * g
         u = jnp.maximum(self.beta2 * u, jnp.abs(g))
-        return p - (lr / (1 - jnp.power(self.beta1, t))) * m / (u + 1e-12), (m, u)
+        # same N403 floor as Adam: bit-identical for t >= 1
+        corr = jnp.maximum(1 - jnp.power(self.beta1, t), 1e-16)
+        return p - (lr / corr) * m / (u + 1e-12), (m, u)

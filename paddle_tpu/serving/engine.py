@@ -62,6 +62,7 @@ from paddle_tpu.core.batch import (
 )
 from paddle_tpu import obs as _obs
 from paddle_tpu.core.compiler import CompileShapeCache
+from paddle_tpu.ops import acc_matmul
 from paddle_tpu.ops.rnn import attention_gru_step
 from paddle_tpu.serving.pages import BlockPagedCache
 
@@ -489,7 +490,7 @@ class ServingEngine:
             def run(w, ids, lk, h):
                 self.trace_counts["prefill_chunk"] += 1
                 emb = take_rows_or_zero(w["emb_w"], ids)
-                gates = jnp.matmul(emb, w[f"{key}_gates_w"])
+                gates = acc_matmul(emb, w[f"{key}_gates_w"])
                 if w[f"{key}_gates_b"] is not None:
                     gates = gates + w[f"{key}_gates_b"]
                 return gru_scan(
@@ -502,7 +503,7 @@ class ServingEngine:
         def scatter(enc_pool, ep_pool, fw_hs, bw_hs, rows, w, sp_b):
             self.trace_counts["prefill_chunk"] += 1
             enc = jnp.concatenate([fw_hs, bw_hs], axis=-1)  # [1, C, 2H]
-            ep = jnp.matmul(enc, w["proj_w"])
+            ep = acc_matmul(enc, w["proj_w"])
             if w["proj_b"] is not None:
                 ep = ep + w["proj_b"]
             if sp_b is not None:
@@ -520,7 +521,7 @@ class ServingEngine:
                        w):
             self.trace_counts["prefill_chunk"] += 1
             enc0 = jnp.concatenate([fw0, bw0], axis=-1)  # [1, 2H]
-            boot = jnp.matmul(enc0, w["boot_w"])
+            boot = acc_matmul(enc0, w["boot_w"])
             if w["boot_b"] is not None:
                 boot = boot + w["boot_b"]
             boot = boot_act(boot)

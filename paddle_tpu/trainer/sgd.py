@@ -481,6 +481,17 @@ class SGD:
             from paddle_tpu.robustness.sentinel import DivergenceSentinel
 
             sentinel = DivergenceSentinel.from_flags()
+        # numerics sanitizer (analysis/num_sanitizer.py): armed via the
+        # num_sanitizer flag / PADDLE_TPU_NUM_SANITIZER=1 it keeps a host
+        # copy of each step's inputs and, when a step is sentinel-flagged,
+        # re-executes it eqn-by-eqn to name the first non-finite-producing
+        # op in a flight-recorder postmortem.  Unarmed: num_san stays
+        # None and this loop is untouched (zero overhead, zero captures).
+        num_san = None
+        if _flags.get_flag("num_sanitizer"):
+            from paddle_tpu.analysis.num_sanitizer import NumericsSanitizer
+
+            num_san = NumericsSanitizer.for_trainer(self)
         recovery = manager = None
         if checkpoint_dir:
             from paddle_tpu import checkpoint as _ckpt
@@ -813,6 +824,14 @@ class SGD:
                     "train_step", cat="trainer", p=pass_id, b=bid,
                 ):
                     self._rng, step_rng = jax.random.split(self._rng)
+                    if num_san is not None:
+                        # the dispatch donates params/state/opt-state —
+                        # copy the step's inputs out first or there is
+                        # nothing left to re-execute when it goes bad
+                        num_san.capture(
+                            params, state, opt_state, batch, step_rng,
+                            where=f"pass {pass_id} batch {bid}",
+                        )
                     params, state, opt_state, metrics = self._run_train_step(
                         params, state, opt_state, batch, step_rng
                     )
@@ -847,6 +866,14 @@ class SGD:
                     pass_id, bid, cost, health, grad_norm, metrics,
                     _batch_rows(batch),
                 )
+                if num_san is not None and (
+                    verdict in ("skip", "diverged")
+                    or not np.isfinite(cost)
+                ):
+                    # name the op that went non-finite, not just the step
+                    num_san.postmortem(
+                        f"{verdict} at pass {pass_id} batch {bid}"
+                    )
                 if not is_live and not replay and recovery is not None:
                     recovery.replay_done()  # window re-applied cleanly
                 if verdict == "diverged":

@@ -188,6 +188,16 @@ define_flag("sentinel_spike_factor", 4.0,
 define_flag("sentinel_spike_patience", 3,
             "consecutive EMA spikes before the sentinel declares "
             "divergence")
+define_flag("num_sanitizer", False,
+            "arm the divergence-localizing numerics sanitizer "
+            "(analysis/num_sanitizer.py; env PADDLE_TPU_NUM_SANITIZER "
+            "reaches subprocesses): the trainer host-copies each step's "
+            "inputs pre-dispatch, and a sentinel-flagged step is re-"
+            "executed eqn-by-eqn to name the first non-finite-producing "
+            "op (layer + source provenance, input max-abs stats under "
+            "StatSet num/<eqn>) in a flight-recorder postmortem.  "
+            "Capture costs one host copy per step — debug drills only; "
+            "unarmed the train path is untouched")
 define_flag("failure_max", 3,
             "rollback retries of the same data window before it is "
             "quarantined and training continues past it — the go/master "

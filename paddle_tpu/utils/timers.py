@@ -7,18 +7,20 @@ blocking waits)."""
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from typing import Dict, Iterator
 
 
 class _Stat:
-    __slots__ = ("total", "count", "max")
+    __slots__ = ("total", "count", "max", "nonfinite")
 
     def __init__(self) -> None:
         self.total = 0.0
         self.count = 0
         self.max = 0.0
+        self.nonfinite = 0
 
     def add(self, dt: float) -> None:
         self.total += dt
@@ -63,9 +65,17 @@ class StatSet:
     def observe(self, name: str, value: float) -> None:
         """Value stat: fold a measured scalar (gradient norm, loss EMA)
         into the same summary surface — `total`/`avg`/`max` are over the
-        observed values instead of wall seconds."""
+        observed values instead of wall seconds.  A non-finite value is
+        counted in the stat's own `nonfinite` bucket instead of folding:
+        one NaN must not poison the avg/max column the chaos drills (and
+        the numerics sanitizer's `num/<eqn>` range stats) assert on."""
+        v = float(value)
         with self._lock:
-            self._stats.setdefault(name, _Stat()).add(float(value))
+            s = self._stats.setdefault(name, _Stat())
+            if math.isfinite(v):
+                s.add(v)
+            else:
+                s.nonfinite += 1
 
     def count(self, name: str) -> int:
         with self._lock:
@@ -79,7 +89,8 @@ class StatSet:
     def summary(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
             return {
-                k: {"total": s.total, "count": s.count, "avg": s.avg, "max": s.max}
+                k: {"total": s.total, "count": s.count, "avg": s.avg,
+                    "max": s.max, "nonfinite": s.nonfinite}
                 for k, s in self._stats.items()
             }
 

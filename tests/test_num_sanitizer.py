@@ -1,0 +1,196 @@
+"""Numerics sanitizer (analysis/num_sanitizer.py): the jaxpr interpreter
+localizes the first non-finite-producing eqn (through scans, with layer
+provenance), the trainer postmortem rides the flight recorder on a
+``nan_batch`` drill, and the unarmed path is untouched (zero captures,
+byte-identical params)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis.num_sanitizer import (
+    find_first_nonfinite,
+    num_sanitizer_armed,
+)
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.robustness import chaos
+from paddle_tpu.utils import flags
+from paddle_tpu.utils.timers import global_stats
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    chaos.disarm()
+    flags.reset_flags()
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+def test_first_nonfinite_names_eqn_and_poisoned_input():
+    def fn(x, w):
+        with jax.named_scope("fc:h1"):
+            y = x @ w
+        return jnp.tanh(y)
+
+    x = np.ones((4, 8), np.float32)
+    x[0, 0] = np.nan
+    rec = find_first_nonfinite(fn, (x, np.ones((8, 8), np.float32)))
+    assert rec is not None
+    assert rec["primitive"] == "dot_general"
+    assert rec["layer"] == "h1"
+    assert rec["poisoned_inputs"] and "arg0" in rec["poisoned_inputs"][0]["input"]
+    # the offending eqn's input stats show the poison
+    assert any(s.get("n_nonfinite") for s in rec["inputs"])
+
+
+def test_first_nonfinite_is_the_producer_not_a_consumer():
+    """A finite input that OVERFLOWS mid-graph: the record names the op
+    that produced the first inf, not the op that consumed it."""
+    def fn(x):
+        big = jnp.exp(x)          # overflows to inf at x=200
+        return big - big          # the consumer turns it into nan
+
+    rec = find_first_nonfinite(fn, (np.full((4,), 200.0, np.float32),))
+    assert rec["primitive"] == "exp"
+    assert rec["poisoned_inputs"] == []
+
+
+def test_first_nonfinite_localizes_inside_scan_step():
+    def fn(xs):
+        def body(c, x):
+            c = c * x             # blows up at the poisoned step
+            return c, jnp.log(c)
+
+        return jax.lax.scan(body, jnp.ones((), jnp.float32), xs)
+
+    xs = np.ones((6,), np.float32)
+    xs[3] = np.inf
+    rec = find_first_nonfinite(fn, (xs,))
+    assert rec["scan_step"] == 3
+    assert rec["primitive"] == "mul"
+    assert "step3" in rec["eqn"]
+
+
+def test_all_finite_returns_none():
+    assert find_first_nonfinite(
+        lambda x: jnp.tanh(x).sum(), (np.ones((4,), np.float32),)
+    ) is None
+
+
+def test_armed_flag_reads_env(monkeypatch):
+    flags.reset_flags()
+    monkeypatch.delenv("PADDLE_TPU_NUM_SANITIZER", raising=False)
+    assert not num_sanitizer_armed()
+    monkeypatch.setenv("PADDLE_TPU_NUM_SANITIZER", "1")
+    assert num_sanitizer_armed()
+
+
+# ---------------------------------------------------------------------------
+# trainer e2e: nan_batch drill -> flight-recorder postmortem
+# ---------------------------------------------------------------------------
+
+
+def _small_trainer(seed=0):
+    reset_auto_names()
+    paddle.init(seed=seed)
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(32))
+    label = paddle.layer.data("label", paddle.data_type.integer_value(4))
+    h = paddle.layer.fc(img, size=16, act=paddle.activation.Relu(), name="h1")
+    pred = paddle.layer.fc(h, size=4, act=paddle.activation.Softmax(),
+                           name="out")
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    return paddle.trainer.SGD(
+        cost=cost,
+        parameters=paddle.parameters.create(cost, seed=seed),
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9
+        ),
+    )
+
+
+def _reader(n_batches=6, rows=8):
+    rng = np.random.RandomState(7)
+    data = [
+        (rng.randn(32).astype("float32"), int(rng.randint(4)))
+        for _ in range(n_batches * rows)
+    ]
+
+    def read():
+        for v, y in data:
+            yield v, y
+
+    return paddle.batch(read, rows)
+
+
+def _final_params(trainer):
+    return {
+        k: np.asarray(v)
+        for k, v in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(trainer.parameters.params)
+        )
+    }
+
+
+def test_nan_batch_postmortem_names_poisoned_eqn(tmp_path):
+    """The acceptance drill: with the sanitizer armed, the nan_batch
+    chaos point's skipped step produces a flight-recorder postmortem
+    naming the first non-finite-producing eqn, its layer, and the
+    poisoned feed slot — instead of just 'a step was skipped'."""
+    flags.set_flag("num_sanitizer", True)
+    flags.set_flag("trace_dir", str(tmp_path))
+    trainer = _small_trainer()
+    chaos.arm("nan_batch@3")
+    base = global_stats.count("num_sanitizer/captures")
+    trainer.train(_reader(), num_passes=1)
+    assert global_stats.count("num_sanitizer/captures") > base
+
+    fl = tmp_path / f"flight-{os.getpid()}.json"
+    obj = json.loads(fl.read_text())
+    assert obj["otherData"]["reason"].startswith("num-sanitizer: skip")
+    num = obj["otherData"]["numerics"]
+    # the first op to consume the poisoned 'pixel' slot, with provenance
+    assert num["primitive"] == "dot_general"
+    assert num["layer"] == "h1"
+    assert any("pixel" in p["input"] for p in num["poisoned_inputs"])
+    assert num["source"] and num["line"]
+    # input max-abs range stats landed in the StatSet num/<eqn> rows
+    summ = global_stats.summary()
+    num_rows = {k: v for k, v in summ.items() if k.startswith("num/")}
+    assert num_rows, sorted(summ)
+    # the poisoned input's NaN observation went to the nonfinite bucket
+    assert any(v["nonfinite"] for v in num_rows.values())
+
+
+def test_unarmed_is_untouched_and_armed_changes_nothing(tmp_path):
+    """Zero-overhead contract: unarmed, the capture counter never moves;
+    and arming the sanitizer (observe-only) leaves the trained params
+    byte-identical to the unarmed run."""
+    base = global_stats.count("num_sanitizer/captures")
+    flags.set_flag("divergence_sentinel", True)
+    # explicit False beats a PADDLE_TPU_NUM_SANITIZER=1 environment (the
+    # `make chaos` target arms it globally) — this leg tests UNARMED
+    flags.set_flag("num_sanitizer", False)
+    t1 = _small_trainer(seed=3)
+    t1.train(_reader(), num_passes=1)
+    assert global_stats.count("num_sanitizer/captures") == base  # unarmed
+    p1 = _final_params(t1)
+
+    flags.set_flag("num_sanitizer", True)
+    flags.set_flag("trace_dir", str(tmp_path))
+    t2 = _small_trainer(seed=3)
+    t2.train(_reader(), num_passes=1)
+    assert global_stats.count("num_sanitizer/captures") > base  # armed
+    p2 = _final_params(t2)
+
+    assert p1.keys() == p2.keys()
+    for k in p1:
+        assert np.array_equal(p1[k], p2[k]), k
