@@ -42,10 +42,15 @@
 #                  recomputed tasks, bit-for-bit params), the serving
 #                  drills (tests/test_serving_e2e.py: open-loop load +
 #                  poisoned-request rejection + slow-client isolation,
-#                  lock-sanitizer armed), and the production-gate fleet
+#                  lock-sanitizer armed), the production-gate fleet
 #                  scenarios (tests/test_scenarios_e2e.py: kill a worker
 #                  AND bounce the master under LIVE train+serve traffic;
-#                  SIGTERM graceful drain of `paddle-tpu serve`).
+#                  SIGTERM graceful drain of `paddle-tpu serve`), and the
+#                  hostile-network drills (tests/test_netem_e2e.py: a
+#                  worker partitioned mid-pass rejoins bit-for-bit, and
+#                  the leader<->standby asymmetric-partition split-brain
+#                  ends with exactly one fenced leader, zero tasks lost,
+#                  a clean surviving journal).
 #   make scenarios — the fast production-gate scenario subset
 #                  (robustness/scenarios.py via `paddle-tpu scenario
 #                  --all-fast`), sanitizer-armed: overload shed-not-
@@ -109,6 +114,7 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_master_failover_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_scenarios_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_netem_e2e.py -q
 	$(MAKE) trace-demo
 
 # the obs-plane acceptance drill (sanitizer-armed: the traced scenario

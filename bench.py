@@ -825,8 +825,15 @@ def bench_scenarios() -> list:
         exactly one victim, recovery-time-after-fault reported;
       * mixed_train_serve — train + serve concurrently in one process:
         training stays bit-identical to the solo run.
+      * partition_under_load — the hostile-network gate (ISSUE 15): a
+        real-RPC training loop rides a corrupt frame (codec reject
+        counter asserted > 0) and a mid-pass link partition while the
+        serving plane takes live deadline traffic; recovery-time-after-
+        partition is the committed metric, params bit-identical to an
+        unfaulted reference leg, surviving journal lints clean.
 
-    The committed round artifact is SCENARIO_r12.json; load_prior_bench
+    Committed round artifacts: SCENARIO_r12.json (overload/chaos/mixed)
+    and SCENARIO_r15.json (+ partition_under_load); load_prior_bench
     reads SCENARIO_r*.json into the same best_prior history BENCH_r*.json
     feeds."""
     from paddle_tpu.robustness import scenarios
@@ -842,6 +849,9 @@ def bench_scenarios() -> list:
     assert nan["passed"], f"nan_request_under_load failed: {nan}"
     mixed = scenarios.scenario_mixed_train_serve()
     assert mixed["passed"], f"mixed_train_serve failed: {mixed}"
+    part = scenarios.scenario_partition_under_load()
+    assert part["passed"], f"partition_under_load failed: {part}"
+    assert part["recovery_after_partition_ms"] < 10_000, part
     return [
         {
             "metric": "scenario_goodput_2x_frac",
@@ -885,6 +895,27 @@ def bench_scenarios() -> list:
                 mixed["train_bit_identical_to_solo"],
             "train_steps_per_s_solo": mixed["train_steps_per_s_solo"],
             "train_steps_per_s_mixed": mixed["train_steps_per_s_mixed"],
+        },
+        {
+            "metric": "scenario_partition_recovery_ms",
+            "value": part["recovery_after_partition_ms"],
+            "unit": "ms partition-onset to next successful task ack "
+            "under live mixed train+serve traffic (gate < 10s; "
+            "correctness gates: codec reject counter > 0, params "
+            "bit-identical, journal clean)",
+            "partition_secs": part["partition_secs"],
+            "chaos_point": part["chaos_point"],
+            "wire_server_rejected_frames":
+                part["wire"].get("server_rejected_frames"),
+            "train_params_bit_identical":
+                part["train_params_bit_identical"],
+            "serve_goodput_frac": part["goodput_frac"],
+            "binds": "netem fault transport over the master_wire codec: "
+            "net_corrupt flips one client frame (CRC rejects, bounded "
+            "retry rides it), net_partition severs the client link for "
+            f"{part['partition_secs']}s mid-pass; the worker's RPC "
+            "retry window absorbs it and the serving plane keeps its "
+            "SLO throughout",
         },
     ]
 

@@ -30,6 +30,20 @@ Rules (``A###``):
                           anchor) carries the pragma
                           ``# obs: allow-wall-clock <why>`` with a
                           REQUIRED justification.
+  A206 raw-deserialization  ``pickle.load``/``pickle.loads``/
+                          ``pickle.Unpickler`` or a bare zero-argument
+                          ``.recv()`` (the ``multiprocessing.connection``
+                          implicit-unpickle read) ANYWHERE outside
+                          ``master_wire.py`` — unpickling executes
+                          attacker-controlled bytes, and the RPC plane's
+                          whole safety story is that every byte crossing a
+                          process boundary rides the restricted typed
+                          codec instead.  Genuinely-local, never-network
+                          reads (a CRC-verified AOT cache blob, an
+                          operator-written dataset file) escape with
+                          ``# wire: allow[A206] <why>`` — justification
+                          REQUIRED, stale pragmas flagged (the shared
+                          analysis.pragmas discipline).
 
 Run via :func:`lint_package` (the ``paddle-tpu lint`` CLI / ``make lint``).
 """
@@ -57,6 +71,11 @@ _READER_PREFIXES = ("reader" + os.sep, "dataset" + os.sep)
 # the wall-clock time.* calls A205 forbids in obs/ modules (monotonic /
 # perf_counter are exactly what spans SHOULD use, so they stay legal)
 _WALL_FNS = frozenset({"time", "time_ns"})
+
+# the pickle entry points that EXECUTE payload bytes (A206); dumps/dump
+# only serialize and stay legal
+_PICKLE_LOADS = frozenset({"load", "loads", "Unpickler"})
+_PICKLE_MODULES = frozenset({"pickle", "cPickle", "_pickle", "dill"})
 
 
 def _name_of(node: ast.AST) -> Optional[str]:
@@ -286,6 +305,75 @@ def _scan_obs_wall_clock(tree: ast.Module, src: str, relpath: str,
     diags.extend(_pragmas.stale_findings(table, used, "obs", relpath))
 
 
+def _scan_wire_hygiene(tree: ast.Module, src: str, relpath: str,
+                       diags: List[Diagnostic]) -> None:
+    """A206 over one module: raw deserialization outside master_wire.py.
+
+    Alias-aware for the pickle module (``import pickle as p``,
+    ``from pickle import loads``); the bare ``.recv()`` check keys on the
+    ZERO-argument signature — ``socket.recv(bufsize)`` reads bytes (legal
+    everywhere), ``Connection.recv()`` unpickles (the hazard)."""
+    from paddle_tpu.analysis import pragmas as _pragmas
+
+    if os.path.basename(relpath) == "master_wire.py":
+        return  # the codec is the one legitimate home of deserialization
+    mods: Set[str] = set()
+    bare: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _PICKLE_MODULES:
+                    mods.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module in _PICKLE_MODULES:
+            for a in node.names:
+                if a.name in _PICKLE_LOADS:
+                    bare[a.asname or a.name] = f"{node.module}.{a.name}"
+    pragma_diags: List[Diagnostic] = []
+    table = _pragmas.collect(src, "wire", relpath, pragma_diags)
+    diags.extend(pragma_diags)
+    malformed = {d.line for d in pragma_diags if d.line is not None}
+    used: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit: Optional[str] = None
+        dotted = _name_of(node.func)
+        if dotted is not None:
+            head, _, tail = dotted.rpartition(".")
+            if head in mods and tail in _PICKLE_LOADS:
+                hit = f"`{dotted}(...)` executes payload bytes to deserialize"
+            elif head == "" and tail in bare:
+                hit = (f"`{dotted}(...)` ({bare[tail]}) executes payload "
+                       f"bytes to deserialize")
+        if hit is None and (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "recv"
+            and not node.args and not node.keywords
+        ):
+            hit = ("bare `.recv()` (Connection-style) implicitly unpickles "
+                   "whatever the peer sent")
+        if hit is None:
+            continue
+        pragma = table.get(node.lineno)
+        if pragma is not None and pragma.suppresses("A206"):
+            used.add(node.lineno)
+            continue
+        if node.lineno in malformed:
+            continue  # the rejected pragma already keeps the lint red
+        diags.append(Diagnostic(
+            rule="A206", severity=Severity.ERROR,
+            message=f"{hit} outside master_wire.py — raw deserialization "
+            "of bytes you did not verify is forbidden on every plane "
+            "(a corrupt or hostile frame must be a structured rejection, "
+            "never an exec)",
+            source=relpath, line=node.lineno,
+            hint="route the bytes through paddle_tpu.master_wire "
+            "(encode_payload/decode_payload, send_msg/recv_msg); a "
+            "genuinely-local, never-network read takes "
+            "`# wire: allow[A206] <why>`",
+        ))
+    diags.extend(_pragmas.stale_findings(table, used, "wire", relpath))
+
+
 def _scan_flag_defs(tree: ast.Module, relpath: str,
                     defs: Dict[str, Tuple[str, int]],
                     diags: List[Diagnostic]) -> None:
@@ -346,6 +434,7 @@ def lint_file(path: str, root: Optional[str] = None,
         "paddle_tpu" + os.sep, "", 1
     ).startswith("obs" + os.sep):
         _scan_obs_wall_clock(tree, src, relpath, diags)
+    _scan_wire_hygiene(tree, src, relpath, diags)
     if _flag_defs is not None:
         _scan_flag_defs(tree, relpath, _flag_defs, diags)
     return diags

@@ -99,6 +99,9 @@ _CROSS_CALL_STOPLIST = frozenset({
 _BLOCKING_TAILS = frozenset({
     "accept", "connect", "recv", "recv_bytes", "send", "sendall",
     "send_bytes",
+    # the master_wire transport helpers block exactly like the raw socket
+    # ops they wrap (one frame send / one frame recv)
+    "send_msg", "recv_msg",
 })
 _SUBPROCESS_FNS = frozenset({"run", "call", "check_call", "check_output", "Popen"})
 _THREADISH_RE = re.compile(r"thread|proc|worker|pending", re.IGNORECASE)

@@ -12,6 +12,8 @@ Per plane the grammar differs only in spelling:
 
     # lock: allow[C304,C306] why      rules come from the bracket list
     # num: allow[N401] why            same grammar, N-rule namespace
+    # wire: allow[A206] why           same grammar, the raw-deserialization
+                                      ban (ast_rules A206)
     # obs: allow-wall-clock why       keyword form; always rule A205
 
 ``collect`` returns ``{line: Pragma}`` plus uniform findings for
@@ -71,6 +73,7 @@ def _allow_plane(name: str, bookkeeping_rule: str, example_rule: str) -> _Plane:
 PLANES: Dict[str, _Plane] = {
     "lock": _allow_plane("lock", "C300", "C304"),
     "num": _allow_plane("num", "N400", "N403"),
+    "wire": _allow_plane("wire", "A206", "A206"),
     "obs": _Plane(
         name="obs",
         pattern=re.compile(r"#\s*obs:\s*allow-wall-clock\s*(())?(.*)$"),

@@ -1155,11 +1155,21 @@ def cmd_master(argv: List[str]) -> int:
                     "records, takeover span) — the failover drill reads it")
     ap.add_argument("--chaos", default=None,
                     help="arm chaos points in THIS candidate, e.g. "
-                    "'kill_master@8' (env PADDLE_TPU_CHAOS also works)")
+                    "'kill_master@8' or 'net_partition@40' (env "
+                    "PADDLE_TPU_CHAOS also works)")
+    ap.add_argument("--rpc-max-message-mb", type=int, default=None,
+                    help="override the rpc_max_message_mb flag: hard "
+                    "bound on one wire frame, enforced on send AND recv "
+                    "(master_wire.py)")
     args = ap.parse_args(argv)
 
     from paddle_tpu import obs as _obs
     from paddle_tpu.master_ha import HAMaster
+
+    if args.rpc_max_message_mb is not None:
+        from paddle_tpu.utils import flags as _flags
+
+        _flags.set_flag("rpc_max_message_mb", args.rpc_max_message_mb)
 
     logging.basicConfig(
         level=logging.INFO, format="%(asctime)s %(name)s %(message)s"
@@ -1397,7 +1407,10 @@ def cmd_lint(argv: List[str]) -> int:
     """``paddle-tpu lint`` — static analysis (analysis/):
 
     * no --config: AST self-lint over the paddle_tpu package source
-      (+ any --extra files), rules A###;
+      (+ any --extra files), rules A### — including A206, the wire-codec
+      hygiene rule: raw ``pickle.loads`` / bare ``Connection.recv()``
+      deserialization outside master_wire.py is forbidden
+      (``# wire: allow[A206] <why>`` escapes a genuinely-local read);
     * --config=conf.py: parse the v1 config and graph-lint its topology
       (rules G###) with layer + config provenance;
     * --journal=master_journal-000001.log: verify a master journal file —

@@ -264,7 +264,7 @@ class AOTCache:
         try:
             from jax.experimental import serialize_executable as se
 
-            payload, in_tree, out_tree = pickle.loads(blob)
+            payload, in_tree, out_tree = pickle.loads(blob)  # wire: allow[A206] local CRC32-verified AOT cache blob under the operator's cache_dir, never network input; serialized XLA executables are not expressible in the restricted wire codec
             exe = se.deserialize_and_load(payload, in_tree, out_tree)
         except Exception as e:
             self._stats.incr("aot_cache/corrupt")

@@ -19,6 +19,17 @@ Pieces:
                      python/paddle/v2/master/client.py; works against an
                      in-process Service or a remote Server address.
 
+Hostile-network plane (ISSUE 15): every message between Server and Client
+rides the master_wire codec — versioned CRC frames over a restricted typed
+payload encoder, bounded by ``rpc_max_message_mb`` on send AND recv — so a
+corrupt, oversized or version-skewed frame is a counted, structured
+rejection, never an exec of peer bytes or an unbounded allocation.
+Replies are seq-correlated (duplicated/reordered deliveries discard as
+stale), and when a ``net_*`` chaos point is armed the transport itself
+injects faults (robustness/netem.py): the retry/timeout/fencing story
+below is drilled against delay, drop, duplication, reordering, corruption
+and one-way partitions, not just process death.
+
 Durable state plane (``journal=True`` — the mode master_ha runs): every
 queue/registry/fence transition appends one CRC-framed, fsync'd record to
 an append-only journal (master_journal.py) BEFORE the RPC that caused it is
@@ -60,10 +71,12 @@ from multiprocessing.connection import Client as _ConnClient, Listener
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from paddle_tpu import master_journal as _mj
+from paddle_tpu import master_wire as _wire
 from paddle_tpu import obs as _obs
 from paddle_tpu.analysis.lock_sanitizer import make_lock, make_rlock
 from paddle_tpu.io import recordio
 from paddle_tpu.robustness import chaos as _chaos
+from paddle_tpu.robustness import netem as _netem
 
 _log = logging.getLogger("paddle_tpu.master")
 
@@ -183,6 +196,10 @@ class Service:
         self._pass_done: Dict[int, int] = {}  # pass -> done count at rotation
         # fence id -> {"arrived": set, "released": None | frozen info dict}
         self.fences: Dict[str, Dict[str, Any]] = {}
+        # worker id -> attested target pass (see start_new_pass): the
+        # failover-regression heal's unanimous-vote ledger, runtime-only
+        self._repass_votes: Dict[str, int] = {}
+        self._repass_unanimous_since: Optional[float] = None
         # -- durable journal plane (master_journal.py) ---------------------
         self._journaled = bool(journal)
         self._journal_fsync = bool(journal_fsync)
@@ -304,37 +321,120 @@ class Service:
         self._journal({"t": "rotate", "from": from_pass})
         self._snapshot(force=True)
 
-    def _rotate_pass_state(self) -> None:
-        """The pure state transition of a pass rotation — shared by the
-        live path and journal replay (``apply_record``)."""
-        # freeze the completed pass's done count: late joiners use it to
-        # verify a retained result map is COMPLETE before replay-applying it
-        self._pass_done[self.pass_id] = len(self.done)
-        self.todo = self.done
-        for t in self.todo:
+    def _advance_pass(self, recycled: List[Task],
+                      pass_done_mark: int) -> None:
+        """Shared tail of every pass rotation (normal or forced): freeze
+        the closing pass's done-count marker (late joiners use it to
+        verify a retained result map is COMPLETE before replay-applying
+        it; -1 = poisoned, never replayable), recycle ``recycled`` into
+        todo at epoch 0, advance the pass, clear the per-pass
+        attestations, and trim retention to the trailing passes (a slow
+        worker may still be fetching pass P's results while P+1
+        streams)."""
+        self._pass_done[self.pass_id] = pass_done_mark
+        for t in recycled:
             t.epoch = 0
+        self.todo = recycled
         self.done = []
         self.pass_id += 1
-        # retain only the trailing passes' result maps (a slow worker may
-        # still be fetching pass P's results while P+1 streams)
+        self._repass_votes.clear()
+        self._repass_unanimous_since = None
         for p in [p for p in self.results if p < self.pass_id - 2]:
             del self.results[p]
         for p in [p for p in self._pass_done if p < self.pass_id - 2]:
             del self._pass_done[p]
 
-    def start_new_pass(self, target_pass: Optional[int] = None) -> int:
+    def _rotate_pass_state(self) -> None:
+        """The pure state transition of a pass rotation — shared by the
+        live path and journal replay (``apply_record``)."""
+        self._advance_pass(self.done, len(self.done))
+
+    def _force_rotate_state(self) -> None:
+        """The failover-regression transition (see ``start_new_pass``):
+        recycle EVERY task — todo, pending, done — into the next pass,
+        drop the pass's (unfinishable) result map, and poison its frozen
+        done-count so retained-map replay is impossible.  Shared by the
+        live path and journal replay (``_apply_frotate``)."""
+        p = self.pass_id
+        tasks = sorted(
+            list(self.todo)
+            + [ent[0] for ent in self.pending.values()]
+            + list(self.done),
+            key=lambda t: t.task_id,
+        )
+        self.pending = {}
+        self.results.pop(p, None)
+        self._advance_pass(tasks, -1)
+
+    def start_new_pass(self, target_pass: Optional[int] = None,
+                       worker_id: Optional[str] = None) -> int:
         """Explicit pass barrier release (auto_rotate=False mode).
 
         ``target_pass`` makes the release idempotent for a fleet: the pass
         rotates only while ``pass_id < target_pass``, so a straggler that
         calls ``start_new_pass(p+1)`` after a fast worker already drained
-        pass p+1 cannot double-rotate the queue past it."""
+        pass p+1 cannot double-rotate the queue past it.
+
+        ``worker_id`` (failover-regression heal): a registered worker
+        calling with ``target_pass > pass_id`` while the queue is NOT
+        drained is ATTESTING that it already applied this pass — its
+        reduction happened against a deposed leader whose final
+        acks/rotation died in that leader's fenced journal generation.
+        One vote proves nothing; when EVERY live worker has attested, no
+        process exists that could legitimately recompute the re-opened
+        tasks (everyone's params already include the pass — recomputed
+        contributions would carry post-apply bits), so the master
+        FORCE-rotates: the stale queue recycles into the next pass and
+        the unfinishable pass's retained result map is POISONED
+        (``_pass_done = -1``) so a late joiner can never replay it as
+        complete — the committed-manifest fallback is its heal."""
         with self._lock:
             if (
                 not self.todo and not self.pending and self.done
                 and (target_pass is None or self.pass_id < target_pass)
             ):
                 self._rotate_pass()
+            elif (
+                target_pass is not None and worker_id is not None
+                and target_pass > self.pass_id
+                and (self.todo or self.pending)
+            ):
+                self._prune_workers()
+                self._repass_votes[worker_id] = target_pass
+                live = set(self.workers)
+                attested = {
+                    w for w, t in self._repass_votes.items()
+                    if t > self.pass_id
+                }
+                if not (live and live <= attested):
+                    self._repass_unanimous_since = None
+                else:
+                    # unanimity must STAY unanimous for a full worker-
+                    # timeout window before it can force anything: a
+                    # briefly-silent-but-alive worker (GC pause, load
+                    # stall) that was just pruned re-registers well
+                    # inside that window, re-enters the live set, and —
+                    # not attesting — breaks unanimity.  Only a worker
+                    # silent long enough to be declared dead everywhere
+                    # else in the system can be absent from the vote.
+                    now = self._clock()
+                    if self._repass_unanimous_since is None:
+                        self._repass_unanimous_since = now
+                    if now - self._repass_unanimous_since >= (
+                        self.worker_timeout_s
+                    ):
+                        _log.warning(
+                            "master: every live worker (%s) attests pass "
+                            "%d was already applied on a deposed leader "
+                            "(stable for %.1fs) — force-rotating past "
+                            "the unrecoverable queue state",
+                            sorted(live), self.pass_id,
+                            now - self._repass_unanimous_since,
+                        )
+                        from_pass = self.pass_id
+                        self._force_rotate_state()
+                        self._journal({"t": "frotate", "from": from_pass})
+                        self._snapshot(force=True)
             return self.pass_id
 
     def renew_lease(self, task_id: int, epoch: int) -> bool:
@@ -489,6 +589,12 @@ class Service:
         is_new = worker_id not in self.workers
         self.workers[worker_id] = self._clock() + self.worker_timeout_s
         if is_new:
+            # a (re)joining incarnation must not inherit a dead one's
+            # force-rotate attestation: a restarted worker whose params
+            # never applied the attested pass would otherwise keep a
+            # spurious unanimity alive and get stranded by its own
+            # ghost's vote
+            self._repass_votes.pop(worker_id, None)
             self._journal({"t": "join", "worker": worker_id})
 
     def register_worker(self, worker_id: str) -> Dict[str, Any]:
@@ -522,6 +628,7 @@ class Service:
         failure event (the task_returned discipline — leaving is not a
         crash)."""
         with self._lock:
+            self._repass_votes.pop(worker_id, None)
             if self.workers.pop(worker_id, None) is not None:
                 self._journal({"t": "leave", "worker": worker_id})
             held = [
@@ -708,6 +815,11 @@ class Service:
                 "n_discarded": len(self.discarded),
                 "fail_events": self.fail_events,
                 "workers": sorted(self.workers),
+                # codec-rejection observability: the corrupt-frame drills
+                # assert server_rejected_frames > 0 IN-RUN through this
+                # field (Server and Service share the process, so the
+                # module counters are one coherent view)
+                "wire": _wire.counters.snapshot(),
             }
 
     # -- save-model arbitration (reference service.go:461-497) -----------
@@ -1116,6 +1228,16 @@ class Service:
             return
         self._rotate_pass_state()
 
+    def _apply_frotate(self, rec) -> None:
+        if self.pass_id != rec["from"]:
+            _log.warning(
+                "journal replay: force-rotate record for pass %d but "
+                "replica is at pass %d — skipping", rec["from"],
+                self.pass_id,
+            )
+            return
+        self._force_rotate_state()
+
     def _apply_unres(self, rec) -> None:
         ids = set(rec["tasks"])
         moved = [t for t in self.done if t.task_id in ids]
@@ -1336,13 +1458,20 @@ _METHODS = ("set_dataset", "get_task", "task_finished", "task_failed",
 
 class Server:
     """Serve a Service over multiprocessing.connection — the process/network
-    boundary of the Go master's net/rpc server."""
+    boundary of the Go master's net/rpc server.  Every message rides the
+    master_wire codec (versioned CRC framing over the restricted typed
+    payload encoder): a corrupt, oversized or unknown-version frame is
+    REJECTED — counted, answered with a structured wire-reject the client
+    retries through — and never crashes the accept loop, never allocates
+    unbounded, never deserializes damaged bytes.  ``max_message_bytes``
+    bounds both directions (default: the ``rpc_max_message_mb`` flag)."""
 
     def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu",
-                 sleep=time.sleep):
+                 sleep=time.sleep, max_message_bytes: Optional[int] = None):
         self.service = service
         self._authkey = authkey
         self._sleep = sleep  # injectable: tests drive the accept-loop backoff
+        self._max_msg = max_message_bytes or _wire.default_max_bytes()
         self._listener = Listener(address, authkey=authkey)
         self.address = self._listener.address
         self._stop = False
@@ -1364,6 +1493,15 @@ class Server:
                     # ConnectionResetError / BrokenPipeError from the auth
                     # handshake: ONE client hung up (RST mid-challenge) —
                     # per-client, same discipline as the clause below
+                    continue
+                if exc.errno is None:
+                    # no errno = not a socket-level failure at all: the
+                    # AUTH HANDSHAKE choked on garbage bytes — e.g.
+                    # multiprocessing's "bad message length" when a port
+                    # scanner's random length prefix blows its bound.
+                    # Strictly per-client; treating it as a broken
+                    # listener let ONE hostile connect close the master's
+                    # port (found by the corrupt-frame storm drill)
                     continue
                 if exc.errno in (
                     _errno.EMFILE, _errno.ENFILE,
@@ -1394,6 +1532,10 @@ class Server:
                 # exact half-open state the client-side dial deadline
                 # exists to escape.  Drop the connection, keep accepting.
                 continue
+            # hostile-network drills: when a net_* chaos point is armed the
+            # accepted connection serves through the fault-injecting
+            # transport (robustness/netem.py); unarmed this is a no-op
+            conn = _netem.maybe_wrap(conn, role="server")
             with self._conns_lock:
                 self._conns.append(conn)
             if self._stop:  # closed while accepting: don't serve it
@@ -1407,19 +1549,82 @@ class Server:
                 name="paddle-master-conn", daemon=True,
             ).start()
 
+    def _reject_frame(self, conn, exc: Exception) -> bool:
+        """One codec rejection: count it, tell the client with a structured
+        wire-reject reply (the request never executed, so the client's
+        bounded retry re-sends it whole).  Returns False when the reply
+        itself cannot be delivered — drop the connection then."""
+        _wire.counters.incr("server_rejected_frames")
+        _log.warning("master: rejected inbound frame: %s", exc)
+        try:
+            _wire.send_msg(
+                conn, (False, {"__wire_reject__": str(exc)}), self._max_msg
+            )
+            return True
+        except (OSError, ValueError, _wire.MasterWireError):
+            return False
+
+    def _reply(self, conn, ok: bool, result, seq) -> None:
+        """Send one reply, echoing the request's correlation ``seq`` (the
+        client discards stale/duplicated replies by it).  A reply the
+        codec refuses — an unencodable or over-budget result — degrades to
+        a structured application error instead of a wedged client."""
+        reply = (ok, result) if seq is None else (ok, result, seq)
+        try:
+            _wire.send_msg(conn, reply, self._max_msg)
+        except _wire.MasterWireError as exc:
+            _wire.counters.incr("server_reply_rejected")
+            fallback = (False, repr(exc))
+            _wire.send_msg(
+                conn, fallback if seq is None else fallback + (seq,),
+                self._max_msg,
+            )
+
     def _handle(self, conn) -> None:
         try:
             while not self._stop:  # deposed leader: stop serving stale state
-                msg = conn.recv()
-                # 3-tuple form carries the obs trace meta (client rpc id);
-                # the 2-tuple form stays accepted (recording disarmed, or
-                # an older client)
+                try:
+                    msg = _wire.recv_msg(conn, self._max_msg)
+                except _wire.WireOversizeError as exc:
+                    # the transport refused the length prefix BEFORE
+                    # allocating and closed the (now desynced) stream —
+                    # count, log, drop this client; the listener keeps
+                    # accepting
+                    _wire.counters.incr("server_rejected_frames")
+                    _wire.counters.incr("server_oversize_frames")
+                    _log.warning("master: dropped connection: %s", exc)
+                    return
+                except _wire.MasterWireError as exc:
+                    # corrupt/unknown-version frame inside an INTACT
+                    # message boundary: stream sync is preserved by the
+                    # transport's own framing, so reject the frame and
+                    # keep serving the connection
+                    if not self._reject_frame(conn, exc):
+                        return
+                    continue
+                # requests are (method, args[, meta]); meta carries the obs
+                # correlation id and the reply-matching seq.  A structurally
+                # alien — but validly encoded — message is a reject, not a
+                # crash (hostile peers send anything).
+                if (not isinstance(msg, (tuple, list)) or len(msg) < 2
+                        or not isinstance(msg[0], str)):
+                    if not self._reject_frame(
+                        conn, _wire.WireCorruptError(
+                            f"request shape {type(msg).__name__} is not "
+                            f"(method, args[, meta])"
+                        )
+                    ):
+                        return
+                    continue
                 method, args = msg[0], msg[1]
                 meta = msg[2] if len(msg) > 2 else None
+                if not isinstance(meta, dict):
+                    meta = None
+                seq = meta.get("seq") if meta else None
                 if method == "__close__":
                     return
                 if method not in _METHODS:
-                    conn.send((False, f"no such method {method}"))
+                    self._reply(conn, False, f"no such method {method}", seq)
                     continue
                 # the server-side half of the skew-alignment pair: span
                 # `rpc:<method>` with the CLIENT's correlation id — `trace
@@ -1429,11 +1634,10 @@ class Server:
                     rpc=(meta or {}).get("rpc"),
                 ):
                     try:
-                        conn.send(
-                            (True, getattr(self.service, method)(*args))
-                        )
+                        ok, result = True, getattr(self.service, method)(*args)
                     except Exception as exc:  # noqa: BLE001 — RPC boundary
-                        conn.send((False, repr(exc)))
+                        ok, result = False, repr(exc)
+                    self._reply(conn, ok, result, seq)
         except (EOFError, OSError, TypeError, AttributeError):
             # TypeError/AttributeError: Server.close() closed this conn while
             # recv() was blocked (multiprocessing nulls the handle mid-read)
@@ -1486,15 +1690,20 @@ class Client:
         reconnect_backoff: float = 0.1,
         call_timeout_s: Optional[float] = 60.0,
         sleep=time.sleep,
+        max_message_bytes: Optional[int] = None,
     ):
         """``call_timeout_s`` is the per-RPC deadline (dial + reply): a
         call against a half-open socket — a master that bounced without an
         RST, a frozen leader — surfaces as :class:`MasterTimeoutError`
-        instead of blocking forever.  ``None`` disables the deadline."""
+        instead of blocking forever.  ``None`` disables the deadline.
+        ``max_message_bytes`` bounds frames BOTH ways (default: the
+        ``rpc_max_message_mb`` flag)."""
         self.call_timeout_s = (
             None if call_timeout_s is None else float(call_timeout_s)
         )
         self._sleep = sleep  # injectable: reconnect backoff + lease polls
+        self._max_msg = max_message_bytes or _wire.default_max_bytes()
+        self._seq = 0  # per-call correlation: stale replies discard by it
         if isinstance(master, Service):
             self._service = master
             self._conn = None
@@ -1502,9 +1711,7 @@ class Client:
             self._service = None
             self._address = tuple(master)
             self._authkey = authkey
-            self._conn = _dial_with_deadline(
-                self._address, authkey, self.call_timeout_s
-            )
+            self._conn = self._dial()
             self._conn_lock = make_lock("master.Client._conn_lock")
         self.reconnect_tries = max(int(reconnect_tries), 1)
         self.reconnect_backoff = float(reconnect_backoff)
@@ -1514,6 +1721,17 @@ class Client:
         self._last_renew = 0.0
         self.lease_renew_secs = 10.0  # renewal throttle ceiling
         self._renew_interval = self.lease_renew_secs
+
+    def _dial(self):
+        """Deadline-guarded dial, wrapped in the netem fault transport
+        when a ``net_*`` chaos point is armed (a re-dial during an active
+        partition stays partitioned — the link is down, not the socket)."""
+        return _netem.maybe_wrap(
+            _dial_with_deadline(
+                self._address, self._authkey, self.call_timeout_s
+            ),
+            role="client",
+        )
 
     def _timeout(self, msg: str) -> "MasterTimeoutError":
         """Tear down the (half-open) connection and build the deadline
@@ -1543,25 +1761,49 @@ class Client:
         :class:`MasterTimeoutError` raises immediately (no in-client
         retry: a frozen peer stays frozen; the HA layer re-discovers the
         leader instead).  The abandoned call may still execute
-        server-side, which the idempotent surface absorbs on retry."""
+        server-side, which the idempotent surface absorbs on retry.
+
+        Hostile-network discipline: the request is wire-encoded ONCE up
+        front — an unencodable or over-budget payload raises a structured
+        :class:`~paddle_tpu.master_wire.MasterWireError` immediately
+        (deterministic; retrying cannot shrink a gradient tree) — and the
+        reply is matched by a per-call ``seq``: a duplicated or reordered
+        delivery (netem drills, at-least-once retries) surfaces as a
+        STALE reply that is discarded, never as a reply credited to the
+        wrong call.  A corrupt reply frame, or the server's structured
+        rejection of our (corrupted-in-flight) request, rides the same
+        bounded reconnect-retry as a transport blip."""
         if self._service is not None:
             with _obs.span("rpc_call:" + method, cat="rpc"):
                 return getattr(self._service, method)(*args)
         last_err: Optional[Exception] = None
         # the client-side half of the skew-alignment pair: the rpc id rides
-        # the wire as a third tuple element so the server span carries the
-        # SAME correlation id (recording off = classic 2-tuple, zero cost)
+        # the wire in the meta dict so the server span carries the SAME
+        # correlation id; `seq` is the reply-matching correlation every
+        # call carries
         rpc_id = _obs.next_rpc_id() if _obs.tracer.recording else None
-        wire = (method, args) if rpc_id is None else (
-            method, args, {"rpc": rpc_id}
-        )
         with self._conn_lock:
+            # seq is minted UNDER the exchange lock: two threads sharing
+            # this client must never carry the same seq, or a late/
+            # duplicated reply could be credited to the wrong call —
+            # the exact misattribution the correlation exists to prevent
+            self._seq += 1
+            seq = self._seq
+            meta: Dict[str, Any] = {"seq": seq}
+            if rpc_id is not None:
+                meta["rpc"] = rpc_id
+            # encode ONCE, outside the retry loop: WireTypeError/
+            # WireOversizeError are deterministic and surface immediately
+            # as the structured send-side bound (satellite: a multi-MB
+            # tree no longer wedges against a frozen peer — it fails
+            # fast, named)
+            frame = _wire.encode_frame(
+                _wire.encode_payload((method, args, meta)), self._max_msg
+            )
             for attempt in range(self.reconnect_tries):
                 try:
                     if self._conn is None:
-                        self._conn = _dial_with_deadline(
-                            self._address, self._authkey, self.call_timeout_s
-                        )
+                        self._conn = self._dial()
                     # the span covers ONLY the send->recv exchange (not
                     # the lock-queue wait or dial retries above): its
                     # midpoint is what `trace merge` pins the server
@@ -1571,7 +1813,7 @@ class Client:
                         "rpc_call:" + method, cat="rpc", rpc=rpc_id,
                     ):
                         try:
-                            self._conn.send(wire)  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
+                            self._conn.send_bytes(frame)  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
                         except BlockingIOError as exc:
                             # SO_SNDTIMEO fired: the peer stopped draining
                             # its socket mid-request (frozen master, full
@@ -1580,30 +1822,13 @@ class Client:
                                 f"master RPC {method}: request stalled "
                                 f"mid-send (frozen master)"
                             ) from exc
-                        if self.call_timeout_s is not None and not (
-                            self._conn.poll(self.call_timeout_s)
-                        ):
-                            raise self._timeout(
-                                f"master RPC {method}: no reply in "
-                                f"{self.call_timeout_s}s (half-open socket "
-                                f"or frozen master); the call may have "
-                                f"executed"
-                            )
-                        try:
-                            ok, result = self._conn.recv()  # lock: allow[C304] same intentional hold: one in-flight RPC per connection, bounded by SO_RCVTIMEO
-                        except BlockingIOError as exc:
-                            # SO_RCVTIMEO fired mid-message: the peer froze
-                            # after sending a PARTIAL reply — past poll()'s
-                            # first-byte deadline, so surface the same way
-                            raise self._timeout(
-                                f"master RPC {method}: reply stalled "
-                                f"mid-message (frozen master); the call "
-                                f"may have executed"
-                            ) from exc
+                        ok, result = self._recv_reply(method, seq)
                     break
                 except MasterTimeoutError:
                     raise
-                except (ConnectionError, EOFError, OSError) as exc:
+                except (
+                    _wire.MasterWireError, ConnectionError, EOFError, OSError,
+                ) as exc:
                     last_err = exc
                     if self._conn is not None:
                         try:
@@ -1624,6 +1849,85 @@ class Client:
             raise MasterRPCError(f"master RPC {method} failed: {result}")
         return result
 
+    def _recv_reply(self, method: str, seq: int) -> Tuple[bool, Any]:
+        """Wait out ONE reply matching ``seq`` under the per-call
+        deadline.  Stale replies (an abandoned call's late answer, a
+        netem-duplicated delivery) are counted and discarded; a corrupt
+        frame or the server's structured wire-reject raises the
+        (retryable) wire error.  Only reached while ``_conn_lock`` is
+        held by ``_call``."""
+        deadline = (
+            None if self.call_timeout_s is None
+            else time.monotonic() + self.call_timeout_s
+        )
+        discarded = 0
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._conn.poll(remaining):
+                    raise self._timeout(
+                        f"master RPC {method}: no reply in "
+                        f"{self.call_timeout_s}s (half-open socket "
+                        f"or frozen master); the call may have "
+                        f"executed"
+                    )
+            try:
+                buf = self._conn.recv_bytes(self._max_msg)  # lock: allow[C304] same intentional hold: one in-flight RPC per connection, bounded by SO_RCVTIMEO
+            except BlockingIOError as exc:
+                # SO_RCVTIMEO fired mid-message: the peer froze
+                # after sending a PARTIAL reply — past poll()'s
+                # first-byte deadline, so surface the same way
+                raise self._timeout(
+                    f"master RPC {method}: reply stalled "
+                    f"mid-message (frozen master); the call "
+                    f"may have executed"
+                ) from exc
+            except OSError as exc:
+                if "bad message length" in str(exc):
+                    # recv-side size bound: refused before allocation
+                    _wire.counters.incr("client_rejected_frames")
+                    _wire.counters.incr("client_oversize_frames")
+                    raise _wire.WireOversizeError(
+                        f"master RPC {method}: reply exceeds the "
+                        f"{self._max_msg}-byte bound (flag "
+                        f"rpc_max_message_mb)"
+                    ) from exc
+                raise
+            try:
+                resp = _wire.decode_payload(
+                    _wire.decode_frame(buf, self._max_msg)
+                )
+            except _wire.MasterWireError:
+                _wire.counters.incr("client_rejected_frames")
+                raise
+            if not isinstance(resp, (tuple, list)) or len(resp) < 2:
+                _wire.counters.incr("client_rejected_frames")
+                raise _wire.WireCorruptError(
+                    f"master RPC {method}: reply shape "
+                    f"{type(resp).__name__} is not (ok, result[, seq])"
+                )
+            if (len(resp) == 2 and resp[0] is False
+                    and isinstance(resp[1], dict)
+                    and "__wire_reject__" in resp[1]):
+                # the server's codec refused OUR frame (corrupted in
+                # flight): the call never executed — retry re-sends it
+                raise _wire.WireCorruptError(
+                    f"master RPC {method}: server rejected request "
+                    f"frame: {resp[1]['__wire_reject__']}"
+                )
+            if len(resp) >= 3 and resp[2] != seq:
+                # a duplicated/reordered delivery, or an abandoned
+                # call's late reply: never credit it to THIS call
+                _wire.counters.incr("stale_replies_discarded")
+                discarded += 1
+                if discarded > 64:
+                    raise _wire.WireCorruptError(
+                        f"master RPC {method}: {discarded} consecutive "
+                        f"stale replies (reply stream desynced)"
+                    )
+                continue
+            return bool(resp[0]), resp[1]
+
     # -- surface ---------------------------------------------------------
     def set_dataset(self, patterns: Sequence[str]) -> int:
         return self._call("set_dataset", list(patterns))
@@ -1631,8 +1935,9 @@ class Client:
     def request_save_model(self, block_secs: float = 60.0) -> bool:
         return self._call("request_save_model", self.trainer_id, block_secs)
 
-    def start_new_pass(self, target_pass: Optional[int] = None) -> int:
-        return self._call("start_new_pass", target_pass)
+    def start_new_pass(self, target_pass: Optional[int] = None,
+                       worker_id: Optional[str] = None) -> int:
+        return self._call("start_new_pass", target_pass, worker_id)
 
     def __getattr__(self, name: str):
         """Every other RPC method (the elastic cluster surface — get_task,
@@ -1720,7 +2025,7 @@ class Client:
             self._records = []
         if self._conn is not None:
             try:
-                self._conn.send(("__close__", ()))
-            except (BrokenPipeError, OSError):
+                _wire.send_msg(self._conn, ("__close__", ()), self._max_msg)
+            except (BrokenPipeError, OSError, _wire.MasterWireError):
                 pass
             self._conn.close()

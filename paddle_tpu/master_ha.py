@@ -39,6 +39,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from paddle_tpu import master_journal as _mj
+from paddle_tpu import master_wire as _wire
 from paddle_tpu.master import Client, MasterRPCError, Server, Service
 
 __all__ = ["LeaseFile", "HAMaster", "HAClient", "discover_endpoint"]
@@ -503,8 +504,17 @@ class HAClient:
                 return getattr(self._client, method)(*args)
             except MasterRPCError:
                 raise  # the master executed the call: a real app error
-            except (ConnectionError, EOFError, OSError):
-                # leader died mid-call: drop the connection, re-discover
+            except _wire.WireTypeError:
+                raise  # unencodable payload: deterministic, re-dialing is futile
+            except _wire.WireOversizeError:
+                raise  # over rpc_max_message_mb: deterministic, same story
+            except (_wire.MasterWireError, ConnectionError, EOFError, OSError):
+                # leader died mid-call — or the Client's bounded retry
+                # exhausted against a storm of corrupt/duplicated frames
+                # (netem drills): drop the connection, re-discover the
+                # leader, ride the failover window.  Send-side wire
+                # errors (type/oversize) re-raised above: those are OUR
+                # payload's fault, not the network's.
                 try:
                     self._client.close()
                 except Exception:
@@ -521,8 +531,8 @@ class HAClient:
     def next_record(self):
         return self._call("next_record")
 
-    def start_new_pass(self, target_pass=None):
-        return self._call("start_new_pass", target_pass)
+    def start_new_pass(self, target_pass=None, worker_id=None):
+        return self._call("start_new_pass", target_pass, worker_id)
 
     def request_save_model(self, block_secs: float = 60.0):
         return self._call("request_save_model", block_secs)

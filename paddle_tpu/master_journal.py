@@ -14,9 +14,14 @@ Frame format (all integers big-endian)::
     MAGIC(4) | seq(8) | length(4) | crc32(4) | payload(length)
 
 ``crc32`` covers ``seq|length|payload``, so a torn header, a torn payload
-and a bit-flipped record are all detected.  The payload is a pickled dict
-``{"t": <record type>, ...}`` — pickle because result payloads carry numpy
-gradient trees, exactly like the RPC plane they arrived on.
+and a bit-flipped record are all detected.  The payload is the dict
+``{"t": <record type>, ...}`` in the master_wire restricted typed encoding
+(``PTJ2`` frames) — the same safe codec the RPC plane the records arrived
+on uses, so numpy gradient trees round-trip bit-exactly and a damaged or
+foreign payload can never execute.  Pre-wire-codec generations (``PTJ1``
+frames, payload pickled) remain READABLE for the one upgrade boot that
+replays them; everything written from then on is ``PTJ2`` (the first
+compaction rewrites the plane).
 
 Durability discipline:
 
@@ -48,8 +53,11 @@ import struct
 import zlib
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from paddle_tpu import master_wire as _wire
+
 __all__ = [
     "MAGIC",
+    "MAGIC_V1",
     "RECORD_TYPES",
     "JournalError",
     "JournalWriter",
@@ -60,7 +68,8 @@ __all__ = [
     "parse_generation",
 ]
 
-MAGIC = b"PTJ1"
+MAGIC = b"PTJ2"      # payload = master_wire restricted typed encoding
+MAGIC_V1 = b"PTJ1"   # legacy: payload pickled (read-only upgrade path)
 _HEADER = struct.Struct(">QI")  # seq, payload length
 _CRC = struct.Struct(">I")
 _FRAME_OVERHEAD = len(MAGIC) + _HEADER.size + _CRC.size
@@ -74,6 +83,9 @@ RECORD_TYPES = frozenset({
     "fail",      # pending -> todo|discarded via the failure_max discipline
     "ret",       # pending -> todo, no failure event (graceful give-back)
     "rotate",    # pass boundary: done -> todo, pass_id++
+    "frotate",   # forced rotation: every live worker attested the pass
+                 # was applied on a deposed leader (failover-regression
+                 # heal) — whole queue recycles, result map poisoned
     "unres",     # requeue_unresulted: done -> todo (results lost)
     "join",      # worker registry join
     "leave",     # worker registry leave (graceful or pruned)
@@ -91,7 +103,7 @@ class JournalError(RuntimeError):
 
 
 def encode_frame(seq: int, record: Dict[str, Any]) -> bytes:
-    payload = pickle.dumps(record, protocol=4)
+    payload = _wire.encode_payload(record)
     header = _HEADER.pack(seq, len(payload))
     crc = zlib.crc32(header + payload) & 0xFFFFFFFF
     return MAGIC + header + _CRC.pack(crc) + payload
@@ -155,7 +167,8 @@ def _iter_frames(
     while o < n:
         if n - o < _FRAME_OVERHEAD:
             raise _Torn(base_offset + o)
-        if data[o : o + 4] != MAGIC:
+        magic = data[o : o + 4]
+        if magic not in (MAGIC, MAGIC_V1):
             raise _Corrupt(base_offset + o, "bad frame magic")
         seq, length = _HEADER.unpack_from(data, o + 4)
         payload_start = o + _FRAME_OVERHEAD
@@ -170,9 +183,12 @@ def _iter_frames(
         if crc != want:
             raise _Corrupt(base_offset + o, "crc mismatch")
         try:
-            record = pickle.loads(blob)
-        except Exception as exc:  # noqa: BLE001 — any unpickle failure
-            raise _Corrupt(base_offset + o, f"unpicklable payload: {exc!r}")
+            if magic == MAGIC_V1:
+                record = pickle.loads(blob)  # wire: allow[A206] pre-wire-codec (PTJ1) journal generations pickled their payloads; this CRC-verified, operator-owned local file is replayed exactly once at the upgrade boot — the first compaction rewrites the plane as PTJ2
+            else:
+                record = _wire.decode_payload(blob)
+        except Exception as exc:  # noqa: BLE001 — any undecodable payload
+            raise _Corrupt(base_offset + o, f"undecodable payload: {exc!r}")
         # end offset is ABSOLUTE (base_offset + position in this read):
         # a tailer feeds it straight back as its next resume offset
         yield base_offset + payload_start + length, seq, record

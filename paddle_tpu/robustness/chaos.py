@@ -39,6 +39,17 @@ Spec grammar (flag ``chaos`` or env ``PADDLE_TPU_CHAOS``)::
                        delivery thread stalls — Request.wait() and the
                        decode loop must keep running (slow-consumer
                        isolation drill)
+    net_delay / net_drop / net_dup / net_reorder / net_corrupt /
+    net_drip / net_partition@N
+                       the hostile-network plane (robustness/netem.py):
+                       when any net_* point is armed, Server/Client
+                       connections wrap in a fault-injecting transport —
+                       the occurrence counts EGRESS MESSAGES process-wide,
+                       so net_partition@10 severs the link (for
+                       PADDLE_TPU_NETEM_PARTITION_SECS, in the
+                       PADDLE_TPU_NETEM_DIRECTION) as the 10th message
+                       leaves, and net_corrupt@3 bit-flips the 3rd frame
+                       (the master_wire CRC must reject it)
 
 Every point can also fire *under live mixed traffic*: the scenario
 harness (robustness/scenarios.py, ``paddle-tpu scenario``) arms
@@ -71,6 +82,7 @@ __all__ = [
     "disarm",
     "fire",
     "active_spec",
+    "armed_points",
     "poison_batch",
     "tear_file",
     "KNOWN_POINTS",
@@ -85,7 +97,11 @@ _ENV = "PADDLE_TPU_CHAOS"
 KNOWN_POINTS = frozenset(
     {"nan_batch", "torn_checkpoint", "kill", "stale_lease",
      "kill_worker", "worker_hang", "kill_master",
-     "nan_request", "serve_slow_client"}
+     "nan_request", "serve_slow_client",
+     # the hostile-network plane (robustness/netem.py consults these on
+     # every egress message of a wrapped RPC connection)
+     "net_delay", "net_drop", "net_dup", "net_reorder", "net_corrupt",
+     "net_drip", "net_partition"}
 )
 
 # point -> occurrence to fire at (None = every consultation)
@@ -138,6 +154,14 @@ def active_spec() -> str:
     return ",".join(
         f"{k}@{v}" if v is not None else k for k, v in sorted(_armed.items())
     )
+
+
+def armed_points() -> frozenset:
+    """The set of currently armed point names (after resolving the
+    environment) — robustness/netem.py keys its zero-cost-when-unarmed
+    wrap decision on this."""
+    _load_env()
+    return frozenset(_armed)
 
 
 def _load_env() -> None:

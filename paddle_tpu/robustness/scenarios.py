@@ -31,6 +31,16 @@ seconds each, in-process):
   beside the serving plane under load with ``nan_request`` fired
   mid-traffic; training must stay bit-identical to a solo run and the
   serving SLO must hold.
+* ``partition_under_load`` — the hostile-network gate (robustness/
+  netem.py + master_wire.py): a REAL RPC training loop (journaled master
+  Service + Server over localhost, an ElasticWorker on a wire-codec
+  Client) runs beside live serving traffic while netem corrupts a frame
+  (the codec must reject it — counter asserted) and then severs the link
+  mid-pass (``net_partition``); gates: the worker rides the partition
+  through its bounded-retry window, recovery-time-after-partition is
+  reported and bounded, final params are bit-identical to an unfaulted
+  reference leg, the surviving journal lints clean, and the co-located
+  serving SLO holds.
 
 Slow scenarios (``SLOW_SCENARIOS`` — tests/test_scenarios_e2e.py,
 `make chaos`; real process fleets):
@@ -69,6 +79,7 @@ __all__ = [
     "scenario_overload",
     "scenario_chaos_under_load",
     "scenario_mixed_train_serve",
+    "scenario_partition_under_load",
     "fleet_reference",
     "run_fleet_chaos",
     "make_serving_engine",
@@ -475,6 +486,250 @@ def scenario_mixed_train_serve(slo_ms: Optional[float] = None,
     }
 
 
+class _AckStamper:
+    """Wraps a master client surface, stamping the wall-clock time of
+    every SUCCESSFUL task_finished ack — the observable the partition
+    drill measures recovery from (first ack landed after the link came
+    back).  Everything else delegates untouched."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.ack_times: List[float] = []
+
+    def __getattr__(self, name):
+        fn = getattr(self._inner, name)
+        if name != "task_finished":
+            return fn
+
+        def stamped(*args):
+            out = fn(*args)
+            if out:
+                self.ack_times.append(time.time())
+            return out
+
+        return stamped
+
+
+def _rpc_training_leg(workdir: str, seed: int, passes: int = 2,
+                      out: Optional[dict] = None) -> dict:
+    """One REAL-RPC training run, in-process: a journaled master Service
+    served over localhost, driven by an ElasticWorker whose every call
+    rides the master_wire codec (and, when netem chaos is armed, the
+    fault-injecting transport).  Deterministic by the elastic protocol,
+    so two legs over the same dataset are bit-identical — faulted or
+    not."""
+    from paddle_tpu.master import Client, Server, Service
+    from paddle_tpu.trainer.elastic import ElasticWorker, NumpyLinearModel
+
+    os.makedirs(workdir, exist_ok=True)
+    data = os.path.join(workdir, "data.rio")
+    _write_linear_dataset(data, n=48, seed=seed)
+    svc = Service(
+        snapshot_path=os.path.join(workdir, "master_state.json"),
+        chunks_per_task=2, timeout_s=8.0, worker_timeout_s=10.0,
+        auto_rotate=False, journal=True,
+    )
+    srv = Server(svc)
+    client = Client(srv.address, call_timeout_s=0.75, reconnect_tries=4,
+                    reconnect_backoff=0.1)
+    stamper = _AckStamper(client)
+    model = NumpyLinearModel(_DIM, lr=0.2)
+    worker = ElasticWorker(stamper, "w0", model, min_workers=1,
+                           rpc_retry_window_s=60.0)
+    res: dict = {}
+    try:
+        client.set_dataset([data])
+        summary = worker.run(passes)
+        res = {
+            "params": model.state(),
+            "tasks_done": summary["tasks_done"],
+            "pass_costs": summary["pass_costs"],
+            "ack_times": list(stamper.ack_times),
+            "master_stats": svc.stats(),
+        }
+    finally:
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001 — the link may be partitioned
+            pass
+        srv.close()
+        jf = None
+        try:
+            with open(os.path.join(workdir, "master_state.json")) as f:
+                jf = json.load(f).get("journal_file")
+        except (OSError, ValueError):
+            pass
+        res["journal_path"] = (
+            os.path.join(workdir, jf) if jf else None
+        )
+        if out is not None:
+            out.update(res)
+    return res
+
+
+def scenario_partition_under_load(slo_ms: Optional[float] = None,
+                                  n_requests: int = 48, seed: int = 0,
+                                  engine=None) -> Dict[str, Any]:
+    """The hostile-network gate: corrupt-frame rejection + a mid-pass
+    link partition under live mixed train+serve traffic.
+
+    Arms ``net_corrupt@2`` (one early client frame bit-flips in flight —
+    the master_wire CRC must reject it server-side, counted, and the
+    client's bounded retry must ride it) and ``net_partition@12`` (the
+    link goes DOWN for ~1.2s as the 12th egress message leaves, mid-pass)
+    on the CLIENT role only, while the serving plane takes open-loop
+    deadline traffic in the same process.  Gates: the worker completes
+    every pass through its retry window, final training params are
+    BIT-IDENTICAL to an unfaulted reference leg, the codec reject counter
+    is > 0, recovery-time-after-partition is bounded, the surviving
+    journal lints clean, and only shed/timeout serving failures occur."""
+    import tempfile
+
+    from paddle_tpu import master_journal as _mj
+    from paddle_tpu import master_wire as _wire
+    from paddle_tpu.robustness import chaos, netem
+
+    engine = engine if engine is not None else make_serving_engine(seed)
+    d = tempfile.mkdtemp(prefix="paddle-tpu-partition-")
+    # unfaulted reference leg FIRST (chaos unarmed): the bit-identity
+    # target — itself over the real wire codec, same dataset, same seeds
+    ref = _rpc_training_leg(os.path.join(d, "reference"), seed)
+    wave = _serve_window(engine, _srcs(seed, 24), None, 0.0, seed)
+    saturation_rps = wave["n_offered"] / wave["wall_s"]
+    slo_s = _resolve_slo_s(slo_ms, wave)
+
+    partition_secs = 1.2
+    env_prev = {
+        k: os.environ.get(k)
+        for k in ("PADDLE_TPU_NETEM_ROLE", "PADDLE_TPU_NETEM_PARTITION_SECS",
+                  "PADDLE_TPU_NETEM_DIRECTION")
+    }
+    os.environ["PADDLE_TPU_NETEM_ROLE"] = "client"
+    os.environ["PADDLE_TPU_NETEM_PARTITION_SECS"] = str(partition_secs)
+    os.environ["PADDLE_TPU_NETEM_DIRECTION"] = "both"
+    _wire.counters.reset()
+    netem.reset()
+    chaos.arm("net_corrupt@2,net_partition@12")
+    faulted: dict = {}
+    trainer = threading.Thread(
+        target=_rpc_training_leg,
+        args=(os.path.join(d, "faulted"), seed),
+        kwargs={"out": faulted}, name="scenario-partition-train",
+        daemon=True,
+    )
+    # the serving schedule is sized to OUTLAST the faulted training leg
+    # and truncates the moment it exits (run_fleet_chaos discipline), so
+    # live deadline traffic genuinely spans the corrupt frame AND the
+    # partition window — faults-at-rest prove nothing
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen
+    from paddle_tpu.serving import Request, ServingScheduler
+
+    reqs: List[Any] = []
+    all_srcs = _srcs(seed + 4, n_requests)
+
+    def _mk(i):
+        r = Request(all_srcs[i % len(all_srcs)])
+        reqs.append(r)
+        return r
+
+    try:
+        trainer.start()
+        t0 = time.perf_counter()
+        t_traffic0 = time.time()
+        with ServingScheduler(engine) as sched:
+            OpenLoopLoadGen(
+                min(0.5 * saturation_rps, 60.0), 20 * n_requests, _mk,
+                seed=seed + 4, deadline_s=slo_s,
+            ).run(sched.submit, stop=lambda: not trainer.is_alive())
+            t_traffic1 = time.time()
+            trainer.join(120.0)
+            for r in reqs:
+                if not r.wait(300):
+                    raise RuntimeError(
+                        f"request {r.req_id} never finalized"
+                    )
+        wall = time.perf_counter() - t0
+    finally:
+        chaos.disarm()
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    served = [r for r in reqs if r.status == "served"]
+    lat = [r.t_done - r.t_submit for r in served]
+    in_slo = [x for x in lat if x <= slo_s]
+    win = {
+        "n_offered": len(reqs),
+        "wall_s": round(wall, 3),
+        "statuses": _status_counts(reqs),
+        "goodput_frac": (
+            round(len(in_slo) / len(reqs), 4) if reqs else None
+        ),
+        "p50_ms": _ms(_pct(lat, 0.50)),
+        "p95_ms": _ms(_pct(lat, 0.95)),
+        "p99_ms": _ms(_pct(lat, 0.99)),
+    }
+    wire_counts = _wire.counters.snapshot()
+    netem_counts = netem.counters.snapshot()
+    t_part = netem.last_partition_start()
+    recovery_s = None
+    if t_part > 0:
+        after = [t - t_part for t in faulted.get("ack_times", ())
+                 if t >= t_part]
+        recovery_s = min(after) if after else None
+    train_identical = (
+        not trainer.is_alive()
+        and faulted.get("params") is not None
+        and all(
+            np.array_equal(faulted["params"][k], ref["params"][k])
+            for k in ref["params"]
+        )
+        and len(faulted.get("pass_costs", ())) == len(ref["pass_costs"])
+    )
+    jpath = faulted.get("journal_path")
+    journal_findings = (
+        _mj.verify_journal(jpath) if jpath and os.path.exists(jpath)
+        else [{"rule": "J001", "severity": "error",
+               "message": "no surviving journal generation"}]
+    )
+    serve_ok = all(
+        r.status in ("served", "shed", "timeout") for r in reqs
+    )
+    rejects = wire_counts.get("server_rejected_frames", 0)
+    gates = {
+        "gate_train_bit_identical": bool(train_identical),
+        "gate_codec_rejected_corrupt_frame": rejects > 0,
+        "gate_partition_fired": t_part > 0,
+        # faults-at-rest prove nothing: the open-loop schedule must have
+        # been live on BOTH sides of the partition onset
+        "gate_traffic_spanned_partition": bool(
+            t_part > 0 and t_traffic0 < t_part < t_traffic1
+        ),
+        "gate_recovered_after_partition": (
+            recovery_s is not None and recovery_s < 10.0
+        ),
+        "gate_journal_lints_clean": not journal_findings,
+        "gate_serving_only_shed_or_timeout": bool(serve_ok),
+    }
+    netem.reset()
+    return {
+        "scenario": "partition_under_load",
+        "chaos_point": "net_corrupt@2,net_partition@12",
+        "partition_secs": partition_secs,
+        "slo_ms": round(slo_s * 1e3, 3),
+        **win,
+        "train_tasks_done": faulted.get("tasks_done"),
+        "train_params_bit_identical": bool(train_identical),
+        "recovery_after_partition_ms": _ms(recovery_s),
+        "wire": wire_counts,
+        "netem": netem_counts,
+        "journal_findings": [f["message"] for f in journal_findings][:5],
+        **gates,
+        "passed": all(gates.values()),
+    }
+
+
 # ---------------------------------------------------------------------------
 # fleet scenarios — real process groups (slow; tests/test_scenarios_e2e.py)
 # ---------------------------------------------------------------------------
@@ -851,6 +1106,7 @@ FAST_SCENARIOS = {
         point="serve_slow_client", **kw
     ),
     "mixed_train_serve": lambda **kw: scenario_mixed_train_serve(**kw),
+    "partition_under_load": lambda **kw: scenario_partition_under_load(**kw),
 }
 
 SLOW_SCENARIOS = {

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu import master_wire as _wire
 from paddle_tpu import obs as _obs
 from paddle_tpu.io import recordio
 from paddle_tpu.robustness import chaos as _chaos
@@ -66,6 +67,17 @@ __all__ = [
 ]
 
 _log = logging.getLogger("paddle_tpu.trainer.elastic")
+
+
+class _PassSuperseded(Exception):
+    """The pass we were reducing closed under us (a force-rotation while
+    we were briefly pruned, or a rotation we slept through): its retained
+    map can no longer be reduced here — the worker must catch up to the
+    master's pass instead.  ``target`` is the master's current pass."""
+
+    def __init__(self, target: int):
+        super().__init__(f"pass superseded; master is at pass {target}")
+        self.target = target
 
 
 # ---------------------------------------------------------------------------
@@ -206,12 +218,25 @@ class ElasticWorker:
         blocks for at most the client's own per-call deadline, so wire
         the client's ``call_timeout_s``/discovery timeout to fractions of
         the window (as ``main()`` does) to keep the total overshoot
-        bounded."""
+        bounded.
+
+        A SEND-SIDE wire-codec refusal (``MasterWireError``: the
+        contribution payload is unencodable or exceeds
+        ``rpc_max_message_mb``) is deterministic — retrying re-encodes the
+        same bytes — so it surfaces immediately as a configuration error
+        naming the flag, never as a wedged worker burning the window."""
         deadline = self._clock() + self.rpc_retry_window_s
         delay = 0.2
         while True:
             try:
                 return getattr(self.client, method)(*args)
+            except _wire.MasterWireError as exc:
+                raise RuntimeError(
+                    f"worker {self.worker_id}: RPC {method} payload "
+                    f"refused by the wire codec ({exc}); raise the "
+                    f"rpc_max_message_mb flag or shrink the per-task "
+                    f"contribution (smaller chunks_per_task)"
+                ) from exc
             except (ConnectionError, TimeoutError) as exc:
                 if self._clock() >= deadline:
                     raise
@@ -319,28 +344,45 @@ class ElasticWorker:
     def _catch_up(self, pass_id: int, target: int) -> int:
         """Reach the exact state "after pass target-1" when the fleet moved
         on without us (late join, or a hang long enough to be pruned):
-        replay retained result maps; when the gap outruns result retention,
-        restore the latest committed manifest and replay the remainder."""
-        try:
-            for p in range(pass_id, target):
-                self._apply_retained_pass(p)
-            return target
-        except RuntimeError:
-            if self.manager is None:
-                raise
-            restored = self.manager.restore_latest(self.model.state())
-            if restored is None:
-                raise
-            _, tree, extra = restored
-            self.model.load(tree, extra)
-            completed = int(extra.get("pass_id", -1))
-            _log.info(
-                "worker %s rejoining via manifest (pass %d applied)",
-                self.worker_id, completed,
-            )
-            for p in range(completed + 1, target):
-                self._apply_retained_pass(p)
-            return target
+        replay retained result maps; when the gap outruns result retention
+        — or a pass was force-rotated and its map POISONED — restore the
+        latest committed manifest and replay the remainder.  A manifest
+        that does not yet bridge the gap is WAITED for (bounded by the
+        RPC retry window): the fleet commits one every pass, so a
+        rejoiner stranded behind an unreplayable pass heals as soon as
+        the next manifest lands instead of crash-looping."""
+        deadline = self._clock() + self.rpc_retry_window_s
+        while True:
+            try:
+                for p in range(pass_id, target):
+                    self._apply_retained_pass(p)
+                    # advance PER applied pass: a later retry of this loop
+                    # (after a partial failure + wait) must never re-apply
+                    # a pass these params already include
+                    pass_id = p + 1
+                return target
+            except RuntimeError:
+                if self.manager is None:
+                    raise
+                restored = self.manager.restore_latest(self.model.state())
+                if restored is not None:
+                    _, tree, extra = restored
+                    completed = int(extra.get("pass_id", -1))
+                    if completed + 1 > pass_id:
+                        # the manifest moves us FORWARD: load and retry
+                        # the (now shorter) retained replay
+                        self.model.load(tree, extra)
+                        pass_id = completed + 1
+                        _log.info(
+                            "worker %s rejoining via manifest (pass %d "
+                            "applied)", self.worker_id, completed,
+                        )
+                        continue
+                if self._clock() >= deadline:
+                    raise
+                self._sleep(max(self.poll_s, 0.2))
+                # the fleet may have moved further on while we waited
+                target = max(target, int(self._rpc("stats")["pass_id"]))
 
     def _run_pass_tasks(self, pass_id: int) -> Optional[int]:
         """Lease and compute this pass's tasks.  Returns None when the pass
@@ -406,6 +448,93 @@ class ElasticWorker:
                 # zombie ack: the lease expired (we hung) and the task was
                 # re-served — the surviving recomputation's bits win
                 self.rejected_acks += 1
+
+    def _heal_pass_results(self, pass_id: int, view: Dict[str, Any],
+                           n_have: int):
+        """The fence's frozen done-count disagrees with the retained
+        result map: a master failover landed inside the fence window.
+        Requeue any done-without-result orphans, recompute whatever the
+        queue re-serves (bit-identical: our params have NOT applied this
+        pass yet), and return the map only once it provably covers the
+        whole pass — rotated-and-frozen-complete, or drained with every
+        done task resulted.  Bounded by the RPC retry window; a heal that
+        cannot converge surfaces the original refusal."""
+        _log.warning(
+            "worker %s: pass %d fence froze %s done tasks but the result "
+            "map holds %d — master failover mid-fence; healing in place",
+            self.worker_id, pass_id, view.get("n_done"), n_have,
+        )
+        deadline = self._clock() + self.rpc_retry_window_s
+        while True:
+            st = self._rpc("stats")
+            if int(st["pass_id"]) < pass_id:
+                # the failover regressed the master to an EARLIER pass
+                # than the one we are reducing: that pass must re-drain
+                # first.  We already applied it (we are a pass ahead), so
+                # attest it forward rather than recompute it with
+                # post-apply params.
+                self._await_master_repass(int(st["pass_id"]), pass_id)
+            self._rpc("requeue_unresulted")
+            self._run_pass_tasks(pass_id)
+            st = self._rpc("stats")
+            pr = self._rpc("pass_results", pass_id)
+            results, n_done = pr["results"], pr["n_done"]
+            if n_done is not None and results and len(results) == n_done:
+                return results  # pass rotated meanwhile: frozen-complete
+            if int(st["pass_id"]) > pass_id:
+                # rotated but NOT frozen-complete (a force-rotation
+                # poisoned the map, or retention dropped it): nothing
+                # reducible remains for this pass here
+                raise _PassSuperseded(int(st["pass_id"]))
+            if (int(st["pass_id"]) == pass_id and st["n_todo"] == 0
+                    and st["n_pending"] == 0 and results
+                    and len(results) == st["n_done"]):
+                return results  # drained: the map covers the whole pass
+            if self._clock() >= deadline:
+                raise RuntimeError(
+                    f"pass {pass_id}: fence froze {view.get('n_done')} "
+                    f"done tasks but only {len(results)} contributions "
+                    f"exist and in-place recompute did not converge — "
+                    f"refusing to apply a partial reduction"
+                )
+            self._sleep(max(self.poll_s, 0.05))
+
+    def _await_master_repass(self, master_pass: int, pass_id: int) -> None:
+        """The master rotated BACKWARD relative to us: a failover replica
+        lost rotations/acks that died with the deposed leader, and the
+        fleet is re-draining a pass our params already applied.  We must
+        neither recompute (our contributions would carry post-apply bits —
+        the workers still AT that pass recompute them bit-identically)
+        nor re-apply.  While waiting the re-drain out we: re-arrive at
+        the re-opened pass's fence (our original arrival may have died
+        with the old leader, and an absent live member would wedge the
+        healers' barrier forever) and ATTEST our target pass through
+        ``start_new_pass(target, worker_id)`` — when every live worker
+        attests (nobody is left who could recompute the pass with
+        pre-apply params), the master force-rotates past the
+        unrecoverable queue state and the fleet recomputes the NEXT pass
+        from its common post-apply params, bit-identically."""
+        _log.warning(
+            "worker %s: master regressed to pass %d (we are at %d) — a "
+            "failover lost rotations; waiting for the fleet to re-drain",
+            self.worker_id, master_pass, pass_id,
+        )
+        deadline = self._clock() + self.rpc_retry_window_s
+        meta = {"ckpt": self.manager is not None}
+        cur = master_pass
+        while cur < pass_id:
+            if self._clock() >= deadline:
+                raise RuntimeError(
+                    f"worker {self.worker_id}: master stuck at pass {cur} "
+                    f"while we already applied pass {pass_id - 1} — the "
+                    f"re-drain never converged"
+                )
+            self._rpc("fence_arrive", f"pass-{cur}", self.worker_id, meta)
+            cur = int(self._rpc(
+                "start_new_pass", pass_id, self.worker_id
+            ))
+            if cur < pass_id:
+                self._sleep(max(self.poll_s, 0.05))
 
     def run(self, num_passes: int) -> Dict[str, Any]:
         info = self._rpc("register_worker", self.worker_id)
@@ -498,29 +627,46 @@ class ElasticWorker:
                 if actual > pass_id:
                     behind = actual
             if behind is not None:
-                # the fleet fenced + rotated without us: replay the missed
-                # passes, then continue at the master's pass
-                pass_id = self._catch_up(pass_id, behind)
+                if behind > pass_id:
+                    # the fleet fenced + rotated without us: replay the
+                    # missed passes, then continue at the master's pass
+                    pass_id = self._catch_up(pass_id, behind)
+                else:
+                    # the MASTER is behind us: a failover replica lost the
+                    # rotation (and possibly acks) that died with the
+                    # deposed leader — heal without recomputing (our
+                    # params already include that pass, so our bits would
+                    # be wrong) and WITHOUT walking pass_id backwards
+                    self._await_master_repass(behind, pass_id)
                 continue
             if self.manager is not None:
                 self.manager.wait()  # join the async shard write pre-fence
             view = self._fence(f"pass-{pass_id}")
             self._commit_pending()
             results = self._rpc("pass_results", pass_id)["results"]
-            if len(results) != int(view.get("n_done", len(results))):
-                # correctness-first: applying a partial reduction would
-                # silently fork the trajectory.  The heal path is a worker
-                # RESTART — startup recovery calls requeue_unresulted and
-                # the orphaned tasks recompute deterministically (run the
-                # fleet under a supervisor that restarts nonzero exits).
-                raise RuntimeError(
-                    f"pass {pass_id}: fence froze {view.get('n_done')} done "
-                    f"tasks but only {len(results)} contributions exist — "
-                    f"results were lost (master failover mid-pass?); "
-                    f"refusing to apply a partial reduction.  Restart this "
-                    f"worker: startup recovery requeues the unresulted "
-                    f"tasks and recomputes them deterministically"
-                )
+            if not results or len(results) != int(
+                view.get("n_done", len(results))
+            ):
+                # a master failover landed inside the fence window: the
+                # new leader's replica is missing acks that died with the
+                # deposed leader (they survive as warm leases / todo), or
+                # the frozen fence view predates them — or the pass was
+                # force-rotated to nothing under us.  Correctness-first
+                # still means NEVER applying a partial (or empty)
+                # reduction — but the heal no longer needs a process
+                # restart: recompute the missing contributions in place
+                # and reduce only a map that covers the WHOLE pass.
+                try:
+                    results = self._heal_pass_results(
+                        pass_id, view, len(results)
+                    )
+                except _PassSuperseded as sup:
+                    # the pass closed under us while we were (briefly)
+                    # pruned: nothing left to reduce here — catch up to
+                    # the fleet's pass (manifest-bridged if the retained
+                    # map was poisoned) and continue there
+                    pass_id = self._catch_up(pass_id, sup.target)
+                    continue
             mean_grads, mean_cost, _rows = reduce_results(results)
             self.model.apply(mean_grads)
             self.pass_costs.append(mean_cost)

@@ -212,6 +212,14 @@ define_flag("chaos", "",
             "chaos fault-point spec, e.g. 'nan_batch@5,kill@12' "
             "(robustness/chaos.py; env PADDLE_TPU_CHAOS reaches "
             "subprocesses) — NEVER set in production")
+define_flag("rpc_max_message_mb", 64,
+            "hard bound (MB) on one master-RPC wire frame, enforced on "
+            "send AND recv (master_wire.py): an over-budget outbound "
+            "payload — a too-large gradient tree — fails fast with a "
+            "structured WireOversizeError instead of wedging against a "
+            "frozen peer's full socket buffer, and an over-budget INBOUND "
+            "length prefix is refused before allocation, so a hostile or "
+            "damaged frame can never balloon the master's heap")
 define_flag("serving_max_slots", 8,
             "in-flight sequence capacity of the serving plane "
             "(serving/engine.py): the continuous-batching decode step is "

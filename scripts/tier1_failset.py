@@ -57,6 +57,7 @@ SLOW_ONLY_FILES = [
     "tests/test_serving_e2e.py",
     "tests/test_scenarios_e2e.py",
     "tests/test_obs_e2e.py",
+    "tests/test_netem_e2e.py",
 ]
 
 

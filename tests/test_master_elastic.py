@@ -605,3 +605,149 @@ def test_elastic_worker_rpc_retry_rides_through_bounce(tmp_path):
     )
     with pytest.raises(master_mod.MasterTransportError):
         w2._rpc("stats")  # bounded: gives up after the window
+
+
+# ---------------------------------------------------------------------------
+# failover-regression heal: unanimous attestation force-rotates a pass the
+# whole fleet already applied on a deposed leader (ISSUE 15 split-brain)
+# ---------------------------------------------------------------------------
+
+def test_force_rotate_requires_unanimous_attestation(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    # simulate the post-failover replica: pass 0 partially done, the
+    # rest re-opened (their acks died with the deposed leader)
+    got = svc.get_task("w0")
+    svc.task_finished(got["task"]["task_id"], got["epoch"],
+                      {"grads": 1.0, "cost": 1.0, "rows": 1}, 0)
+    assert svc.pass_id == 0 and svc.todo  # undrained
+    # one attestation proves nothing
+    assert svc.start_new_pass(1, "w0") == 0
+    assert svc.pass_id == 0
+    # unanimity alone does not fire either: it must stay unanimous for a
+    # full worker-timeout window (a briefly-pruned-but-alive worker gets
+    # the chance to re-register and break it)
+    assert svc.start_new_pass(1, "w1") == 0
+    for _ in range(2):
+        clk.advance(6.0)
+        svc.heartbeat("w0")
+        svc.heartbeat("w1")
+    assert svc.start_new_pass(1, "w1") == 1
+    assert svc.pass_id == 1
+    # the whole queue recycled at epoch 0 for the next pass
+    assert [t.task_id for t in svc.todo] == [0, 1, 2, 3]
+    assert all(t.epoch == 0 for t in svc.todo)
+    assert not svc.pending and not svc.done
+    # the unfinishable pass's retained map is POISONED: a late joiner can
+    # never replay it as complete (manifest fallback is its heal)
+    pr = svc.pass_results(0)
+    assert pr["results"] == {} and pr["n_done"] == -1
+
+
+def test_force_rotate_never_fires_from_healthy_rotation_calls(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk)
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    # drain pass 0 normally
+    for w in ("w0", "w1"):
+        while True:
+            got = svc.get_task(w)
+            if not isinstance(got, dict):
+                break
+            svc.task_finished(got["task"]["task_id"], got["epoch"],
+                              {"grads": 1.0, "cost": 1.0, "rows": 1}, 0)
+    # healthy release: the drained branch rotates, no attestation involved
+    assert svc.start_new_pass(1, "w0") == 1
+    # the straggler's idempotent call neither double-rotates nor votes
+    assert svc.start_new_pass(1, "w1") == 1
+    assert svc.pass_id == 1 and svc._repass_votes == {}
+    # and pass 0's retained map stays REPLAYABLE (frozen-complete)
+    pr = svc.pass_results(0)
+    assert pr["n_done"] == 4 and len(pr["results"]) == 4
+
+
+def test_force_rotate_replays_through_the_journal(tmp_path):
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, journal=True)
+    svc.register_worker("w0")
+    got = svc.get_task("w0")
+    svc.task_finished(got["task"]["task_id"], got["epoch"],
+                      {"grads": 1.0, "cost": 1.0, "rows": 1}, 0)
+    assert svc.start_new_pass(2, "w0") == 0  # unanimity clock starts
+    for _ in range(2):
+        clk.advance(6.0)
+        svc.heartbeat("w0")
+    assert svc.start_new_pass(2, "w0") == 1  # stable: force-rotates
+    # a replica recovering from snapshot+journal lands on the same state
+    replica = master_mod.Service(
+        snapshot_path=str(tmp_path / "snap.json"), clock=clk,
+        auto_rotate=False, chunks_per_task=2,
+    )
+    assert replica.pass_id == 1
+    assert [t.task_id for t in replica.todo] == [0, 1, 2, 3]
+    assert replica.pass_results(0)["n_done"] == -1
+
+
+def test_briefly_pruned_live_worker_breaks_attestation_unanimity(tmp_path):
+    """The stability window's whole point: a worker silent just past the
+    registry timeout (GC pause) is pruned — unanimity among the REST must
+    not fire while it can still come back.  Its re-registration resets
+    the unanimity clock."""
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=4.0)
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    got = svc.get_task("w1")  # w1 is mid-compute when the vote starts
+    clk.advance(5.0)  # w1 goes silent past the registry lease: pruned
+    assert svc.start_new_pass(1, "w0") == 0  # w0 alone IS unanimous now
+    clk.advance(2.0)
+    svc.heartbeat("w0")
+    # w1 wakes up inside the stability window and re-registers
+    svc.register_worker("w1")
+    assert svc.start_new_pass(1, "w0") == 0
+    clk.advance(3.0)  # past the original window — but unanimity was reset
+    svc.heartbeat("w0")
+    svc.heartbeat("w1")
+    assert svc.start_new_pass(1, "w0") == 0  # w1 is live and not attesting
+    assert svc.pass_id == 0 and svc._repass_unanimous_since is None
+    # the prune walked w1's held lease through the failure path, so its
+    # stale-epoch ack is a zombie — and the re-served task completes the
+    # pass the LEGITIMATE way (normal lease discipline, no force)
+    assert svc.task_finished(got["task"]["task_id"], got["epoch"],
+                             {"grads": 1.0, "cost": 1.0, "rows": 1},
+                             0) is False
+    g2 = svc.get_task("w1")
+    while g2["task"]["task_id"] != got["task"]["task_id"]:
+        svc.task_finished(g2["task"]["task_id"], g2["epoch"],
+                          {"grads": 1.0, "cost": 1.0, "rows": 1}, 0)
+        g2 = svc.get_task("w1")
+    assert g2["epoch"] == got["epoch"] + 1
+    assert svc.task_finished(g2["task"]["task_id"], g2["epoch"],
+                             {"grads": 1.0, "cost": 1.0, "rows": 1}, 0)
+
+
+def test_restarted_worker_incarnation_drops_its_ghost_attestation(tmp_path):
+    """Review regression: a worker that attested and then crashed must not
+    leave a vote its RESTARTED incarnation (whose params may never have
+    applied the attested pass) is bound by — the fresh registration drops
+    the ghost vote and unanimity breaks."""
+    clk = _FakeClock()
+    svc = _make_service(tmp_path, clk, worker_timeout_s=4.0)
+    svc.register_worker("w0")
+    svc.register_worker("w1")
+    assert svc.start_new_pass(1, "w0") == 0
+    assert svc.start_new_pass(1, "w1") == 0  # unanimous; window starts
+    clk.advance(5.0)  # w1 crashes (silent past the lease) mid-window
+    svc.heartbeat("w0")
+    svc.register_worker("w1")  # the supervisor's restart re-registers it
+    clk.advance(2.0)
+    svc.heartbeat("w0")
+    svc.heartbeat("w1")
+    # past the original stability window, still unanimous-looking ONLY if
+    # the ghost vote survived — it must not have
+    assert svc.start_new_pass(1, "w0") == 0
+    assert svc.pass_id == 0
+    assert "w1" not in svc._repass_votes

@@ -1,0 +1,303 @@
+"""master_wire codec tests: typed roundtrip (numpy bit-exactness), the
+structured error taxonomy (type / oversize / version / corrupt), the
+allocation bounds a hostile frame must hit, the send+recv
+``rpc_max_message_mb`` enforcement through a real Server/Client pair, and
+the journal's PTJ2 payload migration (+ PTJ1 legacy read)."""
+
+import os
+import pickle
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from paddle_tpu import master_journal as mj
+from paddle_tpu import master_wire as w
+
+
+def _deep_eq(a, b):
+    if isinstance(a, np.ndarray):
+        return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                and a.shape == b.shape
+                and np.array_equal(a, b, equal_nan=True))
+    if isinstance(a, np.generic):
+        return type(a) is type(b) and (a == b or a != a)
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and set(a) == set(b)
+                and all(_deep_eq(a[k], b[k]) for k in a))
+    if isinstance(a, (list, tuple)):
+        return (type(a) is type(b) and len(a) == len(b)
+                and all(_deep_eq(x, y) for x, y in zip(a, b)))
+    return type(a) is type(b) and (a == b or (a != a and b != b))
+
+
+# ---------------------------------------------------------------------------
+# payload roundtrip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("obj", [
+    None, True, False, 0, -1, 2**62, 2**100, -(2**200), 1.5, float("nan"),
+    "", "日本語 text", b"", b"\x00\xff" * 7,
+    [1, [2, [3, None]]], (1, (2,), "x"), {},
+    {"a": 1, 2: "b", b"k": None, 1.5: True},
+    {"grads": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+               "b": np.float32(-0.25)},
+     "cost": 0.125, "rows": 8},
+])
+def test_payload_roundtrip(obj):
+    assert _deep_eq(obj, w.decode_payload(w.encode_payload(obj)))
+
+
+@pytest.mark.parametrize("dtype", [
+    np.bool_, np.int8, np.uint16, np.int32, np.int64, np.float16,
+    np.float32, np.float64, np.complex64,
+])
+def test_ndarray_roundtrip_bit_exact(dtype):
+    rng = np.random.RandomState(3)
+    arr = (rng.randn(5, 3) * 100).astype(dtype)
+    out = w.decode_payload(w.encode_payload(arr))
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    assert out.tobytes() == arr.tobytes()  # BIT exact, not just equal
+
+
+def test_ndarray_empty_zero_dim_and_noncontiguous():
+    for arr in (np.zeros((0,), np.float64), np.zeros((2, 0, 3), np.int8),
+                np.float64(7.0), np.arange(12).reshape(3, 4).T):
+        out = w.decode_payload(w.encode_payload(arr))
+        assert np.array_equal(np.asarray(out), np.asarray(arr))
+
+
+def test_numpy_scalar_preserves_type():
+    out = w.decode_payload(w.encode_payload(np.float32(1.5)))
+    assert type(out) is np.float32 and out == np.float32(1.5)
+
+
+# ---------------------------------------------------------------------------
+# the restricted set: refusals are structured and deterministic
+# ---------------------------------------------------------------------------
+
+
+def test_unencodable_type_is_wire_type_error():
+    class Evil:
+        pass
+
+    with pytest.raises(w.WireTypeError, match="Evil"):
+        w.encode_payload({"x": Evil()})
+    with pytest.raises(w.WireTypeError, match="restricted wire set"):
+        w.encode_payload({1, 2})  # sets are not on the wire
+
+
+def test_object_dtype_rejected_both_sides():
+    with pytest.raises(w.WireTypeError, match="dtype"):
+        w.encode_payload(np.array([object()], dtype=object))
+
+
+def test_non_primitive_dict_key_rejected():
+    with pytest.raises(w.WireTypeError, match="hashable primitives"):
+        w.encode_payload({(1, 2): "x"})  # tuple key is not a primitive
+
+
+def test_nesting_bomb_rejected_on_encode():
+    obj = []
+    for _ in range(w.MAX_DEPTH + 2):
+        obj = [obj]
+    with pytest.raises(w.WireTypeError, match="MAX_DEPTH"):
+        w.encode_payload(obj)
+
+
+def test_decode_never_overallocates():
+    # a crafted count far beyond the buffer must refuse BEFORE allocating
+    bomb = b"l" + struct.pack(">I", 2**31 - 1) + b"N"
+    with pytest.raises(w.WireCorruptError, match="refusing to preallocate"):
+        w.decode_payload(bomb)
+    # ndarray claiming gigabytes it doesn't carry
+    bomb = b"a" + bytes([3]) + b"<f8" + bytes([1]) + struct.pack(">I", 2**30)
+    with pytest.raises(w.WireCorruptError, match="refusing to allocate"):
+        w.decode_payload(bomb)
+
+
+def test_decode_rejects_trailing_and_truncated():
+    enc = w.encode_payload([1, 2])
+    with pytest.raises(w.WireCorruptError, match="trailing"):
+        w.decode_payload(enc + b"\x00")
+    with pytest.raises(w.WireCorruptError, match="truncated"):
+        w.decode_payload(enc[:-1])
+    with pytest.raises(w.WireCorruptError, match="unknown payload type tag"):
+        w.decode_payload(b"Q")
+
+
+def test_decode_rejects_object_dtype_string():
+    blob = b"z" + bytes([3]) + b"|O8" + b"\x00" * 8
+    with pytest.raises(w.WireCorruptError):
+        w.decode_payload(blob)
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_and_overhead():
+    payload = w.encode_payload({"m": "get_task", "a": (1,)})
+    frame = w.encode_frame(payload)
+    assert len(frame) == len(payload) + w.FRAME_OVERHEAD
+    assert w.decode_frame(frame) == payload
+
+
+def test_frame_oversize_send_and_recv():
+    with pytest.raises(w.WireOversizeError, match="refusing to send"):
+        w.encode_frame(b"x" * 100, max_bytes=64)
+    frame = w.encode_frame(b"x" * 100)
+    with pytest.raises(w.WireOversizeError):
+        w.decode_frame(frame, max_bytes=64)
+
+
+def test_frame_corruption_every_byte_detected():
+    """Flip EVERY byte position once: each must surface as a structured
+    MasterWireError — never a misparse, never an unhandled exception."""
+    frame = bytearray(w.encode_frame(w.encode_payload(
+        {"grads": np.arange(3, dtype=np.float32), "rows": 3}
+    )))
+    for i in range(len(frame)):
+        bad = bytearray(frame)
+        bad[i] ^= 0xFF
+        with pytest.raises(w.MasterWireError):
+            w.decode_payload(w.decode_frame(bytes(bad)))
+
+
+def test_frame_unknown_version():
+    frame = bytearray(w.encode_frame(w.encode_payload(1)))
+    frame[3] = w.VERSION + 7
+    with pytest.raises(w.WireVersionError, match="version skew"):
+        w.decode_frame(bytes(frame))
+
+
+def test_frame_truncated_header():
+    with pytest.raises(w.WireCorruptError, match="shorter than"):
+        w.decode_frame(b"PTW")
+    with pytest.raises(w.WireCorruptError, match="bad frame magic"):
+        w.decode_frame(b"NOPE" + b"\x00" * 20)
+
+
+def test_frame_length_field_mismatch():
+    payload = w.encode_payload([1, 2, 3])
+    frame = bytearray(w.encode_frame(payload))
+    struct.pack_into(">I", frame, 4, len(payload) + 1)
+    with pytest.raises(w.MasterWireError):
+        w.decode_frame(bytes(frame))
+
+
+# ---------------------------------------------------------------------------
+# rpc_max_message_mb through a real Server/Client pair
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_oversize_send_is_structured(tmp_path):
+    from paddle_tpu.master import Client, Server, Service
+
+    svc = Service(auto_rotate=False)
+    srv = Server(svc)
+    c = Client(srv.address, call_timeout_s=5.0,
+               max_message_bytes=64 * 1024)
+    try:
+        big = {"grads": {"w": np.zeros(1 << 16, np.float64)}, "cost": 0.0,
+               "rows": 1}
+        with pytest.raises(w.WireOversizeError, match="rpc_max_message_mb"):
+            c.task_finished(0, 0, big, 0)
+        # the structured refusal did not poison the connection
+        assert c.n_tasks() == 0
+    finally:
+        c.close()
+        srv.close()
+
+
+def test_rpc_oversize_recv_refused_before_allocation():
+    """An over-budget INBOUND frame is refused by the server before any
+    allocation (the connection drops; the accept loop survives): the
+    storm satellite's 'oversized inbound frame used to allocate
+    unbounded' hole, closed."""
+    from paddle_tpu.master import Client, MasterTransportError, Server, Service
+
+    w.counters.reset()
+    svc = Service(auto_rotate=False)
+    srv = Server(svc, max_message_bytes=16 * 1024)
+    c = Client(srv.address, call_timeout_s=5.0, reconnect_tries=2,
+               reconnect_backoff=0.01)
+    try:
+        big = {"grads": {"w": np.zeros(1 << 15, np.float64)}, "cost": 0.0,
+               "rows": 1}
+        with pytest.raises(MasterTransportError):
+            c.task_finished(0, 0, big, 0)  # 256 KB frame vs a 16 KB server
+        snap = w.counters.snapshot()
+        assert snap.get("server_oversize_frames", 0) >= 1
+        assert snap.get("server_rejected_frames", 0) >= 1
+        # the accept loop survived: a fresh client is served normally
+        c2 = Client(srv.address, call_timeout_s=5.0)
+        assert c2.n_tasks() == 0
+        c2.close()
+    finally:
+        c.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# journal payloads ride the codec (PTJ2), legacy PTJ1 stays readable
+# ---------------------------------------------------------------------------
+
+
+def test_journal_frames_are_wire_encoded_not_pickled(tmp_path):
+    rec = {"t": "finish", "task": 1, "epoch": 0, "pass": 0,
+           "result": {"grads": {"w": np.ones(4, np.float32)}, "cost": 1.0,
+                      "rows": 4}}
+    frame = mj.encode_frame(7, rec)
+    assert frame[:4] == mj.MAGIC == b"PTJ2"
+    payload = frame[20:]  # MAGIC(4) + seq/len(12) + crc(4)
+    got = w.decode_payload(payload)  # decodes via the codec...
+    assert got["t"] == "finish"
+    with pytest.raises(Exception):  # noqa: B017 — any unpickle failure
+        pickle.loads(payload)  # ...and is NOT pickle
+    p = str(tmp_path / "j.log")
+    with open(p, "wb") as f:
+        f.write(frame)
+    records, info = mj.read_records(p)
+    assert not info["corrupt"] and not info["torn"]
+    assert records[0][0] == 7
+    assert np.array_equal(records[0][1]["result"]["grads"]["w"],
+                          np.ones(4, np.float32))
+
+
+def test_journal_legacy_ptj1_pickled_frames_still_replay(tmp_path):
+    """An upgrade boot must replay a pre-wire-codec journal: PTJ1 frames
+    (pickled payload) decode on the read path; everything newly written
+    is PTJ2."""
+    rec = {"t": "lease", "task": 3, "epoch": 0, "worker": "w1"}
+    payload = pickle.dumps(rec, protocol=4)
+    header = struct.pack(">QI", 5, len(payload))
+    crc = zlib.crc32(header + payload) & 0xFFFFFFFF
+    legacy = mj.MAGIC_V1 + header + struct.pack(">I", crc) + payload
+    p = str(tmp_path / "j.log")
+    with open(p, "wb") as f:
+        f.write(legacy)          # old build's frame...
+        f.write(mj.encode_frame(6, {"t": "fail", "task": 3, "epoch": 0}))
+    records, info = mj.read_records(p)
+    assert not info["corrupt"]
+    assert [(s, r["t"]) for s, r in records] == [(5, "lease"), (6, "fail")]
+    assert mj.verify_journal(p) == []
+
+
+def test_journal_unpicklable_ptj2_payload_flags_corrupt(tmp_path):
+    frame = bytearray(mj.encode_frame(1, {"t": "rotate", "from": 0}))
+    # wreck the payload's type tag AND refresh the CRC: a crc-INTACT
+    # frame whose payload fails the TYPED decode must still flag as
+    # corrupt (never crash, never half-decode)
+    frame[20] = ord("Q")  # unknown wire tag
+    crc = zlib.crc32(bytes(frame[4:16]) + bytes(frame[20:])) & 0xFFFFFFFF
+    struct.pack_into(">I", frame, 16, crc)
+    p = str(tmp_path / "j.log")
+    with open(p, "wb") as f:
+        f.write(bytes(frame))
+    records, info = mj.read_records(p)
+    assert records == [] and info["corrupt"]
+    assert "undecodable payload" in info["error"]

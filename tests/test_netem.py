@@ -1,0 +1,373 @@
+"""Hostile-network drills (fast tier): the netem fault transport against a
+REAL Server/Client pair — duplicated/reordered delivery vs the idempotent
+ack plane (seq-correlated replies, stale discard), corrupt-frame storms vs
+codec rejection (accept loop survives, counter > 0, damaged payloads never
+deserialize), HAClient failover under corrupt + duplicated-response
+delivery with no zombie connection leak, and partition ride-through.
+
+The slow, multi-process partition/split-brain drills live in
+tests/test_netem_e2e.py (`make chaos`)."""
+
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import master_wire as wire
+from paddle_tpu.io import recordio
+from paddle_tpu.master import (
+    Client,
+    MasterTimeoutError,
+    MasterTransportError,
+    Server,
+    Service,
+)
+from paddle_tpu.robustness import chaos, netem
+
+
+@pytest.fixture(autouse=True)
+def _clean_netem(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NETEM_PARTITION_SECS", "0.5")
+    monkeypatch.setenv("PADDLE_TPU_NETEM_DELAY_MS", "30")
+    chaos.disarm()
+    netem.reset()
+    wire.counters.reset()
+    yield
+    chaos.disarm()
+    netem.reset()
+    wire.counters.reset()
+
+
+def _dataset(tmp_path, n=16):
+    data = os.path.join(str(tmp_path), "data.rio")
+    recordio.write_records(
+        data, iter([b"r%d" % i for i in range(n)]), max_chunk_records=2
+    )
+    return data
+
+
+def _payload(x=1.0):
+    return {"grads": {"w": np.full(4, x, np.float32)}, "cost": float(x),
+            "rows": 4}
+
+
+def test_maybe_wrap_is_zero_cost_unarmed():
+    sentinel = object()
+    assert netem.maybe_wrap(sentinel, role="client") is sentinel
+    chaos.arm("net_drop@999")
+    wrapped = netem.maybe_wrap(sentinel, role="client")
+    assert isinstance(wrapped, netem.FaultyConnection)
+
+
+def test_role_gating(monkeypatch):
+    chaos.arm("net_drop@999")
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "server")
+    sentinel = object()
+    assert netem.maybe_wrap(sentinel, role="client") is sentinel
+    assert isinstance(
+        netem.maybe_wrap(sentinel, role="server"), netem.FaultyConnection
+    )
+
+
+def test_duplicated_request_acks_exactly_once(tmp_path, monkeypatch):
+    """net_dup duplicates EVERY client frame: the server must execute the
+    duplicate ack as an idempotent dedupe (one done task, one stored
+    result) and the client must discard the duplicate reply by seq."""
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    chaos.arm("net_dup")
+    try:
+        c = Client(srv.address, call_timeout_s=5.0)
+        c.set_dataset([data])
+        c.register_worker("w0")
+        got = c.get_task("w0")
+        assert c.task_finished(
+            got["task"]["task_id"], got["epoch"], _payload(), got["pass_id"]
+        )
+        time.sleep(0.2)  # let the duplicate's reply land in the buffer
+        assert c.n_tasks() == 4  # the stale reply was discarded, not
+        #                          credited to this call
+        assert len(svc.done) == 1
+        assert len(svc.results[0]) == 1  # stored exactly once
+        assert wire.counters.snapshot().get("stale_replies_discarded", 0) >= 1
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_reordered_delivery_rides_idempotent_ack(tmp_path, monkeypatch):
+    """net_reorder holds an ack frame back and releases it AFTER the
+    retry that follows the timeout: the server sees ack, then stale
+    duplicate — dedupe keeps exactly one completion, the late reply is
+    discarded by seq."""
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    try:
+        c = Client(srv.address, call_timeout_s=0.5, reconnect_tries=2,
+                   reconnect_backoff=0.05)
+        c.set_dataset([data])
+        c.register_worker("w0")
+        got = c.get_task("w0")
+        tid, ep, pid = got["task"]["task_id"], got["epoch"], got["pass_id"]
+        chaos.arm("net_reorder@1")  # the NEXT egress frame is held back
+        acked = False
+        for _ in range(4):  # the at-least-once retry loop a worker runs
+            try:
+                acked = c.task_finished(tid, ep, _payload(), pid)
+                break
+            except (MasterTimeoutError, MasterTransportError):
+                continue
+        assert acked
+        time.sleep(0.2)
+        assert c.n_tasks() == 4
+        assert len(svc.done) == 1 and len(svc.results[0]) == 1
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_corrupt_frame_storm_server_survives(tmp_path):
+    """Garbage at every layer: raw unauthenticated TCP spray, then
+    authenticated-but-alien frames, then CRC-broken frames — the accept
+    loop survives all of it, the reject counter counts, a damaged payload
+    never deserializes (by CRC construction), and a well-behaved client
+    is served throughout."""
+    from multiprocessing.connection import Client as ConnClient
+
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    try:
+        # (1) unauthenticated garbage: dies in the auth handshake,
+        # per-client, accept loop keeps going (the Listener's backlog is
+        # tiny and each bad handshake briefly occupies the accept thread,
+        # so a refused connect just means "busy" — retry like a client)
+        sprayed = 0
+        for i in range(8):
+            s = None
+            for attempt in range(100):
+                try:
+                    s = socket.create_connection(srv.address, timeout=2)
+                    break
+                except OSError:
+                    time.sleep(0.02)
+            if s is None:
+                continue  # accept thread busy chewing earlier garbage
+            try:
+                s.sendall(os.urandom(64))
+            finally:
+                s.close()
+            sprayed += 1
+        assert sprayed >= 4
+        # (2) authenticated garbage frames: not even wire-framed
+        conn = ConnClient(tuple(srv.address), authkey=b"paddle-tpu")
+        rng = np.random.RandomState(0)
+        for i in range(6):
+            conn.send_bytes(rng.bytes(32))
+        # (3) CRC-broken real frames
+        frame = bytearray(wire.encode_frame(
+            wire.encode_payload(("n_tasks", (), {"seq": 1}))
+        ))
+        frame[-1] ^= 0xFF
+        conn.send_bytes(bytes(frame))
+        # (4) a validly-encoded but structurally alien message
+        conn.send_bytes(wire.encode_frame(wire.encode_payload(42)))
+        deadline = time.time() + 5
+        while (wire.counters.snapshot().get("server_rejected_frames", 0) < 8
+               and time.time() < deadline):
+            time.sleep(0.02)
+        assert wire.counters.snapshot()["server_rejected_frames"] >= 8
+        conn.close()
+        # the storm never crashed the accept loop: a clean client works
+        c = Client(srv.address, call_timeout_s=5.0)
+        assert c.set_dataset([data]) == 4
+        assert c.stats()["wire"]["server_rejected_frames"] >= 8
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_corrupt_request_rides_client_retry(tmp_path, monkeypatch):
+    """A frame corrupted in flight surfaces server-side as a structured
+    wire-reject; the client's bounded retry re-sends the call whole and
+    succeeds — nothing ever deserialized the damaged bytes."""
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    chaos.arm("net_corrupt@2")
+    try:
+        c = Client(srv.address, call_timeout_s=5.0)
+        assert c.set_dataset([data]) == 4  # frame 1
+        assert c.n_tasks() == 4            # frame 2: corrupted -> retried
+        snap = wire.counters.snapshot()
+        assert snap.get("server_rejected_frames", 0) >= 1
+        assert netem.counters.snapshot().get("corrupted", 0) == 1
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_partition_rides_bounded_retry(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    chaos.arm("net_partition@2")
+    try:
+        c = Client(srv.address, call_timeout_s=0.3, reconnect_tries=3,
+                   reconnect_backoff=0.05)
+        assert c.set_dataset([data]) == 4  # msg 1
+        t0 = time.time()
+        n = None
+        while n is None and time.time() - t0 < 10:
+            try:
+                n = c.n_tasks()  # msg 2 fires the partition
+            except (ConnectionError, OSError):
+                time.sleep(0.05)
+        assert n == 4
+        assert time.time() - t0 >= 0.4  # genuinely waited the link out
+        assert netem.last_partition_start() > 0
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_delay_and_drop_points(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False)
+    srv = Server(svc)
+    try:
+        chaos.arm("net_delay@1")
+        c = Client(srv.address, call_timeout_s=5.0)
+        t0 = time.time()
+        assert c.set_dataset([data]) == 4
+        assert time.time() - t0 >= 0.025
+        assert netem.counters.snapshot().get("delayed", 0) == 1
+        chaos.arm("net_drop@1")  # re-arm resets consultation counts
+        c2 = Client(srv.address, call_timeout_s=0.3)
+        n = None
+        for _ in range(5):
+            try:
+                n = c2.n_tasks()  # 1st frame dropped -> deadline -> retry
+                break
+            except (MasterTimeoutError, MasterTransportError):
+                continue
+        assert n == 4
+        assert netem.counters.snapshot().get("dropped", 0) == 1
+        c2.close()
+        c.close()
+    finally:
+        srv.close()
+
+
+def test_partition_expires_lease_requeue_and_zombie_ack(tmp_path, monkeypatch):
+    """A worker partitioned while HOLDING a shard lease: the lease
+    expires into the failure/requeue discipline, a survivor recomputes,
+    and the partitioned worker's eventual late ack is rejected as a
+    zombie (epoch guard) — the surviving recomputation's bits win."""
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    monkeypatch.setenv("PADDLE_TPU_NETEM_PARTITION_SECS", "1.0")
+    data = _dataset(tmp_path)
+    svc = Service(chunks_per_task=2, auto_rotate=False, timeout_s=0.4)
+    srv = Server(svc)
+    svc.set_dataset([data])  # in-process setup: no wire messages burned
+    chaos.arm("net_partition@2")
+    try:
+        c = Client(srv.address, call_timeout_s=0.3, reconnect_tries=2,
+                   reconnect_backoff=0.05)
+        got = c.get_task("wA")  # msg 1: lease granted to the victim
+        tid, ep, pid = got["task"]["task_id"], got["epoch"], got["pass_id"]
+        with pytest.raises((MasterTimeoutError, MasterTransportError)):
+            # msg 2 fires the partition: the ack never arrives
+            c.task_finished(tid, ep, _payload(1.0), pid)
+        time.sleep(0.5)  # the held lease expires behind the partition
+        # in-process survivors lease until one reaches the REQUEUED task
+        # (the failure discipline appends it behind the untouched todo;
+        # distinct ids because get_task re-serves a worker's held lease)
+        for i in range(8):
+            got2 = svc.get_task(f"wB{i}")
+            if got2["task"]["task_id"] == tid:
+                break
+        assert got2["task"]["task_id"] == tid
+        assert got2["epoch"] == ep + 1  # the failure discipline bumped it
+        assert svc.stats()["fail_events"] == 1
+        assert svc.task_finished(tid, got2["epoch"], _payload(2.0), pid)
+        time.sleep(0.8)  # partition heals
+        # the victim's retried ack is a ZOMBIE: stale epoch, rejected
+        assert c.task_finished(tid, ep, _payload(1.0), pid) is False
+        assert svc.results[0][tid]["grads"]["w"][0] == np.float32(2.0)
+        c.close()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# HAClient failover under hostile delivery (the satellite drill)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def ha_master(tmp_path):
+    from paddle_tpu.master_ha import HAMaster
+
+    data = _dataset(tmp_path)
+    ha = HAMaster(
+        os.path.join(str(tmp_path), "ha"), [data], owner_id="m0",
+        lease_timeout=5.0, chunks_per_task=2, auto_rotate=False,
+    )
+    ha.start()
+    assert ha.wait_leader(30)
+    yield ha
+    ha.stop()
+
+
+def test_haclient_rides_corrupt_frames_no_conn_leak(ha_master, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "client")
+    from paddle_tpu.master_ha import HAClient
+
+    chaos.arm("net_corrupt@3")
+    hc = HAClient(ha_master.dir, timeout=20.0, call_timeout_s=2.0)
+    try:
+        for i in range(6):  # one of these frames corrupts mid-flight
+            assert "pass_id" in hc.stats()
+        snap = wire.counters.snapshot()
+        assert snap.get("server_rejected_frames", 0) >= 1
+        # no zombie connections: the reject/retry cycle closed what it
+        # dropped (<= the live client conn + one still-draining handler)
+        deadline = time.time() + 5
+        while len(ha_master.server._conns) > 2 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(ha_master.server._conns) <= 2
+    finally:
+        hc.close()
+
+
+def test_haclient_rides_duplicated_responses(ha_master, monkeypatch):
+    """net_dup on the SERVER role duplicates every REPLY: the client must
+    discard the duplicates by seq — every call still returns the right
+    answer, and the bounded-retry window never trips."""
+    monkeypatch.setenv("PADDLE_TPU_NETEM_ROLE", "server")
+    from paddle_tpu.master_ha import HAClient
+
+    chaos.arm("net_dup")
+    # fresh connections AFTER arming so the server side wraps them
+    hc = HAClient(ha_master.dir, timeout=20.0, call_timeout_s=2.0)
+    try:
+        assert hc.register_worker("w0")["pass_id"] == 0
+        got = hc.get_task("w0")
+        assert hc.task_finished(
+            got["task"]["task_id"], got["epoch"], _payload(), got["pass_id"]
+        )
+        assert hc.stats()["n_done"] == 1
+        assert wire.counters.snapshot().get("stale_replies_discarded", 0) >= 1
+        assert len(ha_master.service.results[0]) == 1
+    finally:
+        hc.close()

@@ -21,6 +21,7 @@ def test_registry_names_and_unknown():
     assert set(scenarios.FAST_SCENARIOS) == {
         "overload", "burst_overload", "nan_request_under_load",
         "slow_client_under_load", "mixed_train_serve",
+        "partition_under_load",
     }
     assert set(scenarios.SLOW_SCENARIOS) == {
         "fleet_kill_worker", "fleet_kill_master",

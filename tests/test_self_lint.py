@@ -151,6 +151,89 @@ def test_a203_not_applied_outside_reader_modules(tmp_path):
     assert d == []
 
 
+def test_a206_pickle_loads_flagged(tmp_path):
+    d = _lint_src(tmp_path, """
+        import pickle
+
+        def decode(blob):
+            return pickle.loads(blob)
+    """, "mod.py")
+    assert rules(d) == ["A206"]
+    assert "master_wire" in d[0].message and d[0].hint
+
+
+def test_a206_alias_and_from_import(tmp_path):
+    d = _lint_src(tmp_path, """
+        import pickle as pkl
+        from pickle import loads as unmarshal
+
+        def a(b):
+            return pkl.load(b), unmarshal(b), pkl.Unpickler(b)
+    """, "mod.py")
+    assert rules(d) == ["A206", "A206", "A206"]
+
+
+def test_a206_bare_conn_recv_flagged_socket_recv_fine(tmp_path):
+    d = _lint_src(tmp_path, """
+        def pump(conn, sock):
+            msg = conn.recv()          # Connection-style: implicit unpickle
+            raw = sock.recv(4096)      # socket-style bytes read: fine
+            return msg, raw
+    """, "mod.py")
+    assert rules(d) == ["A206"]
+    assert d[0].line == 3
+
+
+def test_a206_dumps_and_master_wire_exempt(tmp_path):
+    # serializing is legal everywhere; deserializing is legal in the codec
+    d = _lint_src(tmp_path, """
+        import pickle
+
+        def save(obj, f):
+            pickle.dump(obj, f)
+            return pickle.dumps(obj)
+    """, "mod.py")
+    assert d == []
+    d = _lint_src(tmp_path, """
+        import pickle
+
+        def decode(blob):
+            return pickle.loads(blob)
+    """, "paddle_tpu/master_wire.py")
+    assert d == []
+
+
+def test_a206_pragma_suppresses_with_justification(tmp_path):
+    d = _lint_src(tmp_path, """
+        import pickle
+
+        def decode(blob):
+            return pickle.loads(blob)  # wire: allow[A206] local md5-verified dataset file
+    """, "mod.py")
+    assert d == []
+
+
+def test_a206_empty_pragma_justification_rejected(tmp_path):
+    d = _lint_src(tmp_path, """
+        import pickle
+
+        def decode(blob):
+            return pickle.loads(blob)  # wire: allow[A206]
+    """, "mod.py")
+    # the malformed pragma reports (and the hazard is NOT double-reported)
+    assert rules(d) == ["A206"]
+    assert "justification" in d[0].message
+
+
+def test_a206_stale_pragma_flagged(tmp_path):
+    d = _lint_src(tmp_path, """
+        def harmless():  # wire: allow[A206] nothing here needs this anymore
+            return 1
+    """, "mod.py")
+    assert rules(d) == ["A206"]
+    assert "unused" in d[0].message
+
+
 def test_a204_duplicate_flag_definition(tmp_path):
     a = tmp_path / "pkg" / "flags_a.py"
     b = tmp_path / "pkg" / "flags_b.py"
