@@ -135,6 +135,7 @@ test-all:
 verify: test-all
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_allreduce_virtual8())"
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_scaling_virtual8())"
+	$(CPU_ENV) $(PY) -c "import bench; [print(r) for r in bench.bench_quantized()]"
 	$(CPU_ENV) $(PY) -c "import bench; [print(r) for r in bench.bench_aot_warm_boot()]"
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

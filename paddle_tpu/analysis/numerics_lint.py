@@ -262,6 +262,16 @@ class _Walker:
                 sub = eqn.params.get(key)
                 if sub is not None:
                     self.walk_closed(sub, None, _join(region, "while"))
+        elif prim == "shard_map":
+            # descend into the per-shard program: the quantized-allreduce
+            # psums (trainer/step.py's quantized path) live here, and N405
+            # must see the payload psum AND its f32 scale psum in the SAME
+            # region to accept the pair
+            sub = eqn.params.get("jaxpr")
+            if sub is not None:
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                ops = invals if len(inner.invars) == len(invals) else None
+                self.walk_closed(sub, ops, _join(region, "shard_map"))
         elif prim == "cond":
             for sub in eqn.params.get("branches", ()):
                 inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
@@ -480,6 +490,32 @@ def _is_tie_count(val: _Val, depth: int = 0) -> bool:
     return False
 
 
+def _nonzero_rescale_of(val: _Val, t: _Val, depth: int = 0) -> bool:
+    """True when ``val`` is ``t`` itself scaled only by finite nonzero
+    constants (through shape-transparent ops) — nonzero whenever ``t``
+    is, which the zero-switch ``where(t == 0, c, val)`` guarantees on the
+    branch that selects it."""
+    if depth > 8:
+        return False
+    if val is t:
+        return True
+    if val.kind != "op":
+        return False
+    if val.prim in _TRANSPARENT:
+        return bool(val.ins) and _nonzero_rescale_of(val.ins[0], t, depth + 1)
+    if val.prim in ("mul", "div"):
+        hit = False
+        for x in val.ins:
+            if _nonzero_rescale_of(x, t, depth + 1):
+                hit = True
+            elif not (
+                x.const is not None and np.isfinite(x.const) and x.const != 0.0
+            ):
+                return False
+        return hit
+    return False
+
+
 def _positive_guarded(val: _Val, depth: int = 0) -> bool:
     """True when ``val`` is bounded away from zero from below — an
     epsilon idiom (`x + 1e-6`, `max(x, eps)`), a nonzero constant, or a
@@ -515,9 +551,29 @@ def _positive_guarded(val: _Val, depth: int = 0) -> bool:
     if p == "select_n":
         # every selectable branch guarded (jax.nn.softmax's backward
         # divides by select(all_masked, 1, 2) — both branches constants)
-        return len(val.ins) > 1 and all(
+        if len(val.ins) > 1 and all(
             _positive_guarded(x, depth + 1) for x in val.ins[1:]
-        )
+        ):
+            return True
+        # the zero-switch idiom `where(t == 0, c, t*s)` (ops.quantize's
+        # block-scale guard): the branch reached when t != 0 is a pure
+        # nonzero rescaling of t, so the select output never lands at zero
+        pred = val.ins[0] if val.ins else None
+        if (
+            len(val.ins) == 3 and pred is not None and pred.kind == "op"
+            and pred.prim == "eq" and pred.ins
+        ):
+            t = next((x for x in pred.ins if x.const is None), None)
+            against_zero = any(
+                x.const == 0.0 for x in pred.ins if x.const is not None
+            )
+            if (
+                t is not None and against_zero
+                and _positive_guarded(val.ins[2], depth + 1)
+                and _nonzero_rescale_of(val.ins[1], t)
+            ):
+                return True
+        return False
     if p in ("reduce_sum", "cumsum"):
         if bool(val.ins) and _is_tie_count(val.ins[0], depth + 1):
             # sum of eq(x, max(x)) — the max-gradient tie count: the max
@@ -800,7 +856,11 @@ def _rule_n405(visits, diags) -> None:
                     v.eqn,
                     hint="block-scale the quantized allreduce (EQuARX, "
                     "arXiv:2506.17615): psum int8/bf16 blocks AND their "
-                    "f32 scales, dequantize after",
+                    "f32 scales, dequantize after — "
+                    "ops.quantize.quantized_psum emits the accepted pair "
+                    "(quantize_block_scaled/dequantize_block_scaled are "
+                    "the building blocks; trainer/step.py's "
+                    "quantized_allreduce path uses them)",
                 ))
 
 
@@ -1298,15 +1358,39 @@ def certify_precision_plan(
 ) -> PrecisionCertificate:
     """Statically verify a precision plan over the REAL train-step jaxpr.
 
-    ``plan``: ``{"compute_dtype": ..., "master_dtype": ...}`` (names or
-    dtypes; master defaults to float32).  ACCEPT iff no ERROR-severity
-    N-rule fires — in particular a plan whose master dtype is sub-f32
-    (params updated in bf16) is rejected by N402, while the sanctioned
-    master-f32/compute-bf16 split passes on the shipped flagships.  This
-    is the gate a ROADMAP-item-2 quantized/low-precision config must
-    clear before it is allowed near a convergence run."""
+    ``plan``: ``{"compute_dtype": ..., "master_dtype": ...,
+    "quantized_weights": bool}`` (names or dtypes; master defaults to
+    float32).  ACCEPT iff no ERROR-severity N-rule fires — in particular a
+    plan whose master dtype is sub-f32 (params updated in bf16) is
+    rejected by N402, while the sanctioned master-f32/compute-bf16 split
+    passes on the shipped flagships.  This is the gate a ROADMAP-item-2
+    quantized/low-precision config must clear before it is allowed near a
+    convergence run.
+
+    ``quantized_weights`` declares weight-ONLY int8 (the serving decode
+    bundle as int8 blocks + f32 scales, dequantized in-graph): it leaves
+    the traced train plane untouched, so the sanctioned splits still
+    ACCEPT.  A NON-FLOAT master or compute dtype (int8 master params /
+    optimizer state) is rejected outright, without tracing: integer state
+    cannot carry the update accumulation at all."""
     compute = np.dtype(plan.get("compute_dtype") or np.float32)
     master = np.dtype(plan.get("master_dtype") or np.float32)
+    for role, dt in (("master", master), ("compute", compute)):
+        if not _is_float(dt):
+            d = Diagnostic(
+                rule="N402", severity=Severity.ERROR,
+                message=f"precision plan asks for {role} dtype {dt} — "
+                "integer master params/optimizer state cannot accumulate "
+                "updates (every step requantizes the whole trajectory); "
+                "quantization must stay weight-only",
+                hint="keep master/compute dtypes float; declare int8 "
+                "serving weights via plan['quantized_weights']=True "
+                "(ops.quantize.quantize_weight_bundle)",
+            )
+            return PrecisionCertificate(
+                ok=False, compute_dtype=str(compute),
+                master_dtype=str(master), diagnostics=[d], rows=[],
+            )
 
     f = _PragmaFilter()
     step, args = _step_parts(

@@ -1571,7 +1571,7 @@ class Server:
         a structured application error instead of a wedged client."""
         reply = (ok, result) if seq is None else (ok, result, seq)
         try:
-            _wire.send_msg(conn, reply, self._max_msg)
+            _wire.send_msg(conn, reply, self._max_msg, label="server")
         except _wire.MasterWireError as exc:
             _wire.counters.incr("server_reply_rejected")
             fallback = (False, repr(exc))
@@ -1584,7 +1584,7 @@ class Server:
         try:
             while not self._stop:  # deposed leader: stop serving stale state
                 try:
-                    msg = _wire.recv_msg(conn, self._max_msg)
+                    msg = _wire.recv_msg(conn, self._max_msg, label="server")
                 except _wire.WireOversizeError as exc:
                     # the transport refused the length prefix BEFORE
                     # allocating and closed the (now desynced) stream —
@@ -1814,6 +1814,7 @@ class Client:
                     ):
                         try:
                             self._conn.send_bytes(frame)  # lock: allow[C304] _conn_lock serializes the whole RPC exchange by design; the poll deadline + SO_SNDTIMEO bound the hold
+                            _wire.count_bytes("sent", len(frame), "client")
                         except BlockingIOError as exc:
                             # SO_SNDTIMEO fired: the peer stopped draining
                             # its socket mid-request (frozen master, full
@@ -1893,6 +1894,7 @@ class Client:
                         f"rpc_max_message_mb)"
                     ) from exc
                 raise
+            _wire.count_bytes("recv", len(buf), "client")
             try:
                 resp = _wire.decode_payload(
                     _wire.decode_frame(buf, self._max_msg)

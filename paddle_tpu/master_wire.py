@@ -38,6 +38,10 @@ Payload type tags (1 ASCII byte each)::
     a           numpy ndarray (u8 dtype-str length + dtype str + u8 ndim +
                 u32 dims... + raw C-order bytes); dtype kind must be one of
                 b/i/u/f/c — object/void dtypes are REJECTED on both sides
+    q / Q       numpy int8 / uint8 ndarray (u8 ndim + u32 dims... + raw
+                bytes) — the compact spelling for quantized payloads, which
+                skips the dtype string entirely so a tree of small blocks
+                does not pay per-array dtype framing
     z           numpy scalar (u8 dtype-str length + dtype str + raw bytes)
 
 Decoding is allocation-bounded: collection counts are validated against the
@@ -76,6 +80,7 @@ __all__ = [
     "decode_frame",
     "send_msg",
     "recv_msg",
+    "count_bytes",
     "default_max_bytes",
     "counters",
 ]
@@ -245,11 +250,22 @@ def _check_dtype(dt: np.dtype, path: str) -> bytes:
     return s.encode("ascii")
 
 
+# int8/uint8 arrays (the quantized-gradient payload blocks) get dedicated
+# one-byte tags with no dtype string: a gradient tree split into many small
+# blocks would otherwise pay the 5-byte dtype framing per block.
+_COMPACT_TAGS = {np.dtype(np.int8): b"q", np.dtype(np.uint8): b"Q"}
+_COMPACT_DTYPES = {tag: dt for dt, tag in _COMPACT_TAGS.items()}
+
+
 def _enc_array(arr: np.ndarray, out: bytearray, path: str) -> None:
-    ds = _check_dtype(arr.dtype, path)
     if arr.ndim > _MAX_NDIM:
         raise WireTypeError(f"ndarray ndim {arr.ndim} > {_MAX_NDIM} at {path}")
-    out += b"a" + _U8.pack(len(ds)) + ds + _U8.pack(arr.ndim)
+    compact = _COMPACT_TAGS.get(arr.dtype)
+    if compact is not None:
+        out += compact + _U8.pack(arr.ndim)
+    else:
+        ds = _check_dtype(arr.dtype, path)
+        out += b"a" + _U8.pack(len(ds)) + ds + _U8.pack(arr.ndim)
     for dim in arr.shape:
         out += _U32.pack(dim)
     out += np.ascontiguousarray(arr).tobytes()
@@ -367,8 +383,8 @@ def _dec(cur: _Cursor, depth: int) -> Any:
                 )
             out[key] = _dec(cur, depth + 1)
         return out
-    if tag == b"a":
-        dt = _dec_dtype(cur)
+    if tag == b"a" or tag in _COMPACT_DTYPES:
+        dt = _COMPACT_DTYPES[tag] if tag != b"a" else _dec_dtype(cur)
         (ndim,) = _U8.unpack(cur.take(1))
         if ndim > _MAX_NDIM:
             raise WireCorruptError(f"ndarray ndim {ndim} > {_MAX_NDIM}")
@@ -470,13 +486,36 @@ def decode_frame(buf: bytes, max_bytes: Optional[int] = None) -> bytes:
 # transport helpers (one frame per Connection message)
 # ---------------------------------------------------------------------------
 
-def send_msg(conn, obj: Any, max_bytes: Optional[int] = None) -> None:
+def count_bytes(direction: str, n: int, label: Optional[str] = None) -> None:
+    """Tally ``n`` wire bytes under ``wire_bytes_{direction}`` — the metric
+    the quantized-allreduce bench gates on.  The aggregate row always
+    updates; ``label`` adds a per-connection row (``wire_bytes_sent[repl]``)
+    so a fleet's traffic decomposes by endpoint.  Mirrored into the global
+    StatSet when the timers plane is importable (never a hard dependency —
+    the codec must stay loadable from stripped wire-only processes)."""
+    key = f"wire_bytes_{direction}"
+    counters.incr(key, n)
+    if label:
+        counters.incr(f"{key}[{label}]", n)
+    try:
+        from paddle_tpu.utils.timers import global_stats
+
+        global_stats.incr(key, n)
+    except Exception:  # noqa: BLE001 — timers plane not loaded
+        pass
+
+
+def send_msg(conn, obj: Any, max_bytes: Optional[int] = None,
+             label: Optional[str] = None) -> None:
     """Encode + frame + send one message over a
     ``multiprocessing.connection`` Connection (or a netem wrapper)."""
-    conn.send_bytes(encode_frame(encode_payload(obj), max_bytes))
+    frame = encode_frame(encode_payload(obj), max_bytes)
+    count_bytes("sent", len(frame), label)
+    conn.send_bytes(frame)
 
 
-def recv_msg(conn, max_bytes: Optional[int] = None) -> Any:
+def recv_msg(conn, max_bytes: Optional[int] = None,
+             label: Optional[str] = None) -> Any:
     """Receive + verify + decode one message.  The recv-side size bound
     rides ``recv_bytes(maxlength)`` so an over-budget length prefix is
     refused BEFORE allocation (the transport closes the desynced stream;
@@ -493,4 +532,5 @@ def recv_msg(conn, max_bytes: Optional[int] = None) -> Any:
                 f"connection dropped"
             ) from exc
         raise
+    count_bytes("recv", len(buf), label)
     return decode_payload(decode_frame(buf, max_bytes))

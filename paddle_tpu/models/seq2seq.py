@@ -283,6 +283,19 @@ class Seq2SeqGenerator:
             "sp_b": p_sp.get("b"),
         }
 
+    def decode_weight_bytes(self, gp=None) -> int:
+        """Resident bytes of the fused decode bundle at full precision —
+        the f32 baseline of the serving weight-only-int8 capacity math
+        (ops.quantize.weight_bundle_bytes measures the quantized side)."""
+        if gp is None:
+            gp = self.net.materialize_shared(self.params.params)
+        w = self.fused_decode_weights(gp)
+        if w is None:
+            return 0
+        from paddle_tpu.ops.quantize import weight_bundle_bytes
+
+        return weight_bundle_bytes(w)
+
     def _step_fn(self, statics, gp):
         """Build step_fn(ids, carry) for beam/greedy: embeds ids with the
         trained trg_emb table, runs the decoder sub-network once — through

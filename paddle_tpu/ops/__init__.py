@@ -8,11 +8,14 @@ truncating every partial sum.  At f32 they are byte-for-byte
 ``jnp.matmul``/``jnp.einsum`` — no behavior change on the default path.
 The numerics lint (analysis/numerics_lint.py, rule N401) flags any
 low-precision contraction that bypasses this discipline.
+
+``ops.quantize`` is the block-scaled quantization plane (the quantized
+allreduce, the elastic wire contributions, int8 weight-only serving);
+jax is imported lazily here so that plane's numpy half stays importable
+from jax-free processes (elastic's numpy workers, master_wire).
 """
 
 from __future__ import annotations
-
-import jax.numpy as jnp
 
 __all__ = ["acc_matmul", "acc_einsum", "needs_f32_acc"]
 
@@ -20,6 +23,8 @@ __all__ = ["acc_matmul", "acc_einsum", "needs_f32_acc"]
 def needs_f32_acc(dtype) -> bool:
     """True for sub-f32 float dtypes (bf16/f16/f8) — the dtypes whose
     contractions must accumulate upward."""
+    import jax.numpy as jnp
+
     return (
         jnp.issubdtype(dtype, jnp.floating)
         and jnp.finfo(dtype).bits < 32
@@ -29,6 +34,8 @@ def needs_f32_acc(dtype) -> bool:
 def acc_matmul(x, w):
     """``x @ w`` accumulating in f32 for sub-f32 operands, result cast
     back to the operand dtype; the plain matmul (bit-identical) at f32+."""
+    import jax.numpy as jnp
+
     if needs_f32_acc(x.dtype):
         y = jnp.matmul(x, w, preferred_element_type=jnp.float32)
         return y.astype(x.dtype)  # num: allow[N406] intentional single rounding: the f32-accumulated GEMM result quantizes ONCE to the compute dtype at the op boundary (a full-precision consumer may immediately re-promote)
@@ -38,6 +45,8 @@ def acc_matmul(x, w):
 def acc_einsum(subscripts: str, *operands):
     """``jnp.einsum`` with the same f32-accumulation discipline as
     :func:`acc_matmul` (keyed on the first operand's dtype)."""
+    import jax.numpy as jnp
+
     if operands and needs_f32_acc(operands[0].dtype):
         y = jnp.einsum(subscripts, *operands,
                        preferred_element_type=jnp.float32)

@@ -1,0 +1,381 @@
+"""Block-scaled quantization — the shared core of every quantized surface.
+
+One format, three planes (EQuARX, arXiv:2506.17615: block-scaled int8
+recovers near-f32 allreduce accuracy at a fraction of the interconnect
+bytes):
+
+  * **in-graph collectives** (:func:`quantized_psum`, used by
+    trainer/step.py behind the ``quantized_allreduce`` flag): the gradient
+    psum rides as an int8 (or bf16) payload psum with its f32 block-scale
+    psum emitted side-by-side in the SAME region — the structure rule N405
+    (analysis/numerics_lint.py) statically requires of any sub-f32 psum;
+  * **elastic RPC results** (:func:`quantize_tree` /
+    :func:`dequantize_tree`, numpy-only — no jax import — so the wire
+    plane and the numpy elastic workers stay light): a per-task gradient
+    contribution rides master_wire as (int8 blocks, f32 scales) typed
+    arrays and is dequantized BEFORE the sorted-order reduction, keeping
+    the deterministic-trajectory contract of reduce_results;
+  * **serving weight-only int8** (:func:`quantize_weight_bundle` /
+    :func:`dequantize_weight_bundle`, serving/engine.py behind
+    ``serving_int8_weights``): decode weights live as int8 blocks + f32
+    scales and dequantize in-graph per dispatch, shrinking resident
+    weight bytes under ``serving_hbm_budget_mb``.
+
+Format: an array is flattened C-order, zero-padded to a multiple of
+``block``, and reshaped to ``[n_blocks, block]``; each block stores a
+payload (int8 in ``[-127, 127]``, or bf16 in ``[-1, 1]``) plus one f32
+scale (max-abs over the block, divided by 127 for int8).  Dequantize is
+``payload * scale`` truncated back to the original shape.  A zero block
+quantizes against scale 1.0 (the zero-guard applies ONLY at exact amax 0:
+a scale that underflows a narrow ``scale_dtype`` saturates LOUDLY — the
+division produces inf and the numerics sanitizer names the eqn — instead
+of being silently absorbed; tests/test_num_sanitizer.py drills this).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DEFAULT_BLOCK",
+    "INT8_MAX",
+    "quantize_block_scaled",
+    "dequantize_block_scaled",
+    "quantized_psum",
+    "quantize_array",
+    "dequantize_array",
+    "is_quantized_array",
+    "quantize_tree",
+    "dequantize_tree",
+    "tree_wire_bytes",
+    "quantize_weight_bundle",
+    "dequantize_weight_bundle",
+    "weight_bundle_bytes",
+]
+
+DEFAULT_BLOCK = 256
+INT8_MAX = 127.0
+
+# the wire marker key of a quantized-leaf dict (a plain string key so the
+# restricted master_wire codec carries it without any new type)
+QUANT_KEY = "__bsq__"
+
+
+def _resolve_block(block: Optional[int]) -> int:
+    if block is not None:
+        return int(block)
+    try:
+        from paddle_tpu.utils.flags import get_flag
+
+        return int(get_flag("quantize_block_size"))
+    except Exception:  # noqa: BLE001 — flag plane not loaded (stripped use)
+        return DEFAULT_BLOCK
+
+
+# ---------------------------------------------------------------------------
+# numpy core — the wire plane (NO jax import: elastic's numpy workers and
+# master_wire stay jax-free)
+# ---------------------------------------------------------------------------
+
+def quantize_array(a: np.ndarray, block: Optional[int] = None
+                   ) -> Dict[str, Any]:
+    """One float ndarray -> the wire-ready quantized-leaf dict
+    ``{QUANT_KEY: 1, "q": int8 [nb, block], "s": f32 [nb], "shape": [...],
+    "dtype": "<name>"}`` (every value inside the restricted master_wire
+    type set).  Deterministic round-half-even — the producing worker's
+    bytes are the contribution; every reducer dequantizes the SAME bytes,
+    so the sorted-order reduction stays bit-identical fleet-wide."""
+    block = _resolve_block(block)
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.floating):
+        raise TypeError(f"quantize_array wants a float array, got {a.dtype}")
+    n = a.size
+    nb = max((n + block - 1) // block, 1)
+    flat = np.zeros((nb * block,), np.float32)
+    flat[:n] = a.astype(np.float32, copy=False).reshape(-1)
+    blocks = flat.reshape(nb, block)
+    amax = np.max(np.abs(blocks), axis=1)
+    scale = amax / np.float32(INT8_MAX)
+    safe = np.where(amax == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.rint(blocks / safe[:, None]), -INT8_MAX, INT8_MAX)
+    return {
+        QUANT_KEY: 1,
+        "q": q.astype(np.int8),
+        "s": scale.astype(np.float32),
+        "shape": [int(d) for d in a.shape],
+        "dtype": str(a.dtype),
+    }
+
+
+def is_quantized_array(obj: Any) -> bool:
+    return isinstance(obj, dict) and obj.get(QUANT_KEY) == 1
+
+
+def dequantize_array(d: Dict[str, Any]) -> np.ndarray:
+    """Inverse of :func:`quantize_array` (up to the one rounding)."""
+    q = np.asarray(d["q"], np.float32)
+    s = np.asarray(d["s"], np.float32)
+    flat = (q * s[:, None]).reshape(-1)
+    shape = tuple(int(x) for x in d["shape"])
+    n = int(np.prod(shape)) if shape else 1
+    out = flat[:n].reshape(shape)
+    return out.astype(np.dtype(str(d["dtype"])), copy=False)
+
+
+def quantize_tree(tree: Any, block: Optional[int] = None) -> Any:
+    """Recursively quantize every float ndarray leaf of a nested-dict
+    gradient tree (the elastic contribution payload); non-float leaves
+    and scalars pass through untouched."""
+    if isinstance(tree, dict):
+        return {k: quantize_tree(v, block) for k, v in tree.items()}
+    arr = np.asarray(tree)
+    if arr.ndim >= 1 and np.issubdtype(arr.dtype, np.floating):
+        return quantize_array(arr, block)
+    return tree
+
+
+def dequantize_tree(tree: Any) -> Any:
+    """Recursively undo :func:`quantize_tree`; a mixed tree (some tasks
+    quantized, some not — a fleet mid-flag-flip) dequantizes only the
+    marked leaves."""
+    if is_quantized_array(tree):
+        return dequantize_array(tree)
+    if isinstance(tree, dict):
+        return {k: dequantize_tree(v) for k, v in tree.items()}
+    return tree
+
+
+def tree_wire_bytes(tree: Any) -> int:
+    """Approximate payload bytes of a contribution tree (array bytes only
+    — framing/tag overhead excluded), for the wire-reduction arithmetic
+    the bench records check against the measured counters."""
+    if isinstance(tree, dict):
+        return sum(tree_wire_bytes(v) for v in tree.values())
+    arr = np.asarray(tree)
+    return int(arr.nbytes)
+
+
+# ---------------------------------------------------------------------------
+# jax core — in-graph quantize/dequantize + the quantized collective
+# (jax imported lazily so this module stays importable on the wire plane)
+# ---------------------------------------------------------------------------
+
+def quantize_block_scaled(
+    x,
+    block: Optional[int] = None,
+    payload_dtype=None,
+    stochastic: bool = False,
+    rng=None,
+    scale_dtype=None,
+):
+    """In-graph block-scaled quantize: ``x`` (any shape, float) ->
+    ``(payload [nb, block], scales [nb])``.
+
+    ``payload_dtype``: int8 (default) or bfloat16.  int8 payloads round to
+    ``[-127, 127]`` with per-block scale ``amax/127``; bf16 payloads store
+    ``block/amax`` in ``[-1, 1]`` with scale ``amax``.  ``stochastic``
+    (int8 only) rounds ``floor(v + u)``, ``u ~ U[0, 1)`` from ``rng`` —
+    unbiased in expectation, the EQuARX recipe for gradient traffic.
+
+    ``scale_dtype`` narrows the STORED scale (default f32).  The zero
+    guard applies only at exact amax 0; a scale that underflows a narrow
+    scale_dtype divides to inf — a saturating config fails loudly under
+    the numerics sanitizer instead of silently zeroing blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    block = _resolve_block(block)
+    if payload_dtype is None:
+        payload_dtype = jnp.int8
+    if scale_dtype is None:
+        scale_dtype = jnp.float32
+    x = jnp.asarray(x)
+    n = x.size
+    nb = max((n + block - 1) // block, 1)
+    flat = jnp.zeros((nb * block,), jnp.float32)
+    flat = flat.at[:n].set(x.astype(jnp.float32).reshape(-1))
+    blocks = flat.reshape(nb, block)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    if jnp.dtype(payload_dtype) == jnp.dtype(jnp.int8):
+        scale = (amax / jnp.float32(INT8_MAX)).astype(scale_dtype)
+        safe = jnp.where(amax == 0.0, jnp.float32(1.0),
+                         scale.astype(jnp.float32))
+        v = blocks / safe[:, None]
+        if stochastic:
+            if rng is None:
+                raise ValueError("stochastic rounding needs an rng key")
+            v = jnp.floor(v + jax.random.uniform(rng, v.shape, jnp.float32))
+        else:
+            v = jnp.round(v)
+        payload = jnp.clip(v, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+    else:
+        scale = amax.astype(scale_dtype)
+        safe = jnp.where(amax == 0.0, jnp.float32(1.0),
+                         scale.astype(jnp.float32))
+        payload = (blocks / safe[:, None]).astype(payload_dtype)
+    return payload, scale
+
+
+def dequantize_block_scaled(payload, scales, shape, dtype=None):
+    """Inverse of :func:`quantize_block_scaled`: ``payload * scale``,
+    truncated back to ``shape``/``dtype``."""
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    flat = payload.astype(jnp.float32) * scales.astype(jnp.float32)[:, None]
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return flat.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def quantized_psum(
+    tree,
+    axis_name: str,
+    block: Optional[int] = None,
+    payload_dtype=None,
+    stochastic: bool = False,
+    rng=None,
+    mean: bool = False,
+):
+    """Block-scaled quantized allreduce of a gradient pytree over a mesh
+    axis (inside shard_map/pmap) — the in-graph half of the tentpole.
+
+    Per leaf, per block of ``block`` elements:
+
+      1. ``amax_i = max|x_i|`` locally (f32);
+      2. ``S = psum(amax, axis)`` — the **f32 scale psum** (the N405
+         block-scale anchor), and the shared quantization bound:
+         every shard quantizes against ``scale = S/127``, so
+         ``|q_i| <= 127 * amax_i / S`` and the payload psum is
+         **overflow-free by construction** (``sum_i |q_i| <= 127``) with
+         adaptive headroom — a shard holding most of the magnitude keeps
+         most of the int8 range;
+      3. ``Q = psum(q, axis)`` at the payload dtype — the bandwidth win:
+         1 byte/element (+ 4/block for the scales) instead of 4;
+      4. dequantize ``Q * scale`` back to the leaf dtype.
+
+    ``mean=True`` divides by the axis size (the gradient-mean contract of
+    the data-parallel step).  ``stochastic`` decorrelates per-shard
+    rounding by folding the axis index into ``rng``."""
+    import jax
+    import jax.numpy as jnp
+
+    block = _resolve_block(block)
+    if payload_dtype is None:
+        payload_dtype = jnp.int8
+    int8 = jnp.dtype(payload_dtype) == jnp.dtype(jnp.int8)
+    if stochastic and rng is not None:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = (
+        jax.random.split(rng, max(len(leaves), 1))
+        if (stochastic and rng is not None) else [None] * len(leaves)
+    )
+
+    def leaf_psum(g, key):
+        shape, dt = g.shape, g.dtype
+        n = g.size
+        nb = max((n + block - 1) // block, 1)
+        flat = jnp.zeros((nb * block,), jnp.float32)
+        flat = flat.at[:n].set(g.astype(jnp.float32).reshape(-1))
+        blocks = flat.reshape(nb, block)
+        amax = jnp.max(jnp.abs(blocks), axis=1)
+        # the f32 scale psum — the shared bound AND the N405 anchor
+        total = jax.lax.psum(amax, axis_name)
+        if int8:
+            scale = total / jnp.float32(INT8_MAX)
+        else:
+            scale = total
+        safe = jnp.where(total == 0.0, jnp.float32(1.0), scale)
+        v = blocks / safe[:, None]
+        if int8:
+            if key is not None:
+                v = jnp.floor(
+                    v + jax.random.uniform(key, v.shape, jnp.float32)
+                )
+            else:
+                v = jnp.round(v)
+            payload = jnp.clip(v, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+        else:
+            payload = v.astype(payload_dtype)
+        summed = jax.lax.psum(payload, axis_name)
+        out = summed.astype(jnp.float32) * safe[:, None]
+        if mean:
+            out = out / jnp.float32(jax.lax.psum(1, axis_name))
+        return out.reshape(-1)[:n].reshape(shape).astype(dt)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaf_psum(g, k) for g, k in zip(leaves, keys)]
+    )
+
+
+# ---------------------------------------------------------------------------
+# serving weight bundles — int8 weight-only decode
+# ---------------------------------------------------------------------------
+
+def quantize_weight_bundle(
+    w: Dict[str, Any],
+    block: Optional[int] = None,
+    min_size: int = 512,
+) -> Tuple[Dict[str, Any], Dict[str, Tuple[Tuple[int, ...], Any]]]:
+    """Quantize the DENSE MATRICES of a fused decode-weight bundle
+    (serving/engine.py's jit argument): every float leaf with ndim >= 2
+    and >= ``min_size`` elements becomes ``{"q": int8 blocks, "s": f32
+    scales}``; biases / vectors / None ride through at full precision
+    (weight-ONLY quantization — the certify_precision_plan ACCEPT case).
+
+    Returns ``(bundle, meta)`` where ``meta`` maps quantized keys to
+    ``(shape, dtype)`` — the static half the in-graph dequantize needs
+    (the bundle itself stays a pure array pytree for jit)."""
+    import jax.numpy as jnp
+
+    block = _resolve_block(block)
+    out: Dict[str, Any] = {}
+    meta: Dict[str, Tuple[Tuple[int, ...], Any]] = {}
+    for k, v in w.items():
+        if (
+            v is not None
+            and hasattr(v, "dtype")
+            and jnp.issubdtype(v.dtype, jnp.floating)
+            and getattr(v, "ndim", 0) >= 2
+            and v.size >= min_size
+        ):
+            q, s = quantize_block_scaled(v, block=block)
+            out[k] = {"q": q, "s": s}
+            meta[k] = (tuple(int(d) for d in v.shape), v.dtype)
+        else:
+            out[k] = v
+    return out, meta
+
+
+def dequantize_weight_bundle(
+    w: Dict[str, Any],
+    meta: Dict[str, Tuple[Tuple[int, ...], Any]],
+) -> Dict[str, Any]:
+    """In-graph inverse of :func:`quantize_weight_bundle` — runs at the
+    top of every decode dispatch, so resident HBM holds the int8 blocks
+    and only the dispatch working set pays the f32 materialization."""
+    return {
+        k: (
+            dequantize_block_scaled(v["q"], v["s"], *meta[k])
+            if k in meta else v
+        )
+        for k, v in w.items()
+    }
+
+
+def weight_bundle_bytes(w: Dict[str, Any]) -> int:
+    """Resident bytes of a (possibly quantized) weight bundle."""
+    total = 0
+    for v in w.values():
+        if v is None:
+            continue
+        if isinstance(v, dict):
+            total += sum(int(x.nbytes) for x in v.values())
+        else:
+            total += int(v.nbytes)
+    return total

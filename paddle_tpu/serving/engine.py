@@ -143,6 +143,7 @@ class ServingEngine:
         block_steps: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
         aot_cache_dir: Optional[str] = None,
+        int8_weights: Optional[bool] = None,
         clock=time.perf_counter,
         stats=None,
     ):
@@ -192,6 +193,23 @@ class ServingEngine:
         self._gp = gp
         self._state = generator.params.state
         self._w = generator.fused_decode_weights(gp)
+        # weight-only int8 (the serving_int8_weights flag): the RESIDENT
+        # decode bundle holds int8 blocks + f32 scales and every dispatch
+        # dequantizes in-graph, so HBM carries ~1/4 the weight bytes while
+        # biases/vectors (and the host-side sp_b uses) stay full-precision
+        # f32 in self._w.  Bit-drift vs the f32 bundle is bounded by the
+        # serving_int8_drift_budget flag (tests/bench assert it).
+        from paddle_tpu.ops import quantize as _bsq
+
+        if int8_weights is None:
+            int8_weights = bool(_flags.get_flag("serving_int8_weights"))
+        self.int8_weights = bool(int8_weights)
+        self._w_meta: Dict[str, Any] = {}
+        if self.int8_weights:
+            self._w_arg, self._w_meta = _bsq.quantize_weight_bundle(self._w)
+        else:
+            self._w_arg = self._w
+        self.weight_bytes = _bsq.weight_bundle_bytes(self._w_arg)
         mt = generator._match
         self._acts = {
             "gate_act": mt.gate_act, "act": mt.act, "att_act": mt.att_act,
@@ -570,12 +588,20 @@ class ServingEngine:
         blk = self.block_tokens
         eos = self._gen.eos_id
         acts = self._acts
+        w_meta = self._w_meta
 
         k_steps = self.block_steps
 
         def decode(h_state, enc_pool, ep_pool, slot_idx, tables, enc_len,
                    ids, live, w):
             self.trace_counts["decode"] += 1
+            if w_meta:
+                # int8-resident weights: one in-graph dequantize per
+                # dispatch (amortized over K tokens x B slots); XLA keeps
+                # the f32 materialization in the dispatch working set
+                from paddle_tpu.ops import quantize as _bsq
+
+                w = _bsq.dequantize_weight_bundle(w, w_meta)
             h = h_state[slot_idx]  # [B, H]
             enc = enc_pool[tables].reshape(b_rung, p_rung * blk, -1)
             ep = ep_pool[tables].reshape(b_rung, p_rung * blk, -1)
@@ -891,7 +917,7 @@ class ServingEngine:
             live[k] = True
         args = (
             self._h, self._enc_pool, self._ep_pool, slot_idx, tables,
-            enc_len, ids, live, self._w,
+            enc_len, ids, live, self._w_arg,
         )
         exe = self._decode_exe(b_rung, p_rung, args)
         self._h, toks = exe(*args)
@@ -977,6 +1003,41 @@ class ServingEngine:
         n = int(np.asarray(lengths)[0])
         return [int(t) for t in np.asarray(toks)[0, :n]]
 
+    def weight_drift(self) -> float:
+        """Bit-drift of the resident quantized bundle vs its f32 source:
+        max over quantized keys of ``max|dequant(q) - w| / max|w|`` — the
+        explicit budget the serving_int8_drift_budget flag bounds (0.0 on
+        the f32 path)."""
+        if not self._w_meta:
+            return 0.0
+        from paddle_tpu.ops import quantize as _bsq
+
+        deq = _bsq.dequantize_weight_bundle(self._w_arg, self._w_meta)
+        worst = 0.0
+        for k in self._w_meta:
+            a = np.asarray(self._w[k], np.float32)
+            d = np.asarray(deq[k], np.float32)
+            denom = float(np.max(np.abs(a))) or 1.0
+            worst = max(worst, float(np.max(np.abs(d - a))) / denom)
+        return worst
+
+    def slots_per_gb(self, src_tokens: Optional[int] = None) -> float:
+        """Capacity arithmetic the serving bench gates on: concurrent
+        decode slots one GB of HBM holds AFTER the resident weight bundle,
+        at the per-slot footprint of a ``src_tokens``-token source (default
+        one page).  Weight-only int8 shrinks ``weight_bytes`` ~4x, so this
+        rises under the same ``serving_hbm_budget_mb``."""
+        pages = (
+            self._pages.pages_for_tokens(src_tokens)
+            if src_tokens is not None else 1
+        )
+        per_slot = (
+            pages * self._pages.bytes_per_block
+            + self.hidden_dim * jnp.dtype(self._dtype).itemsize
+        )
+        free = max((1 << 30) - self.weight_bytes, 0)
+        return free / float(per_slot)
+
     def summary(self) -> Dict[str, Any]:
         return {
             "live": self.n_live,
@@ -987,4 +1048,7 @@ class ServingEngine:
             "decode_shapes": len(self._decode_table),
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "trace_counts": dict(self.trace_counts),
+            "int8_weights": self.int8_weights,
+            "weight_bytes": self.weight_bytes,
+            "slots_per_gb": self.slots_per_gb(),
         }

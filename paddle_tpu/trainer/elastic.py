@@ -56,7 +56,9 @@ import numpy as np
 from paddle_tpu import master_wire as _wire
 from paddle_tpu import obs as _obs
 from paddle_tpu.io import recordio
+from paddle_tpu.ops import quantize as _bsq
 from paddle_tpu.robustness import chaos as _chaos
+from paddle_tpu.utils.timers import global_stats
 
 __all__ = [
     "ElasticWorker",
@@ -114,14 +116,21 @@ def reduce_results(results: Dict[int, Any]) -> Tuple[Any, float, int]:
     """(mean_grads, mean_cost, total_rows) from a pass's ``{task_id:
     {"grads", "cost", "rows"}}`` map, reduced in sorted task-id order —
     the canonical order every worker uses, so the reduction is
-    bit-identical fleet-wide."""
+    bit-identical fleet-wide.
+
+    Contributions may arrive block-scale quantized (the producing worker
+    ran with ``elastic_quantized_grads``): dequantize-THEN-reduce keeps
+    the determinism contract, because every reducer dequantizes the SAME
+    producer bytes before the same sorted-order float ops — which worker
+    quantized which task still cannot change the trajectory."""
     order = sorted(results)
     if not order:
         raise ValueError("empty result map: nothing to reduce")
     total_rows = sum(int(results[t]["rows"]) for t in order)
     acc = None
     for t in order:
-        acc = _tree_axpy(acc, results[t]["grads"], float(results[t]["rows"]))
+        grads = _bsq.dequantize_tree(results[t]["grads"])
+        acc = _tree_axpy(acc, grads, float(results[t]["rows"]))
     mean = _tree_scale(acc, 1.0 / total_rows)
     mean_cost = sum(float(results[t]["cost"]) for t in order) / total_rows
     return mean, mean_cost, total_rows
@@ -163,6 +172,7 @@ class ElasticWorker:
         poll_s: float = 0.02,
         min_workers: int = 1,
         rpc_retry_window_s: float = 60.0,
+        quantized_grads: Optional[bool] = None,
         clock=time.time,
         sleep=time.sleep,
     ):
@@ -193,11 +203,27 @@ class ElasticWorker:
         # a pass whose shards this worker wrote but whose manifest is not
         # yet published: (step, num_shards, extra)
         self._pending_commit: Optional[Tuple[int, int, Dict[str, Any]]] = None
+        # block-scale quantize this worker's gradient contributions before
+        # they ride the wire (reduce_results dequantizes EVERY contribution,
+        # so a mixed fleet mid-flag-flip still reduces deterministically);
+        # default from the elastic_quantized_grads flag, whose
+        # PADDLE_TPU_ELASTIC_QUANTIZED_GRADS env spelling reaches launcher-
+        # spawned worker processes
+        if quantized_grads is None:
+            try:
+                from paddle_tpu.utils.flags import get_flag
+
+                quantized_grads = bool(get_flag("elastic_quantized_grads"))
+            except Exception:  # noqa: BLE001 — flag plane not loaded
+                quantized_grads = False
+        self.quantized_grads = bool(quantized_grads)
         # observability
         self.pass_costs: List[float] = []
         self.tasks_done = 0
         self.rejected_acks = 0
         self.busy_s = 0.0
+        self.grad_payload_bytes = 0
+        self.wire_bytes_per_pass: List[int] = []
         self.t_work0: Optional[float] = None
         self.t_work1: Optional[float] = None
 
@@ -434,6 +460,11 @@ class ElasticWorker:
                     records, pass_id, tid
                 )
             self.busy_s += self._clock() - t0
+            if self.quantized_grads:
+                grads = _bsq.quantize_tree(grads)
+            nbytes = _bsq.tree_wire_bytes(grads)
+            self.grad_payload_bytes += nbytes
+            global_stats.incr("elastic_grad_payload_bytes", nbytes)
             payload = {
                 "grads": grads, "cost": float(cost_sum), "rows": int(rows)
             }
@@ -618,6 +649,7 @@ class ElasticWorker:
         self.t_work0 = self._clock()
         pass_id = current
         while pass_id < num_passes:
+            wb0 = _wire.counters.snapshot()
             behind = self._run_pass_tasks(pass_id)
             if behind is None:
                 # drained — but a pruned-then-rejoined worker (hang) may
@@ -670,6 +702,16 @@ class ElasticWorker:
             mean_grads, mean_cost, _rows = reduce_results(results)
             self.model.apply(mean_grads)
             self.pass_costs.append(mean_cost)
+            # this worker's RPC traffic for the whole pass (lease/ack/
+            # fence/result-fetch frames) — the per-pass counter the
+            # quantized-vs-f32 fleet bench gates its >= 3x reduction on
+            wb1 = _wire.counters.snapshot()
+            wb = sum(
+                wb1.get(k, 0) - wb0.get(k, 0)
+                for k in ("wire_bytes_sent", "wire_bytes_recv")
+            )
+            self.wire_bytes_per_pass.append(wb)
+            global_stats.incr("elastic_wire_bytes_pass", wb)
             self._write_shard(pass_id, view.get("writers", []))
             if pass_id + 1 < num_passes:
                 self._rpc("start_new_pass", pass_id + 1)
@@ -690,6 +732,9 @@ class ElasticWorker:
             "busy_s": self.busy_s,
             "t_work0": self.t_work0,
             "t_work1": self.t_work1,
+            "quantized_grads": self.quantized_grads,
+            "grad_payload_bytes": self.grad_payload_bytes,
+            "wire_bytes_per_pass": self.wire_bytes_per_pass,
         }
 
 
@@ -920,6 +965,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chaos", default=None,
                     help="arm chaos points in THIS worker, e.g. "
                     "'kill_worker@2' (env PADDLE_TPU_CHAOS also works)")
+    ap.add_argument("--quantized-grads", action="store_true", default=None,
+                    help="block-scale quantize gradient contributions "
+                    "(int8 blocks + f32 scales) before they ride the wire; "
+                    "default from the elastic_quantized_grads flag / "
+                    "PADDLE_TPU_ELASTIC_QUANTIZED_GRADS env")
     args = ap.parse_args(argv)
 
     logging.basicConfig(
@@ -967,6 +1017,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         poll_s=args.poll_s,
         min_workers=args.min_workers,
         rpc_retry_window_s=window,
+        quantized_grads=args.quantized_grads,
     )
     summary = worker.run(args.num_passes)
     if args.stats_out:

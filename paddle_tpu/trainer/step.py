@@ -91,6 +91,14 @@ def _sentinel_enabled(sentinel: Optional[bool]) -> bool:
     return bool(get_flag("divergence_sentinel"))
 
 
+def _quantized_enabled(quantized: Optional[bool]) -> bool:
+    if quantized is not None:
+        return bool(quantized)
+    from paddle_tpu.utils.flags import get_flag
+
+    return bool(get_flag("quantized_allreduce"))
+
+
 def _train_step_body(
     network: CompiledNetwork,
     optimizer: Optimizer,
@@ -142,6 +150,110 @@ def _train_step_body(
     return step
 
 
+def make_quantized_train_step(
+    network: CompiledNetwork,
+    optimizer: Optimizer,
+    mesh: Mesh,
+    extra_metrics: Optional[
+        Callable[[Dict[str, Any]], Dict[str, jnp.ndarray]]
+    ] = None,
+    prune_masks: Optional[Params] = None,
+    sentinel: Optional[bool] = None,
+):
+    """The ``quantized_allreduce`` train step: same signature and metric
+    surface as :func:`make_train_step`, but the data-axis gradient
+    reduction is an EXPLICIT block-scaled quantized collective
+    (ops/quantize.py :func:`~paddle_tpu.ops.quantize.quantized_psum`)
+    instead of the implicit f32 psum XLA SPMD inserts.
+
+    Structure: a ``shard_map`` over the (pure data-parallel) mesh computes
+    per-shard gradients, then psums the int8/bf16 payload blocks AND their
+    f32 scales side-by-side — the exact region shape rule N405 certifies —
+    and dequantizes to the gradient mean; cost pmeans at f32; per-row
+    layer outputs reassemble across the data axis so ``extra_metrics``
+    still sees the whole batch.  The optimizer update, prune masks and the
+    divergence sentinel run on the reduced (replicated) gradients exactly
+    as in the baseline body, so everything downstream of the allreduce is
+    shared.
+
+    Payload dtype / block size / stochastic rounding come from the
+    ``quantize_*`` flags at build time."""
+    import numpy as np
+
+    from paddle_tpu.ops.quantize import quantized_psum
+    from paddle_tpu.parallel.mesh import shard_map
+    from paddle_tpu.utils.flags import get_flag
+
+    if mesh.shape.get("model", 1) != 1:
+        raise ValueError(
+            "quantized_allreduce needs a pure data-parallel mesh "
+            f"(model axis is {mesh.shape.get('model')}); quantize only "
+            "the data-axis gradient reduction"
+        )
+    guard = _sentinel_enabled(sentinel)
+    payload_dtype = jnp.dtype(str(get_flag("quantize_payload_dtype")))
+    block = int(get_flag("quantize_block_size"))
+    stochastic = bool(get_flag("quantize_stochastic_rounding"))
+    # collapse to a 1-axis data mesh over the same devices in the same
+    # order: shard_map wants every mesh axis named in its specs
+    qmesh = Mesh(np.array(mesh.devices).reshape(-1), (DATA_AXIS,))
+
+    def shard_grads(params, state, batch, rng):
+        def loss_fn(p):
+            return network.cost(p, batch, state=state, rng=rng, train=True)
+
+        (cost, (outs, new_state)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        grads = quantized_psum(
+            grads, DATA_AXIS, block=block, payload_dtype=payload_dtype,
+            stochastic=stochastic, rng=(rng if stochastic else None),
+            mean=True,
+        )
+        cost = jax.lax.pmean(cost.astype(jnp.float32), DATA_AXIS)
+        return grads, cost, new_state, outs
+
+    smapped = shard_map(
+        shard_grads, mesh=qmesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P()),
+        out_specs=(P(), P(), P(), P(DATA_AXIS)),
+        check_vma=False,  # per-shard state/dropout outs: replication is by
+        # construction of the deterministic update, not provable statically
+    )
+
+    def step(params, state, opt_state, batch, rng):
+        grads, cost, new_state, outs = smapped(params, state, batch, rng)
+        new_params, new_opt_state = optimizer.update(grads, opt_state, params)
+        new_params = apply_prune_masks(new_params, prune_masks)
+        metrics = {"cost": cost}
+        if guard:
+            grad_norm = _global_norm(grads)
+            healthy = jnp.isfinite(cost) & jnp.isfinite(grad_norm)
+
+            def keep(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(healthy, n, o), new, old
+                )
+
+            new_params = keep(new_params, params)
+            new_state = keep(new_state, state)
+            new_opt_state = keep(new_opt_state, opt_state)
+            metrics["health"] = healthy.astype(jnp.float32)
+            metrics["grad_norm"] = grad_norm
+        if extra_metrics is not None:
+            metrics.update(extra_metrics(outs))
+        return new_params, new_state, new_opt_state, metrics
+
+    repl = NamedSharding(qmesh, P())
+    batch_sh = NamedSharding(qmesh, P(DATA_AXIS))
+    return jax.jit(
+        step,
+        donate_argnums=(0, 1, 2),
+        in_shardings=(repl, repl, repl, batch_sh, repl),
+        out_shardings=(repl, repl, repl, repl),
+    )
+
+
 def make_train_step(
     network: CompiledNetwork,
     optimizer: Optimizer,
@@ -152,6 +264,7 @@ def make_train_step(
     infer_param_shardings: bool = False,
     prune_masks: Optional[Params] = None,
     sentinel: Optional[bool] = None,
+    quantized: Optional[bool] = None,
 ):
     """Returns jitted
     (params, state, opt_state, batch, rng) ->
@@ -160,7 +273,23 @@ def make_train_step(
     With infer_param_shardings=True the params/opt_state shardings follow the
     argument placement (use parallel.sharding.shard_params first) so
     model-axis-sharded tables stay sharded through the update; otherwise
-    params are pinned replicated.  sentinel: see _train_step_body."""
+    params are pinned replicated.  sentinel: see _train_step_body.
+
+    quantized (None = the ``quantized_allreduce`` flag): with a data-
+    parallel mesh, route the gradient reduction through the block-scaled
+    quantized collective (:func:`make_quantized_train_step`).  OFF is the
+    byte-for-byte historical path — no graph change whatsoever.  Without
+    a mesh there is no cross-device reduction to quantize and the flag is
+    a no-op."""
+    if (
+        _quantized_enabled(quantized)
+        and mesh is not None
+        and not infer_param_shardings
+    ):
+        return make_quantized_train_step(
+            network, optimizer, mesh, extra_metrics,
+            prune_masks=prune_masks, sentinel=sentinel,
+        )
     step = _train_step_body(
         network, optimizer, extra_metrics, prune_masks, sentinel=sentinel
     )

@@ -324,3 +324,48 @@ define_flag("use_pallas_attention", False,
             "O(T*dh) attention memory instead of the [T,T] score matrix — "
             "enable for context lengths whose dense scores blow HBM; at "
             "short T XLA's fused dense path is faster")
+define_flag("quantized_allreduce", False,
+            "block-scaled quantized gradient allreduce (ops/quantize.py "
+            "quantized_psum): the data-axis gradient psum rides as an "
+            "int8/bf16 payload psum with its f32 block-scale psum beside "
+            "it (the N405 structure), cutting per-step allreduce bytes "
+            "~4x (EQuARX, arXiv:2506.17615).  OFF (default) keeps the "
+            "implicit f32 psum — bit-identical to every prior trajectory")
+define_flag("quantize_block_size", 256,
+            "elements per block of the block-scaled quantization format "
+            "(one f32 max-abs scale per block; shared by the in-graph "
+            "allreduce, the elastic wire contributions, and int8 serving "
+            "weights).  Smaller blocks track local dynamic range tighter "
+            "at more scale overhead (4 bytes per block)")
+define_flag("quantize_payload_dtype", "int8",
+            "payload dtype of the quantized allreduce: 'int8' (1 "
+            "byte/element, rounded into [-127,127]) or 'bfloat16' (2 "
+            "bytes/element, no rounding step beyond the bf16 mantissa)")
+define_flag("quantize_stochastic_rounding", False,
+            "stochastic rounding for int8 quantized-allreduce payloads "
+            "(floor(v + u), u~U[0,1), per-shard decorrelated): unbiased "
+            "in expectation, trades per-step noise for zero systematic "
+            "rounding drift over a long run")
+define_flag("elastic_quantized_grads", False,
+            "elastic workers submit per-task gradient contributions as "
+            "block-scaled (int8 blocks, f32 scales) typed arrays on the "
+            "master wire (ops/quantize.py quantize_tree) — ~4x fewer "
+            "result-plane bytes per pass; reduce_results dequantizes "
+            "BEFORE the sorted-order reduction, so the deterministic-"
+            "trajectory contract is unchanged (all workers reduce the "
+            "same dequantized bytes).  Env "
+            "PADDLE_TPU_ELASTIC_QUANTIZED_GRADS reaches worker "
+            "subprocesses")
+define_flag("serving_int8_weights", False,
+            "weight-only int8 serving decode: the fused decode-weight "
+            "bundle's dense matrices live as int8 blocks + f32 scales "
+            "and dequantize in-graph per dispatch (~4x smaller resident "
+            "weight bytes under serving_hbm_budget_mb -> more concurrent "
+            "slots per GB); biases/vectors stay f32, training is "
+            "untouched (the certify_precision_plan weight-only ACCEPT "
+            "case)")
+define_flag("serving_int8_drift_budget", 0.08,
+            "max tolerated per-step drift of int8-weight decode vs the "
+            "f32 reference, measured as max|logits_int8 - logits_f32| / "
+            "max|logits_f32| on a probe batch — the explicit bit-drift "
+            "budget the serving bench and tests gate on")

@@ -58,6 +58,7 @@ SLOW_ONLY_FILES = [
     "tests/test_scenarios_e2e.py",
     "tests/test_obs_e2e.py",
     "tests/test_netem_e2e.py",
+    "tests/test_quantized_e2e.py",
 ]
 
 

@@ -124,7 +124,12 @@ def test_decode_rejects_trailing_and_truncated():
         w.decode_payload(enc + b"\x00")
     with pytest.raises(w.WireCorruptError, match="truncated"):
         w.decode_payload(enc[:-1])
+    # "Q" stopped being unknown when the compact uint8 tag landed — probe
+    # with a byte outside the whole tag vocabulary
     with pytest.raises(w.WireCorruptError, match="unknown payload type tag"):
+        w.decode_payload(b"~")
+    # a TRUNCATED compact-tag array is a corruption error, not a crash
+    with pytest.raises(w.WireCorruptError, match="truncated"):
         w.decode_payload(b"Q")
 
 

@@ -86,6 +86,36 @@ def test_all_finite_returns_none():
     ) is None
 
 
+def test_saturating_quantization_scale_underflow_named_as_div():
+    """The quantization chaos drill (ops/quantize.py's LOUD-failure
+    contract): tiny-magnitude blocks with a narrow ``scale_dtype`` make
+    the stored block scale underflow to 0 — amax is NOT exactly zero, so
+    the zero-guard stays out of the way, the quantize division produces
+    inf, and the sanitizer names that div eqn instead of the config
+    silently zeroing every block."""
+    from paddle_tpu.ops.quantize import quantize_block_scaled
+
+    def quant(x):
+        payload, scale = quantize_block_scaled(
+            x, block=64, scale_dtype=jnp.float16
+        )
+        return payload.astype(jnp.float32).sum() + scale.sum()
+
+    # amax ~1e-8: amax/127 ~ 7.9e-11 is below the smallest f16 subnormal
+    # (~6e-8), so the f16-stored scale reads back 0.0
+    x = np.full((64,), 1e-8, np.float32)
+    rec = find_first_nonfinite(quant, (x,))
+    assert rec is not None
+    assert rec["primitive"] == "div"
+    assert rec["poisoned_inputs"] == []  # the div PRODUCES the first inf
+    # the healthy config (f32 scales) on the same data is finite
+    def quant_ok(x):
+        payload, scale = quantize_block_scaled(x, block=64)
+        return payload.astype(jnp.float32).sum() + scale.sum()
+
+    assert find_first_nonfinite(quant_ok, (x,)) is None
+
+
 def test_armed_flag_reads_env(monkeypatch):
     flags.reset_flags()
     monkeypatch.delenv("PADDLE_TPU_NUM_SANITIZER", raising=False)

@@ -248,6 +248,81 @@ def test_n405_block_scaled_psum_passes():
     )
 
 
+def test_n405_quantized_psum_helper_lints_zero():
+    """ACCEPT-path mutation check: the shipped ops.quantize.quantized_psum
+    emits the payload psum + f32 scale psum pair, and the WHOLE jaxpr
+    lints to zero diagnostics — not merely 'no N405' (a guard regression
+    in the helper would surface as N403 here)."""
+    from paddle_tpu.ops.quantize import quantized_psum
+
+    g = {"w": jnp.ones((300,), jnp.float32), "b": jnp.ones((7,), jnp.float32)}
+    for payload in (jnp.int8, jnp.bfloat16):
+        d = _lint_psum(
+            lambda t: quantized_psum(t, "dp", payload_dtype=payload), g
+        )
+        assert d == [], (str(payload), format_diagnostics(d))
+    # stochastic rounding keeps the same psum structure
+    d = _lint_psum(
+        lambda t, k: quantized_psum(t, "dp", stochastic=True, rng=k),
+        g, jax.random.PRNGKey(0),
+    )
+    assert d == [], format_diagnostics(d)
+
+
+def test_n405_mutated_quantized_psum_fires_and_hint_names_helpers():
+    """Strip the scale psum off the block-scaled pair (quantize against a
+    purely LOCAL scale, psum only the int8 payload) — the exact mutation
+    N405 exists to catch — and the fix hint must point at the ops
+    quantize helpers."""
+
+    def local_scale_only(x):
+        amax = jnp.max(jnp.abs(x))
+        scale = jnp.where(amax == 0.0, jnp.float32(1.0), amax / 127.0)
+        q = jnp.round(x / scale).astype(jnp.int8)
+        summed = jax.lax.psum(q, "dp")  # no f32 psum beside it
+        return summed.astype(jnp.float32) * scale
+
+    d = _lint_psum(local_scale_only, jnp.ones((64,), jnp.float32))
+    n405 = [x for x in d if x.rule == "N405"]
+    assert n405, format_diagnostics(d)
+    assert "ops.quantize.quantized_psum" in (n405[0].hint or "")
+    assert "quantize_block_scaled" in (n405[0].hint or "")
+
+
+def test_n405_sees_through_shard_map():
+    """The walker descends into shard_map bodies (where the quantized
+    allreduce actually lives): a naked int8 psum inside one fires, the
+    correctly paired one stays silent."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.ops.quantize import quantized_psum
+    from paddle_tpu.parallel.mesh import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n = len(jax.devices())
+
+    def naked(g):
+        def body(t):
+            q = t.astype(jnp.int8)
+            return jax.lax.psum(q, "dp").astype(jnp.float32)
+
+        return shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                         out_specs=P("dp"), check_vma=False)(g)
+
+    closed = jax.make_jaxpr(naked)(jnp.zeros((n, 32), jnp.float32))
+    assert "N405" in rules(lint_numerics_jaxpr(closed, apply_pragmas=False))
+
+    def paired(g):
+        return shard_map(
+            lambda t: quantized_psum(t, "dp", mean=True), mesh=mesh,
+            in_specs=(P("dp"),), out_specs=P("dp"), check_vma=False,
+        )(g)
+
+    closed = jax.make_jaxpr(paired)(jnp.zeros((n, 300), jnp.float32))
+    d = lint_numerics_jaxpr(closed, apply_pragmas=False)
+    assert d == [], format_diagnostics(d)
+
+
 # ---------------------------------------------------------------------------
 # N406 dtype round-trip churn
 # ---------------------------------------------------------------------------
@@ -351,6 +426,39 @@ def test_certify_rejects_bf16_master_accepts_bf16_compute_f32_master():
     assert not bad.ok, bad.format()
     assert "N402" in {d.rule for d in bad.diagnostics}
     assert "REJECT" in bad.format()
+
+
+def test_certify_int8_weight_only_accepts_int8_master_rejects():
+    """The quantization-plane split: declaring weight-ONLY int8 (the
+    serving decode bundle) leaves the train plane untouched and ACCEPTs;
+    asking for int8 master params or optimizer state REJECTs outright,
+    without even tracing."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import Topology, reset_auto_names
+
+    reset_auto_names()
+    x = paddle.layer.data("x", paddle.data_type.dense_vector(8))
+    h = paddle.layer.fc(x, size=16, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(h, size=4, act=paddle.activation.Softmax())
+    y = paddle.layer.data("y", paddle.data_type.integer_value(4))
+    topo = Topology([paddle.layer.classification_cost(input=pred, label=y)])
+
+    ok = certify_precision_plan(
+        topo, {"compute_dtype": "bfloat16", "quantized_weights": True}
+    )
+    assert ok.ok, ok.format()
+
+    for plan in (
+        {"master_dtype": "int8"},
+        {"compute_dtype": "int8"},
+        {"compute_dtype": "bfloat16", "master_dtype": "int8",
+         "quantized_weights": True},
+    ):
+        bad = certify_precision_plan(topo, plan)
+        assert not bad.ok, (plan, bad.format())
+        assert "N402" in {d.rule for d in bad.diagnostics}
+        assert "weight-only" in bad.diagnostics[0].message
+        assert "quantized_weights" in (bad.diagnostics[0].hint or "")
 
 
 # ---------------------------------------------------------------------------

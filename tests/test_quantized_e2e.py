@@ -1,0 +1,258 @@
+"""Quantized-collectives e2e A/B (slow tier; ISSUE 16 acceptance drills).
+
+Four contracts of the ``quantized_allreduce`` tentpole, each against the
+REAL training loop (paddle.trainer.SGD on the 8-device virtual mesh):
+
+* OFF is bit-identical — the flag unset and explicitly False produce
+  byte-equal trained params (no graph change whatsoever on the default
+  path);
+* ON converges — an MLP classifier and an LSTM text classifier both
+  train to within tolerance of their f32 arms (round-to-nearest AND
+  stochastic-rounding int8, plus the bf16 payload arm);
+* serving int8 weight-only decode keeps the dequantization drift inside
+  the ``serving_int8_drift_budget`` flag while shrinking resident weight
+  bytes ~4x and raising slots-per-GB.
+
+Every arm trains a real fleet of passes, so the module is slow-marked
+(scripts/tier1_failset.py --slow-guard pins the whole file out of tier 1).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.utils import flags
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    flags.reset_flags()
+
+
+# ---------------------------------------------------------------------------
+# arms
+# ---------------------------------------------------------------------------
+
+DIM, CLASSES = 32, 4
+
+
+def _mlp_trainer(seed=0):
+    reset_auto_names()
+    paddle.init(seed=seed)
+    img = paddle.layer.data("pixel", paddle.data_type.dense_vector(DIM))
+    label = paddle.layer.data(
+        "label", paddle.data_type.integer_value(CLASSES)
+    )
+    h = paddle.layer.fc(img, size=24, act=paddle.activation.Relu(),
+                        name="h1")
+    pred = paddle.layer.fc(h, size=CLASSES,
+                           act=paddle.activation.Softmax(), name="out")
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    return paddle.trainer.SGD(
+        cost=cost,
+        parameters=paddle.parameters.create(cost, seed=seed),
+        update_equation=paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9
+        ),
+        mesh=make_mesh(data=-1, model=1),  # the data-parallel mesh the
+        # quantized collective replaces the implicit psum on
+    )
+
+
+def _mlp_reader(n_batches=10, rows=16):
+    """Learnable synthetic task: the label is the argmax of a fixed random
+    projection, so the cost has real signal to descend."""
+    rng = np.random.RandomState(11)
+    w_true = rng.randn(DIM, CLASSES).astype(np.float32)
+    xs = rng.randn(n_batches * rows, DIM).astype(np.float32)
+    ys = np.argmax(xs @ w_true, axis=1)
+
+    def read():
+        for v, y in zip(xs, ys):
+            yield v, int(y)
+
+    return paddle.batch(read, rows)
+
+
+def _train_costs(trainer, reader, num_passes=3):
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(float(e.cost))
+
+    trainer.train(reader, num_passes=num_passes, event_handler=handler)
+    return costs
+
+
+def _final_params(trainer):
+    return {
+        k: np.asarray(v)
+        for k, v in jax.tree_util.tree_leaves_with_path(
+            jax.device_get(trainer.parameters.params)
+        )
+    }
+
+
+def _run_mlp_arm(num_passes=3, seed=0):
+    t = _mlp_trainer(seed=seed)
+    costs = _train_costs(t, _mlp_reader(), num_passes)
+    return costs, _final_params(t)
+
+
+# ---------------------------------------------------------------------------
+# OFF bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_off_is_bit_identical():
+    """Flag unset (the historical default) and explicitly False trace the
+    SAME graph: trained params are byte-equal."""
+    costs_default, p_default = _run_mlp_arm(num_passes=2)
+    flags.set_flag("quantized_allreduce", False)
+    costs_off, p_off = _run_mlp_arm(num_passes=2)
+    assert costs_default == costs_off
+    assert p_default.keys() == p_off.keys()
+    for k in p_default:
+        assert np.array_equal(p_default[k], p_off[k]), k
+
+
+# ---------------------------------------------------------------------------
+# ON: convergence A/B
+# ---------------------------------------------------------------------------
+
+
+def _assert_converged_close(costs_f32, costs_q):
+    head_f, tail_f = np.mean(costs_f32[:4]), np.mean(costs_f32[-4:])
+    head_q, tail_q = np.mean(costs_q[:4]), np.mean(costs_q[-4:])
+    assert tail_f < head_f * 0.7, (head_f, tail_f)
+    assert tail_q < head_q * 0.7, (head_q, tail_q)  # quantized arm learns
+    # A/B tolerance: the quantized trajectory lands in the same cost
+    # neighborhood (block-scaled int8 error is ~amax/254 per element)
+    assert abs(tail_q - tail_f) < 0.25 * max(head_f - tail_f, 1e-6), (
+        tail_f, tail_q,
+    )
+
+
+@pytest.mark.parametrize(
+    "payload,stochastic",
+    [("int8", False), ("int8", True), ("bfloat16", False)],
+    ids=["int8", "int8-stochastic", "bf16"],
+)
+def test_mlp_convergence_ab(payload, stochastic):
+    costs_f32, p_f32 = _run_mlp_arm()
+    flags.set_flag("quantized_allreduce", True)
+    flags.set_flag("quantize_payload_dtype", payload)
+    flags.set_flag("quantize_stochastic_rounding", stochastic)
+    costs_q, p_q = _run_mlp_arm()
+    _assert_converged_close(costs_f32, costs_q)
+    # the flag really switched the collective: trajectories differ
+    assert any(
+        not np.array_equal(p_f32[k], p_q[k]) for k in p_f32
+    )
+
+
+def _lstm_trainer(vocab, seed=0):
+    reset_auto_names()
+    paddle.init(seed=seed)
+    words = paddle.layer.data(
+        "word", paddle.data_type.integer_value_sequence(vocab)
+    )
+    emb = paddle.layer.embedding(input=words, size=8)
+    lstm = paddle.layer.networks.simple_lstm(input=emb, size=12)
+    last = paddle.layer.last_seq(input=lstm)
+    pred = paddle.layer.fc(last, size=2, act=paddle.activation.Softmax())
+    label = paddle.layer.data("label", paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=pred, label=label)
+    return paddle.trainer.SGD(
+        cost=cost,
+        parameters=paddle.parameters.create(cost, seed=seed),
+        update_equation=paddle.optimizer.Adam(learning_rate=2e-2),
+        mesh=make_mesh(data=-1, model=1),
+    )
+
+
+def _lstm_reader(vocab, n_batches=8, rows=16, seq_len=10):
+    """Label = whether the LAST token is in the top half of the vocab —
+    exactly what last_seq over an LSTM can learn quickly."""
+    rng = np.random.RandomState(13)
+    data = []
+    for _ in range(n_batches * rows):
+        seq = rng.randint(2, vocab, size=seq_len).tolist()
+        data.append((seq, int(seq[-1] >= vocab // 2)))
+
+    def read():
+        for row in data:
+            yield row
+
+    return paddle.batch(read, rows)
+
+
+def test_lstm_convergence_ab():
+    vocab = 50
+
+    def arm():
+        t = _lstm_trainer(vocab)
+        return _train_costs(t, _lstm_reader(vocab), num_passes=8)
+
+    costs_f32 = arm()
+    flags.set_flag("quantized_allreduce", True)
+    costs_q = arm()
+    _assert_converged_close(costs_f32, costs_q)
+
+
+# ---------------------------------------------------------------------------
+# serving int8 weight-only decode
+# ---------------------------------------------------------------------------
+
+
+def test_serving_int8_drift_and_capacity():
+    from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+    from paddle_tpu.serving import ServingEngine
+
+    V, E, H, MAXLEN = 96, 24, 32, 12
+
+    def build(int8):
+        reset_auto_names()
+        cost, _ = seq2seq_cost(V, V, word_dim=E, hidden_dim=H)
+        params = paddle.parameters.create(cost, seed=7)
+        gen = Seq2SeqGenerator(
+            params, V, V, word_dim=E, hidden_dim=H,
+            bos_id=0, eos_id=1, max_length=MAXLEN,
+        )
+        return ServingEngine(gen, max_slots=8, hbm_budget_mb=2,
+                             max_new_tokens=MAXLEN, int8_weights=int8)
+
+    f32 = build(False)
+    q8 = build(True)
+    assert not f32.int8_weights and q8.int8_weights
+
+    budget = float(flags.get_flag("serving_int8_drift_budget"))
+    drift = q8.weight_drift()
+    assert 0.0 < drift < budget, (drift, budget)
+    assert f32.weight_drift() == 0.0
+
+    # resident weight bytes shrink ~4x; decode slots per GB go UP
+    assert f32.weight_bytes > 2.5 * q8.weight_bytes
+    assert q8.slots_per_gb(16) > f32.slots_per_gb(16)
+
+    # the quantized engine still decodes: every request completes, and
+    # most outputs match the f32 argmax (ties may legitimately flip)
+    rng = np.random.RandomState(3)
+    srcs = [rng.randint(2, V, size=6).tolist() for _ in range(6)]
+    outs_f = [f32.reference_decode(s, MAXLEN) for s in srcs]
+    outs_q = [q8.reference_decode(s, MAXLEN) for s in srcs]
+    assert all(len(o) > 0 for o in outs_q)
+    same = sum(a == b for a, b in zip(outs_f, outs_q))
+    assert same >= len(srcs) // 2, (same, len(srcs))
+
+    summ = q8.summary()
+    assert summ["int8_weights"] is True
+    assert summ["weight_bytes"] == q8.weight_bytes
+    assert summ["slots_per_gb"] > 0
