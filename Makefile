@@ -18,10 +18,13 @@
 #                  Zero findings = pass.
 #   make test    — fast tier: lint, then every test not marked `slow`;
 #                  < 6 min on the virtual 8-device CPU mesh.  The CI gate.
-#   make verify  — the full suite, then a bench smoke (one metric), the
-#                  AOT-cache warm-boot record (cold/warm compile counts +
-#                  wall time, dispatches-per-epoch) and the 8-device
-#                  multichip dry-run compile.
+#   make verify  — the full suite, then the decode-speed gate (beam-5
+#                  nmt_generate + spec-decode/prefix-cache A/B under the
+#                  bench regression guard — any >5%-worse-than-history
+#                  metric fails the target), a bench smoke (one metric),
+#                  the AOT-cache warm-boot record (cold/warm compile
+#                  counts + wall time, dispatches-per-epoch) and the
+#                  8-device multichip dry-run compile.
 #   make bench   — the full benchmark set (one JSON line per metric).
 #   make tier1-check / tier1-update — diff (or re-snapshot) the tier-1
 #                  failing-test SET against tests/tier1_failures_baseline.txt
@@ -50,7 +53,10 @@
 #                  worker partitioned mid-pass rejoins bit-for-bit, and
 #                  the leader<->standby asymmetric-partition split-brain
 #                  ends with exactly one fenced leader, zero tasks lost,
-#                  a clean surviving journal).
+#                  a clean surviving journal), and the decode-speed
+#                  drills (tests/test_decode_speed_e2e.py: shared-prefix
+#                  open-loop load over the COW cache, speculative decode
+#                  under load, cancel-mid-speculation page drain).
 #   make scenarios — the fast production-gate scenario subset
 #                  (robustness/scenarios.py via `paddle-tpu scenario
 #                  --all-fast`), sanitizer-armed: overload shed-not-
@@ -115,6 +121,7 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_serving_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_scenarios_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_netem_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_decode_speed_e2e.py -q
 	$(MAKE) trace-demo
 
 # the obs-plane acceptance drill (sanitizer-armed: the traced scenario
@@ -133,6 +140,7 @@ test-all:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
 
 verify: test-all
+	$(CPU_ENV) $(PY) -c "import bench; bench.run_gated('nmt_generate', 'decode_speed')"
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_allreduce_virtual8())"
 	$(CPU_ENV) $(PY) -c "import bench; print(bench.bench_scaling_virtual8())"
 	$(CPU_ENV) $(PY) -c "import bench; [print(r) for r in bench.bench_quantized()]"

@@ -811,6 +811,240 @@ def bench_serving() -> list:
     ]
 
 
+def bench_decode_speed() -> list:
+    """Decode raw speed (PR 17): the tentpole pair A/B-measured on the
+    container-sized NMT flagship shape.
+
+    * speculative decoding — n-gram draft + verify-K in ONE dispatch vs
+      the plain greedy block-decode loop, SAME requests: tokens/s both
+      arms, accept rate, and outputs asserted BIT-IDENTICAL (rejection
+      falls back to the true argmax chain);
+    * COW prefix sharing — PrefixMixer traffic (pooled prefixes + exact
+      duplicates) through the threaded scheduler under open-loop load:
+      hit rate, shared-block peak, and served p99 per-token latency
+      gated against the PR-12 SLO (<= 1.05x the one-shot eager p99,
+      the bench_serving discipline) with sharing ON."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.topology import reset_auto_names
+    from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+    from paddle_tpu.reader.feeder import DataFeeder
+    from paddle_tpu.core.batch import DEFAULT_LADDER
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+    from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
+
+    reset_auto_names()
+    vocab, word_dim, hidden, max_new = 1000, 128, 128, 24
+    n_requests, max_slots, k_steps = 32, 16, 8
+    cost, _ = seq2seq_cost(vocab, vocab, word_dim=word_dim, hidden_dim=hidden)
+    params = paddle.parameters.create(cost, seed=0)
+    gen = Seq2SeqGenerator(
+        params, vocab, vocab, word_dim=word_dim, hidden_dim=hidden,
+        bos_id=0, eos_id=1, max_length=max_new,
+    )
+    rng = np.random.RandomState(1)
+    srcs = [
+        rng.randint(2, vocab, size=rng.randint(4, 31)).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def make_engine(**kw):
+        return ServingEngine(
+            gen, max_slots=max_slots, hbm_budget_mb=16,
+            max_new_tokens=max_new, block_steps=k_steps, **kw,
+        )
+
+    def prewarm(eng):
+        for gsz in (1, 2, 4, 8, 16):
+            for src_len in (5, 20):  # 1-page and 2-page rungs
+                eng.admit([Request([2] * src_len) for _ in range(gsz)])
+                while eng.n_live:
+                    eng.step()
+
+    def run_engine(eng, reqs):
+        pending = list(reqs)
+        t0 = time.perf_counter()
+        while pending or eng.n_live or eng.n_prefilling:
+            if pending:
+                admitted = eng.admit(pending)
+                pending = pending[len(admitted):]
+            eng.step()
+        return time.perf_counter() - t0
+
+    # -- A/B: greedy block decode vs speculative verify-K -----------------
+    greedy = make_engine(spec_decode=False)
+    refs = [greedy.reference_decode(s, max_new) for s in srcs]
+    prewarm(greedy)
+    g_reqs = [Request(s) for s in srcs]
+    g_wall = run_engine(greedy, g_reqs)
+    g_tokens = sum(len(r.tokens) for r in g_reqs)
+    assert all(r.tokens == ref for r, ref in zip(g_reqs, refs))
+
+    spec = make_engine(spec_decode=True)
+    prewarm(spec)
+    s_reqs = [Request(s) for s in srcs]
+    s_wall = run_engine(spec, s_reqs)
+    s_tokens = sum(len(r.tokens) for r in s_reqs)
+    # the acceptance bit: speculation NEVER changes a token
+    spec_identical = all(r.tokens == ref for r, ref in zip(s_reqs, refs))
+    assert spec_identical, "speculative decode diverged from greedy"
+
+    # -- COW prefix sharing under open-loop load --------------------------
+    mixer = PrefixMixer(
+        vocab, pool_size=4, prefix_frac=0.6, prefix_tokens=16,
+        tail_tokens=10, dup_frac=0.5, seed=4,
+    )
+    p_srcs = [mixer.source(i) for i in range(n_requests)]
+    shared = make_engine(prefix_cache=True)
+    p_refs = [shared.reference_decode(s, max_new) for s in p_srcs]
+    prewarm(shared)
+    # the prewarm wave's duplicate prompts hit the cache too — zero the
+    # counters so the reported rate covers ONLY the measured traffic
+    shared.prefix_hits = shared.prefix_misses = 0
+
+    # one-shot EAGER p99 (the pre-serving path, retraced per call): the
+    # PR-12 SLO reference the served p99 is gated against
+    feeder = DataFeeder(
+        gen._enc_net.topology.data_types(), ladder=DEFAULT_LADDER,
+        min_seq_len=1,
+    )
+    eager_tpot = []
+    for s in p_srcs[:6]:
+        r0 = time.perf_counter()
+        _, lens = gen.generate_greedy(feeder([(s,)]), max_new_tokens=max_new)
+        n = int(np.asarray(lens)[0])
+        eager_tpot.append((time.perf_counter() - r0) / max(n, 1))
+
+    peak_shared = [0]
+
+    def on_done(_r):
+        # sampled at each completion, while other same-prefix requests
+        # are still live over the shared mapping
+        peak_shared[0] = max(peak_shared[0], shared.pages.n_shared)
+
+    p_reqs = [Request(s, callback=on_done) for s in p_srcs]
+    with ServingScheduler(shared) as sched:
+        t1 = time.perf_counter()
+        # offered fast enough that same-prefix requests OVERLAP in
+        # flight (the condition under which sharing holds one copy);
+        # queue wait is excluded from the tpot gate (t_admit-based)
+        OpenLoopLoadGen(
+            100.0, len(p_reqs), lambda i: p_reqs[i], seed=4
+        ).run(sched.submit)
+        for r in p_reqs:
+            if not r.wait(300):
+                raise RuntimeError(f"unserved request {r.req_id}")
+        p_wall = time.perf_counter() - t1
+    assert all(
+        r.error is None and r.tokens == ref
+        for r, ref in zip(p_reqs, p_refs)
+    ), "prefix-shared decode diverged from the one-shot path"
+    assert shared.prefix_hits > 0, "the duplicate-heavy mix never hit"
+    assert shared.pages.n_used == 0, shared.pages.summary()
+
+    def pct(xs, p):
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    tpots = sorted(
+        (r.t_done - r.t_admit) / max(len(r.tokens), 1) for r in p_reqs
+    )
+    p99_shared = pct(tpots, 0.99)
+    p99_eager = pct(sorted(eager_tpot), 0.99)
+    slo_ok = p99_shared <= p99_eager * 1.05
+    assert slo_ok, (
+        f"prefix sharing blew the PR-12 p99 SLO: "
+        f"{p99_shared * 1e3:.2f} vs {p99_eager * 1e3:.2f} ms eager"
+    )
+    hit_rate = shared.prefix_hits / max(
+        shared.prefix_hits + shared.prefix_misses, 1
+    )
+    return [
+        {
+            "metric": "spec_decode_tokens_per_sec",
+            "value": round(s_tokens / s_wall, 1),
+            "unit": "tokens/sec",
+            "greedy_tokens_per_sec": round(g_tokens / g_wall, 1),
+            "speedup_vs_greedy": round(
+                (s_tokens / s_wall) / (g_tokens / g_wall), 3
+            ),
+            "accept_rate": round(spec.spec_accept_rate(), 4),
+            "drafted": spec.spec_proposed,
+            "accepted": spec.spec_accepted,
+            "spec_ngram": spec.spec_ngram,
+            "verify_block_steps": k_steps,
+            "bit_identical_to_greedy": spec_identical,
+            "n_requests": n_requests,
+            "binds": "same requests through the same engine shape, spec "
+            "ON vs OFF; the verify program hoists all K draft embeddings "
+            "into one batched GEMM, and a rejected draft costs nothing "
+            "but the unconsumed tail of its dispatch (the emitted tokens "
+            "are the true argmax chain either way).  On this CPU host "
+            "both arms are compute-bound, so the ratio isolates the "
+            "dispatch/hoist arithmetic, not an HBM win.  Note the greedy "
+            "arm's block loop ALREADY emits K exact tokens per dispatch "
+            "on this recurrent decoder (the amortization speculation buys "
+            "architectures whose step can't scan), so spec trades emitted "
+            "tokens for draft verification here — the guard pins that "
+            "trade from getting worse, not a speedup claim",
+        },
+        {
+            "metric": "spec_accept_rate",
+            "value": round(spec.spec_accept_rate(), 4),
+            "unit": "fraction of drafted tokens confirmed",
+            "drafted": spec.spec_proposed,
+            "accepted": spec.spec_accepted,
+            "spec_ngram": spec.spec_ngram,
+        },
+        {
+            "metric": "prefix_cache_hit_rate",
+            "value": round(hit_rate, 4),
+            "unit": "fraction of admissions mapping warmed blocks",
+            "hits": shared.prefix_hits,
+            "misses": shared.prefix_misses,
+            "entries": shared.prefix_cache_len,
+            "peak_pages_shared": peak_shared[0],
+            "pages_retained": shared.pages.n_retained,
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in p_reqs) / p_wall, 1
+            ),
+            "p99_token_ms": round(p99_shared * 1e3, 3),
+            "eager_p99_token_ms": round(p99_eager * 1e3, 3),
+            "meets_p99_slo": slo_ok,
+            "bit_identical_to_oneshot": True,
+            "binds": "PrefixMixer open-loop mix (pool 4, prefix_frac "
+            "0.6, dup_frac 0.5): every duplicate prompt admits with ZERO "
+            "prefill dispatches over refcount-shared blocks; p99 "
+            "per-token latency gated <= 1.05x the one-shot eager path "
+            "(the PR-12 SLO discipline) with sharing ON",
+        },
+    ]
+
+
+def run_gated(*names) -> None:
+    """Run named bench arms under the regression guard (the `make verify`
+    legs): each ``bench_<name>()`` result gets best_prior/regressed fields
+    against the committed BENCH_r*.json history, a REGRESSION_GUARD line
+    sums them up, and any regression (or non-finite metric) exits
+    nonzero — the same discipline `make bench` applies to the full set."""
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    prior = load_prior_bench(repo_dir)
+    results = []
+    for name in names:
+        rs = globals()["bench_" + name]()
+        for r in rs if isinstance(rs, list) else [rs]:
+            r.update(regression_fields(
+                r.get("metric", ""), r.get("value"), r.get("unit"), prior
+            ))
+            results.append(r)
+            print(json.dumps(r), flush=True)
+    guard = build_guard(results)
+    print(json.dumps(guard), flush=True)
+    if guard["regressed"] or guard["non_finite"]:
+        raise SystemExit(
+            "bench regression vs committed history: "
+            + json.dumps(guard["regressed"] + guard["non_finite"])
+        )
+
+
 def bench_scenarios() -> list:
     """Production-gate scenario record (ROADMAP item 5): the scenario
     harness (robustness/scenarios.py) run under the bench regression
@@ -2855,6 +3089,7 @@ def main() -> None:
     prior = load_prior_bench(repo_dir)
     results = []
     for fn in (bench_resnet, bench_nmt, bench_nmt_generate, bench_serving,
+               bench_decode_speed,
                bench_scenarios, bench_tracing_overhead,
                bench_allreduce,
                bench_allreduce_virtual8, bench_scaling_virtual8,

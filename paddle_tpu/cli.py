@@ -716,12 +716,27 @@ def cmd_serve(argv: List[str]) -> int:
                     help="chunked prefill bound (default: the "
                     "serving_prefill_chunk_tokens flag; 0 = whole-prompt "
                     "prefill)")
+    ap.add_argument("--prefix-cache", action="store_true", default=None,
+                    help="arm copy-on-write prompt-prefix sharing (default: "
+                    "the serving_prefix_cache flag)")
+    ap.add_argument("--spec-decode", action="store_true", default=None,
+                    help="arm n-gram speculative decoding (default: the "
+                    "serving_spec_decode flag)")
     ap.add_argument("--drain-timeout-s", type=float, default=60.0,
                     help="graceful-drain budget after SIGTERM/SIGINT")
     ap.add_argument("--requests", default="",
                     help="file of requests (space-separated src ids/line)")
     ap.add_argument("--synthetic", type=int, default=16,
                     help="generate N random requests when --requests is empty")
+    ap.add_argument("--prefix-pool", type=int, default=0,
+                    help="share prompt prefixes across synthetic requests: "
+                    "draw from a seeded pool of N prefixes "
+                    "(reader/loadgen.PrefixMixer) — the realistic workload "
+                    "for the serving_prefix_cache COW sharing path; 0 = "
+                    "fully independent prompts")
+    ap.add_argument("--prefix-frac", type=float, default=0.5,
+                    help="fraction of synthetic requests that start with a "
+                    "pool prefix (only with --prefix-pool)")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="open-loop arrival rate (req/s); 0 = submit all "
                     "immediately")
@@ -775,6 +790,8 @@ def cmd_serve(argv: List[str]) -> int:
         hbm_budget_mb=args.hbm_budget_mb,
         max_new_tokens=args.max_new_tokens,
         prefill_chunk_tokens=args.prefill_chunk_tokens,
+        prefix_cache=args.prefix_cache,
+        spec_decode=args.spec_decode,
     )
 
     if args.requests:
@@ -782,6 +799,14 @@ def cmd_serve(argv: List[str]) -> int:
             sources = [
                 [int(t) for t in line.split()] for line in f if line.strip()
             ]
+    elif args.prefix_pool > 0:
+        from paddle_tpu.reader.loadgen import PrefixMixer
+
+        mixer = PrefixMixer(
+            args.src_vocab, pool_size=args.prefix_pool,
+            prefix_frac=args.prefix_frac, seed=args.seed,
+        )
+        sources = [mixer.source(i) for i in range(args.synthetic)]
     else:
         rng = np.random.RandomState(args.seed)
         sources = [

@@ -26,6 +26,36 @@ L = paddle.layer
 A = paddle.activation
 
 
+def make_fused_step(w, enc, ep, emask, *, gate_act, act, att_act):
+    """``step(ids [N], h [N,H]) -> (logp [N,V], h_t [N,H])`` over the fused
+    attention-GRU decode chain — THE per-token numerical contract every
+    decode face shares: the one-shot beam/greedy path here, and the
+    serving plane's paged beam program (serving/engine.py) gathers its
+    ``enc``/``ep`` through the page table and calls this same builder.
+    One closure, one chain, bit-identity by construction.
+
+    ``ep`` must already carry the folded state-projection bias (sp_b adds
+    at prefill time, never per step); ``w`` is the
+    :meth:`Seq2SeqGenerator.fused_decode_weights` bundle."""
+    from paddle_tpu.ops.rnn import attention_gru_step
+
+    def step(ids, h):
+        xg = jnp.take(w["emb_w"], ids, axis=0) @ w["w_emb"]
+        if w["xg_bias"] is not None:
+            xg = xg + w["xg_bias"]
+        h_t = attention_gru_step(
+            xg, h, enc, ep, emask, w["w1"], w["v"], w["w_ctx"], w["w_c"],
+            gate_act=gate_act, act=act, att_act=att_act,
+        )
+        logits = h_t @ w["head_w"]
+        if w["head_b"] is not None:
+            logits = logits + w["head_b"]
+        prob = jax.nn.softmax(logits, axis=-1)
+        return jnp.log(jnp.maximum(prob, 1e-9)), h_t
+
+    return step
+
+
 def encoder_net(
     src_word: LayerOutput, word_dim: int, hidden_dim: int
 ) -> Tuple[LayerOutput, LayerOutput]:
@@ -307,8 +337,6 @@ class Seq2SeqGenerator:
         m0 = self._memories[0] if self._memories else None
 
         if self._match is not None and get_flag("fused_attention_gru"):
-            from paddle_tpu.ops.rnn import attention_gru_step
-
             mt = self._match
             w = self.fused_decode_weights(gp)
             enc_t = statics[mt.enc_name]
@@ -316,21 +344,14 @@ class Seq2SeqGenerator:
             if w["sp_b"] is not None:
                 ep = ep + w["sp_b"]
             emask = enc_t.mask(bool) if enc_t.lengths is not None else None
+            fused = make_fused_step(
+                w, enc_t.data, ep, emask,
+                gate_act=mt.gate_act, act=mt.act, att_act=mt.att_act,
+            )
 
             def step_fn(ids, carry):
-                xg = jnp.take(w["emb_w"], ids, axis=0) @ w["w_emb"]
-                if w["xg_bias"] is not None:
-                    xg = xg + w["xg_bias"]
-                h_t = attention_gru_step(
-                    xg, carry[m0.name], enc_t.data, ep, emask, w["w1"],
-                    w["v"], w["w_ctx"], w["w_c"],
-                    gate_act=mt.gate_act, act=mt.act, att_act=mt.att_act,
-                )
-                logits = h_t @ w["head_w"]
-                if w["head_b"] is not None:
-                    logits = logits + w["head_b"]
-                prob = jax.nn.softmax(logits, axis=-1)
-                return jnp.log(jnp.maximum(prob, 1e-9)), {m0.name: h_t}
+                logp, h_t = fused(ids, carry[m0.name])
+                return logp, {m0.name: h_t}
 
             return step_fn
 

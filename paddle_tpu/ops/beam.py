@@ -32,6 +32,19 @@ import jax.numpy as jnp
 NEG_INF = -1e9
 
 
+def greedy_token_chain(logits):
+    """``(logp [.., V], nxt [..])`` from raw logits via THE greedy argmax
+    chain — softmax, floor at 1e-9, log, argmax — the exact op sequence
+    the one-shot generator emits (its head produces probabilities and
+    :func:`greedy_search` consumes ``log(max(prob, 1e-9))``).  The serving
+    plane's fused decode AND speculative-verify programs call this so
+    every token they emit rode bit-for-bit the same chain: speculative
+    rejection "falls back to greedy" by construction, not by tolerance."""
+    prob = jax.nn.softmax(logits, axis=-1)
+    logp = jnp.log(jnp.maximum(prob, 1e-9))
+    return logp, jnp.argmax(logp, axis=-1).astype(jnp.int32)
+
+
 def beam_search(
     step_fn: Callable[[jnp.ndarray, Any], Tuple[jnp.ndarray, Any]],
     init_carry: Any,

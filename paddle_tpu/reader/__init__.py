@@ -14,5 +14,5 @@ from paddle_tpu.reader.decorator import (  # noqa: F401
     xmap_readers,
 )
 from paddle_tpu.reader.feeder import DataFeeder  # noqa: F401
-from paddle_tpu.reader.loadgen import OpenLoopLoadGen  # noqa: F401
+from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer  # noqa: F401
 from paddle_tpu.reader.pass_cache import PassCache  # noqa: F401

@@ -24,7 +24,73 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
-__all__ = ["OpenLoopLoadGen"]
+__all__ = ["OpenLoopLoadGen", "PrefixMixer"]
+
+
+class PrefixMixer:
+    """Seeded shared-prefix traffic shaper for synthetic sources — the
+    workload half of the copy-on-write prefix cache (serving/engine.py):
+    production request streams repeat system prompts and conversation
+    heads, so the bench/scenario traffic must too, or the cache's hit
+    path never executes under load.
+
+    A pool of ``pool_size`` prefixes (each ``prefix_tokens`` long) is
+    drawn once from ``seed``; :meth:`source` then makes the i-th request's
+    source ids — with probability ``prefix_frac`` a pool prefix (chosen
+    round-robin so every pool entry warms) followed by a fresh random
+    tail, otherwise a fully fresh source.  FULL-duplicate prompts (tail
+    length 0) arise with ``dup_frac``, exercising the exact-prompt hit
+    path; everything is deterministic in (seed, i).
+
+    Vocab ids draw from [2, vocab) — 0/1 stay reserved for BOS/EOS,
+    matching the serving CLI's synthetic sources."""
+
+    def __init__(
+        self,
+        vocab: int,
+        *,
+        pool_size: int = 4,
+        prefix_frac: float = 0.5,
+        prefix_tokens: int = 12,
+        tail_tokens: int = 8,
+        dup_frac: float = 0.25,
+        seed: int = 0,
+    ):
+        if not 0.0 <= prefix_frac <= 1.0:
+            raise ValueError("prefix_frac must be in [0, 1]")
+        if not 0.0 <= dup_frac <= 1.0:
+            raise ValueError("dup_frac must be in [0, 1]")
+        if pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
+        self.vocab = int(vocab)
+        self.pool_size = int(pool_size)
+        self.prefix_frac = float(prefix_frac)
+        self.dup_frac = float(dup_frac)
+        rng = np.random.RandomState(seed)
+        self.pool: List[List[int]] = [
+            rng.randint(2, vocab, size=prefix_tokens).tolist()
+            for _ in range(self.pool_size)
+        ]
+        self._tail_tokens = int(tail_tokens)
+        self._seed = int(seed)
+
+    def source(self, i: int) -> List[int]:
+        """Source ids of the i-th request — deterministic in (seed, i),
+        independent of call order (each request derives its own RNG), so
+        a replayed drill offers the identical prompt stream."""
+        rng = np.random.RandomState((self._seed * 1_000_003 + i) & 0x7FFFFFFF)
+        if rng.random_sample() >= self.prefix_frac:
+            n = 1 + rng.randint(
+                self._tail_tokens + len(self.pool[0])
+            )
+            return rng.randint(2, self.vocab, size=n).tolist()
+        prefix = self.pool[i % self.pool_size]
+        if rng.random_sample() < self.dup_frac:
+            return list(prefix)  # exact repeat: the full-prompt hit path
+        tail = rng.randint(
+            2, self.vocab, size=1 + rng.randint(self._tail_tokens)
+        ).tolist()
+        return list(prefix) + tail
 
 
 class OpenLoopLoadGen:

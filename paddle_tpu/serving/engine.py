@@ -42,11 +42,36 @@ executes the identical per-step op sequence as the unsplit scan (pinned
 in tests/test_serving.py against the one-shot path).  The chunk programs
 are four fixed-shape jits (fw scan, bw scan, scatter+project, boot
 write) counted under ``trace_counts['prefill_chunk']``.
+
+**Decode raw speed (PR 17)** adds three faces over the same two pools:
+
+* *COW prefix sharing* (``serving_prefix_cache``): finished prompts park
+  their pages + captured boot state in a cache keyed on signature-seeded
+  token-block hash chains; an exact-prompt repeat maps the SAME blocks
+  into its page table (refcount +1) and decodes immediately — zero
+  prefill dispatches, bit-identical by construction.  Exact-prompt-only
+  because the bi-GRU's backward direction makes every encoded position
+  suffix-dependent; partial overlap instead resumes the chunked
+  prefill's FORWARD pass from cached carries (prefix-determined, so
+  bit-exact).  Writes go through the :meth:`ServingEngine.ensure_private_pages`
+  COW barrier; blocks free only at refcount 0; eviction is LRU over
+  refcount-0 blocks under the same ``serving_hbm_budget_mb``.
+* *Speculative decoding* (``serving_spec_decode``): an n-gram
+  prompt-lookup draft proposes K tokens and ONE dispatch (the decode
+  program family's shape, draft-teacher-forced so the embedding GEMM
+  hoists out of the scan) verifies them against the target's own argmax
+  chain — the emitted tokens ARE the greedy chain's, acceptance only
+  changes how many land per dispatch.
+* *Paged beam serving*: a request with ``beam_size`` runs
+  ops/beam.beam_search over the page-table-gathered memory with the SAME
+  fused step closure the one-shot path uses
+  (models/seq2seq.make_fused_step) — beam decode as a serving citizen.
 """
 
 from __future__ import annotations
 
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -63,6 +88,7 @@ from paddle_tpu.core.batch import (
 from paddle_tpu import obs as _obs
 from paddle_tpu.core.compiler import CompileShapeCache
 from paddle_tpu.ops import acc_matmul
+from paddle_tpu.ops.beam import greedy_token_chain
 from paddle_tpu.ops.rnn import attention_gru_step
 from paddle_tpu.serving.pages import BlockPagedCache
 
@@ -70,15 +96,21 @@ __all__ = ["ServingEngine"]
 
 
 class _Slot:
-    """One in-flight sequence: its page-table row + host-side decode state."""
+    """One in-flight sequence: its page-table row + host-side decode state.
+
+    ``beam`` > 0 routes the slot through the paged whole-sequence beam
+    program instead of the continuous greedy/speculative loop; ``boot_h``
+    holds the host copy of the decoder boot state captured after prefill
+    (None unless the prefix cache is on and the slot prefilled cleanly) —
+    it becomes the cache entry's resume state when the slot retires."""
 
     __slots__ = (
         "request", "pages", "enc_tokens", "last_id", "tokens", "max_new",
-        "admit_seq",
+        "admit_seq", "beam", "boot_h",
     )
 
     def __init__(self, request, pages, enc_tokens, last_id, tokens, max_new,
-                 admit_seq):
+                 admit_seq, beam=0, boot_h=None):
         self.request = request
         self.pages = pages
         self.enc_tokens = enc_tokens
@@ -86,6 +118,8 @@ class _Slot:
         self.tokens = tokens
         self.max_new = max_new
         self.admit_seq = admit_seq
+        self.beam = beam
+        self.boot_h = boot_h
 
 
 class _PendingPrefill:
@@ -144,6 +178,9 @@ class ServingEngine:
         prefill_chunk_tokens: Optional[int] = None,
         aot_cache_dir: Optional[str] = None,
         int8_weights: Optional[bool] = None,
+        prefix_cache: Optional[bool] = None,
+        spec_decode: Optional[bool] = None,
+        spec_ngram: Optional[int] = None,
         clock=time.perf_counter,
         stats=None,
     ):
@@ -270,6 +307,59 @@ class ServingEngine:
         self._free_slots = list(range(self.max_slots - 1, -1, -1))
         self._admit_seq = 0
 
+        # -- copy-on-write prefix cache (serving_prefix_cache) ------------
+        # Full-prompt entries only: the bi-GRU encoder's BACKWARD direction
+        # makes every encoded position depend on the prompt SUFFIX, so a
+        # cached block is bit-identical for a new request only when the
+        # ENTIRE prompt matches — partial-prefix overlap reuses the cached
+        # forward-GRU carries on the chunked path (below) instead.  The
+        # key chains per-block token-tuple hashes seeded by the engine
+        # signature (topology fingerprint + feed dtype + source slot/vocab
+        # + special ids + weight precision): two engines that tokenize or
+        # compute differently can NEVER alias an entry, and the stored
+        # exact token tuple makes hash collisions a miss, not a wrong hit.
+        self.prefix_cache_enabled = bool(
+            prefix_cache if prefix_cache is not None
+            else _flags.get_flag("serving_prefix_cache")
+        )
+        from paddle_tpu.core import aot_cache as _aotmod
+
+        self._cache_sig = (
+            _aotmod.topology_fingerprint(self._gen.net),
+            str(jnp.dtype(self._dtype)),
+            self.src_slot,
+            self.src_vocab,
+            int(self._gen.bos_id),
+            int(self._gen.eos_id),
+            self.int8_weights,
+        )
+        self._cache_sig_hash = hash(self._cache_sig)
+        # key -> {tokens, pages, boot_h, enc_tokens}; block id -> owning key
+        self._prefix_cache: Dict[tuple, Dict[str, Any]] = {}
+        self._prefix_owner: Dict[int, tuple] = {}
+        self._pages.on_evict = self._on_block_evicted
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        # forward-GRU carry cache for chunked prefills: prompt-prefix (at
+        # chunk boundaries, fully inside the true length) -> carried fw
+        # state + per-chunk activations; a new long prompt resumes its fw
+        # pass at the longest cached boundary (the bw pass always re-runs —
+        # it reads the suffix).  Bounded LRU; device arrays are read-only.
+        self._fw_cache: "OrderedDict[tuple, Dict[str, Any]]" = OrderedDict()
+        self._fw_cache_cap = 8
+
+        # -- speculative decoding (serving_spec_decode) --------------------
+        self.spec_decode = bool(
+            spec_decode if spec_decode is not None
+            else _flags.get_flag("serving_spec_decode")
+        )
+        self.spec_ngram = max(1, int(
+            spec_ngram if spec_ngram is not None
+            else _flags.get_flag("serving_spec_ngram")
+        ))
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
         # chunked prefill: validate the chunk bound against the block size
         # and the ladder (every taller rung must split into whole chunks),
         # then extract the encoder weight bundle — an unmatched topology
@@ -299,9 +389,14 @@ class ServingEngine:
         # contract training feeds use; decode keys are (slot-rung,
         # page-rung) pairs counted through the same StatSet surface
         self.prefill_shapes = CompileShapeCache("serving_prefill", self._stats)
-        self.trace_counts = {"prefill": 0, "decode": 0, "prefill_chunk": 0}
+        self.trace_counts = {
+            "prefill": 0, "decode": 0, "prefill_chunk": 0, "verify": 0,
+            "beam": 0,
+        }
         self._prefill_jit = self._make_prefill()
         self._decode_table: Dict[Tuple[int, int], Any] = {}
+        self._verify_table: Dict[Tuple[int, int], Any] = {}
+        self._beam_table: Dict[Tuple[int, int, int], Any] = {}
         self._prefill_table: Dict[tuple, Any] = {}
         self._ref_table: Dict[tuple, Any] = {}
         self._chunk_jits: Optional[Dict[str, Any]] = (
@@ -354,12 +449,14 @@ class ServingEngine:
         for sid, s in self._slots.items():
             if s.request is request:
                 self._slots.pop(sid)
-                self._pages.free(s.pages)
+                self._release_slot_pages(s)
                 self._free_slots.append(sid)
                 self._stats.incr("serving/canceled")
                 return True
         for sid, p in self._prefilling.items():
             if p.request is request:
+                # mid-chunked-prefill pages are only PARTIALLY written —
+                # never cacheable, straight back to the free list
                 self._prefilling.pop(sid)
                 self._pages.free(p.pages)
                 self._free_slots.append(sid)
@@ -378,6 +475,111 @@ class ServingEngine:
                 self.cancel(p.request)
                 return p.request
         return None
+
+    # -- copy-on-write prefix cache ---------------------------------------
+    def _prefix_key(self, tokens) -> tuple:
+        """Cache key of a full prompt: the per-block hash chain seeded by
+        the engine signature.  Chaining block-tuple hashes (not one flat
+        hash) is what lets the same arithmetic address block-aligned
+        prefixes, and the signature seed is the ISSUE's aliasing guard —
+        a different topology fingerprint, feed dtype or tokenizer
+        (source slot/vocab, special ids) can never produce this key."""
+        h = self._cache_sig_hash
+        toks = [int(t) for t in tokens]
+        for i in range(0, len(toks), self.block_tokens):
+            h = hash((h, tuple(toks[i:i + self.block_tokens])))
+        return (h, len(toks))
+
+    def _prefix_lookup(self, src):
+        """(key, entry) when the FULL prompt is cached, else None.  The
+        stored exact token tuple is compared on every hit, so a hash
+        collision degrades to a miss — aliasing is structurally off."""
+        key = self._prefix_key(src)
+        ent = self._prefix_cache.get(key)
+        if ent is None or ent["tokens"] != tuple(int(t) for t in src):
+            return None
+        return key, ent
+
+    def _release_slot_pages(self, s: _Slot) -> None:
+        """Retire/cancel/preempt all funnel here — THE prefix-cache
+        insertion point.  A slot whose pages ARE the cache entry releases
+        with retain (the entry's blocks park refcount-0 in the warm LRU
+        pool); a slot with a captured boot state and no entry yet INSERTS
+        one (its fully-written pages become the shared copy); anything
+        else — cache off, resumed prefill (no clean boot), a COW'd or
+        duplicate-prompt slot — frees normally."""
+        if not self.prefix_cache_enabled:
+            self._pages.free(s.pages)
+            return
+        key = self._prefix_key(s.request.src_ids)
+        ent = self._prefix_cache.get(key)
+        if ent is not None and list(ent["pages"]) == list(s.pages):
+            self._pages.release(s.pages, retain=True)
+            return
+        if ent is None and s.boot_h is not None:
+            self._prefix_cache[key] = {
+                "tokens": tuple(int(t) for t in s.request.src_ids),
+                "pages": list(s.pages),
+                "boot_h": s.boot_h,
+                "enc_tokens": s.enc_tokens,
+            }
+            for p in s.pages:
+                self._prefix_owner[p] = key
+            self._pages.release(s.pages, retain=True)
+            self._stats.incr("serving/prefix_inserted")
+            return
+        self._pages.free(s.pages)
+
+    def _on_block_evicted(self, block: int) -> None:
+        """Allocator reclaimed a retained block (LRU, under HBM pressure):
+        the entry owning it just lost bytes — drop the WHOLE entry so a
+        later hit can never map a half-dead prefix.  Its surviving blocks
+        stay in the retained pool as plain reclaimable capacity."""
+        key = self._prefix_owner.pop(block, None)
+        if key is None:
+            return
+        ent = self._prefix_cache.pop(key, None)
+        if ent is not None:
+            for p in ent["pages"]:
+                self._prefix_owner.pop(p, None)
+            self._stats.incr("serving/prefix_evicted")
+
+    def ensure_private_pages(self, s: _Slot) -> bool:
+        """The copy-on-write barrier: called before ANY write into a
+        slot's encoder pages, it swaps every block the slot shares with
+        another reader (refcount >= 2) for a fresh private copy — pool
+        rows copied FIRST, page table remapped after — so a write can
+        never mutate bytes another sequence is attending over.  False =
+        no blocks for the copies (caller must wait; state untouched).
+
+        The shipped decode/verify/beam programs write only the slot plane
+        (``_h``) and READ the encoder pools, so shared pages are safe
+        during decode by construction; this barrier is the mandatory
+        gate for any pool-writing path (and what the COW-safety tests
+        pin)."""
+        if all(self._pages.refcount(p) == 1 for p in s.pages):
+            return True
+        new_pages, copies = self._pages.cow(s.pages)
+        if new_pages is None:
+            return False
+        src = jnp.asarray([a for a, _ in copies], jnp.int32)
+        dst = jnp.asarray([b for _, b in copies], jnp.int32)
+        self._enc_pool = self._enc_pool.at[dst].set(self._enc_pool[src])
+        self._ep_pool = self._ep_pool.at[dst].set(self._ep_pool[src])
+        s.pages = new_pages
+        self._stats.incr("serving/cow_copies", len(copies))
+        return True
+
+    @property
+    def prefix_cache_len(self) -> int:
+        return len(self._prefix_cache)
+
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens the target model confirmed (0.0
+        before any speculative dispatch ran)."""
+        if not self.spec_proposed:
+            return 0.0
+        return self.spec_accepted / float(self.spec_proposed)
 
     # -- chunked-prefill weight extraction --------------------------------
     def _extract_encoder_weights(self):
@@ -623,9 +825,7 @@ class ServingEngine:
                 if w["head_b"] is not None:
                     logits = logits + w["head_b"]
                 # the exact ops/beam greedy chain, for bit-identity
-                prob = jax.nn.softmax(logits, axis=-1)
-                logp = jnp.log(jnp.maximum(prob, 1e-9))
-                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+                _, nxt = greedy_token_chain(logits)
                 # dead lanes and finished rows only re-emit EOS, and a
                 # finished row's state freezes — the host reads tokens up
                 # to the FIRST eos, so every visible token rode the exact
@@ -643,6 +843,145 @@ class ServingEngine:
             return h_state, jnp.swapaxes(toks, 0, 1)  # [B, K]
 
         return jax.jit(decode, donate_argnums=(0,))
+
+    def _make_verify(self, b_rung: int, p_rung: int):
+        """The speculative verify-K program — the SAME compiled shape
+        family as :meth:`_make_decode` (one (slot-rung, page-rung) jit,
+        K = ``serving_decode_block_steps`` inner steps per dispatch), but
+        the K step inputs are the DRAFT tokens instead of each step's own
+        argmax, so position j's input no longer waits on position j-1's
+        output: the embedding+projection half of the chain hoists into ONE
+        batched [B, K] GEMM before the scan — the sequential-GRU flops a
+        draft actually buys back.
+
+        Emission contract (what keeps the fallback bit-identical): the
+        draft is a HYPOTHESIS that these are the greedy tokens.  With
+        ``m`` = leading positions where the target's own argmax agreed
+        with the draft, steps 0..m all consumed correct context, so the
+        first m tokens (== the draft's) AND the target's own token at
+        position m are exactly the greedy chain — ``n_emit = min(m+1, K)``
+        tokens land per row, and the host consumes EXACTLY that many
+        (positions past n_emit rode misdrafted context and are garbage by
+        contract, never EOS-clamped into looking final).  Full agreement
+        emits all K; total disagreement emits 1 — plain greedy pace, same
+        tokens, never slower in tokens-per-dispatch."""
+        blk = self.block_tokens
+        acts = self._acts
+        w_meta = self._w_meta
+        k_steps = self.block_steps
+
+        def verify(h_state, enc_pool, ep_pool, slot_idx, tables, enc_len,
+                   ids, live, draft, w):
+            self.trace_counts["verify"] += 1
+            if w_meta:
+                from paddle_tpu.ops import quantize as _bsq
+
+                w = _bsq.dequantize_weight_bundle(w, w_meta)
+            h = h_state[slot_idx]  # [B, H]
+            enc = enc_pool[tables].reshape(b_rung, p_rung * blk, -1)
+            ep = ep_pool[tables].reshape(b_rung, p_rung * blk, -1)
+            emask = (
+                jnp.arange(p_rung * blk, dtype=jnp.int32)[None, :]
+                < enc_len[:, None]
+            )
+            # teacher-forced inputs: step 0 consumes the real last token,
+            # step j consumes draft[j-1]; all K embeddings in one GEMM
+            inp = jnp.concatenate([ids[:, None], draft[:, :-1]], axis=1)
+            xg_all = jnp.take(w["emb_w"], inp, axis=0) @ w["w_emb"]
+            if w["xg_bias"] is not None:
+                xg_all = xg_all + w["xg_bias"]
+
+            def inner(h_p, xg):
+                h_t = attention_gru_step(
+                    xg, h_p, enc, ep, emask, w["w1"], w["v"], w["w_ctx"],
+                    w["w_c"], **acts,
+                )
+                logits = h_t @ w["head_w"]
+                if w["head_b"] is not None:
+                    logits = logits + w["head_b"]
+                _, nxt = greedy_token_chain(logits)
+                return h_t, (nxt, h_t)
+
+            _, (toks, hs) = jax.lax.scan(
+                inner, h, jnp.swapaxes(xg_all, 0, 1)
+            )
+            toks = jnp.swapaxes(toks, 0, 1)      # [B, K]
+            hs = jnp.swapaxes(hs, 0, 1)          # [B, K, H]
+            match = jnp.cumprod(
+                (toks == draft).astype(jnp.int32), axis=1
+            )
+            m_full = jnp.sum(match, axis=1)      # leading agreement count
+            n_emit = jnp.minimum(m_full + 1, k_steps)
+            # h after step n_emit-1 consumed only verified context — the
+            # exact greedy state after the emitted tokens; dead lanes
+            # freeze (and stamp n_emit 0 so the host skips them)
+            h_sel = hs[jnp.arange(b_rung), n_emit - 1]
+            h_new = jnp.where(live[:, None], h_sel, h)
+            n_emit = jnp.where(live, n_emit, 0)
+            h_state = h_state.at[slot_idx].set(h_new)
+            return h_state, toks, n_emit, m_full
+
+        return jax.jit(verify, donate_argnums=(0,))
+
+    def _make_beam(self, p_rung: int, beam_k: int, max_new: int):
+        """The paged whole-sequence beam program: gathers one request's
+        encoder memory through its page-table row (exactly like decode —
+        page-table contents, never shapes), expands it to the K beam
+        rows, and runs ops/beam.beam_search over the SAME fused step the
+        one-shot ``Seq2SeqGenerator.generate`` uses
+        (models/seq2seq.make_fused_step — one closure, one chain), from
+        the slot's booted decoder state.  The pool's score keys already
+        carry the folded sp_b, so the per-step math is identical to the
+        one-shot path's statics.  One compiled variant per (page-rung,
+        beam-width, max-len)."""
+        from paddle_tpu.models.seq2seq import make_fused_step
+        from paddle_tpu.ops.beam import beam_search
+
+        blk = self.block_tokens
+        acts = self._acts
+        w_meta = self._w_meta
+        gen = self._gen
+
+        def beam(h_state, enc_pool, ep_pool, sid, table, enc_len, w):
+            self.trace_counts["beam"] += 1
+            if w_meta:
+                from paddle_tpu.ops import quantize as _bsq
+
+                w = _bsq.dequantize_weight_bundle(w, w_meta)
+            enc = enc_pool[table].reshape(1, p_rung * blk, -1)
+            ep = ep_pool[table].reshape(1, p_rung * blk, -1)
+            emask = (
+                jnp.arange(p_rung * blk, dtype=jnp.int32)[None, :]
+                < enc_len[:, None]
+            )
+            fused = make_fused_step(
+                w,
+                jnp.repeat(enc, beam_k, axis=0),
+                jnp.repeat(ep, beam_k, axis=0),
+                jnp.repeat(emask, beam_k, axis=0),
+                gate_act=acts["gate_act"], act=acts["act"],
+                att_act=acts["att_act"],
+            )
+
+            def step_fn(step_ids, carry):
+                logp, h_t = fused(step_ids, carry["h"])
+                return logp, {"h": h_t}
+
+            return beam_search(
+                step_fn,
+                {"h": h_state[sid]},  # [1, H]; beam_search repeats to K
+                batch_size=1,
+                beam_size=beam_k,
+                vocab_size=self.trg_vocab,
+                bos_id=gen.bos_id,
+                eos_id=gen.eos_id,
+                max_len=max_new,
+                candidate_adjust_fn=gen.candidate_adjust_fn,
+                drop_fn=gen.drop_fn,
+                norm_fn=gen.norm_fn,
+            )
+
+        return jax.jit(beam)
 
     def _prefill_exe(self, batch, args):
         if self._aot is None:
@@ -694,6 +1033,32 @@ class ServingEngine:
             self._stats.incr("serving_decode/compile_hit")
         return exe
 
+    def _verify_exe(self, b_rung: int, p_rung: int, args):
+        key = (b_rung, p_rung)
+        exe = self._verify_table.get(key)
+        if exe is None:
+            self._stats.incr("serving_verify/compile_miss")
+            exe = self._make_verify(b_rung, p_rung)
+            if self._aot is not None:
+                from paddle_tpu.core import aot_cache as _aot
+
+                exe = self._aot.get_or_compile(
+                    exe, args,
+                    {
+                        "kind": "serving_verify",
+                        "topology": _aot.topology_fingerprint(self._gen.net),
+                        "slot_rung": b_rung,
+                        "page_rung": p_rung,
+                        "pool_rows": self._pages.pool_rows,
+                        "block_tokens": self.block_tokens,
+                        "max_slots": self.max_slots,
+                    },
+                )
+            self._verify_table[key] = exe
+        else:
+            self._stats.incr("serving_verify/compile_hit")
+        return exe
+
     # -- admission -------------------------------------------------------
     def _chunked_extent(self, src_len: int) -> Optional[int]:
         """Padded extent when ``src_len`` takes the chunked-prefill path
@@ -740,6 +1105,34 @@ class ServingEngine:
         )
         self._admit_seq += 1
         self._stats.incr("serving/chunked_prefills")
+        if not self.prefix_cache_enabled:
+            return
+        # partial-prefix reuse: resume the FORWARD pass at the longest
+        # cached chunk boundary fully inside the true prompt (fw carries
+        # depend only on the prefix, so they are bit-exact for any
+        # continuation; the bw pass reads the suffix and always re-runs)
+        p = self._prefilling[sid]
+        C = self.prefill_chunk_tokens
+        true_len = int(length[0])
+        for j in range(p.n_chunks - 1, -1, -1):
+            if (j + 1) * C > true_len:
+                continue
+            key = (self._cache_sig_hash,
+                   tuple(int(t) for t in ids[0, :(j + 1) * C]))
+            ent = self._fw_cache.get(key)
+            if ent is None:
+                continue
+            p.cursor = j + 1
+            p.h = ent["h"]
+            for i in range(j + 1):
+                p.fw_chunks[i] = ent["chunks"][i]
+            self._fw_cache.move_to_end(key)
+            self._stats.incr("serving/prefix_fw_reuse", j + 1)
+            if p.cursor == p.n_chunks:
+                p.phase = "bw"
+                p.cursor = p.n_chunks - 1
+                p.h = jnp.zeros_like(ent["h"])
+            break
 
     def admit(self, requests: Sequence) -> List:
         """Admit a FIFO prefix of ``requests`` (free slot + pages for each;
@@ -753,9 +1146,62 @@ class ServingEngine:
             if not self._free_slots:
                 break
             src = r.src_ids
+            beam_k = int(getattr(r, "beam_size", None) or 0)
+            if beam_k <= 1:
+                beam_k = 0  # beam of one IS greedy — the cheaper loop
+            max_new = min(
+                r.max_new_tokens or self.default_max_new_tokens,
+                self._gen.max_length,
+            )
+            resume = getattr(r, "_resume", None)
+            hit = (
+                self._prefix_lookup(src)
+                if self.prefix_cache_enabled else None
+            )
+            if hit is not None:
+                # prefill-once: the cached blocks map straight into this
+                # request's page table (refcount +1, zero new blocks, ZERO
+                # prefill dispatches) and the decoder boots from the
+                # entry's captured state — bit-identical because the
+                # entry's pages hold exactly what prefilling this prompt
+                # would write (same tokens, same engine signature)
+                key, ent = hit
+                self._pages.share(ent["pages"])
+                sid = self._free_slots.pop()
+                admitted.append(r)
+                self.prefix_hits += 1
+                self._stats.incr("serving/prefix_hits")
+                if resume is not None:
+                    h_row = jnp.asarray(resume["h"], self._dtype)
+                    r._resume = None
+                else:
+                    h_row = jnp.asarray(ent["boot_h"], self._dtype)
+                self._h = self._h.at[sid].set(h_row)
+                self._slots[sid] = _Slot(
+                    request=r,
+                    pages=list(ent["pages"]),
+                    enc_tokens=ent["enc_tokens"],
+                    last_id=(
+                        resume["last_id"] if resume is not None
+                        else self._gen.bos_id
+                    ),
+                    tokens=(
+                        list(resume["tokens"]) if resume is not None else []
+                    ),
+                    max_new=max_new,
+                    admit_seq=self._admit_seq,
+                    beam=beam_k,
+                    boot_h=None,
+                )
+                self._admit_seq += 1
+                r.t_admit = self._clock()
+                continue
             pages = self._pages.alloc(self._pages.pages_for_tokens(len(src)))
             if pages is None:
                 break
+            if self.prefix_cache_enabled:
+                self.prefix_misses += 1
+                self._stats.incr("serving/prefix_misses")
             sid = self._free_slots.pop()
             admitted.append(r)
             chunk_extent = self._chunked_extent(len(src))
@@ -763,7 +1209,6 @@ class ServingEngine:
                 self._admit_chunked(r, sid, pages, chunk_extent)
                 r.t_admit = self._clock()
                 continue
-            resume = getattr(r, "_resume", None)
             slot = _Slot(
                 request=r,
                 pages=pages,
@@ -773,11 +1218,9 @@ class ServingEngine:
                     else self._gen.bos_id
                 ),
                 tokens=list(resume["tokens"]) if resume is not None else [],
-                max_new=min(
-                    r.max_new_tokens or self.default_max_new_tokens,
-                    self._gen.max_length,
-                ),
+                max_new=max_new,
                 admit_seq=self._admit_seq,
+                beam=beam_k,
             )
             self._admit_seq += 1
             self._slots[sid] = slot
@@ -818,6 +1261,15 @@ class ServingEngine:
             reqs=[r.req_id for _, r, _ in group],
         ):
             self._enc_pool, self._ep_pool, self._h = exe(*args)
+        if self.prefix_cache_enabled:
+            # capture each cleanly-booted slot's decoder boot state (tiny
+            # [H] row) — at retire its fully-written pages + this state
+            # become the prefix-cache entry; resumed slots carry a mid-
+            # decode h, not the boot, so they never seed an entry
+            h_host = np.asarray(self._h)
+            for k, (sid, _, _) in enumerate(group):
+                if boot_mask[k]:
+                    self._slots[sid].boot_h = h_host[sid].copy()
         now = self._clock()
         for _, r, _ in group:
             r.t_admit = now
@@ -845,6 +1297,19 @@ class ServingEngine:
             hs, h = jits["fw"](w, ids, lk, p.h)
             p.fw_chunks[k] = hs
             p.h = h
+            if (self.prefix_cache_enabled
+                    and (k + 1) * C <= int(p.length[0])):
+                # chunk fully inside the true length: its activations and
+                # the carried state are prefix-determined — cacheable for
+                # any future prompt sharing this chunk-aligned prefix
+                key = (self._cache_sig_hash,
+                       tuple(int(t) for t in p.ids[0, :(k + 1) * C]))
+                self._fw_cache.pop(key, None)
+                self._fw_cache[key] = {
+                    "h": h, "chunks": list(p.fw_chunks[:k + 1]),
+                }
+                while len(self._fw_cache) > self._fw_cache_cap:
+                    self._fw_cache.popitem(last=False)
             p.cursor += 1
             if p.cursor == p.n_chunks:
                 p.phase = "bw"
@@ -873,6 +1338,12 @@ class ServingEngine:
             hs[:, 0], jnp.asarray(boot_mask), jnp.asarray(h_override), w,
         )
         self._prefilling.pop(sid)
+        boot_h = None
+        if self.prefix_cache_enabled and p.resume is None:
+            boot_h = np.asarray(self._h[sid]).copy()
+        beam_k = int(getattr(p.request, "beam_size", None) or 0)
+        if beam_k <= 1:
+            beam_k = 0
         self._slots[sid] = _Slot(
             request=p.request,
             pages=p.pages,
@@ -884,6 +1355,8 @@ class ServingEngine:
             tokens=list(p.resume["tokens"]) if p.resume is not None else [],
             max_new=p.max_new,
             admit_seq=p.admit_seq,
+            beam=beam_k,
+            boot_h=boot_h,
         )
 
     # -- decode ----------------------------------------------------------
@@ -898,7 +1371,18 @@ class ServingEngine:
             self._advance_prefill()
         if not self._slots:
             return []
+        finished = []
+        # beam slots: each one whole-sequence paged beam dispatch, retired
+        # immediately (beam requests deliver a complete best hypothesis,
+        # not a token stream)
+        for sid in sorted(self._slots):
+            if self._slots[sid].beam:
+                finished.append(self._finish_beam(sid))
         live_ids = sorted(self._slots)
+        if not live_ids:
+            if finished:
+                self._stats.incr("serving/decode_steps")
+            return finished
         b_rung = ladder_len(len(live_ids), DEFAULT_BATCH_LADDER)
         max_pages = max(len(self._slots[s].pages) for s in live_ids)
         p_rung = ladder_len(max_pages, self._page_ladder)
@@ -915,22 +1399,43 @@ class ServingEngine:
             enc_len[k] = s.enc_tokens
             ids[k] = s.last_id
             live[k] = True
-        args = (
-            self._h, self._enc_pool, self._ep_pool, slot_idx, tables,
-            enc_len, ids, live, self._w_arg,
-        )
-        exe = self._decode_exe(b_rung, p_rung, args)
-        self._h, toks = exe(*args)
-        toks_host = np.asarray(toks)  # [B, K]: ONE host sync per K tokens
+        k_steps = self.block_steps
+        if self.spec_decode:
+            draft = np.full((b_rung, k_steps), self._gen.eos_id, np.int32)
+            for k, sid in enumerate(live_ids):
+                draft[k] = self._draft_tokens(self._slots[sid], k_steps)
+            args = (
+                self._h, self._enc_pool, self._ep_pool, slot_idx, tables,
+                enc_len, ids, live, draft, self._w_arg,
+            )
+            exe = self._verify_exe(b_rung, p_rung, args)
+            self._h, toks, n_emit, m_full = exe(*args)
+            toks_host = np.asarray(toks)
+            n_emit_host = np.asarray(n_emit)
+            m_full_host = np.asarray(m_full)
+        else:
+            args = (
+                self._h, self._enc_pool, self._ep_pool, slot_idx, tables,
+                enc_len, ids, live, self._w_arg,
+            )
+            exe = self._decode_exe(b_rung, p_rung, args)
+            self._h, toks = exe(*args)
+            toks_host = np.asarray(toks)  # [B,K]: ONE host sync per K tokens
+            n_emit_host = None
         now = self._clock()
-        finished = []
         for k, sid in enumerate(live_ids):
             s = self._slots[sid]
             r = s.request
             if r.t_first_token is None:
                 r.t_first_token = now
             done = False
-            for j in range(toks_host.shape[1]):
+            # spec mode: consume EXACTLY the verified tokens — positions
+            # past n_emit rode misdrafted context and never reach a client
+            limit = (
+                int(n_emit_host[k]) if n_emit_host is not None
+                else toks_host.shape[1]
+            )
+            for j in range(limit):
                 tok = int(toks_host[k, j])
                 if tok == self._gen.eos_id:
                     done = True
@@ -941,14 +1446,79 @@ class ServingEngine:
                 if len(s.tokens) >= s.max_new:
                     done = True
                     break
+            if n_emit_host is not None:
+                self.spec_proposed += k_steps
+                self.spec_accepted += int(m_full_host[k])
             if done:
                 finished.append(self._retire(sid))
         self._stats.incr("serving/decode_steps")
         return finished
 
+    def _draft_tokens(self, s: _Slot, k: int) -> List[int]:
+        """Prompt-lookup n-gram draft (the flagged draft model): match the
+        request's trailing ``serving_spec_ngram`` GENERATED tokens against
+        its own earlier generation and propose the continuation after the
+        most recent match, padding by repetition.  Draws only from target-
+        vocab tokens the request itself emitted — no second network, no
+        extra weights, and a wrong guess costs nothing: the verify
+        dispatch emits the true greedy tokens either way."""
+        n = self.spec_ngram
+        hist = s.tokens
+        out: List[int] = []
+        if len(hist) > n:
+            key = tuple(hist[-n:])
+            for i in range(len(hist) - n - 1, -1, -1):
+                if tuple(hist[i:i + n]) == key:
+                    out = list(hist[i + n:i + n + k])
+                    break
+        fill = out[-1] if out else s.last_id
+        while len(out) < k:
+            out.append(fill)
+        return out[:k]
+
+    def _finish_beam(self, sid: int):
+        """Run one beam slot to completion: one paged beam dispatch, best
+        hypothesis trimmed at EOS onto the request, slot retired."""
+        s = self._slots[sid]
+        p_rung = ladder_len(len(s.pages), self._page_ladder)
+        table = np.full((1, p_rung), self._pages.scratch, np.int32)
+        table[0, : len(s.pages)] = s.pages
+        key = (p_rung, s.beam, s.max_new)
+        exe = self._beam_table.get(key)
+        if exe is None:
+            self._stats.incr("serving_beam/compile_miss")
+            exe = self._make_beam(p_rung, s.beam, s.max_new)
+            self._beam_table[key] = exe
+        else:
+            self._stats.incr("serving_beam/compile_hit")
+        with _obs.span(
+            "beam", cat="serving", req=s.request.req_id, beam=s.beam,
+        ):
+            seqs, scores = exe(
+                self._h, self._enc_pool, self._ep_pool,
+                np.asarray([sid], np.int32), table,
+                np.asarray([s.enc_tokens], np.int32), self._w_arg,
+            )
+        best = np.asarray(seqs)[0, 0]
+        toks: List[int] = []
+        for t in best:
+            t = int(t)
+            if t == self._gen.eos_id:
+                break
+            toks.append(t)
+        s.tokens = toks[: s.max_new]
+        now = self._clock()
+        r = s.request
+        if r.t_first_token is None:
+            r.t_first_token = now
+        r.token_times.extend([now] * len(s.tokens))
+        r.beam_score = float(np.asarray(scores)[0, 0])
+        self._stats.incr("serving/beam_requests")
+        return self._retire(sid)
+
     def _retire(self, sid: int):
         s = self._slots.pop(sid)
-        self._pages.free(s.pages)
+        self._release_slot_pages(s)
         self._free_slots.append(sid)
         s.request.tokens = s.tokens
         self._stats.incr("serving/completed")
@@ -967,7 +1537,7 @@ class ServingEngine:
             return None
         sid = max(self._slots, key=lambda s: self._slots[s].admit_seq)
         s = self._slots.pop(sid)
-        self._pages.free(s.pages)
+        self._release_slot_pages(s)
         self._free_slots.append(sid)
         s.request._resume = {
             "h": np.asarray(self._h[sid]),
@@ -1051,4 +1621,10 @@ class ServingEngine:
             "int8_weights": self.int8_weights,
             "weight_bytes": self.weight_bytes,
             "slots_per_gb": self.slots_per_gb(),
+            "prefix_cache": self.prefix_cache_enabled,
+            "prefix_entries": self.prefix_cache_len,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "spec_decode": self.spec_decode,
+            "spec_accept_rate": self.spec_accept_rate(),
         }

@@ -17,13 +17,35 @@ allocation is accounted in bytes, and exhaustion is a *refused admission*
 extra SCRATCH block absorbs the writes/gathers of padded (dead) rows so
 ladder padding never corrupts live state.
 
-Counters ride the StatSet plane: ``serving/pages_alloc``,
-``serving/pages_free``, ``serving/alloc_refused``.
+**Copy-on-write prefix sharing (PR 17)** replaces the free/shadow-set
+discipline with per-block REFCOUNTS — the ragged-paged-attention
+blueprint's shared-prefix blocks as first-class citizens:
+
+* :meth:`alloc` hands out blocks at refcount 1;
+* :meth:`share` maps an already-populated block into another page table
+  (refcount +1) — N sessions over one warmed prefix hold ONE copy;
+* :meth:`release` drops a reference; a block frees only at refcount 0.
+  ``retain=True`` parks a refcount-0 block in the RETAINED pool instead
+  of the free list: still populated, instantly revivable by a later
+  ``share`` (the prefix cache's warm blocks), evicted LRU-first when
+  ``alloc`` outgrows the free list — the same ``serving_hbm_budget_mb``
+  covers live and retained blocks, retained capacity is free capacity;
+* :meth:`cow` gives a writer private copies of any block it shares with
+  another reader BEFORE the write (the caller copies the pool rows the
+  returned (src, dst) pairs name) — a decode/prefill write can never
+  mutate bytes another sequence is attending over.
+
+``free`` remains as the non-retaining release spelling (the PR-10 call
+surface).  Counters ride the StatSet plane: ``serving/pages_alloc``,
+``serving/pages_free``, ``serving/alloc_refused``, plus the sharing
+plane's ``serving/pages_shared``, ``serving/pages_evicted`` and
+``serving/pages_cow``.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["BlockPagedCache"]
 
@@ -35,13 +57,18 @@ class BlockPagedCache:
     engine stores two pools: ``enc`` [block, 2H] attention values and
     ``ep`` [block, H] projected score keys).  The device arrays themselves
     are owned by the engine (they are donated through jit every prefill);
-    this class owns the free list, the budget math and the page-table
+    this class owns the refcounts, the budget math and the page-table
     bookkeeping.
 
     Sizing rule (README "Serving"): with f32 pools,
     ``bytes_per_block = block_tokens * sum(feature_dims) * 4`` and
     ``n_blocks = budget_bytes // bytes_per_block``; a request of S source
-    tokens needs ``ceil(S / block_tokens)`` blocks while in flight.
+    tokens needs ``ceil(S / block_tokens)`` blocks while in flight —
+    shared blocks count ONCE no matter how many page tables map them.
+
+    ``on_evict(block_id)`` (assignable) fires when :meth:`alloc` reclaims
+    a retained refcount-0 block — the prefix cache invalidates the entry
+    whose bytes just died.
     """
 
     def __init__(
@@ -75,10 +102,14 @@ class BlockPagedCache:
         self.n_blocks = int(n_blocks)
         self._stats = stats if stats is not None else global_stats
         # LIFO free list: recently freed (still-warm) blocks re-allocate
-        # first.  Block ids are stable ints in [0, n_blocks); the shadow
-        # set keeps the per-retire double-free check O(1).
+        # first.  Block ids are stable ints in [0, n_blocks); _ref[b] counts
+        # the page tables mapping block b (0 = free or retained).
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
-        self._free_set = set(self._free)
+        self._ref: List[int] = [0] * self.n_blocks
+        # refcount-0 blocks whose bytes are still warm (prefix-cache
+        # entries): insertion order IS the LRU order — oldest first out
+        self._retained: "OrderedDict[int, None]" = OrderedDict()
+        self.on_evict: Optional[Callable[[int], None]] = None
 
     # -- scratch ---------------------------------------------------------
     @property
@@ -99,38 +130,125 @@ class BlockPagedCache:
         return len(self._free)
 
     @property
+    def n_retained(self) -> int:
+        """Refcount-0 blocks kept warm for the prefix cache (reclaimable)."""
+        return len(self._retained)
+
+    @property
     def n_used(self) -> int:
-        return self.n_blocks - len(self._free)
+        """Blocks some page table maps (refcount >= 1).  Retained blocks
+        are NOT used: they are evictable capacity, and the SLO gauge
+        ``pages_in_use`` must return to 0 when the plane drains even with
+        a warm prefix cache."""
+        return self.n_blocks - len(self._free) - len(self._retained)
+
+    @property
+    def n_shared(self) -> int:
+        """Blocks mapped by MORE than one page table right now."""
+        return sum(1 for r in self._ref if r >= 2)
 
     @property
     def used_bytes(self) -> int:
         return self.n_used * self.bytes_per_block
 
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
     def pages_for_tokens(self, n_tokens: int) -> int:
         """Blocks a sequence of ``n_tokens`` source tokens occupies."""
         return max(1, -(-int(n_tokens) // self.block_tokens))
 
-    # -- alloc / free ----------------------------------------------------
+    # -- alloc / share / release -----------------------------------------
     def alloc(self, n_pages: int) -> Optional[List[int]]:
-        """``n_pages`` block ids, or None when the budget can't cover them
-        (admission control: the caller keeps the request queued)."""
-        if n_pages > len(self._free):
+        """``n_pages`` block ids at refcount 1, or None when the budget
+        can't cover them (admission control: the caller keeps the request
+        queued).  The free list drains first; then retained refcount-0
+        blocks are EVICTED oldest-first (LRU) — ``on_evict`` fires per
+        reclaimed block so the prefix cache drops the dead entry."""
+        if n_pages > len(self._free) + len(self._retained):
             self._stats.incr("serving/alloc_refused")
             return None
-        pages = [self._free.pop() for _ in range(n_pages)]
-        self._free_set.difference_update(pages)
+        pages = []
+        for _ in range(n_pages):
+            if self._free:
+                p = self._free.pop()
+            else:
+                p, _ = self._retained.popitem(last=False)  # LRU-oldest
+                self._stats.incr("serving/pages_evicted")
+                if self.on_evict is not None:
+                    self.on_evict(p)
+            self._ref[p] = 1
+            pages.append(p)
         self._stats.incr("serving/pages_alloc", n_pages)
         return pages
 
-    def free(self, pages: Sequence[int]) -> None:
+    def share(self, pages: Sequence[int]) -> None:
+        """Map already-populated blocks into ANOTHER page table: refcount
+        +1 each; a retained block revives (leaves the LRU pool).  Sharing
+        a free block is a bug — its bytes are undefined — and raises."""
+        for p in pages:
+            if not (0 <= p < self.n_blocks):
+                raise ValueError(f"sharing foreign block id {p}")
+            if self._ref[p] == 0 and p not in self._retained:
+                raise ValueError(
+                    f"sharing free block {p} (undefined contents)"
+                )
+        for p in pages:
+            if self._ref[p] == 0:
+                self._retained.pop(p)
+            self._ref[p] += 1
+        self._stats.incr("serving/pages_shared", len(pages))
+
+    def release(self, pages: Sequence[int], retain: bool = False) -> None:
+        """Drop one reference per block; a block frees only at refcount 0.
+        ``retain=True`` parks refcount-0 blocks in the warm LRU pool
+        (most-recently-released = last out) instead of the free list.
+        Releasing a block no table maps (double release / foreign id)
+        raises — the double-free discipline, now refcount-exact."""
         for p in pages:
             if not (0 <= p < self.n_blocks):
                 raise ValueError(f"freeing foreign block id {p}")
-            if p in self._free_set:
-                raise ValueError(f"double free of block {p}")
-        self._free.extend(pages)
-        self._free_set.update(pages)
+            if self._ref[p] == 0:
+                raise ValueError(
+                    f"double free of block {p} (refcount already 0)"
+                )
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                if retain:
+                    self._retained[p] = None  # appended = most recent
+                else:
+                    self._free.append(p)
         self._stats.incr("serving/pages_free", len(pages))
+
+    def free(self, pages: Sequence[int]) -> None:
+        """The non-retaining release (the PR-10 call surface)."""
+        self.release(pages, retain=False)
+
+    def cow(self, pages: Sequence[int]
+            ) -> Tuple[Optional[List[int]], List[Tuple[int, int]]]:
+        """Copy-on-write: private replacements for every block of
+        ``pages`` that another page table also maps (refcount >= 2).
+        Returns ``(new_pages, copies)`` — ``new_pages`` is the caller's
+        page list with shared blocks swapped for fresh refcount-1 blocks,
+        ``copies`` the (src, dst) pairs whose POOL ROWS the caller must
+        copy BEFORE writing (the copy half of copy-on-write; this class
+        never touches device memory).  ``(None, [])`` when the budget
+        can't cover the copies (the write waits, exactly like a refused
+        admission).  Exclusively-owned pages come back unchanged."""
+        shared = [p for p in pages if self._ref[p] >= 2]
+        if not shared:
+            return list(pages), []
+        fresh = self.alloc(len(shared))
+        if fresh is None:
+            return None, []
+        repl = dict(zip(shared, fresh))
+        for p in shared:
+            self._ref[p] -= 1  # >= 2 on entry, so never reaches 0 here
+        self._stats.incr("serving/pages_cow", len(shared))
+        return [repl.get(p, p) for p in pages], [
+            (p, repl[p]) for p in shared
+        ]
 
     def summary(self) -> Dict[str, int]:
         return {
@@ -138,5 +256,7 @@ class BlockPagedCache:
             "block_tokens": self.block_tokens,
             "bytes_per_block": self.bytes_per_block,
             "n_free": self.n_free,
+            "n_retained": self.n_retained,
+            "n_shared": self.n_shared,
             "used_bytes": self.used_bytes,
         }

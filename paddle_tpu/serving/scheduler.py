@@ -124,12 +124,19 @@ class Request:
         req_id: Optional[str] = None,
         callback: Optional[Callable[["Request"], Any]] = None,
         deadline_s: Optional[float] = None,
+        beam_size: Optional[int] = None,
     ):
         self.req_id = req_id if req_id is not None else f"r{next(_req_counter)}"
         self.src_ids = list(src_ids)
         self.max_new_tokens = max_new_tokens
         self.callback = callback
         self.deadline_s = deadline_s
+        # beam decode as a serving citizen: > 1 routes the request through
+        # the engine's paged whole-sequence beam program (one dispatch,
+        # best hypothesis in ``tokens`` + its ``beam_score``); None/1 =
+        # the continuous greedy/speculative loop
+        self.beam_size = beam_size
+        self.beam_score: Optional[float] = None
         self.status = "pending"
         self.tokens: Optional[List[int]] = None
         self.error: Optional[str] = None
@@ -235,6 +242,26 @@ class ServingScheduler:
                 lambda: self._predicted_wait_s(self._depth) or 0.0,
                 "EWMA-predicted queue wait of a request arriving now — "
                 "the shed predictor's own estimate",
+            ),
+            "paddle_tpu_serving_prefix_cache_hits": (
+                lambda: self._engine.prefix_hits,
+                "admissions whose full prompt mapped cached blocks — "
+                "zero prefill dispatches each (serving_prefix_cache)",
+            ),
+            "paddle_tpu_serving_prefix_cache_misses": (
+                lambda: self._engine.prefix_misses,
+                "admissions that prefilled fresh pages (prefix cache "
+                "enabled but no full-prompt entry matched)",
+            ),
+            "paddle_tpu_serving_pages_shared": (
+                lambda: self._engine.pages.n_shared,
+                "HBM blocks currently mapped by MORE than one page table "
+                "(copy-on-write prefix sharing)",
+            ),
+            "paddle_tpu_serving_spec_accept_rate": (
+                lambda: self._engine.spec_accept_rate(),
+                "fraction of speculative draft tokens the target model "
+                "confirmed (serving_spec_decode; 0.0 until armed)",
             ),
         }
         for name, (fn, help_) in self._gauges.items():
@@ -400,6 +427,20 @@ class ServingScheduler:
             )
             if f is None or not np.isfinite(f) or f != int(f) or int(f) < 1:
                 return f"max_new_tokens must be a positive integer, got {m!r}"
+        if r.beam_size is not None:
+            b = r.beam_size
+            f = (
+                float(b)
+                if isinstance(b, (int, float, np.floating, np.integer))
+                else None
+            )
+            if f is None or not np.isfinite(f) or f != int(f) or int(f) < 1:
+                return f"beam_size must be a positive integer, got {b!r}"
+            if int(f) > eng.trg_vocab:
+                return (
+                    f"beam_size {int(f)} exceeds the target vocab "
+                    f"({eng.trg_vocab} candidates per step)"
+                )
         return None
 
     # -- SLO predictor (step thread only) --------------------------------

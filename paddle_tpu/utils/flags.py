@@ -248,6 +248,32 @@ define_flag("serving_decode_block_steps", 4,
             "quantize to K-token boundaries (finished rows clamp to EOS "
             "in-graph, so outputs stay bit-identical to the one-shot "
             "path).  1 = sync every token (lowest time-to-first-token)")
+define_flag("serving_prefix_cache", False,
+            "copy-on-write prefix sharing in the serving plane "
+            "(serving/engine.py): finished prompts park their encoder "
+            "pages in a refcount-0 LRU pool keyed on token-block hashes + "
+            "the engine's topology fingerprint; a request whose FULL "
+            "prompt matches maps the same blocks into its page table with "
+            "ZERO prefill dispatches (bit-identical — the bi-GRU encoder "
+            "reads the whole prompt, so only exact-prompt reuse is sound; "
+            "chunked prefills additionally resume mid-prompt from cached "
+            "forward-GRU carries).  Blocks free only at refcount 0; "
+            "eviction is LRU under the same serving_hbm_budget_mb")
+define_flag("serving_spec_decode", False,
+            "speculative decoding in the serving plane: an n-gram draft "
+            "proposes serving_decode_block_steps tokens and the target "
+            "model verifies ALL of them in ONE dispatch (the existing "
+            "K-steps compiled shape, drafts as inputs); the emitted "
+            "tokens are exactly the greedy argmax chain's — acceptance "
+            "only changes how many land per dispatch, never their values "
+            "(rejection falls back bit-identically).  Accepted-token "
+            "rate rides serving metrics as spec_accept_rate")
+define_flag("serving_spec_ngram", 2,
+            "context n-gram order of the serving draft proposer: the last "
+            "n generated tokens are matched against the request's own "
+            "generated history and the continuation after the most recent "
+            "match is proposed (prompt-lookup decoding); larger n = "
+            "fewer, more precise matches")
 define_flag("serving_default_deadline_s", 0.0,
             "default end-to-end deadline (seconds from submit) stamped on "
             "serving requests that carry none of their own; the scheduler "

@@ -59,6 +59,7 @@ SLOW_ONLY_FILES = [
     "tests/test_obs_e2e.py",
     "tests/test_netem_e2e.py",
     "tests/test_quantized_e2e.py",
+    "tests/test_decode_speed_e2e.py",
 ]
 
 

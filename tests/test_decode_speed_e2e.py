@@ -1,0 +1,145 @@
+"""Decode raw speed e2e drills (slow; `make chaos` runs them
+SANITIZER-ARMED) — the PR-17 tentpole under real threaded load.
+
+Three scenarios over the real scheduler:
+
+* shared-prefix open-loop load — PrefixMixer traffic (the workload the
+  COW prefix cache exists for) through the arrival clock: every request
+  bit-identical to the one-shot path, the cache takes real hits, and
+  ``pages_in_use`` drains to 0 with the warm entries still resident;
+* speculative decoding under load — the verify-K path serves an open-loop
+  burst bit-identically to plain greedy, accept-rate metric live;
+* cancel mid-speculation — a timed-out ``generate()`` cancels its
+  in-flight speculative request: ``pages_in_use`` returns to 0 (shared
+  blocks refcount down, never double-free) and the survivors finish.
+
+Real threads + wall-clock load: the whole module is slow-marked
+(scripts/tier1_failset.py --slow-guard pins that).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.topology import reset_auto_names
+from paddle_tpu.models.seq2seq import Seq2SeqGenerator, seq2seq_cost
+from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+from paddle_tpu.robustness import chaos
+from paddle_tpu.serving import Request, ServingEngine, ServingScheduler
+
+pytestmark = pytest.mark.slow
+
+V, E, H = 40, 12, 16
+BOS, EOS = 0, 1
+MAXLEN = 12
+
+
+@pytest.fixture()
+def small_gen():
+    reset_auto_names()
+    cost, _ = seq2seq_cost(V, V, word_dim=E, hidden_dim=H)
+    params = paddle.parameters.create(cost, seed=7)
+    return Seq2SeqGenerator(
+        params, V, V, word_dim=E, hidden_dim=H,
+        bos_id=BOS, eos_id=EOS, max_length=MAXLEN,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.disarm()
+
+
+def _no_leaked_serve_threads():
+    return not [
+        t for t in threading.enumerate() if t.name.startswith("paddle-serve")
+    ]
+
+
+def test_prefix_sharing_under_open_loop_load(small_gen):
+    """PrefixMixer traffic (pooled prefixes, exact duplicates, fresh
+    prompts) over the threaded scheduler with the COW cache armed: all
+    bit-identical, real cache hits, pages drained."""
+    eng = ServingEngine(small_gen, max_slots=8, hbm_budget_mb=2,
+                        max_new_tokens=MAXLEN, prefix_cache=True)
+    mixer = PrefixMixer(V, pool_size=3, prefix_frac=0.6, dup_frac=0.5,
+                        seed=11)
+    srcs = [mixer.source(i) for i in range(24)]
+    refs = [eng.reference_decode(s, MAXLEN) for s in srcs]
+    reqs = [Request(s) for s in srcs]
+    with ServingScheduler(eng) as sched:
+        gen = OpenLoopLoadGen(8.0, len(reqs), lambda i: reqs[i], seed=11)
+        gen.run(sched.submit)
+        for r in reqs:
+            assert r.wait(120), r
+    assert _no_leaked_serve_threads()
+    for r, ref in zip(reqs, refs):
+        assert r.error is None, r
+        assert r.result() == ref, r.req_id
+    # duplicate prompts in the mix MUST have mapped warmed blocks
+    assert eng.prefix_hits > 0
+    assert eng.prefix_misses + eng.prefix_hits == len(srcs)
+    # the SLO gauge drains even though warm entries stay resident
+    assert eng.pages.n_used == 0 and eng.pages.n_retained > 0
+
+
+def test_spec_decode_under_open_loop_load(small_gen):
+    eng = ServingEngine(small_gen, max_slots=8, hbm_budget_mb=2,
+                        max_new_tokens=MAXLEN, spec_decode=True)
+    rng = np.random.RandomState(13)
+    srcs = [rng.randint(2, V, size=rng.randint(3, 30)).tolist()
+            for _ in range(16)]
+    refs = [eng.reference_decode(s, MAXLEN) for s in srcs]
+    reqs = [Request(s) for s in srcs]
+    with ServingScheduler(eng) as sched:
+        gen = OpenLoopLoadGen(8.0, len(reqs), lambda i: reqs[i], seed=13)
+        gen.run(sched.submit)
+        for r in reqs:
+            assert r.wait(120), r
+    assert _no_leaked_serve_threads()
+    for r, ref in zip(reqs, refs):
+        assert r.error is None, r
+        assert r.result() == ref, r.req_id
+    assert eng.spec_proposed > 0
+    assert 0.0 <= eng.spec_accept_rate() <= 1.0
+
+
+def test_cancel_mid_speculation_drains_pages(small_gen):
+    """The orphaned-slot drill on the speculative + shared path: a
+    timed-out ``generate()`` cancels its request while verify dispatches
+    are in flight over SHARED prefix blocks — refcounts step down cleanly
+    (no double free, no leak) and pages_in_use returns to 0."""
+    eng = ServingEngine(small_gen, max_slots=8, hbm_budget_mb=2,
+                        max_new_tokens=MAXLEN, prefix_cache=True,
+                        spec_decode=True)
+    src = [2 + i % (V - 2) for i in range(9)]
+    sched = ServingScheduler(eng)
+    try:
+        # warm the prefix entry so the canceled request decodes over a
+        # SHARED mapping (refcount 2: entry + slot)
+        assert sched.generate(src, timeout=60.0) == eng.reference_decode(
+            src, MAXLEN
+        )
+        with pytest.raises(TimeoutError):
+            sched.generate(src, timeout=0.0)
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            if eng.pages.n_used == 0 and eng.n_live == 0:
+                break
+            time.sleep(0.01)
+        assert eng.pages.n_used == 0, eng.pages.summary()
+        assert eng.n_live == 0 and eng.n_prefilling == 0
+        # the warm entry survived the cancel — a follow-up request still
+        # hits and stays bit-identical
+        hits = eng.prefix_hits
+        assert sched.generate(src, timeout=60.0) == eng.reference_decode(
+            src, MAXLEN
+        )
+        assert eng.prefix_hits > hits
+    finally:
+        sched.close()
+    assert _no_leaked_serve_threads()
