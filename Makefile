@@ -122,6 +122,7 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_scenarios_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_netem_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_decode_speed_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_fleet_serving_e2e.py -q
 	$(MAKE) trace-demo
 
 # the obs-plane acceptance drill (sanitizer-armed: the traced scenario

@@ -743,7 +743,22 @@ def cmd_serve(argv: List[str]) -> int:
     ap.add_argument("--arrival", default="poisson",
                     choices=["poisson", "uniform", "burst"],
                     help="open-loop arrival process (reader/loadgen.py)")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="stamp synthetic requests with session ids drawn "
+                    "from a pool of N sessions (PrefixMixer.session_of — "
+                    "the fleet router's affinity key); 0 = session-less")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--register", default="",
+                    help="run as a FLEET ENGINE: register with the router "
+                    "at host:port (serving/router.py) and serve requests "
+                    "over the typed wire RPC instead of a local workload; "
+                    "SIGTERM drains and deregisters")
+    ap.add_argument("--engine-id", default="",
+                    help="engine identity on the router's lease plane "
+                    "(default: engine-<pid>; only with --register)")
+    ap.add_argument("--engine-port", type=int, default=0,
+                    help="data-plane listen port (0 = ephemeral; only "
+                    "with --register)")
     ap.add_argument("--timeout-s", type=float, default=120.0)
     ap.add_argument("--stats-out", default="",
                     help="write the summary JSON here too")
@@ -794,6 +809,10 @@ def cmd_serve(argv: List[str]) -> int:
         spec_decode=args.spec_decode,
     )
 
+    if args.register:
+        return _serve_as_fleet_engine(args, engine)
+
+    session_of = None
     if args.requests:
         with open(args.requests) as f:
             sources = [
@@ -805,14 +824,20 @@ def cmd_serve(argv: List[str]) -> int:
         mixer = PrefixMixer(
             args.src_vocab, pool_size=args.prefix_pool,
             prefix_frac=args.prefix_frac, seed=args.seed,
+            sessions=args.sessions,
         )
         sources = [mixer.source(i) for i in range(args.synthetic)]
+        if args.sessions > 0:
+            session_of = mixer.session_of
     else:
         rng = np.random.RandomState(args.seed)
         sources = [
             rng.randint(2, args.src_vocab, size=rng.randint(3, 24)).tolist()
             for _ in range(args.synthetic)
         ]
+    if args.sessions > 0 and session_of is None:
+        # no prefix pool to correlate with: sessions spread round-robin
+        session_of = lambda i: f"sess{i % args.sessions}"  # noqa: E731
 
     done = []
 
@@ -866,11 +891,14 @@ def cmd_serve(argv: List[str]) -> int:
                 submitted = OpenLoopLoadGen(
                     args.rate, len(reqs), lambda i: reqs[i],
                     seed=args.seed, process=args.arrival,
+                    session_of=session_of,
                 ).run(sched.submit, stop=lambda: guard.triggered)
             else:
-                for r in reqs:
+                for i, r in enumerate(reqs):
                     if guard.triggered:
                         break
+                    if session_of is not None:
+                        r.session_id = session_of(i)
                     sched.submit(r)
                     submitted.append(r)
             if guard.triggered:
@@ -937,6 +965,305 @@ def cmd_serve(argv: List[str]) -> int:
         # request (no 'closed' stragglers) — the graceful-exit contract
         return 0 if (drained_clean and not by_status["closed"]) else 1
     return 0 if (ok and not by_status["closed"]) else 1
+
+
+def _serve_as_fleet_engine(args, engine) -> int:
+    """The `paddle-tpu serve --register host:port` mode: this process is
+    one FLEET ENGINE — a ServingScheduler wrapped in an EngineAgent that
+    registers on the router's heartbeat-lease plane and serves requests
+    arriving over the typed wire RPC (serving/router.py).  No local
+    workload; SIGTERM drains the scheduler, deregisters, exits 0 on a
+    clean drain — the rolling-restart contract."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.obs.metrics import MetricsExporter
+    from paddle_tpu.robustness.preemption import PreemptionGuard
+    from paddle_tpu.serving import EngineAgent, ServingScheduler
+    from paddle_tpu.utils import flags as _serve_flags
+
+    host, _, port = args.register.rpartition(":")
+    if not host or not port.isdigit():
+        print(f"--register wants host:port, got {args.register!r}",
+              file=sys.stderr)
+        return 2
+    engine_id = args.engine_id or f"engine-{_os.getpid()}"
+    metrics = MetricsExporter(
+        path=args.metrics_out,
+        port=(None if args.metrics_port is None
+              else (args.metrics_port if args.metrics_port > 0 else -1)),
+    ) if (
+        args.metrics_out or args.metrics_port
+        or _serve_flags.get_flag("metrics_out")
+        or _serve_flags.get_flag("metrics_port")
+    ) else None
+    drained_clean = False
+    with PreemptionGuard() as guard:
+        sched = ServingScheduler(
+            engine, queue_limit=args.queue_limit,
+            default_deadline_s=args.deadline_s,
+        )
+        agent = EngineAgent(
+            sched, engine_id, (host, int(port)),
+            address=("127.0.0.1", args.engine_port),
+        )
+        # the harness parses this line for identity + data-plane port
+        print(_json.dumps({
+            "engine_id": engine_id,
+            "data_plane": list(agent.address),
+            "router": [host, int(port)],
+        }), flush=True)
+        try:
+            while not guard.triggered:
+                _time.sleep(0.1)
+            _echo(f"draining: engine {engine_id} finishing in-flight work")
+            drained_clean = sched.drain(args.drain_timeout_s)
+        finally:
+            agent.close()
+            sched.close()
+            if metrics is not None:
+                metrics.close()
+    summary = {
+        "engine_id": engine_id,
+        "drained_clean": drained_clean,
+        "engine": engine.summary(),
+    }
+    print(_json.dumps(summary), flush=True)
+    if args.stats_out:
+        _obs.write_stats_json(args.stats_out, summary)
+    _obs.tracer.dump()
+    return 0 if drained_clean else 1
+
+
+def cmd_route(argv: List[str]) -> int:
+    """``paddle-tpu route`` — the serving-fleet router frontend
+    (serving/router.py): admission (deadlines, bounded queue, shed) +
+    least-predicted-wait dispatch with prefix/session affinity over the
+    engines registered on its heartbeat-lease plane (`paddle-tpu serve
+    --register`).  With ``--synthetic N`` it also DRIVES an open-loop
+    workload through the fleet and prints the per-request lines + final
+    summary (the `paddle-tpu serve` report shape, one tier up); with
+    ``--synthetic 0`` it routes for external clients until SIGTERM."""
+    import json as _json
+    import time as _time
+
+    ap = argparse.ArgumentParser(
+        prog="paddle-tpu route",
+        description="SLO-aware affinity-routing fleet frontend "
+                    "(serving/router.py)",
+    )
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="router RPC port (0 = ephemeral, printed on the "
+                    "ready line)")
+    ap.add_argument("--journal", default="",
+                    help="append-only JSON-lines routing journal; restart "
+                    "with the predecessor's journal to refuse re-serving "
+                    "its finalized request ids (HA failover)")
+    ap.add_argument("--lease-timeout-s", type=float, default=None,
+                    help="engine heartbeat lease (default: the "
+                    "router_lease_timeout_s flag)")
+    ap.add_argument("--queue-limit", type=int, default=None,
+                    help="bound on requests inside admission+dispatch "
+                    "(default: the router_queue_limit flag; 0 = unbounded)")
+    ap.add_argument("--deadline-s", type=float, default=None,
+                    help="default per-request deadline stamped at the "
+                    "frontend (default: the serving_default_deadline_s "
+                    "flag; 0 = none)")
+    ap.add_argument("--no-affinity", action="store_true",
+                    help="disable prefix/session affinity (pure "
+                    "least-predicted-wait) — the A/B lever for the "
+                    "prefix-hit-rate comparison")
+    ap.add_argument("--affinity-slack-s", type=float, default=None)
+    ap.add_argument("--stats-poll-s", type=float, default=None)
+    ap.add_argument("--expect-engines", type=int, default=0,
+                    help="wait until N engines hold live leases before "
+                    "offering traffic")
+    ap.add_argument("--expect-timeout-s", type=float, default=30.0)
+    ap.add_argument("--synthetic", type=int, default=0,
+                    help="drive N open-loop synthetic requests through the "
+                    "fleet; 0 = daemon mode (route for external clients "
+                    "until SIGTERM)")
+    ap.add_argument("--src-vocab", type=int, default=1000)
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate (req/s); 0 = submit all "
+                    "immediately")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=["poisson", "uniform", "burst"])
+    ap.add_argument("--prefix-pool", type=int, default=0,
+                    help="share prompt prefixes across synthetic requests "
+                    "(reader/loadgen.PrefixMixer) — what affinity routing "
+                    "concentrates per engine")
+    ap.add_argument("--prefix-frac", type=float, default=0.5)
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="stamp session ids from a pool of N "
+                    "(PrefixMixer.session_of) — the affinity key")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout-s", type=float, default=120.0,
+                    help="wait budget for the synthetic workload")
+    ap.add_argument("--stats-out", default="",
+                    help="write the summary JSON here too")
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--metrics-out", default=None,
+                    help="periodic Prometheus snapshot: fleet gauges "
+                    "(paddle_tpu_fleet_engines, per-engine queue depth/"
+                    "pages/predicted wait) + the fleet request ledger")
+    ap.add_argument("--metrics-port", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from paddle_tpu import obs as _obs
+
+    _obs.tracer.configure(role="route", trace_dir=args.trace_dir)
+    from paddle_tpu.obs.metrics import MetricsExporter
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+    from paddle_tpu.robustness.preemption import PreemptionGuard
+    from paddle_tpu.serving import FleetClient, Request, Router
+    from paddle_tpu.serving import percentile, status_counts
+    from paddle_tpu.utils import flags as _route_flags
+
+    metrics = MetricsExporter(
+        path=args.metrics_out,
+        port=(None if args.metrics_port is None
+              else (args.metrics_port if args.metrics_port > 0 else -1)),
+    ) if (
+        args.metrics_out or args.metrics_port
+        or _route_flags.get_flag("metrics_out")
+        or _route_flags.get_flag("metrics_port")
+    ) else None
+    if metrics is not None and metrics.port:
+        _echo(f"metrics: http://127.0.0.1:{metrics.port}/metrics")
+
+    router = Router(
+        address=(args.host, args.port),
+        journal_path=args.journal or None,
+        lease_timeout_s=args.lease_timeout_s,
+        queue_limit=args.queue_limit,
+        default_deadline_s=args.deadline_s,
+        affinity=False if args.no_affinity else None,
+        affinity_slack_s=args.affinity_slack_s,
+        stats_poll_s=args.stats_poll_s,
+    )
+    # the harness parses this line for the routing address
+    print(_json.dumps({"router": list(router.address)}), flush=True)
+    rc = 0
+    t0 = _time.perf_counter()
+    try:
+        with PreemptionGuard() as guard:
+            if args.expect_engines > 0:
+                deadline = _time.perf_counter() + args.expect_timeout_s
+                while (len(router.live_engines()) < args.expect_engines
+                       and _time.perf_counter() < deadline
+                       and not guard.triggered):
+                    _time.sleep(0.05)
+                live = len(router.live_engines())
+                if live < args.expect_engines:
+                    _echo(f"only {live}/{args.expect_engines} engines "
+                          "registered before the deadline")
+                    return 1
+                _echo(f"fleet ready: {live} engine(s)")
+            if args.synthetic <= 0:
+                # daemon mode: route until SIGTERM
+                while not guard.triggered:
+                    _time.sleep(0.1)
+                return 0
+            mixer = PrefixMixer(
+                args.src_vocab,
+                pool_size=max(1, args.prefix_pool),
+                prefix_frac=args.prefix_frac if args.prefix_pool > 0 else 0.0,
+                seed=args.seed, sessions=args.sessions,
+            )
+            t0 = _time.perf_counter()
+
+            done = []
+
+            def on_done(r):
+                done.append(r)
+                print(_json.dumps({
+                    "req": r.req_id,
+                    "status": r.status,
+                    "tokens": r.tokens,
+                    "error": r.error,
+                    "latency_ms": round((r.t_done - r.t_submit) * 1e3, 3),
+                }), flush=True)
+
+            reqs = [
+                Request(
+                    mixer.source(i), args.max_new_tokens,
+                    req_id=f"route-{args.seed}-{i}", callback=on_done,
+                    deadline_s=args.deadline_s,
+                )
+                for i in range(args.synthetic)
+            ]
+            fc = FleetClient(router.address)
+            try:
+                if args.rate > 0:
+                    OpenLoopLoadGen(
+                        args.rate, len(reqs), lambda i: reqs[i],
+                        seed=args.seed, process=args.arrival,
+                        session_of=mixer.session_of,
+                    ).run(fc.submit, stop=lambda: guard.triggered)
+                else:
+                    for i, r in enumerate(reqs):
+                        if guard.triggered:
+                            break
+                        sid = mixer.session_of(i)
+                        if sid is not None:
+                            r.session_id = sid
+                        fc.submit(r)
+                wait_deadline = _time.perf_counter() + args.timeout_s
+                for r in reqs:
+                    while not r.done():
+                        if guard.triggered or (
+                            _time.perf_counter() > wait_deadline
+                        ):
+                            break
+                        r.wait(0.2)
+                    if guard.triggered:
+                        break
+            finally:
+                fc.close()
+    finally:
+        fleet = router.fleet_stats()
+        router.close()
+        if metrics is not None:
+            metrics.close()
+    wall = _time.perf_counter() - t0
+    by_status = status_counts(r for r in reqs if r.done())
+    ok = [r for r in reqs if r.status == "served"]
+    lats = sorted(
+        (r.t_done - r.t_submit) * 1e3
+        for r in ok if r.t_done is not None and r.t_submit is not None
+    )
+
+    def pct(p):
+        v = percentile(lats, p)
+        return None if v is None else round(v, 3)
+
+    summary = {
+        "served": by_status["served"],
+        "shed": by_status["shed"],
+        "rejected": by_status["rejected"],
+        "timeout": by_status["timeout"],
+        "unfinished": len(reqs) - sum(by_status.values()),
+        "wall_s": round(wall, 3),
+        "sustained_req_per_sec": (
+            round(len(ok) / wall, 3) if wall > 0 else None
+        ),
+        "p50_latency_ms": pct(0.50),
+        "p95_latency_ms": pct(0.95),
+        "p99_latency_ms": pct(0.99),
+        "fleet": fleet,
+    }
+    print(_json.dumps(summary), flush=True)
+    if args.stats_out:
+        _obs.write_stats_json(args.stats_out, summary)
+    _obs.tracer.dump()
+    return rc if (ok or args.synthetic <= 0) else 1
 
 
 def cmd_scenario(argv: List[str]) -> int:
@@ -1614,6 +1941,7 @@ _COMMANDS = {
     "lint": cmd_lint,
     "cache": cmd_cache,
     "serve": cmd_serve,
+    "route": cmd_route,
     "scenario": cmd_scenario,
     "trace": cmd_trace,
     "worker": cmd_worker,
@@ -1639,7 +1967,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    serve             continuous-batching serving plane over")
         print("                      the NMT flagship (request queue + paged")
         print("                      decode cache, SLO admission/shedding,")
-        print("                      SIGTERM graceful drain)")
+        print("                      SIGTERM graceful drain); --register")
+        print("                      joins a fleet router as one engine")
+        print("    route             serving-fleet frontend: SLO admission +")
+        print("                      least-predicted-wait affinity routing")
+        print("                      over registered engines (lease plane,")
+        print("                      idempotent ledger, rolling restart)")
         print("    scenario          production-gate scenario harness: mixed")
         print("                      traffic + chaos under load, SLO metrics")
         print("    trace             merge/validate span-timeline files: zip")

@@ -1467,12 +1467,29 @@ class Server:
     bounds both directions (default: the ``rpc_max_message_mb`` flag)."""
 
     def __init__(self, service: Service, address=("127.0.0.1", 0), authkey=b"paddle-tpu",
-                 sleep=time.sleep, max_message_bytes: Optional[int] = None):
+                 sleep=time.sleep, max_message_bytes: Optional[int] = None,
+                 methods: Optional[Tuple[str, ...]] = None,
+                 backlog: int = 16):
+        """``methods``: the RPC whitelist to dispatch (default: the master
+        ``_METHODS`` surface).  Other planes — the serving-fleet router and
+        its engine agents (serving/router.py) — reuse this hardened
+        server (codec rejects, hostile-handshake accept loop, per-conn
+        threads) by passing their own service object + whitelist.
+
+        ``backlog``: the listen queue depth.  The Listener default (1) is
+        fine for a training fleet whose workers dial once at staggered
+        times, but a SERVING plane dials in bursts — per-request client
+        connections arriving together overflow a 1-deep accept queue, and
+        the dropped SYNs park on kernel retransmit timers (1s, 2s, 4s...)
+        that read as multi-second routing latency.  The serving fleet
+        passes a deeper queue still."""
         self.service = service
+        self._methods = tuple(methods) if methods is not None else _METHODS
         self._authkey = authkey
         self._sleep = sleep  # injectable: tests drive the accept-loop backoff
         self._max_msg = max_message_bytes or _wire.default_max_bytes()
-        self._listener = Listener(address, authkey=authkey)
+        self._listener = Listener(address, backlog=int(backlog),
+                                  authkey=authkey)
         self.address = self._listener.address
         self._stop = False
         self._conns: List = []
@@ -1623,7 +1640,7 @@ class Server:
                 seq = meta.get("seq") if meta else None
                 if method == "__close__":
                     return
-                if method not in _METHODS:
+                if method not in self._methods:
                     self._reply(conn, False, f"no such method {method}", seq)
                     continue
                 # the server-side half of the skew-alignment pair: span
@@ -1691,6 +1708,7 @@ class Client:
         call_timeout_s: Optional[float] = 60.0,
         sleep=time.sleep,
         max_message_bytes: Optional[int] = None,
+        methods: Optional[Tuple[str, ...]] = None,
     ):
         """``call_timeout_s`` is the per-RPC deadline (dial + reply): a
         call against a half-open socket — a master that bounced without an
@@ -1701,6 +1719,9 @@ class Client:
         self.call_timeout_s = (
             None if call_timeout_s is None else float(call_timeout_s)
         )
+        # the delegation surface __getattr__ exposes; other planes (the
+        # serving-fleet router/engine RPC) pass their own whitelist
+        self._methods = tuple(methods) if methods is not None else _METHODS
         self._sleep = sleep  # injectable: reconnect backoff + lease polls
         self._max_msg = max_message_bytes or _wire.default_max_bytes()
         self._seq = 0  # per-call correlation: stale replies discard by it
@@ -1948,7 +1969,7 @@ class Client:
         stats, ...) delegates positionally straight from ``_METHODS`` —
         ONE definition instead of a hand-kept mirror per client class.
         Signatures/semantics are the Service methods'."""
-        if name in _METHODS:
+        if name != "_methods" and name in self._methods:
             return lambda *args: self._call(name, *args)
         raise AttributeError(
             f"{type(self).__name__!s} has no attribute {name!r}"

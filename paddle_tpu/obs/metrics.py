@@ -36,30 +36,48 @@ __all__ = [
 _log = logging.getLogger("paddle_tpu.obs")
 
 
+def _series_key(name: str, labels) -> str:
+    """The exposition line's series id: ``family`` bare, or
+    ``family{k="v",...}`` with labels sorted (one canonical key per
+    label set, so register/unregister pairs always meet)."""
+    if not labels:
+        return name
+    inner = ",".join(
+        f'{k}="{_escape(str(v))}"' for k, v in sorted(labels.items())
+    )
+    return f"{name}{{{inner}}}"
+
+
 class _GaugeRegistry:
     """Process-wide named gauge callbacks (guarded; reads snapshot)."""
 
     def __init__(self) -> None:
         self._lock = make_lock("obs-gauges")
-        self._gauges: Dict[str, Tuple[Callable[[], float], str]] = {}
+        # series key -> (fn, help, family name)
+        self._gauges: Dict[str, Tuple[Callable[[], float], str, str]] = {}
 
     def register(self, name: str, fn: Callable[[], float],
-                 help_: str = "") -> None:
+                 help_: str = "", labels=None) -> None:
         """Latest registration wins (a newer scheduler instance takes the
-        name over); keep the returned ``fn`` to unregister safely."""
+        name over); keep the returned ``fn`` to unregister safely.
+        ``labels`` (dict) makes a LABELED series of the ``name`` family —
+        the fleet router registers one series per engine
+        (``engine="..."``); HELP/TYPE render once per family."""
         with self._lock:
-            self._gauges[name] = (fn, help_)
+            self._gauges[_series_key(name, labels)] = (fn, help_, name)
 
-    def unregister(self, name: str, fn: Optional[Callable] = None) -> None:
+    def unregister(self, name: str, fn: Optional[Callable] = None,
+                   labels=None) -> None:
         """Remove a gauge — but only if ``fn`` (when given) is still the
         registered callback: a closed older instance must not tear down
         the gauge a newer instance re-registered under the same name."""
+        key = _series_key(name, labels)
         with self._lock:
-            if fn is not None and self._gauges.get(name, (None,))[0] is not fn:
+            if fn is not None and self._gauges.get(key, (None,))[0] is not fn:
                 return
-            self._gauges.pop(name, None)
+            self._gauges.pop(key, None)
 
-    def snapshot(self) -> Dict[str, Tuple[Callable[[], float], str]]:
+    def snapshot(self) -> Dict[str, Tuple[Callable[[], float], str, str]]:
         with self._lock:
             return dict(self._gauges)
 
@@ -82,6 +100,16 @@ _LEDGER = (
     ("timeout", "serving/timeout"),
 )
 
+# the router-tier ledger (serving/router.py increments fleet/<status>):
+# same disjoint categories, distinct StatSet names — a process hosting
+# BOTH a router and an engine reports each tier's counts once
+_FLEET_LEDGER = (
+    ("served", "fleet/served"),
+    ("shed", "fleet/shed"),
+    ("rejected", "fleet/rejected"),
+    ("timeout", "fleet/timeout"),
+)
+
 
 def render_prometheus(stats=None) -> str:
     """The full exposition: registered gauges, the serving ledger, and
@@ -92,15 +120,20 @@ def render_prometheus(stats=None) -> str:
     summary = stats.summary()
     lines: List[str] = []
 
-    for name, (fn, help_) in sorted(_registry.snapshot().items()):
+    seen_families = set()
+    for key, (fn, help_, family) in sorted(_registry.snapshot().items()):
         try:
             value = float(fn())
         except Exception:  # noqa: BLE001 — a dead gauge must not kill export
             continue
-        if help_:
-            lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {value}")
+        if family not in seen_families:
+            # HELP/TYPE once per FAMILY: labeled series (the router's
+            # per-engine gauges) share one header like any exporter's
+            seen_families.add(family)
+            if help_:
+                lines.append(f"# HELP {family} {help_}")
+            lines.append(f"# TYPE {family} gauge")
+        lines.append(f"{key} {value}")
 
     lines.append(
         "# HELP paddle_tpu_serving_requests_total finalized serving "
@@ -111,6 +144,20 @@ def render_prometheus(stats=None) -> str:
         count = summary.get(stat, {}).get("count", 0)
         lines.append(
             f'paddle_tpu_serving_requests_total{{status="{status}"}} '
+            f"{int(count)}"
+        )
+
+    lines.append(
+        "# HELP paddle_tpu_fleet_requests_total requests finalized by the "
+        "fleet router, by disjoint terminal status (serving/router.py — "
+        "distinct from the per-engine serving ledger so an in-process "
+        "fleet never double-counts)"
+    )
+    lines.append("# TYPE paddle_tpu_fleet_requests_total counter")
+    for status, stat in _FLEET_LEDGER:
+        count = summary.get(stat, {}).get("count", 0)
+        lines.append(
+            f'paddle_tpu_fleet_requests_total{{status="{status}"}} '
             f"{int(count)}"
         )
 

@@ -55,6 +55,7 @@ class PrefixMixer:
         tail_tokens: int = 8,
         dup_frac: float = 0.25,
         seed: int = 0,
+        sessions: int = 0,
     ):
         if not 0.0 <= prefix_frac <= 1.0:
             raise ValueError("prefix_frac must be in [0, 1]")
@@ -73,6 +74,7 @@ class PrefixMixer:
         ]
         self._tail_tokens = int(tail_tokens)
         self._seed = int(seed)
+        self.sessions = int(sessions)
 
     def source(self, i: int) -> List[int]:
         """Source ids of the i-th request — deterministic in (seed, i),
@@ -91,6 +93,22 @@ class PrefixMixer:
             2, self.vocab, size=1 + rng.randint(self._tail_tokens)
         ).tolist()
         return list(prefix) + tail
+
+    def session_of(self, i: int) -> Optional[str]:
+        """Session id of the i-th request — deterministic in (seed, i),
+        or None when ``sessions`` is 0 (the default: session-less
+        traffic).  A prefix-bearing request's session follows its POOL
+        entry, so every request sharing a prompt head shares a session —
+        exactly the correlation the fleet router's session-affinity
+        routing keys on (shared-prefix traffic concentrates on the
+        engine whose cache already holds the blocks); fresh-source
+        requests spread round-robin over the session space."""
+        if self.sessions <= 0:
+            return None
+        rng = np.random.RandomState((self._seed * 1_000_003 + i) & 0x7FFFFFFF)
+        if rng.random_sample() >= self.prefix_frac:
+            return f"sess{i % self.sessions}"
+        return f"sess{(i % self.pool_size) % self.sessions}"
 
 
 class OpenLoopLoadGen:
@@ -112,6 +130,11 @@ class OpenLoopLoadGen:
     ``deadline_s``: when set, every built request is stamped with this
     per-request end-to-end deadline (``request.deadline_s``) before
     submission — the SLO input the scheduler's admission shedding reads.
+
+    ``session_of``: optional ``i -> session id`` callable (e.g.
+    :meth:`PrefixMixer.session_of`); a non-None id is stamped on the
+    built request (``request.session_id``) before submission — the
+    fleet router's affinity-routing key.
     """
 
     def __init__(
@@ -123,6 +146,7 @@ class OpenLoopLoadGen:
         process: str = "poisson",
         seed: int = 0,
         deadline_s: Optional[float] = None,
+        session_of: Optional[Callable[[int], Optional[str]]] = None,
         burst_factor: float = 3.0,
         burst_fraction: float = 0.2,
         clock=time.perf_counter,
@@ -136,6 +160,7 @@ class OpenLoopLoadGen:
         self.n_requests = int(n_requests)
         self.make_request = make_request
         self.deadline_s = deadline_s
+        self.session_of = session_of
         self._clock = clock
         self._sleep = sleep
         rng = np.random.RandomState(seed)
@@ -218,5 +243,9 @@ class OpenLoopLoadGen:
             req = self.make_request(i)
             if self.deadline_s is not None:
                 req.deadline_s = self.deadline_s
+            if self.session_of is not None:
+                sid = self.session_of(i)
+                if sid is not None:
+                    req.session_id = sid
             submitted.append(submit(req))
         return submitted

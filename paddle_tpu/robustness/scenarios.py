@@ -53,6 +53,18 @@ Slow scenarios (``SLOW_SCENARIOS`` — tests/test_scenarios_e2e.py,
   time after the fault, zero-recompute accounting, bit-identity of the
   final training parameters vs an unfaulted reference, and the serving
   status mix (only shed/timed-out requests may fail).
+* ``fleet_serving`` — the serving-FLEET kill drill (ISSUE 18): N real
+  ``paddle-tpu serve --register`` engine processes behind the affinity
+  router (serving/router.py), open-loop deadline traffic through the
+  fleet client, SIGKILL one engine mid-window.  Gates: the corpse is
+  pruned by lease expiry (recovery time bounded), traffic re-routes to
+  survivors with goodput holding, the disjoint fleet ledger sums to the
+  offered count, and the routing journal finalizes every request id
+  exactly once — zero double-serves.
+* ``fleet_rolling_restart`` — drain+replace EVERY engine under live
+  traffic: replacement registers first, the old engine drains via the
+  router's drain protocol and exits 0 on SIGTERM; the fleet never drops
+  below N-1 live engines and no request dies to the restart.
 
 `paddle-tpu scenario` runs any of these from the command line; bench.py
 ``bench_scenarios`` puts the fast gates under the regression guard
@@ -82,6 +94,8 @@ __all__ = [
     "scenario_partition_under_load",
     "fleet_reference",
     "run_fleet_chaos",
+    "run_fleet_serving",
+    "run_fleet_rolling_restart",
     "make_serving_engine",
 ]
 
@@ -1093,6 +1107,380 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
 
 
 # ---------------------------------------------------------------------------
+# serving fleet drills (serving/router.py): an in-process router frontend
+# over REAL `paddle-tpu serve --register` engine subprocesses
+# ---------------------------------------------------------------------------
+
+_ENGINE_SLOTS = 2
+
+
+def _spawn_engine(engine_id: str, router_addr, seed: int = 0, extra=()):
+    """One fleet engine subprocess (`paddle-tpu serve --register`) on the
+    tiny flagship, BLAS pinned to one thread (the _fleet_env discipline:
+    N engines on a small container must not fight over OpenMP pools).
+    ``extra``: additional CLI args (bench_fleet_serving passes
+    ``--prefix-cache`` for the affinity A/B)."""
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu", "serve",
+         "--register", f"{router_addr[0]}:{router_addr[1]}",
+         "--engine-id", engine_id,
+         "--max-slots", str(_ENGINE_SLOTS), "--hbm-budget-mb", "2",
+         "--src-vocab", str(_V), "--trg-vocab", str(_V),
+         "--word-dim", str(_E), "--hidden-dim", str(_H),
+         "--max-length", str(_MAXLEN), "--seed", str(seed),
+         "--drain-timeout-s", "60"] + list(extra),
+        env=_fleet_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _wait_engines(router, n: int, timeout_s: float = 120.0,
+                  procs=()) -> None:
+    deadline = time.time() + timeout_s
+    while len(router.live_engines()) < n:
+        for p in procs:
+            if p.poll() is not None:
+                _out, err = p.communicate()
+                raise RuntimeError(
+                    f"engine died before registering (rc {p.returncode}): "
+                    f"{err[-2000:]}"
+                )
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"only {len(router.live_engines())}/{n} engines registered "
+                f"in {timeout_s}s"
+            )
+        time.sleep(0.05)  # lock: allow[C306] fleet-assembly poll over real subprocesses: wall-clock by design
+
+
+def _prewarm_fleet(router) -> None:
+    """Compile every engine's serve path BEFORE the measured window (the
+    bench prewarm discipline, one tier up): each engine's slot rungs are
+    exercised through its own data plane, so the drill's latencies and
+    EWMAs measure dispatch under routing, not XLA."""
+    from paddle_tpu import master as _master
+    from paddle_tpu.serving.router import ENGINE_METHODS
+
+    engines = router.fleet_stats()["engines"]
+    for k, (eid, view) in enumerate(sorted(engines.items())):
+        addr = tuple(view["address"])
+        for j, src_len in enumerate((5, 20)):
+            # rung 2 as well: two concurrent requests batch on the engine
+            def _one(i, n=src_len, a=addr):
+                c = _master.Client(a, methods=ENGINE_METHODS,
+                                   call_timeout_s=180.0, reconnect_tries=2)
+                try:
+                    c.serve(f"warm-{a[1]}-{n}-{i}", [2] * n, 4, None, None,
+                            None)
+                finally:
+                    c.close()
+            ts = [threading.Thread(target=_one, args=(i,),
+                                   name="scenario-fleet-warm", daemon=True)
+                  for i in range(_ENGINE_SLOTS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(240.0)
+
+
+def _journal_double_serves(journal_path: str) -> int:
+    """Count request ids finalized MORE than once in the routing journal —
+    the on-disk proof of the zero-double-serve contract."""
+    done: Dict[str, int] = {}
+    with open(journal_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("t") == "done" and rec.get("req"):
+                done[rec["req"]] = done.get(rec["req"], 0) + 1
+    return sum(1 for c in done.values() if c > 1)
+
+
+def run_fleet_serving(workdir: str, n_engines: int = 2,
+                      n_requests: int = 36, rate_rps: float = 6.0,
+                      slo_ms: Optional[float] = None,
+                      seed: int = 0) -> Dict[str, Any]:
+    """The serving-fleet kill drill (ISSUE 18): N real engine processes
+    behind the affinity router, open-loop deadline traffic, then SIGKILL
+    one engine mid-window.  Gates: the dead engine is pruned via lease
+    expiry (recovery time bounded), traffic re-routes to the survivors
+    (goodput holds — only shed/timeout may fail), the disjoint fleet
+    ledger sums to the offered count, and the routing journal finalizes
+    every request id EXACTLY once (zero double-serves)."""
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+    from paddle_tpu.serving import FleetClient, Request, Router
+
+    d = os.path.abspath(workdir)
+    os.makedirs(d, exist_ok=True)
+    journal = os.path.join(d, "journal.jsonl")
+    slo_s = (float(slo_ms) / 1e3) if slo_ms and slo_ms > 0 else 30.0
+    lease_s = 1.5
+    router = Router(
+        address=("127.0.0.1", 0), journal_path=journal,
+        lease_timeout_s=lease_s, stats_poll_s=0.1,
+    )
+    procs = []
+    reqs: List[Any] = []
+    kill_stamp: Dict[str, float] = {}
+    try:
+        procs = [
+            _spawn_engine(f"eng{i}", router.address, seed)
+            for i in range(n_engines)
+        ]
+        _wait_engines(router, n_engines, procs=procs)
+        _prewarm_fleet(router)
+
+        mixer = PrefixMixer(_V, pool_size=3, prefix_frac=0.5, seed=seed,
+                            sessions=4)
+
+        def mk(i):
+            r = Request(
+                mixer.source(i), 8, req_id=f"flt-{seed}-{i}",
+                session_id=mixer.session_of(i),
+            )
+            reqs.append(r)
+            return r
+
+        victim = procs[0]
+
+        def _kill_mid_window():
+            # fire roughly a third into the arrival schedule
+            time.sleep((n_requests / rate_rps) / 3.0)
+            kill_stamp["t"] = time.time()
+            victim.kill()
+
+        killer = threading.Thread(target=_kill_mid_window,
+                                  name="scenario-fleet-kill", daemon=True)
+        fc = FleetClient(router.address)
+        t0 = time.perf_counter()
+        try:
+            killer.start()
+            OpenLoopLoadGen(
+                rate_rps, n_requests, mk, seed=seed, deadline_s=slo_s,
+            ).run(fc.submit)
+            for r in reqs:
+                if not r.wait(300):
+                    raise RuntimeError(f"request {r.req_id} never finalized")
+        finally:
+            fc.close()
+            killer.join(60.0)
+        wall = time.perf_counter() - t0
+
+        # the lease plane prunes the corpse; recovery = SIGKILL -> pruned
+        deadline = time.time() + 4 * lease_s + 5.0
+        while "eng0" in router.live_engines():
+            if time.time() > deadline:
+                raise RuntimeError("killed engine never pruned")
+            time.sleep(0.02)  # lock: allow[C306] watches a REAL lease expire: wall-clock by design
+        recovery_s = time.time() - kill_stamp["t"]
+        victim.communicate(timeout=60)
+        fleet = router.fleet_stats()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        survivor_rcs = []
+        for p in procs:
+            if p.stdout is not None and not p.stdout.closed:
+                try:
+                    p.communicate(timeout=90)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.communicate()
+            survivor_rcs.append(p.returncode)
+        router.close()
+
+    statuses = _status_counts(reqs)
+    served = [r for r in reqs if r.status == "served"]
+    lat = [r.t_done - r.t_submit for r in served]
+    in_slo = [x for x in lat if x <= slo_s]
+    fail_bad = [
+        r for r in reqs if r.status not in ("served", "shed", "timeout")
+    ]
+    double_serves = _journal_double_serves(journal)
+    ledger_total = sum(fleet["ledger"].values())
+    return {
+        "scenario": "fleet_serving",
+        "n_engines": n_engines,
+        "slo_ms": round(slo_s * 1e3, 3),
+        "n_offered": len(reqs),
+        "offered_rps": round(rate_rps, 2),
+        "wall_s": round(wall, 3),
+        "statuses": statuses,
+        "goodput_frac": round(len(in_slo) / len(reqs), 4) if reqs else None,
+        "p50_ms": _ms(_pct(lat, 0.50)),
+        "p95_ms": _ms(_pct(lat, 0.95)),
+        "p99_ms": _ms(_pct(lat, 0.99)),
+        "recovery_after_kill_s": round(recovery_s, 3),
+        "reroutes": fleet["reroutes"],
+        "duplicates_discarded": fleet["duplicates_discarded"],
+        "double_served": double_serves,
+        "ledger": fleet["ledger"],
+        "ledger_disjoint": ledger_total
+        == fleet["ledger"]["served"] + fleet["ledger"]["shed"]
+        + fleet["ledger"]["rejected"] + fleet["ledger"]["timeout"]
+        + fleet["ledger"]["closed"],
+        "survivor_rcs": survivor_rcs[1:],
+        "passed": bool(
+            not fail_bad
+            and double_serves == 0
+            and recovery_s <= 4 * lease_s + 5.0
+            and len(in_slo) / len(reqs) >= 0.5
+            and all(rc == 0 for rc in survivor_rcs[1:])
+        ),
+    }
+
+
+def run_fleet_rolling_restart(workdir: str, n_engines: int = 2,
+                              n_requests: int = 30, rate_rps: float = 4.0,
+                              slo_ms: Optional[float] = None,
+                              seed: int = 0) -> Dict[str, Any]:
+    """The rolling-restart drill (ISSUE 18): drain+replace EVERY engine
+    under live open-loop traffic — replacement registers first, then the
+    old engine drains via the router's drain protocol and exits on
+    SIGTERM.  Gates: every drain clean and every retired engine exits 0,
+    the fleet never drops below N-1 live engines, and no request dies to
+    the restart (only served/shed/timeout terminal states)."""
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+    from paddle_tpu.serving import FleetClient, Request, Router
+
+    d = os.path.abspath(workdir)
+    os.makedirs(d, exist_ok=True)
+    journal = os.path.join(d, "journal.jsonl")
+    router = Router(
+        address=("127.0.0.1", 0), journal_path=journal,
+        lease_timeout_s=2.0, stats_poll_s=0.1,
+    )
+    procs: Dict[str, Any] = {}
+    reqs: List[Any] = []
+    min_live = [n_engines]
+    stop_sampling = threading.Event()
+
+    def _sample_live():
+        while not stop_sampling.is_set():
+            min_live[0] = min(min_live[0], len(router.live_engines()))
+            time.sleep(0.05)  # lock: allow[C306] samples REAL fleet membership over a wall-clock drill window
+
+    sampler = threading.Thread(target=_sample_live,
+                               name="scenario-fleet-sample", daemon=True)
+    drains: Dict[str, Any] = {}
+    rcs: Dict[str, int] = {}
+    try:
+        for i in range(n_engines):
+            procs[f"eng{i}"] = _spawn_engine(f"eng{i}", router.address, seed)
+        _wait_engines(router, n_engines, procs=list(procs.values()))
+        _prewarm_fleet(router)
+        sampler.start()
+
+        mixer = PrefixMixer(_V, pool_size=3, prefix_frac=0.5, seed=seed,
+                            sessions=4)
+
+        def mk(i):
+            r = Request(
+                mixer.source(i), 8, req_id=f"roll-{seed}-{i}",
+                session_id=mixer.session_of(i),
+            )
+            reqs.append(r)
+            return r
+
+        fc = FleetClient(router.address)
+        gen_done = threading.Event()
+        gen_err: List[BaseException] = []
+
+        def _offer():
+            try:
+                OpenLoopLoadGen(rate_rps, n_requests, mk, seed=seed).run(
+                    fc.submit
+                )
+            except BaseException as e:  # noqa: BLE001 — reported by the join below
+                gen_err.append(e)
+            finally:
+                gen_done.set()
+
+        offerer = threading.Thread(target=_offer,
+                                   name="scenario-fleet-offer", daemon=True)
+        t0 = time.perf_counter()
+        try:
+            offerer.start()
+            for i in range(n_engines):
+                old = f"eng{i}"
+                new = f"eng{n_engines + i}"
+                # replacement FIRST: the fleet grows to N+1, drains to N,
+                # and never dips below N-1 even transiently
+                procs[new] = _spawn_engine(new, router.address, seed)
+                _wait_engines(router, n_engines + 1,
+                              procs=[procs[new]])
+                clean = router.drain_engine(old, timeout_s=90.0)
+                drains[old] = clean
+                p = procs[old]
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+                try:
+                    p.communicate(timeout=90)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.communicate()
+                rcs[old] = p.returncode
+            offerer.join(300.0)
+            if gen_err:
+                raise gen_err[0]
+            for r in reqs:
+                if not r.wait(300):
+                    raise RuntimeError(f"request {r.req_id} never finalized")
+        finally:
+            fc.close()
+        wall = time.perf_counter() - t0
+        fleet = router.fleet_stats()
+    finally:
+        stop_sampling.set()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for name, p in procs.items():
+            if name in rcs:
+                continue
+            try:
+                p.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+        router.close()
+
+    statuses = _status_counts(reqs)
+    fail_bad = [
+        r for r in reqs if r.status not in ("served", "shed", "timeout")
+    ]
+    double_serves = _journal_double_serves(journal)
+    return {
+        "scenario": "fleet_rolling_restart",
+        "n_engines": n_engines,
+        "rotations": n_engines,
+        "n_offered": len(reqs),
+        "wall_s": round(wall, 3),
+        "statuses": statuses,
+        "drains_clean": drains,
+        "retired_rcs": rcs,
+        "min_live_engines": min_live[0],
+        "double_served": double_serves,
+        "reroutes": fleet["reroutes"],
+        "ledger": fleet["ledger"],
+        "passed": bool(
+            not fail_bad
+            and double_serves == 0
+            and all(drains.values())
+            and all(rc == 0 for rc in rcs.values())
+            and min_live[0] >= n_engines - 1
+            and statuses["served"] >= 1
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -1115,6 +1503,12 @@ SLOW_SCENARIOS = {
     ),
     "fleet_kill_master": lambda workdir, **kw: run_fleet_chaos(
         workdir, kill="kill_master", **kw
+    ),
+    "fleet_serving": lambda workdir, **kw: run_fleet_serving(
+        workdir, **kw
+    ),
+    "fleet_rolling_restart": lambda workdir, **kw: run_fleet_rolling_restart(
+        workdir, **kw
     ),
 }
 

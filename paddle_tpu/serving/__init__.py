@@ -19,11 +19,23 @@ comparison, arXiv:2605.25645, sets the metric vocabulary):
 * :mod:`~paddle_tpu.serving.scheduler` — request queue + continuous
   batching: sequences admit and retire every step, no recompiles; plus
   the production SLO plane (ISSUE 12): per-request deadlines, bounded-
-  queue backpressure, deadline-aware shedding, ``cancel``/``drain``.
+  queue backpressure, deadline-aware shedding, ``cancel``/``drain``;
+* :mod:`~paddle_tpu.serving.router` — the fleet tier (ISSUE 18): an
+  SLO-aware, affinity-routing frontend over N engine processes on
+  heartbeat leases, speaking the typed wire codec, with a journal-backed
+  idempotent request ledger (zero double-serve across router failover)
+  and drain-aware rolling restart.
 """
 
 from paddle_tpu.serving.engine import ServingEngine
 from paddle_tpu.serving.pages import BlockPagedCache
+from paddle_tpu.serving.router import (
+    EngineAgent,
+    FleetClient,
+    Router,
+    affinity_key,
+    rendezvous_pick,
+)
 from paddle_tpu.serving.scheduler import (
     Request,
     ServingScheduler,
@@ -33,9 +45,14 @@ from paddle_tpu.serving.scheduler import (
 
 __all__ = [
     "BlockPagedCache",
+    "EngineAgent",
+    "FleetClient",
     "Request",
+    "Router",
     "ServingEngine",
     "ServingScheduler",
+    "affinity_key",
     "percentile",
+    "rendezvous_pick",
     "status_counts",
 ]

@@ -125,12 +125,18 @@ class Request:
         callback: Optional[Callable[["Request"], Any]] = None,
         deadline_s: Optional[float] = None,
         beam_size: Optional[int] = None,
+        session_id: Optional[str] = None,
     ):
         self.req_id = req_id if req_id is not None else f"r{next(_req_counter)}"
         self.src_ids = list(src_ids)
         self.max_new_tokens = max_new_tokens
         self.callback = callback
         self.deadline_s = deadline_s
+        # conversation/session handle: the fleet router's affinity key —
+        # requests sharing a session (and so, in production, a prompt
+        # head) concentrate on the engine whose prefix cache already
+        # holds their blocks.  Opaque to the single-engine scheduler.
+        self.session_id = session_id
         # beam decode as a serving citizen: > 1 routes the request through
         # the engine's paged whole-sequence beam program (one dispatch,
         # best hypothesis in ``tokens`` + its ``beam_score``); None/1 =
@@ -337,6 +343,32 @@ class ServingScheduler:
         requests are untouched."""
         req_id = request.req_id if isinstance(request, Request) else request
         self._cancel_q.put((req_id, reason))
+
+    def export_stats(self) -> dict:
+        """One plain-dict snapshot of the SLO gauges — the engine side of
+        the fleet router's single typed stats RPC (serving/router.py).
+        Same quantities the Prometheus gauges expose, but shipped as one
+        wire-codec payload (the ``write_stats_json`` record shape), so the
+        router never scrapes text.  Advisory reads of step-thread state,
+        exactly like the gauge callbacks: stale by at most one poll."""
+        eng = self._engine
+        with self._lock:
+            depth = self._depth
+        return {
+            "queue_depth": int(depth),
+            "pages_in_use": int(eng.pages.n_used),
+            "predicted_wait_s": float(self._predicted_wait_s(depth) or 0.0),
+            "est_service_s": float(self._est_service_s() or 0.0),
+            "prefix_cache_hits": int(eng.prefix_hits),
+            "prefix_cache_misses": int(eng.prefix_misses),
+            "pages_shared": int(eng.pages.n_shared),
+            "spec_accept_rate": float(eng.spec_accept_rate()),
+            "n_live": int(eng.n_live),
+            "n_prefilling": int(getattr(eng, "n_prefilling", 0)),
+            "n_free_slots": int(eng.n_free_slots),
+            "max_slots": int(eng.max_slots),
+            "draining": bool(self._draining.is_set()),
+        }
 
     def drain(self, timeout: float = 60.0) -> bool:
         """Graceful shutdown: stop admitting (further submits are

@@ -395,3 +395,36 @@ define_flag("serving_int8_drift_budget", 0.08,
             "f32 reference, measured as max|logits_int8 - logits_f32| / "
             "max|logits_f32| on a probe batch — the explicit bit-drift "
             "budget the serving bench and tests gate on")
+define_flag("router_lease_timeout_s", 2.0,
+            "heartbeat-lease timeout of the serving-fleet router's "
+            "engine registry (serving/router.py — the master cluster "
+            "plane's worker-lease discipline lifted to the serving "
+            "tier): an engine silent this long is pruned and its "
+            "in-flight requests re-route to the survivors")
+define_flag("router_queue_limit", 0,
+            "bound on requests concurrently inside the router's "
+            "admission/dispatch section (the serving_queue_limit "
+            "semantics one tier up): past it a request is REJECTED at "
+            "the frontend before paying a network hop; 0 = unbounded")
+define_flag("router_stats_poll_s", 0.2,
+            "period of the router's per-engine stats poll — one typed "
+            "RPC per engine per period (scheduler.export_stats over the "
+            "wire codec, not a Prometheus scrape); routing scores read "
+            "the latest snapshot")
+define_flag("router_affinity", True,
+            "prefix/session affinity routing in the fleet router: hash "
+            "the request's session id (or its prefix block-chain key) "
+            "to a preferred engine by rendezvous hashing, so "
+            "shared-prefix traffic concentrates where the COW prefix "
+            "cache already holds the blocks.  The preferred engine is "
+            "OVERRIDDEN when its predicted wait exceeds the best "
+            "engine's by more than router_affinity_slack_s — affinity "
+            "must never defeat load balance")
+define_flag("router_affinity_slack_s", 0.25,
+            "how much worse (seconds of predicted wait) the affinity-"
+            "preferred engine may be before the router falls back to "
+            "the least-predicted-wait choice")
+define_flag("router_call_timeout_s", 120.0,
+            "per-request deadline of the router->engine serve RPC "
+            "(dial + full decode + reply); requests carrying their own "
+            "SLO use min(remaining deadline + grace, this)")

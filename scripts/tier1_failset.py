@@ -60,6 +60,7 @@ SLOW_ONLY_FILES = [
     "tests/test_netem_e2e.py",
     "tests/test_quantized_e2e.py",
     "tests/test_decode_speed_e2e.py",
+    "tests/test_fleet_serving_e2e.py",
 ]
 
 

@@ -1,0 +1,159 @@
+"""Fleet-serving e2e drills (slow; `make chaos` runs them SANITIZER-ARMED).
+
+Three tiers over the REAL wire (paddle_tpu/serving/router.py on
+master.Server sockets):
+
+* socket fleet with fake schedulers — Router + 2 ``EngineAgent`` data
+  planes + ``FleetClient``, requests spread over both engines, outputs
+  deterministic, and a duplicate submit over the wire returns the
+  ORIGINAL tokens flagged ``duplicate`` (the at-least-once ack plane);
+* the ``fleet_serving`` scenario — real ``paddle-tpu serve --register``
+  engine subprocesses, SIGKILL one mid-window: lease-expiry re-route,
+  bounded recovery, journal-audited zero double-serves;
+* the ``fleet_rolling_restart`` scenario — drain+replace every engine
+  under live traffic: clean drains, rc 0 exits, fleet never below N-1.
+
+Real processes + wall-clock traffic, so the module is slow-marked
+(scripts/tier1_failset.py --slow-guard pins that).
+"""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu import master
+from paddle_tpu.robustness.scenarios import (
+    run_fleet_rolling_restart,
+    run_fleet_serving,
+)
+from paddle_tpu.serving import EngineAgent, FleetClient, Request, Router
+from paddle_tpu.serving.router import ROUTER_METHODS
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fleet_threads():
+    before = set(threading.enumerate())
+    yield
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t not in before and t.name.startswith("paddle-")
+            and t.is_alive()
+        ]
+        if not leaked:
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"leaked fleet threads: {[t.name for t in leaked]}")
+
+
+class FakeScheduler:
+    """Scheduler-shaped stub: finalizes every request instantly with a
+    deterministic token echo — the wire/routing path is under test here,
+    not decoding (the scenario tests below run real engines)."""
+
+    def __init__(self):
+        self.draining = False
+        self.served = 0
+
+    def submit(self, r):
+        r.tokens = [len(r.src_ids), int(r.src_ids[0])]
+        r.status = "served"
+        r.error = None
+        self.served += 1
+        r._event.set()
+
+    def cancel(self, r, reason=""):
+        pass
+
+    def export_stats(self):
+        return {
+            "queue_depth": 0, "pages_in_use": 0, "predicted_wait_s": 0.0,
+            "est_service_s": 0.01, "max_slots": 4, "n_live": 0,
+            "draining": self.draining,
+        }
+
+    def drain(self, timeout_s):
+        self.draining = True
+        return True
+
+
+def test_socket_fleet_routes_and_dedups():
+    router = Router(address=("127.0.0.1", 0), stats_poll_s=0.1,
+                    lease_timeout_s=2.0)
+    agents = []
+    try:
+        scheds = [FakeScheduler() for _ in range(2)]
+        agents = [
+            EngineAgent(s, f"eng{i}", router.address)
+            for i, s in enumerate(scheds)
+        ]
+        for a in agents:
+            assert a.registered.wait(10.0), "engine never registered"
+        assert router.live_engines() == ["eng0", "eng1"]
+
+        reqs = [Request([2 + i, 3, 4], 4, req_id=f"w{i}") for i in range(12)]
+        fc = FleetClient(router.address)
+        try:
+            for r in reqs:
+                fc.submit(r)
+            for r in reqs:
+                assert r.wait(30.0), f"request {r.req_id} never finalized"
+        finally:
+            fc.close()
+        for i, r in enumerate(reqs):
+            assert r.status == "served" and r.error is None
+            assert r.tokens == [3, 2 + i]  # the fake's deterministic echo
+        assert sum(s.served for s in scheds) == 12
+        assert all(s.served > 0 for s in scheds), (
+            "least-predicted-wait routing never spread across the fleet: "
+            f"{[s.served for s in scheds]}"
+        )
+
+        # duplicate submit over the REAL wire: the ledger answers with the
+        # original tokens, no second engine dispatch
+        c = master.Client(router.address, methods=ROUTER_METHODS,
+                          call_timeout_s=30.0)
+        try:
+            first = c.serve("dup1", [5, 6, 7], 4, None, None, None)
+            again = c.serve("dup1", [5, 6, 7], 4, None, None, None)
+        finally:
+            c.close()
+        assert first["status"] == "served" and "duplicate" not in first
+        assert again["duplicate"] is True
+        assert again["tokens"] == first["tokens"] == [3, 5]
+        assert sum(s.served for s in scheds) == 13
+        ledger = router.fleet_stats()["ledger"]
+        assert ledger["served"] == 13 and sum(ledger.values()) == 13
+    finally:
+        for a in agents:
+            a.close()
+        router.close()
+
+
+def test_fleet_serving_scenario_kill_one_engine(tmp_path):
+    out = run_fleet_serving(
+        str(tmp_path), n_engines=2, n_requests=24, rate_rps=6.0, seed=0,
+    )
+    assert out["passed"], out
+    assert out["double_served"] == 0
+    assert out["ledger_disjoint"] is True
+    assert sum(out["statuses"].values()) == out["n_offered"]
+    assert out["reroutes"] >= 0 and out["recovery_after_kill_s"] <= 11.0
+    # only SLO-sanctioned failure modes may appear under the kill
+    assert out["statuses"]["rejected"] == 0 and out["statuses"]["closed"] == 0
+
+
+def test_fleet_rolling_restart_scenario(tmp_path):
+    out = run_fleet_rolling_restart(
+        str(tmp_path), n_engines=2, n_requests=16, rate_rps=4.0, seed=0,
+    )
+    assert out["passed"], out
+    assert all(out["drains_clean"].values())
+    assert all(rc == 0 for rc in out["retired_rcs"].values())
+    assert out["min_live_engines"] >= 1
+    assert out["double_served"] == 0
+    assert out["statuses"]["rejected"] == 0 and out["statuses"]["closed"] == 0

@@ -1,0 +1,334 @@
+"""Fleet-router fast units (paddle_tpu/serving/router.py) — the routing
+POLICY in-process, no sockets, no engine subprocesses:
+
+* least-predicted-wait dispatch (engine EWMA + router-side in-flight);
+* affinity-key stability (block-chain hash, tail-invariant) + rendezvous
+  minimal movement (a new engine steals only the keys it wins);
+* drain-aware exclusion (a draining engine takes no new requests);
+* lease-expiry removal (a silent engine is pruned, a heartbeat renews);
+* the zero-double-serve ledger: duplicate submits AND duplicate result
+  deliveries return the ORIGINAL record exactly once, and a journal-
+  recovered router refuses ids its predecessor already settled.
+
+Fake engines are injected through ``client_factory`` — the router dials
+its data plane per request, so a dict of scripted callables stands in for
+the whole fleet.  The socket path is covered by
+tests/test_fleet_serving_e2e.py (slow, `make chaos`).
+"""
+
+import threading
+
+import pytest
+
+from paddle_tpu.serving.router import (
+    Router,
+    affinity_key,
+    rendezvous_pick,
+)
+
+
+class FakeClock:
+    def __init__(self, now=0.0):
+        self.now = float(now)
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += float(dt)
+
+
+class FakeEngineClient:
+    """Scripted engine data-plane client: behavior keyed on the engine's
+    fake address (the router never cares what is behind the dial)."""
+
+    def __init__(self, book, address):
+        self._book = book
+        self._addr = (str(address[0]), int(address[1]))
+
+    def serve(self, req_id, src_ids, max_new_tokens=None, deadline_s=None,
+              beam_size=None, session_id=None):
+        self._book.setdefault("serves", []).append((self._addr, str(req_id)))
+        fn = self._book.get("serve")
+        if fn is not None:
+            return fn(self._addr, req_id, src_ids)
+        return {
+            "req_id": str(req_id), "status": "served",
+            "tokens": [7, 8, 9], "error": None,
+            "engine": f"fake@{self._addr[1]}",
+        }
+
+    def stats(self):
+        return dict(self._book.get("stats", {}).get(self._addr, {}))
+
+    def drain(self, timeout_s):
+        self._book.setdefault("drains", []).append(self._addr)
+        return True
+
+    def ping(self):
+        return "pong"
+
+    def close(self):
+        pass
+
+
+def make_router(book, clk, **kw):
+    kw.setdefault("address", None)
+    kw.setdefault("stats_poll_s", 3600.0)  # poll thread idles: units script
+    kw.setdefault("lease_timeout_s", 2.0)  # h.stats directly
+    kw.setdefault("sleep", lambda s: clk.advance(s))
+    return Router(
+        clock=clk,
+        client_factory=lambda addr, timeout: FakeEngineClient(book, addr),
+        **kw,
+    )
+
+
+def set_stats(router, engine_id, **st):
+    with router._lock:
+        router._engines[engine_id].stats = st
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_router_threads():
+    before = {t for t in threading.enumerate()}
+    yield
+    leaked = [
+        t for t in threading.enumerate()
+        if t not in before and t.name.startswith("paddle-") and t.is_alive()
+    ]
+    assert not leaked, f"leaked router threads: {[t.name for t in leaked]}"
+
+
+# -- routing policy ---------------------------------------------------------
+
+def test_least_predicted_wait_choice():
+    clk = FakeClock()
+    r = make_router({}, clk, affinity=False)
+    try:
+        for i, e in enumerate(("a", "b", "c")):
+            r.register_engine(e, "127.0.0.1", 9000 + i)
+        set_stats(r, "a", predicted_wait_s=0.5, est_service_s=0.1,
+                  max_slots=2)
+        set_stats(r, "b", predicted_wait_s=0.05, est_service_s=0.1,
+                  max_slots=2)
+        set_stats(r, "c", predicted_wait_s=0.2, est_service_s=0.1,
+                  max_slots=2)
+        assert r.pick_engine() == "b"
+        # router-side in-flight amortized over slots covers poll staleness:
+        # 12 in flight on b -> 0.05 + 12*0.1/2 = 0.65 > c's 0.2, a's 0.5
+        with r._lock:
+            r._engines["b"].inflight = 12
+        assert r.pick_engine() == "c"
+        # exclusion (the re-route `tried` set) falls through to the next
+        assert r.pick_engine(exclude=("c",)) == "a"
+    finally:
+        r.close()
+
+
+def test_affinity_key_stability():
+    blk = 16
+    head = list(range(2, 2 + blk))  # one whole block
+    k1 = affinity_key(head + [30, 31], None, blk)
+    k2 = affinity_key(head + [40, 41, 42], None, blk)
+    k3 = affinity_key(head + [30, 31], None, blk)
+    # the key hashes WHOLE blocks only: same head-block => same key,
+    # whatever the sub-block tail — exactly the prefix-cache share unit
+    assert k1 == k2 == k3
+    assert affinity_key([9] * blk + [1], None, blk) != k1
+    # a session id overrides the content hash (conversation stickiness)
+    assert affinity_key(head, "u1", blk) == "sess:u1"
+    # sub-block prompts still key deterministically
+    assert affinity_key([2, 3], None, blk) == affinity_key([2, 3], None, blk)
+
+
+def test_rendezvous_minimal_movement():
+    keys = [f"k{i}" for i in range(100)]
+    old = ["e0", "e1", "e2"]
+    before = {k: rendezvous_pick(k, old) for k in keys}
+    # stable under permutation of the candidate list
+    assert all(
+        rendezvous_pick(k, ["e2", "e0", "e1"]) == before[k] for k in keys
+    )
+    after = {k: rendezvous_pick(k, old + ["e3"]) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    # rendezvous hashing: every moved key moved TO the new engine, and
+    # roughly 1/4 of the keyspace moved (not a full reshuffle)
+    assert moved and all(after[k] == "e3" for k in moved)
+    assert len(moved) < 50
+
+
+def test_affinity_respects_slack():
+    clk = FakeClock()
+    r = make_router({}, clk, affinity=True, affinity_slack_s=0.25)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        r.register_engine("b", "127.0.0.1", 9001)
+        key = "sess:pin"
+        pref = rendezvous_pick(key, ["a", "b"])
+        other = "b" if pref == "a" else "a"
+        # within slack: affinity wins even when the other engine is idler
+        set_stats(r, pref, predicted_wait_s=0.2, est_service_s=0.0,
+                  max_slots=1)
+        set_stats(r, other, predicted_wait_s=0.0, est_service_s=0.0,
+                  max_slots=1)
+        assert r.pick_engine(key) == pref
+        # beyond slack: load wins over stickiness
+        set_stats(r, pref, predicted_wait_s=10.0, est_service_s=0.0,
+                  max_slots=1)
+        assert r.pick_engine(key) == other
+    finally:
+        r.close()
+
+
+def test_drain_aware_exclusion():
+    clk = FakeClock()
+    book = {}
+    r = make_router(book, clk, affinity=False)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        r.register_engine("b", "127.0.0.1", 9001)
+        with r._lock:
+            r._engines["a"].draining = True
+        assert r.pick_engine() == "b"
+        assert r.pick_engine(exclude=("b",)) is None  # draining never picked
+        # the full drain protocol: forwarded over the wire, then deregistered
+        assert r.drain_engine("b") is True
+        assert book["drains"] == [("127.0.0.1", 9001)]
+        assert r.pick_engine() is None
+    finally:
+        r.close()
+
+
+def test_lease_expiry_removal():
+    clk = FakeClock()
+    r = make_router({}, clk, lease_timeout_s=2.0)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        r.register_engine("b", "127.0.0.1", 9001)
+        clk.advance(1.0)
+        assert r.heartbeat("a") is True  # renews to t=3.0
+        clk.advance(1.5)  # t=2.5: b's lease (t=2.0) expired, a's holds
+        assert r.live_engines() == ["a"]
+        # an expired engine's heartbeat is refused — it must re-register
+        assert r.heartbeat("b") is False
+        ack = r.register_engine("b", "127.0.0.1", 9001)
+        assert "b" in ack["engines"] and r.live_engines() == ["a", "b"]
+    finally:
+        r.close()
+
+
+# -- the zero-double-serve ledger -------------------------------------------
+
+def test_zero_double_serve_on_duplicate_delivery():
+    clk = FakeClock()
+    book = {}
+    r = make_router(book, clk, affinity=False)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        first = r.serve("r1", [2, 3, 4])
+        assert first["status"] == "served" and first["tokens"] == [7, 8, 9]
+        # an at-least-once client retry re-delivers the SAME req_id: the
+        # ledger returns the original record, flagged, without a second
+        # engine dispatch
+        again = r.serve("r1", [2, 3, 4])
+        assert again["duplicate"] is True
+        assert again["tokens"] == [7, 8, 9] and again["status"] == "served"
+        assert [rid for _, rid in book["serves"]] == ["r1"]
+        ledger = r.fleet_stats()["ledger"]
+        assert ledger["served"] == 1 and sum(ledger.values()) == 1
+    finally:
+        r.close()
+
+
+def test_duplicate_result_delivery_discarded():
+    clk = FakeClock()
+    r = make_router({}, clk)
+    try:
+        one = r._finalize("rq", "served", tokens=[1, 2], engine="a")
+        assert one["tokens"] == [1, 2] and "duplicate" not in one
+        # a re-route race delivers a SECOND terminal result for the same
+        # id: first writer wins, the late copy is counted and discarded
+        two = r._finalize("rq", "served", tokens=[9, 9], engine="b")
+        assert two["duplicate"] is True and two["tokens"] == [1, 2]
+        assert two["engine"] == "a"
+        assert r.duplicates_discarded == 1
+        assert r.fleet_stats()["ledger"]["served"] == 1
+    finally:
+        r.close()
+
+
+def test_journal_failover_refuses_double_serve(tmp_path):
+    journal = str(tmp_path / "journal.jsonl")
+    clk = FakeClock()
+    book = {}
+    r1 = make_router(book, clk, journal_path=journal)
+    try:
+        r1.register_engine("a", "127.0.0.1", 9000)
+        assert r1.serve("r1", [2, 3])["status"] == "served"
+    finally:
+        r1.close()
+    # HA failover: a fresh incarnation recovers the ledger from the journal
+    r2 = make_router(book, clk, journal_path=journal)
+    try:
+        r2.register_engine("a", "127.0.0.1", 9000)
+        dup = r2.serve("r1", [2, 3])
+        assert dup["duplicate"] is True and dup["status"] == "served"
+        assert "recovered" in dup["error"]
+        # only the original pre-failover dispatch ever reached an engine
+        assert [rid for _, rid in book["serves"]] == ["r1"]
+        fresh = r2.serve("r2", [2, 3])
+        assert fresh["status"] == "served" and "duplicate" not in fresh
+    finally:
+        r2.close()
+
+
+def test_frontend_validation_rejects_before_network():
+    clk = FakeClock()
+    book = {}
+    r = make_router(book, clk)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        bad = [
+            r.serve("v1", "not-a-token-list"),
+            r.serve("v2", [2, -5, 3]),
+            r.serve("v3", [2, 3], max_new_tokens=0),
+            r.serve("v4", [2, 3], deadline_s=-1.0),
+            r.serve("v5", [2, 3], beam_size=0),
+        ]
+        assert all(b["status"] == "rejected" for b in bad)
+        assert book.get("serves", []) == []  # no network hop was paid
+        ledger = r.fleet_stats()["ledger"]
+        assert ledger["rejected"] == 5 and sum(ledger.values()) == 5
+    finally:
+        r.close()
+
+
+def test_autoscaler_hook_spawn_and_retire():
+    clk = FakeClock(100.0)
+    # the scale decisions, not the lease plane, are under test: a lease
+    # long enough that the virtual-clock jumps never expire anyone
+    r = make_router({}, clk, lease_timeout_s=1000.0)
+    try:
+        r.register_engine("a", "127.0.0.1", 9000)
+        calls = []
+        r.set_autoscaler(
+            spawn=lambda router: calls.append("spawn"),
+            retire=lambda router, e: calls.append(f"retire:{e}"),
+            shed_rate_threshold=0.5, window_s=5.0, min_engines=1,
+            max_engines=4, cooldown_s=1.0,
+        )
+        # sustained shed rate above threshold -> spawn
+        with r._lock:
+            r._shed_times.extend([clk.now - 0.5] * 4)
+        assert r.maybe_autoscale() == "spawn"
+        assert calls == ["spawn"]
+        # cooldown gates a second action
+        assert r.maybe_autoscale() is None
+        # a quiet window with a fleet above min -> retire the idlest
+        clk.advance(10.0)
+        r.register_engine("b", "127.0.0.1", 9001)
+        assert r.maybe_autoscale() == "retire"
+        assert calls == ["spawn", "retire:a"]
+    finally:
+        r.close()

@@ -25,6 +25,7 @@ def test_registry_names_and_unknown():
     }
     assert set(scenarios.SLOW_SCENARIOS) == {
         "fleet_kill_worker", "fleet_kill_master",
+        "fleet_serving", "fleet_rolling_restart",
     }
     with pytest.raises(KeyError):
         scenarios.run_scenario("frobnicate")
