@@ -83,6 +83,7 @@ lint:
 		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --donation
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --concurrency
+	$(CPU_ENV) $(PY) -m paddle_tpu lint --protocol
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics
 	$(CPU_ENV) $(PY) -m paddle_tpu lint --numerics \
 		$(foreach c,$(wildcard tests/configs/*.py),--config $(c))
@@ -123,6 +124,18 @@ chaos:
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_netem_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_decode_speed_e2e.py -q
 	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_fleet_serving_e2e.py -q
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_explore_e2e.py -q
+	# interleaving explorer batch: seeded (replayable) schedules over the
+	# real router/master/HA planes must come back clean...
+	$(CPU_ENV) $(PY) -m paddle_tpu explore --model router --schedules 200 --seed 0 --dfs-depth 3
+	$(CPU_ENV) $(PY) -m paddle_tpu explore --model ha --schedules 200 --seed 0 --dfs-depth 4
+	$(CPU_ENV) $(PY) -m paddle_tpu explore --model master --schedules 60 --seed 0
+	# ...and the planted-bug canary proves the harness can still see:
+	# detect (exit 1) -> shrunk spec on disk -> replay reproduces (exit 0)
+	$(CPU_ENV) $(PY) -m paddle_tpu explore --model router --schedules 200 \
+		--seed 7 --max-events 12 --plant double_serve \
+		--out /tmp/paddle_tpu_canary.spec.json; test $$? -eq 1
+	$(CPU_ENV) $(PY) -m paddle_tpu explore --replay /tmp/paddle_tpu_canary.spec.json
 	$(MAKE) trace-demo
 
 # the obs-plane acceptance drill (sanitizer-armed: the traced scenario

@@ -32,6 +32,8 @@ from typing import List
 _EXPORTS = {
     "Diagnostic": "diagnostics",
     "DiagnosticError": "diagnostics",
+    "ProtocolError": "diagnostics",
+    "protocol_error": "diagnostics",
     "Severity": "diagnostics",
     "config_assert": "diagnostics",
     "errors": "diagnostics",
@@ -49,6 +51,11 @@ _EXPORTS = {
     "trace_step": "trace_lint",
     "lint_concurrency_file": "concurrency_lint",
     "lint_concurrency_package": "concurrency_lint",
+    "lint_protocol_package": "protocol_lint",
+    "lint_protocol_sources": "protocol_lint",
+    "explore_schedules": "interleave",
+    "replay_spec": "interleave",
+    "shrink_events": "interleave",
     "PrecisionCertificate": "numerics_lint",
     "certify_precision_plan": "numerics_lint",
     "lint_numerics_config": "numerics_lint",

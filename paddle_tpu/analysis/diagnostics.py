@@ -12,7 +12,9 @@ linter (``analysis.trace_lint``) and the AST self-linter
 so users always see *which layer* (or file) produced a finding.
 
 Rule-id namespaces:  ``G###`` graph lint · ``T###`` trace hygiene ·
-``A###`` AST self-lint.
+``A###`` AST self-lint · ``C###`` concurrency · ``N###`` numerics ·
+``P###`` protocol conformance (``analysis.protocol_lint`` + the runtime
+:class:`ProtocolError` raises in the serving/wire planes).
 """
 
 from __future__ import annotations
@@ -93,6 +95,36 @@ class DiagnosticError(ValueError):
     @property
     def rules(self) -> List[str]:
         return [d.rule for d in self.diagnostics]
+
+
+class ProtocolError(DiagnosticError, RuntimeError):
+    """A distributed-protocol misuse (P-rule namespace): calling into a
+    closed client, violating a lifecycle contract, breaking a lease/fence
+    invariant at runtime.  Doubly inherits RuntimeError so the historical
+    bare ``raise RuntimeError(...)`` sites in the serving/RPC planes can
+    upgrade to structured diagnostics without breaking any existing
+    ``except RuntimeError`` handler (and DiagnosticError keeps ``except
+    ValueError`` consumers working too)."""
+
+
+def protocol_error(
+    rule: str,
+    message: str,
+    *,
+    source: Optional[str] = None,
+    hint: Optional[str] = None,
+) -> ProtocolError:
+    """Build a single-finding :class:`ProtocolError` (the raise-site
+    shorthand the serving/wire planes use for lifecycle misuse)."""
+    return ProtocolError(
+        Diagnostic(
+            rule=rule,
+            severity=Severity.ERROR,
+            message=message,
+            source=source,
+            hint=hint,
+        )
+    )
 
 
 def config_assert(

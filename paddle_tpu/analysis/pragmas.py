@@ -14,6 +14,8 @@ Per plane the grammar differs only in spelling:
     # num: allow[N401] why            same grammar, N-rule namespace
     # wire: allow[A206] why           same grammar, the raw-deserialization
                                       ban (ast_rules A206)
+    # proto: allow[P504] why          same grammar, the protocol
+                                      conformance plane (protocol_lint)
     # obs: allow-wall-clock why       keyword form; always rule A205
 
 ``collect`` returns ``{line: Pragma}`` plus uniform findings for
@@ -74,6 +76,7 @@ PLANES: Dict[str, _Plane] = {
     "lock": _allow_plane("lock", "C300", "C304"),
     "num": _allow_plane("num", "N400", "N403"),
     "wire": _allow_plane("wire", "A206", "A206"),
+    "proto": _allow_plane("proto", "P500", "P504"),
     "obs": _Plane(
         name="obs",
         pattern=re.compile(r"#\s*obs:\s*allow-wall-clock\s*(())?(.*)$"),

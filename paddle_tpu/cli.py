@@ -1777,6 +1777,13 @@ def cmd_lint(argv: List[str]) -> int:
       blocking-under-lock, thread-leak and injectable-clock checks
       (the static leg of the concurrency plane; the runtime leg is
       PADDLE_TPU_LOCK_SANITIZER=1 on the chaos drills);
+    * --protocol: protocol-conformance lint (rules P###) over the
+      distributed planes (master RPC/journal/wire + serving fleet) —
+      RPC whitelist vs handler vs wire-universe conformance (P501),
+      journal record/replay/compaction coverage (P502), status-ledger
+      exhaustiveness (P503), lease/fence monotonicity (P504), timeout
+      completeness (P505); ``# proto: allow[P###] <why>`` escapes an
+      intentional finding (skips the self-lint);
     * --numerics: precision-flow lint (rules N###) over the compiled
       train-step jaxprs — low-precision accumulation, master-precision
       escapes, unguarded domain hazards, overflowing mask literals,
@@ -1814,6 +1821,13 @@ def cmd_lint(argv: List[str]) -> int:
                     help="precision-flow lint (rules N###) over the "
                     "compiled train-step jaxprs: package probes, or each "
                     "--config's real step (skips the self-lint)")
+    ap.add_argument("--protocol", action="store_true",
+                    help="protocol-conformance lint (rules P###) over the "
+                    "distributed planes: RPC surface vs handlers vs wire "
+                    "universe, journal record/replay/compaction coverage, "
+                    "status-ledger exhaustiveness, lease/fence "
+                    "monotonicity, timeout completeness (skips the "
+                    "self-lint)")
     ap.add_argument("--compute-dtype", default=None,
                     help="numerics: compute dtype of the precision plan "
                     "(e.g. bfloat16; default f32)")
@@ -1850,6 +1864,10 @@ def cmd_lint(argv: List[str]) -> int:
         )
 
         diags.extend(lint_concurrency_package(extra_paths=args.extra))
+    if args.protocol:
+        from paddle_tpu.analysis.protocol_lint import lint_protocol_package
+
+        diags.extend(lint_protocol_package())
     if args.numerics:
         from paddle_tpu.analysis.numerics_lint import (
             certify_precision_plan,
@@ -1920,7 +1938,7 @@ def cmd_lint(argv: List[str]) -> int:
                 continue
             diags.extend(analysis.lint_parsed(parsed))
     if not (args.config or args.journal or args.donation
-            or args.concurrency or args.numerics):
+            or args.concurrency or args.numerics or args.protocol):
         diags = analysis.lint_package(extra_paths=args.extra)
 
     if args.min_severity:
@@ -1931,6 +1949,112 @@ def cmd_lint(argv: List[str]) -> int:
     return 1 if diags else 0
 
 
+def cmd_explore(argv: List[str]) -> int:
+    """Deterministic interleaving explorer over the distributed planes.
+
+    Drives the REAL state machines (serving router, journaled master,
+    HA lease file) in-process on a virtual clock with a simulated
+    transport, searching event interleavings for protocol-invariant
+    violations (double-serve, epoch-fence breach, recovery infidelity).
+
+    * default: seeded-random exploration (``--schedules`` independent
+      schedules; schedule i draws from ``Random(f"{seed}:{i}")``, so
+      any run replays exactly).
+    * --dfs-depth N: additionally sweep every interleaving up to depth
+      N (bounded DFS, first ``--dfs-branch`` enabled events per state).
+    * --plant NAME: plant a known bug (canary) to prove the harness
+      detects, shrinks, and replays — e.g. ``double_serve``.
+    * --replay SPEC.json: re-run a shrunk violation spec; exit 0 iff
+      the violation reproduces (the regression-test contract).
+
+    Exit code: 0 = clean (or replay reproduced), 1 = violation found
+    (or replay failed to reproduce).  A found violation is ddmin-shrunk
+    to a minimal replayable spec, printed, and written to ``--out``.
+    """
+    ap = argparse.ArgumentParser(prog="paddle-tpu explore",
+                                 description=cmd_explore.__doc__)
+    ap.add_argument("--model", default="router",
+                    choices=["router", "master", "ha"],
+                    help="which state machine to drive (default router)")
+    ap.add_argument("--schedules", type=int, default=200,
+                    help="number of seeded-random schedules (default 200)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="batch seed; schedule i uses Random(f'{seed}:{i}')")
+    ap.add_argument("--max-events", type=int, default=14,
+                    help="events per random schedule (default 14)")
+    ap.add_argument("--dfs-depth", type=int, default=0,
+                    help="also run bounded DFS to this depth (0 = skip)")
+    ap.add_argument("--dfs-branch", type=int, default=5,
+                    help="DFS branch limit per state (default 5)")
+    ap.add_argument("--plant", default=None,
+                    help="plant a known bug as a harness canary "
+                    "(e.g. double_serve)")
+    ap.add_argument("--replay", default=None, metavar="SPEC",
+                    help="re-run a shrunk violation spec JSON file")
+    ap.add_argument("--out", default=None, metavar="SPEC",
+                    help="write the shrunk violation spec here")
+    args = ap.parse_args(argv)
+
+    import json
+    import logging
+    import tempfile
+
+    from paddle_tpu.analysis.interleave import (
+        dfs_explore, explore_schedules, make_model, replay_spec,
+    )
+
+    # fault injection makes the router log every simulated transport
+    # failure — noise at batch scale, so keep only real errors
+    logging.getLogger("paddle_tpu").setLevel(logging.ERROR)
+
+    if args.replay:
+        with open(args.replay, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+        out = replay_spec(spec)
+        if out["reproduced"]:
+            print(f"reproduced ({out['applied']} events applied):")
+            for v in out["violations"]:
+                print(f"  {v}")
+            return 0
+        print(f"spec did NOT reproduce ({out['applied']} events applied, "
+              "no violation)", file=sys.stderr)
+        return 1
+
+    workdir = tempfile.mkdtemp(prefix="paddle-tpu-explore-")
+    model = make_model(args.model, workdir, planted=args.plant)
+    try:
+        res = explore_schedules(model, schedules=args.schedules,
+                                seed=args.seed, max_events=args.max_events)
+        if not res["violation_found"] and args.dfs_depth > 0:
+            dres = dfs_explore(model, depth=args.dfs_depth,
+                               branch_limit=args.dfs_branch)
+            print(f"dfs: {dres['paths_run']} paths to depth "
+                  f"{args.dfs_depth}")
+            if dres["violation_found"]:
+                res = {"violation_found": True,
+                       "schedules_run": res["schedules_run"],
+                       "spec": dres["spec"]}
+        if not res["violation_found"]:
+            print(f"clean: {res['schedules_run']} schedules on model "
+                  f"{args.model!r} (seed {args.seed}), no violation")
+            return 0
+        spec = res["spec"]
+        print(f"VIOLATION on model {args.model!r} after "
+              f"{res['schedules_run']} schedules, shrunk to "
+              f"{len(spec['events'])} events:")
+        for v in spec["violations"]:
+            print(f"  {v}")
+        print(json.dumps(spec, indent=2, sort_keys=True))
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                json.dump(spec, fh, indent=2, sort_keys=True)
+            print(f"spec written to {args.out} "
+                  f"(replay: paddle-tpu explore --replay {args.out})")
+        return 1
+    finally:
+        model.close()
+
+
 _COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
@@ -1939,6 +2063,7 @@ _COMMANDS = {
     "merge_model": cmd_merge_model,
     "plotcurve": cmd_plotcurve,
     "lint": cmd_lint,
+    "explore": cmd_explore,
     "cache": cmd_cache,
     "serve": cmd_serve,
     "route": cmd_route,
@@ -1962,6 +2087,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("    plotcurve         plot training curves from a log")
         print("    lint              static analysis: graph-lint a config, or")
         print("                      self-lint the package source")
+        print("    explore           interleaving explorer: drive the real")
+        print("                      router/master/HA state machines on a")
+        print("                      virtual clock, hunt protocol-invariant")
+        print("                      violations, shrink + replay specs")
         print("    cache             AOT executable cache: ls / warm / prune /")
         print("                      clear a persistent compile cache dir")
         print("    serve             continuous-batching serving plane over")

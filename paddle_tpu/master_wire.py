@@ -114,9 +114,32 @@ class MasterWireError(RuntimeError):
     """Base of the structured wire-codec error taxonomy.  Every subclass
     names WHAT the codec refused (type, size, version, integrity) — a
     hostile or damaged frame surfaces as exactly one of these, never as a
-    MemoryError, a pickle exec, or a silent misparse."""
+    MemoryError, a pickle exec, or a silent misparse.
+
+    Each class carries its protocol-conformance rule id and fix hint (the
+    ``P###`` namespace shared with ``analysis/protocol_lint.py``) and
+    builds a structured ``diagnostics`` list on construction, so the CLI
+    and tests consume wire failures the same way as lint findings.  Still
+    a plain RuntimeError subclass: a wire error must NEVER be swallowed
+    by the broad ``except ValueError`` recovery paths in the journal/
+    config planes."""
 
     kind = "wire"
+    rule = "P501"
+    hint = "keep RPC payloads inside the typed wire universe"
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+        message = args[0] if args else self.__class__.__doc__.split("\n")[0]
+        self.diagnostics = [Diagnostic(
+            rule=self.rule, severity=Severity.ERROR, message=str(message),
+            source="master_wire.py", hint=self.hint,
+        )]
+
+    @property
+    def rules(self):
+        return [d.rule for d in self.diagnostics]
 
 
 class WireTypeError(MasterWireError):
@@ -124,6 +147,9 @@ class WireTypeError(MasterWireError):
     (deterministic: re-sending the same payload fails the same way)."""
 
     kind = "type"
+    rule = "P501"
+    hint = ("reply with None/bool/int/float/str/bytes/list/tuple/dict/"
+            "ndarray only — convert sets to sorted lists, objects to dicts")
 
 
 class WireOversizeError(MasterWireError):
@@ -131,6 +157,9 @@ class WireOversizeError(MasterWireError):
     BEFORE any byte hits the wire, and on recv BEFORE any allocation."""
 
     kind = "oversize"
+    rule = "P506"
+    hint = ("shrink the payload (chunk the task / quantize the gradient) "
+            "or raise the rpc_max_message_mb flag on BOTH peers")
 
 
 class WireVersionError(MasterWireError):
@@ -138,6 +167,9 @@ class WireVersionError(MasterWireError):
     (version skew between fleet processes)."""
 
     kind = "version"
+    rule = "P507"
+    hint = ("upgrade the older peer — wire VERSION must match across the "
+            "fleet (rolling restarts go through drain, not mixed versions)")
 
 
 class WireCorruptError(MasterWireError):
@@ -145,6 +177,10 @@ class WireCorruptError(MasterWireError):
     mismatch, CRC mismatch, or an undecodable payload."""
 
     kind = "corrupt"
+    rule = "P508"
+    hint = ("treat the connection as dead and re-dial — a CRC/framing "
+            "mismatch means the stream is unsynchronized, not retryable "
+            "in place")
 
 
 class _Counters:

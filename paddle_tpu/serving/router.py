@@ -68,8 +68,11 @@ import numpy as np
 
 from paddle_tpu import master as _master
 from paddle_tpu import obs as _obs
+from paddle_tpu.analysis.diagnostics import protocol_error
 from paddle_tpu.analysis.lock_sanitizer import THREAD_PREFIX, make_lock
-from paddle_tpu.serving.scheduler import Request, percentile, status_counts
+from paddle_tpu.serving.scheduler import (
+    TERMINAL_STATUSES, Request, percentile, status_counts,
+)
 
 __all__ = [
     "ROUTER_METHODS",
@@ -92,8 +95,10 @@ ROUTER_METHODS = (
 )
 ENGINE_METHODS = ("serve", "stats", "drain", "ping")
 
-# terminal statuses the fleet ledger counts (the scheduler's disjoint set)
-_TERMINAL = ("served", "shed", "rejected", "timeout", "closed")
+# terminal statuses the fleet ledger counts — a REFERENCE to the
+# scheduler's declared disjoint set, never a copied literal (lint P503
+# flags any parallel status-set literal that drifts from the declaration)
+_TERMINAL = TERMINAL_STATUSES
 
 
 def affinity_key(src_ids: Sequence, session_id: Optional[str] = None,
@@ -521,13 +526,40 @@ class Router:
         )
         tried: set = set()
         attempts = 0
+        sweeps = 0
         while True:
             attempts += 1
             engine_id = self.pick_engine(key, exclude=tried)
             if engine_id is None and tried:
                 # every live engine failed this request's transport:
                 # start over on whatever the registry holds NOW (a
-                # replacement may have joined mid-flight)
+                # replacement may have joined mid-flight).  Found by the
+                # interleave explorer (analysis/interleave.py): without
+                # the sweep bound + backoff below, a no-deadline request
+                # against a leased-but-unreachable engine (partial
+                # partition: heartbeats land, the data plane doesn't)
+                # re-routed in a ZERO-DELAY infinite loop — no timeout
+                # path at all (the P505 hazard, dynamic edition).
+                sweeps += 1
+                if sweeps > 8:
+                    status = (
+                        "timeout" if t_deadline is not None else "rejected"
+                    )
+                    return self._finalize(
+                        req_id, status, t0=t0,
+                        error="no reachable serving engine (every live "
+                              "engine failed transport across "
+                              f"{sweeps - 1} full sweeps)",
+                    )
+                # back off so lease expiry / the deadline can fire
+                self._sleep(min(0.05, self.stats_poll_s))
+                if (t_deadline is not None
+                        and self._clock() >= t_deadline):
+                    return self._finalize(
+                        req_id, "timeout", t0=t0,
+                        error="timeout: every live engine failed "
+                              "transport and the deadline passed",
+                    )
                 tried = set()
                 engine_id = self.pick_engine(key)
             if engine_id is None:
@@ -1135,7 +1167,15 @@ class FleetClient:
         request.t_submit = self._clock()
         with self._threads_lock:
             if self._closed:
-                raise RuntimeError("fleet client is closed")
+                raise protocol_error(
+                    "P509",
+                    f"submit({request.req_id}) on a closed FleetClient — "
+                    "the client joined its workers and will finalize "
+                    "nothing",
+                    source="serving/router.py",
+                    hint="submit before close(); a drained client must be "
+                    "re-constructed, not reused",
+                )
             t = threading.Thread(
                 target=self._run, args=(request,),
                 name=THREAD_PREFIX + "fleet-submit", daemon=True,

@@ -62,10 +62,12 @@ from typing import Any, Callable, List, Optional, Sequence, Union
 import numpy as np
 
 from paddle_tpu import obs as _obs
+from paddle_tpu.analysis.diagnostics import protocol_error
 from paddle_tpu.analysis.lock_sanitizer import THREAD_PREFIX, make_lock
 from paddle_tpu.robustness import chaos
 
-__all__ = ["Request", "ServingScheduler", "percentile", "status_counts"]
+__all__ = ["Request", "ServingScheduler", "TERMINAL_STATUSES",
+           "percentile", "status_counts"]
 
 _log = logging.getLogger("paddle_tpu.serving")
 
@@ -94,10 +96,18 @@ def percentile(xs, p: float):
     return xs[min(len(xs) - 1, int(p * len(xs)))]
 
 
+# The ONE declared disjoint set of terminal request statuses.  Every
+# transition site in the serving planes must land on a member (lint
+# P503 cross-checks assignments, status= keywords and comparisons in
+# scheduler.py/router.py against this tuple); "pending" is the sole
+# transient state.
+TERMINAL_STATUSES = ("served", "shed", "rejected", "timeout", "closed")
+
+
 def status_counts(requests) -> dict:
     """The disjoint status ledger over finalized requests (every summary
     reports exactly these keys, zero-filled)."""
-    out = {"served": 0, "shed": 0, "rejected": 0, "timeout": 0, "closed": 0}
+    out = {s: 0 for s in TERMINAL_STATUSES}
     for r in requests:
         out[r.status] = out.get(r.status, 0) + 1
     return out
@@ -165,7 +175,13 @@ class Request:
     def result(self) -> List[int]:
         """Generated tokens; raises on a rejected/shed/failed request."""
         if not self._event.is_set():
-            raise RuntimeError(f"request {self.req_id} not finished")
+            raise protocol_error(
+                "P509",
+                f"result() on request {self.req_id} before it finished",
+                source="serving/scheduler.py",
+                hint="wait() for the request (it sets the done event) "
+                     "before reading result()",
+            )
         if self.error is not None:
             raise RuntimeError(f"request {self.req_id}: {self.error}")
         return list(self.tokens or [])
@@ -302,7 +318,14 @@ class ServingScheduler:
         # queue.Queue.put never blocks, so nothing sleeps under the lock
         with self._lock:
             if self._closed:
-                raise RuntimeError("scheduler is closed")
+                raise protocol_error(
+                    "P509",
+                    f"submit({request.req_id}) on a closed scheduler — "
+                    "close() already finalized every outstanding request",
+                    source="serving/scheduler.py",
+                    hint="submit before close(); a closed scheduler must "
+                    "be re-constructed, not reused",
+                )
             if self._draining.is_set():
                 refuse = "rejected: scheduler draining"
             elif self.queue_limit and self._depth >= self.queue_limit:

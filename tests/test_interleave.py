@@ -1,0 +1,192 @@
+"""Interleaving explorer (analysis/interleave.py): seeded determinism,
+the planted double-serve canary (detect -> shrink <=6 events -> replay),
+clean batches over every model, and the shrunk-spec regression for the
+router re-route livelock the explorer found (see Router._dispatch)."""
+
+import logging
+
+import pytest
+
+from paddle_tpu.analysis.interleave import (
+    dfs_explore,
+    explore_schedules,
+    make_model,
+    replay_spec,
+    run_schedule,
+    shrink_events,
+)
+
+
+@pytest.fixture(autouse=True)
+def _quiet_router_logs():
+    # fault injection makes the router narrate every simulated transport
+    # failure; hundreds of schedules would drown the test output
+    logger = logging.getLogger("paddle_tpu")
+    prev = logger.level
+    logger.setLevel(logging.ERROR)
+    yield
+    logger.setLevel(prev)
+
+
+@pytest.fixture()
+def router_model(tmp_path):
+    m = make_model("router", str(tmp_path))
+    yield m
+    m.close()
+
+
+# the acceptance canary, computed once per module: plant the journal bug,
+# let the batch find it, shrink, and keep the spec for the replay tests
+@pytest.fixture(scope="module")
+def canary(tmp_path_factory):
+    logging.getLogger("paddle_tpu").setLevel(logging.ERROR)
+    m = make_model("router", str(tmp_path_factory.mktemp("canary")),
+                   planted="double_serve")
+    res = explore_schedules(m, schedules=200, seed=7, max_events=12)
+    m.close()
+    return res
+
+
+# ---------------------------------------------------------------------------
+# determinism: same seed, same trajectory, same shrunk spec
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_exploration_is_deterministic(tmp_path):
+    outs = []
+    for run in ("a", "b"):
+        m = make_model("router", str(tmp_path / run),
+                       planted="double_serve")
+        outs.append(explore_schedules(m, schedules=200, seed=7,
+                                      max_events=12))
+        m.close()
+    a, b = outs
+    assert a["violation_found"] and b["violation_found"]
+    assert a["schedules_run"] == b["schedules_run"]
+    assert a["spec"]["events"] == b["spec"]["events"]
+    assert a["spec"]["violations"] == b["spec"]["violations"]
+
+
+# ---------------------------------------------------------------------------
+# the canary: detect, shrink to a handful of events, replay
+# ---------------------------------------------------------------------------
+
+
+def test_planted_double_serve_is_caught_and_shrunk(canary):
+    assert canary["violation_found"], (
+        "planted journal bug escaped 200 schedules — the harness is blind"
+    )
+    spec = canary["spec"]
+    assert len(spec["events"]) <= 6, spec["events"]
+    assert any("double-serve" in v for v in spec["violations"])
+    # the shrunk schedule must still exercise the failure ingredients:
+    # a settle, a router bounce, and a client retry
+    ops = [e["op"] for e in spec["events"]]
+    assert "crash_router" in ops and "retry" in ops
+
+
+def test_replay_of_shrunk_spec_reproduces(canary):
+    out = replay_spec(canary["spec"])
+    assert out["reproduced"], out
+    assert any("double-serve" in v for v in out["violations"])
+
+
+def test_replay_of_clean_spec_reports_no_reproduction(tmp_path):
+    spec = {
+        "version": 1, "model": "router", "planted": None, "seed": 0,
+        "events": [{"op": "submit", "req": "q1"}],
+        "violations": ["(none expected)"],
+    }
+    out = replay_spec(spec, workdir=str(tmp_path))
+    assert not out["reproduced"]
+    assert out["violations"] == []
+
+
+def test_shrink_events_drops_irrelevant_noise(tmp_path):
+    # pad the violating core with no-op churn; ddmin must strip it
+    m = make_model("router", str(tmp_path), planted="double_serve")
+    noisy = [
+        {"op": "advance", "dt": 3.0},
+        {"op": "submit", "req": "q2"},
+        {"op": "heartbeat", "engine": "e1"},
+        {"op": "advance", "dt": 3.0},
+        {"op": "crash_router"},
+        {"op": "restart_router"},
+        {"op": "heartbeat", "engine": "e2"},
+        {"op": "retry", "req": "q2"},
+    ]
+    assert run_schedule(m, noisy)["violations"]
+    small = shrink_events(m, noisy)
+    assert len(small) <= 4
+    assert run_schedule(m, small)["violations"]
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# clean batches: the real (unplanted) planes survive exploration
+# ---------------------------------------------------------------------------
+
+
+def test_router_random_batch_is_clean(router_model):
+    res = explore_schedules(router_model, schedules=40, seed=1,
+                            max_events=12)
+    assert not res["violation_found"], res["spec"]
+
+
+def test_router_dfs_sweep_is_clean(router_model):
+    res = dfs_explore(router_model, depth=3, branch_limit=5, max_paths=200)
+    assert not res["violation_found"], res["spec"]
+    assert res["paths_run"] > 50
+
+
+def test_master_random_batch_is_clean(tmp_path):
+    m = make_model("master", str(tmp_path))
+    res = explore_schedules(m, schedules=25, seed=11, max_events=12)
+    m.close()
+    assert not res["violation_found"], res["spec"]
+
+
+def test_ha_random_and_dfs_are_clean(tmp_path):
+    m = make_model("ha", str(tmp_path))
+    res = explore_schedules(m, schedules=40, seed=5, max_events=10)
+    assert not res["violation_found"], res["spec"]
+    res = dfs_explore(m, depth=4, branch_limit=5, max_paths=400)
+    assert not res["violation_found"], res["spec"]
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# targeted schedules: protocol facts the models must hold
+# ---------------------------------------------------------------------------
+
+
+def test_master_duplicate_ack_is_idempotent(tmp_path):
+    # the reply-lost retry: a duplicate (task, epoch) ack is accepted-
+    # and-deduped — queue state frozen, first result payload wins
+    m = make_model("master", str(tmp_path))
+    out = run_schedule(m, [
+        {"op": "get", "worker": "w0"},
+        {"op": "finish", "worker": "w0"},
+        {"op": "stale_ack"},
+    ])
+    m.close()
+    assert out["violations"] == []
+
+
+def test_router_terminates_when_every_engine_is_unreachable(tmp_path):
+    # regression for the re-route livelock the explorer found: with all
+    # live engines partitioned (heartbeats fine, data plane dead) and no
+    # request deadline, _dispatch used to reset its tried-set and spin
+    # forever with zero delay — no terminal status, no timeout path.
+    # The fix bounds the sweeps and settles the request as rejected.
+    m = make_model("router", str(tmp_path))
+    out = run_schedule(m, [
+        {"op": "partition", "engine": "e1"},
+        {"op": "partition", "engine": "e2"},
+        {"op": "submit", "req": "q1"},
+    ])
+    assert out["violations"] == []
+    assert out["applied"] == 3  # the submit RETURNED — no livelock
+    assert m.results[-1]["status"] == "rejected"
+    assert "sweeps" in (m.results[-1].get("error") or "")
+    m.close()

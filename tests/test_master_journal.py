@@ -9,7 +9,6 @@ live state), replay is idempotent under double delivery, an unknown
 record type is a HARD error everywhere, and a fenced (deposed) leader can
 never append again."""
 
-import ast
 import json
 import os
 
@@ -260,27 +259,32 @@ def test_cli_lint_journal(tmp_path, capsys):
 
 
 def test_every_journaled_record_type_is_known_and_applicable():
-    """Self-check folded into the suite: every ``{"t": ...}`` literal that
-    master.py appends is a registered record type, and every registered
-    type has a replay op — so a typo'd emission or a missing handler is a
-    test failure, not a silent recovery hole."""
-    src = open(os.path.join(
-        os.path.dirname(master_mod.__file__), "master.py")).read()
-    emitted = set()
-    for node in ast.walk(ast.parse(src)):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr == "_journal" and node.args):
-            d = node.args[0]
-            assert isinstance(d, ast.Dict), "journal arg must be a literal"
-            for k, v in zip(d.keys, d.values):
-                if getattr(k, "value", None) == "t":
-                    assert isinstance(v, ast.Constant)
-                    emitted.add(v.value)
-    assert emitted, "no journal emissions found (AST scan broke?)"
-    assert emitted <= mj.RECORD_TYPES
-    for t in mj.RECORD_TYPES:
-        assert hasattr(master_mod.Service, f"_apply_{t}")
+    """Emission <-> registration <-> replay coverage, now owned by the
+    protocol lint (rule P502 in analysis/protocol_lint.py) instead of a
+    hand-rolled AST walk here: the package must carry zero P502 findings,
+    and — so this assertion can't rot into a vacuous pass — a seeded
+    typo'd emission must make P502 fire through the same entry point."""
+    from paddle_tpu.analysis import format_diagnostics
+    from paddle_tpu.analysis.protocol_lint import (
+        PROTOCOL_FILES,
+        lint_protocol_sources,
+    )
+
+    pkg = os.path.dirname(master_mod.__file__)
+    srcs = {
+        rel: open(os.path.join(pkg, rel), encoding="utf-8").read()
+        for rel in PROTOCOL_FILES
+    }
+    p502 = [d for d in lint_protocol_sources(srcs) if d.rule == "P502"]
+    assert p502 == [], format_diagnostics(p502)
+
+    mutated = dict(srcs)
+    mutated["master.py"] = srcs["master.py"].replace(
+        '{"t": "rotate", "from": from_pass}',
+        '{"t": "rotateX", "from": from_pass}', 1)
+    assert mutated["master.py"] != srcs["master.py"]
+    assert any(d.rule == "P502"
+               for d in lint_protocol_sources(mutated))
 
 
 # ---------------------------------------------------------------------------

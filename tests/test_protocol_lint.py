@@ -1,0 +1,193 @@
+"""Protocol lint (analysis/protocol_lint.py): the package gate — the
+shipped distributed planes produce zero P-findings — plus one firing
+mutation per P-rule (the test_concurrency_lint.py discipline: take the
+REAL sources, seed exactly one protocol drift, assert exactly that rule
+fires).  Mutations run through ``lint_protocol_sources`` so the real
+files on disk are never touched."""
+
+import os
+
+from paddle_tpu.analysis import format_diagnostics
+from paddle_tpu.analysis.protocol_lint import (
+    PROTOCOL_FILES,
+    lint_protocol_package,
+    lint_protocol_sources,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+
+def rules(diags):
+    return sorted({d.rule for d in diags})
+
+
+def _sources():
+    out = {}
+    for rel in PROTOCOL_FILES:
+        with open(os.path.join(PKG, rel), encoding="utf-8") as fh:
+            out[rel] = fh.read()
+    return out
+
+
+def _mutated(rel, old, new):
+    """Real package sources with exactly one edit applied to ``rel``."""
+    srcs = _sources()
+    before = srcs[rel]
+    srcs[rel] = before.replace(old, new, 1)
+    assert srcs[rel] != before, (
+        f"mutation anchor drifted: {old!r} not found in {rel}"
+    )
+    return lint_protocol_sources(srcs)
+
+
+# ---------------------------------------------------------------------------
+# the repo gate: the shipped package is clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_protocol_lint_is_clean():
+    diags = lint_protocol_package()
+    assert diags == [], format_diagnostics(diags)
+
+
+def test_baseline_sources_are_clean():
+    # the mutation harness below only proves anything if the UNMUTATED
+    # sources lint clean through the same entry point
+    diags = lint_protocol_sources(_sources())
+    assert diags == [], format_diagnostics(diags)
+
+
+def test_cli_protocol_leg_exits_zero(capsys):
+    from paddle_tpu.cli import cmd_lint
+
+    assert cmd_lint(["--protocol"]) == 0
+    assert "no diagnostics" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# P501 — RPC surface: whitelist <-> handler <-> wire universe
+# ---------------------------------------------------------------------------
+
+
+def test_p501_whitelisted_method_without_handler():
+    # rename the Service handler out from under the _METHODS whitelist
+    d = _mutated("master.py", "def get_task(", "def get_task_unbound(")
+    assert "P501" in rules(d)
+    assert any("get_task" in x.message for x in d if x.rule == "P501")
+
+
+def test_p501_unwireable_reply_type():
+    # a handler whose reply is a set literal can never cross the wire
+    d = _mutated(
+        "serving/router.py",
+        "def ping(self) -> str:",
+        "def ping(self):\n"
+        "        return {1, 2}\n"
+        "\n"
+        "    def _unused_ping(self) -> str:",
+    )
+    assert "P501" in rules(d)
+    assert any("ping" in x.message for x in d if x.rule == "P501")
+
+
+# ---------------------------------------------------------------------------
+# P502 — journal emission <-> registered record type <-> replay handler
+# ---------------------------------------------------------------------------
+
+
+def test_p502_emitted_type_not_registered():
+    d = _mutated(
+        "master.py",
+        '{"t": "rotate", "from": from_pass}',
+        '{"t": "rotateX", "from": from_pass}',
+    )
+    assert "P502" in rules(d)
+    assert any("rotateX" in x.message for x in d if x.rule == "P502")
+
+
+def test_p502_registered_type_without_apply_handler():
+    d = _mutated("master.py", "def _apply_lease(", "def _apply_leaseXX(")
+    assert "P502" in rules(d)
+    assert any("lease" in x.message for x in d if x.rule == "P502")
+
+
+def test_p502_dead_registered_type():
+    # register a type nobody ever journals: a recovery path that can
+    # never be exercised (usually a leftover from a removed transition)
+    d = _mutated("master_journal.py", '"lease",', '"zzz_dead",\n    "lease",')
+    assert "P502" in rules(d)
+    assert any("zzz_dead" in x.message for x in d if x.rule == "P502")
+
+
+# ---------------------------------------------------------------------------
+# P503 — status-ledger exhaustiveness
+# ---------------------------------------------------------------------------
+
+
+def test_p503_rogue_status_literal():
+    d = _mutated(
+        "serving/router.py", 'status = "rejected"', 'status = "exploded"'
+    )
+    assert "P503" in rules(d)
+    assert any("exploded" in x.message for x in d if x.rule == "P503")
+
+
+# ---------------------------------------------------------------------------
+# P504 — lease/fence monotonicity
+# ---------------------------------------------------------------------------
+
+
+def test_p504_epoch_fence_uses_ordering_not_equality():
+    # epoch fences compare for identity; an ordering comparison silently
+    # accepts stale holders (or rejects live ones) after wrap/reset
+    d = _mutated(
+        "master.py", "ent[0].epoch != epoch", "ent[0].epoch <= epoch"
+    )
+    assert "P504" in rules(d)
+
+
+def test_p504_seq_dedupe_uses_equality_not_ordering():
+    # journal seq dedupe must be an ordering (<=) — equality lets a
+    # reordered/duplicated record slip past the monotonicity fence
+    d = _mutated("master.py", "if seq <= self._seq:", "if seq == self._seq:")
+    assert "P504" in rules(d)
+
+
+# ---------------------------------------------------------------------------
+# P505 — timeout completeness
+# ---------------------------------------------------------------------------
+
+
+def test_p505_unbounded_poll():
+    d = _mutated(
+        "master.py", "self._conn.poll(remaining)", "self._conn.poll()"
+    )
+    assert "P505" in rules(d)
+
+
+# ---------------------------------------------------------------------------
+# pragma plane: `# proto: allow[P50x] why` suppression + staleness
+# ---------------------------------------------------------------------------
+
+
+def test_proto_pragma_suppresses_finding():
+    d = _mutated(
+        "master.py",
+        "if ent is None or ent[0].epoch != epoch:",
+        "if ent is None or ent[0].epoch <= epoch:"
+        "  # proto: allow[P504] mutation-fixture suppression",
+    )
+    assert d == [], format_diagnostics(d)
+
+
+def test_stale_proto_pragma_is_flagged():
+    d = _mutated(
+        "master.py",
+        "if ent is None or ent[0].epoch != epoch:",
+        "if ent is None or ent[0].epoch != epoch:"
+        "  # proto: allow[P504] nothing wrong here",
+    )
+    # the compare is already correct: the pragma suppresses nothing and
+    # must be flagged as stale, not silently tolerated
+    assert "P500" in rules(d)
