@@ -56,7 +56,11 @@
 #                  a clean surviving journal), and the decode-speed
 #                  drills (tests/test_decode_speed_e2e.py: shared-prefix
 #                  open-loop load over the COW cache, speculative decode
-#                  under load, cancel-mid-speculation page drain).
+#                  under load, cancel-mid-speculation page drain), plus
+#                  the chaos-composition fuzzer batch (paddle-tpu fuzz:
+#                  25 seeded compositions over the fault vocabulary must
+#                  run invariant-clean, and a planted-bug canary must be
+#                  detected, ddmin-shrunk to a spec, and replayed).
 #   make scenarios — the fast production-gate scenario subset
 #                  (robustness/scenarios.py via `paddle-tpu scenario
 #                  --all-fast`), sanitizer-armed: overload shed-not-
@@ -136,6 +140,17 @@ chaos:
 		--seed 7 --max-events 12 --plant double_serve \
 		--out /tmp/paddle_tpu_canary.spec.json; test $$? -eq 1
 	$(CPU_ENV) $(PY) -m paddle_tpu explore --replay /tmp/paddle_tpu_canary.spec.json
+	# chaos-composition fuzzer (robustness/fuzz.py): the record/replay +
+	# fuzz CLI drills, then a seeded 25-composition batch over the real
+	# engine/scheduler must come back clean...
+	$(CPU_ENV) PADDLE_TPU_LOCK_SANITIZER=1 $(PY) -m pytest tests/test_fuzz_e2e.py -q
+	$(CPU_ENV) $(PY) -m paddle_tpu fuzz --count 25 --seed 0
+	# ...and the planted-bug canary proves the fuzzer can still see:
+	# detect (exit 1) -> ddmin-shrunk spec on disk -> replay reproduces
+	$(CPU_ENV) $(PY) -m paddle_tpu fuzz --count 25 --seed 7 \
+		--plant ledger_skew \
+		--out /tmp/paddle_tpu_fuzz_canary.spec.json; test $$? -eq 1
+	$(CPU_ENV) $(PY) -m paddle_tpu fuzz --replay /tmp/paddle_tpu_fuzz_canary.spec.json
 	$(MAKE) trace-demo
 
 # the obs-plane acceptance drill (sanitizer-armed: the traced scenario

@@ -1249,11 +1249,15 @@ def bench_scenarios() -> list:
         serving plane takes live deadline traffic; recovery-time-after-
         partition is the committed metric, params bit-identical to an
         unfaulted reference leg, surviving journal lints clean.
+      * trace_replay_drift — the scenario-realism gate (ISSUE 20): a
+        recorded two-class overload window replays bit-identically from
+        its .ptt trace; replay-vs-live p99/goodput drift bounded, per-
+        class admission sheds the batch class first in both windows.
 
-    Committed round artifacts: SCENARIO_r12.json (overload/chaos/mixed)
-    and SCENARIO_r15.json (+ partition_under_load); load_prior_bench
-    reads SCENARIO_r*.json into the same best_prior history BENCH_r*.json
-    feeds."""
+    Committed round artifacts: SCENARIO_r12.json (overload/chaos/mixed),
+    SCENARIO_r15.json (+ partition_under_load) and SCENARIO_r20.json
+    (+ trace_replay_drift); load_prior_bench reads SCENARIO_r*.json into
+    the same best_prior history BENCH_r*.json feeds."""
     from paddle_tpu.robustness import scenarios
 
     ov = scenarios.scenario_overload()
@@ -1270,6 +1274,8 @@ def bench_scenarios() -> list:
     part = scenarios.scenario_partition_under_load()
     assert part["passed"], f"partition_under_load failed: {part}"
     assert part["recovery_after_partition_ms"] < 10_000, part
+    trd = scenarios.scenario_trace_replay_drift()
+    assert trd["passed"], f"trace_replay_drift failed: {trd}"
     return [
         {
             "metric": "scenario_goodput_2x_frac",
@@ -1334,6 +1340,47 @@ def bench_scenarios() -> list:
             f"{part['partition_secs']}s mid-pass; the worker's RPC "
             "retry window absorbs it and the serving plane keeps its "
             "SLO throughout",
+        },
+        {
+            "metric": "scenario_trace_replay_goodput",
+            "value": trd["replay"]["goodput_frac"],
+            "unit": "fraction of REPLAYED requests completed within SLO "
+            "on a recorded 2x-saturation two-class window (drift vs the "
+            "live window gated <= 0.35 in-run)",
+            "slo_ms": trd["slo_ms"],
+            "trace_records": trd["trace_records"],
+            "live_goodput_frac": trd["live"]["goodput_frac"],
+            "goodput_drift": round(abs(trd["replay"]["goodput_frac"]
+                                       - trd["live"]["goodput_frac"]), 4),
+            "gate_offer_bit_identical": trd["gate_offer_bit_identical"],
+            "gate_goodput_drift": trd["gate_goodput_drift"],
+            "p0_goodput_live":
+                trd["live"]["classes"]["p0"]["goodput_frac"],
+            "p0_goodput_replay":
+                trd["replay"]["classes"]["p0"]["goodput_frac"],
+            "p2_goodput_live":
+                trd["live"]["classes"]["p2"]["goodput_frac"],
+            "p2_goodput_replay":
+                trd["replay"]["classes"]["p2"]["goodput_frac"],
+            "gate_high_class_goodput": trd["gate_high_class_goodput"],
+            "gate_low_class_sheds_first":
+                trd["gate_low_class_sheds_first"],
+            "binds": "record a PrefixMixer two-class (p0 interactive / "
+            "p2 batch) 2x-saturation window to a .ptt request-lifecycle "
+            "trace while serving it live, then replay the trace against "
+            "a fresh scheduler: the replayed offer is bit-identical "
+            "(prompts, sessions, classes, deadlines, order), per-class "
+            "admission (class_shed_slack {0:0.7, 2:1.5}) must shed the "
+            "batch class first in BOTH windows",
+        },
+        {
+            "metric": "scenario_trace_replay_p99_ms",
+            "value": trd["replay"]["p99_ms"],
+            "unit": "ms end-to-end p99 of served requests in the "
+            "REPLAYED window (drift vs live gated <= 3x + 250ms in-run; "
+            "cpu container)",
+            "live_p99_ms": trd["live"]["p99_ms"],
+            "gate_p99_drift": trd["gate_p99_drift"],
         },
     ]
 

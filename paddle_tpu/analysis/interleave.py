@@ -178,7 +178,7 @@ class _SimEngineClient:
                 "sim engine unreachable")
 
     def serve(self, req_id, src_ids, max_new_tokens=None, deadline_s=None,
-              beam_size=None, session_id=None):
+              beam_size=None, session_id=None, priority=None):
         self._check_up()
         m = self._m
         m.tick += 1

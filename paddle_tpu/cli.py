@@ -747,6 +747,21 @@ def cmd_serve(argv: List[str]) -> int:
                     help="stamp synthetic requests with session ids drawn "
                     "from a pool of N sessions (PrefixMixer.session_of — "
                     "the fleet router's affinity key); 0 = session-less")
+    ap.add_argument("--priority-every", type=int, default=0,
+                    help="stamp every Nth request interactive class p0 and "
+                    "the rest batch class p2 (per-class SLO admission, "
+                    "serving/scheduler.py); 0 = everything default class p1")
+    ap.add_argument("--record-trace", default="", metavar="TRACE",
+                    help="record the offered workload to a replayable "
+                    ".ptt request-lifecycle trace (robustness/traces.py): "
+                    "arrival offsets, ids, full source ids, deadlines, "
+                    "sessions, priority classes")
+    ap.add_argument("--replay", default="", metavar="TRACE",
+                    help="REPLAY a recorded .ptt trace instead of offering "
+                    "synthetic load: the recorded arrival clock, prompts, "
+                    "ids, deadlines, sessions and priorities are "
+                    "reproduced bit-for-bit (--synthetic/--rate/--arrival "
+                    "are ignored)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--register", default="",
                     help="run as a FLEET ENGINE: register with the router "
@@ -813,7 +828,15 @@ def cmd_serve(argv: List[str]) -> int:
         return _serve_as_fleet_engine(args, engine)
 
     session_of = None
-    if args.requests:
+    replay_trace = None
+    sources = []
+    if args.replay:
+        # the recorded day IS the workload: prompts/ids/deadlines/
+        # sessions/priorities all come from the trace records
+        from paddle_tpu.robustness.traces import read_trace
+
+        replay_trace = read_trace(args.replay)
+    elif args.requests:
         with open(args.requests) as f:
             sources = [
                 [int(t) for t in line.split()] for line in f if line.strip()
@@ -835,9 +858,14 @@ def cmd_serve(argv: List[str]) -> int:
             rng.randint(2, args.src_vocab, size=rng.randint(3, 24)).tolist()
             for _ in range(args.synthetic)
         ]
-    if args.sessions > 0 and session_of is None:
+    if args.sessions > 0 and session_of is None and replay_trace is None:
         # no prefix pool to correlate with: sessions spread round-robin
         session_of = lambda i: f"sess{i % args.sessions}"  # noqa: E731
+    priority_of = None
+    if args.priority_every > 0 and replay_trace is None:
+        priority_of = (
+            lambda i: 0 if i % args.priority_every == 0 else 2
+        )
 
     done = []
 
@@ -852,10 +880,25 @@ def cmd_serve(argv: List[str]) -> int:
         }), flush=True)
 
     deadline_s = args.deadline_s
-    reqs = [
-        Request(src, callback=on_done, deadline_s=deadline_s)
-        for src in sources
-    ]
+    if replay_trace is not None:
+        # replay: every request carries the RECORDED identity — ids,
+        # deadlines, sessions, priority classes.  The live flags must
+        # not re-derive any of it (the loadgen's stamp-if-absent
+        # contract keeps recorded values authoritative).
+        reqs = [
+            Request(
+                list(rec["src"]), rec.get("mnt"),
+                req_id=str(rec["id"]), callback=on_done,
+                deadline_s=rec.get("dl"), session_id=rec.get("sess"),
+                priority=rec.get("prio"),
+            )
+            for rec in replay_trace.requests()
+        ]
+    else:
+        reqs = [
+            Request(src, callback=on_done, deadline_s=deadline_s)
+            for src in sources
+        ]
     drained_clean = None
     t0 = _time.perf_counter()
     # live metrics export (obs/metrics.py): the SLO gauges the scheduler
@@ -878,6 +921,14 @@ def cmd_serve(argv: List[str]) -> int:
     ) else None
     if metrics is not None and metrics.port:
         _echo(f"metrics: http://127.0.0.1:{metrics.port}/metrics")
+    writer = None
+    if args.record_trace:
+        from paddle_tpu.robustness.traces import TraceWriter
+
+        writer = TraceWriter(args.record_trace, meta={
+            "cmd": "serve", "seed": args.seed, "rate": args.rate,
+            "arrival": args.arrival,
+        })
     with PreemptionGuard() as guard:
         sched = ServingScheduler(
             engine, queue_limit=args.queue_limit,
@@ -885,21 +936,46 @@ def cmd_serve(argv: List[str]) -> int:
                 args.deadline_s if args.deadline_s is not None else None
             ),
         )
+
+        def _submit(r):
+            # record AFTER the loadgen stamped deadline/session/priority
+            # (run() stamps before calling submit), so the trace carries
+            # the values the scheduler actually saw
+            if writer is not None:
+                writer.record_request(r)
+            return sched.submit(r)
+
         try:
             submitted = []
-            if args.rate > 0:
+            if replay_trace is not None:
+                from paddle_tpu.robustness.traces import TraceReplayLoadGen
+
+                it = iter(reqs)
+                submitted = TraceReplayLoadGen(
+                    replay_trace,
+                    request_factory=lambda rec: next(it),
+                ).run(
+                    _submit, stop=lambda: guard.triggered,
+                    cancel=lambda rid, reason: sched.cancel(
+                        rid, reason or "timeout: canceled"),
+                )
+            elif args.rate > 0:
                 submitted = OpenLoopLoadGen(
                     args.rate, len(reqs), lambda i: reqs[i],
                     seed=args.seed, process=args.arrival,
-                    session_of=session_of,
-                ).run(sched.submit, stop=lambda: guard.triggered)
+                    session_of=session_of, priority_of=priority_of,
+                ).run(_submit, stop=lambda: guard.triggered)
             else:
                 for i, r in enumerate(reqs):
                     if guard.triggered:
                         break
                     if session_of is not None:
                         r.session_id = session_of(i)
-                    sched.submit(r)
+                    if priority_of is not None:
+                        pri = priority_of(i)
+                        if pri is not None:
+                            r.priority = int(pri)
+                    _submit(r)
                     submitted.append(r)
             if guard.triggered:
                 # graceful drain: stop admitting, finish what's in flight,
@@ -925,6 +1001,8 @@ def cmd_serve(argv: List[str]) -> int:
                     drained_clean = sched.drain(args.drain_timeout_s)
         finally:
             sched.close()
+            if writer is not None:
+                writer.close()
             if metrics is not None:
                 metrics.close()
     from paddle_tpu.serving import percentile, status_counts
@@ -956,6 +1034,18 @@ def cmd_serve(argv: List[str]) -> int:
         "p99_token_ms": pct(tpots, 0.99),
         "engine": engine.summary(),
     }
+    class_labels = sorted({r.class_label for r in reqs})
+    if len(class_labels) > 1:
+        # per-class status ledger — the p0-stays-served-while-p2-sheds
+        # evidence the per-class admission plane exists to produce
+        summary["classes"] = {
+            c: status_counts([r for r in reqs if r.class_label == c])
+            for c in class_labels
+        }
+    if replay_trace is not None:
+        summary["replayed_trace"] = args.replay
+    if writer is not None:
+        summary["recorded_trace"] = args.record_trace
     print(_json.dumps(summary), flush=True)
     if args.stats_out:
         _obs.write_stats_json(args.stats_out, summary)
@@ -1101,6 +1191,18 @@ def cmd_route(argv: List[str]) -> int:
     ap.add_argument("--sessions", type=int, default=0,
                     help="stamp session ids from a pool of N "
                     "(PrefixMixer.session_of) — the affinity key")
+    ap.add_argument("--priority-every", type=int, default=0,
+                    help="stamp every Nth synthetic request interactive "
+                    "class p0 and the rest batch class p2; 0 = all p1")
+    ap.add_argument("--record-trace", default="", metavar="TRACE",
+                    help="record the fleet workload to a replayable .ptt "
+                    "request-lifecycle trace (robustness/traces.py)")
+    ap.add_argument("--replay", default="", metavar="TRACE",
+                    help="replay a recorded .ptt trace through the fleet "
+                    "instead of synthetic load (recorded arrivals/ids/"
+                    "deadlines/sessions/priorities; --synthetic/--rate "
+                    "are ignored; recorded cancels are dropped — the "
+                    "fleet client has no cancel RPC)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--timeout-s", type=float, default=120.0,
                     help="wait budget for the synthetic workload")
@@ -1166,7 +1268,7 @@ def cmd_route(argv: List[str]) -> int:
                           "registered before the deadline")
                     return 1
                 _echo(f"fleet ready: {live} engine(s)")
-            if args.synthetic <= 0:
+            if args.synthetic <= 0 and not args.replay:
                 # daemon mode: route until SIGTERM
                 while not guard.triggered:
                     _time.sleep(0.1)
@@ -1191,22 +1293,68 @@ def cmd_route(argv: List[str]) -> int:
                     "latency_ms": round((r.t_done - r.t_submit) * 1e3, 3),
                 }), flush=True)
 
-            reqs = [
-                Request(
-                    mixer.source(i), args.max_new_tokens,
-                    req_id=f"route-{args.seed}-{i}", callback=on_done,
-                    deadline_s=args.deadline_s,
+            replay_trace = None
+            if args.replay:
+                from paddle_tpu.robustness.traces import read_trace
+
+                replay_trace = read_trace(args.replay)
+                reqs = [
+                    Request(
+                        list(rec["src"]), rec.get("mnt"),
+                        req_id=str(rec["id"]), callback=on_done,
+                        deadline_s=rec.get("dl"),
+                        session_id=rec.get("sess"),
+                        priority=rec.get("prio"),
+                    )
+                    for rec in replay_trace.requests()
+                ]
+            else:
+                reqs = [
+                    Request(
+                        mixer.source(i), args.max_new_tokens,
+                        req_id=f"route-{args.seed}-{i}", callback=on_done,
+                        deadline_s=args.deadline_s,
+                    )
+                    for i in range(args.synthetic)
+                ]
+            priority_of = None
+            if args.priority_every > 0 and replay_trace is None:
+                priority_of = (
+                    lambda i: 0 if i % args.priority_every == 0 else 2
                 )
-                for i in range(args.synthetic)
-            ]
+            writer = None
+            if args.record_trace:
+                from paddle_tpu.robustness.traces import TraceWriter
+
+                writer = TraceWriter(args.record_trace, meta={
+                    "cmd": "route", "seed": args.seed, "rate": args.rate,
+                    "arrival": args.arrival,
+                })
             fc = FleetClient(router.address)
+
+            def _submit(r):
+                if writer is not None:
+                    writer.record_request(r)
+                return fc.submit(r)
+
             try:
-                if args.rate > 0:
+                if replay_trace is not None:
+                    from paddle_tpu.robustness.traces import (
+                        TraceReplayLoadGen,
+                    )
+
+                    it = iter(reqs)
+                    TraceReplayLoadGen(
+                        replay_trace,
+                        request_factory=lambda rec: next(it),
+                    ).run(_submit, stop=lambda: guard.triggered)
+                elif args.rate > 0:
                     OpenLoopLoadGen(
                         args.rate, len(reqs), lambda i: reqs[i],
                         seed=args.seed, process=args.arrival,
                         session_of=mixer.session_of,
-                    ).run(fc.submit, stop=lambda: guard.triggered)
+                        priority_of=priority_of,
+                    ).run(_submit, stop=lambda: guard.triggered)
                 else:
                     for i, r in enumerate(reqs):
                         if guard.triggered:
@@ -1214,7 +1362,11 @@ def cmd_route(argv: List[str]) -> int:
                         sid = mixer.session_of(i)
                         if sid is not None:
                             r.session_id = sid
-                        fc.submit(r)
+                        if priority_of is not None:
+                            pri = priority_of(i)
+                            if pri is not None:
+                                r.priority = int(pri)
+                        _submit(r)
                 wait_deadline = _time.perf_counter() + args.timeout_s
                 for r in reqs:
                     while not r.done():
@@ -1227,6 +1379,8 @@ def cmd_route(argv: List[str]) -> int:
                         break
             finally:
                 fc.close()
+                if writer is not None:
+                    writer.close()
     finally:
         fleet = router.fleet_stats()
         router.close()
@@ -1259,11 +1413,17 @@ def cmd_route(argv: List[str]) -> int:
         "p99_latency_ms": pct(0.99),
         "fleet": fleet,
     }
+    class_labels = sorted({r.class_label for r in reqs})
+    if len(class_labels) > 1:
+        summary["classes"] = {
+            c: status_counts(r for r in reqs if r.class_label == c)
+            for c in class_labels
+        }
     print(_json.dumps(summary), flush=True)
     if args.stats_out:
         _obs.write_stats_json(args.stats_out, summary)
     _obs.tracer.dump()
-    return rc if (ok or args.synthetic <= 0) else 1
+    return rc if (ok or (args.synthetic <= 0 and not args.replay)) else 1
 
 
 def cmd_scenario(argv: List[str]) -> int:
@@ -2055,6 +2215,96 @@ def cmd_explore(argv: List[str]) -> int:
         model.close()
 
 
+def cmd_fuzz(argv: List[str]) -> int:
+    """Coverage-guided chaos-composition fuzzer (robustness/fuzz.py).
+
+    Samples seeded COMPOSITIONS of the existing fault vocabulary —
+    arrival process x rate factor, serve-plane chaos (nan_request,
+    serve_slow_client), network emulation (delay/drop/dup/corrupt/
+    partition), training chaos (worker_hang), torn checkpoints — as
+    declarative specs, runs each cocktail against the REAL serving/
+    training/checkpoint planes in-process, and checks the invariant
+    set (disjoint status ledger, bit-identical training params, journal
+    lint, page/thread leaks, armed-chaos consultation, checkpoint
+    restore past torn artifacts).
+
+    * default: ``--count`` seeded compositions; composition i draws
+      from ``Random(f"{seed}:{i}")``, so any run replays exactly.
+    * --plant NAME: plant a known bug (canary) to prove the harness
+      detects, shrinks, and replays — e.g. ``ledger_skew``.
+    * --replay SPEC.json: re-run a shrunk violation spec; exit 0 iff
+      the violation reproduces (the regression-test contract, shared
+      with ``paddle-tpu explore``).
+
+    Exit code: 0 = clean (or replay reproduced), 1 = violation found
+    (or replay failed to reproduce).  A found violation is ddmin-shrunk
+    to a minimal replayable spec, printed, and written to ``--out``.
+    """
+    ap = argparse.ArgumentParser(prog="paddle-tpu fuzz",
+                                 description=cmd_fuzz.__doc__)
+    ap.add_argument("--count", type=int, default=25,
+                    help="number of seeded compositions (default 25)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="batch seed; composition i uses "
+                    "Random(f'{seed}:{i}')")
+    ap.add_argument("--requests", type=int, default=16,
+                    help="serving requests offered per composition")
+    ap.add_argument("--plant", default=None,
+                    help="plant a known bug as a harness canary "
+                    "(e.g. ledger_skew)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip ddmin shrinking of a found violation")
+    ap.add_argument("--replay", default=None, metavar="SPEC",
+                    help="re-run a shrunk violation spec JSON file")
+    ap.add_argument("--out", default=None, metavar="SPEC",
+                    help="write the shrunk violation spec here")
+    args = ap.parse_args(argv)
+
+    import json
+    import logging
+    import tempfile
+
+    from paddle_tpu.robustness import fuzz as _fz
+
+    # fault cocktails make every plane log its injected failures —
+    # noise at batch scale, so keep only real errors
+    logging.getLogger("paddle_tpu").setLevel(logging.ERROR)
+
+    workdir = tempfile.mkdtemp(prefix="paddle-tpu-fuzz-")
+    if args.replay:
+        spec = _fz.load_spec(args.replay)
+        out = _fz.replay_fuzz_spec(spec, workdir=workdir)
+        if out["reproduced"]:
+            print("reproduced:")
+            for v in out["violations"]:
+                print(f"  {v}")
+            return 0
+        print("spec did NOT reproduce (clean run, no violation)",
+              file=sys.stderr)
+        return 1
+
+    res = _fz.fuzz_batch(
+        count=args.count, seed=args.seed, workdir=workdir,
+        planted=args.plant, shrink=not args.no_shrink,
+        n_requests=args.requests, log=lambda m: _echo(f"fuzz: {m}"),
+    )
+    if not res["violation_found"]:
+        print(f"clean: {res['compositions_run']} compositions "
+              f"(seed {args.seed}), no violation")
+        return 0
+    spec = res["spec"]
+    print(f"VIOLATION after {res['compositions_run']} compositions, "
+          f"shrunk to {len(spec['items'])} item(s):")
+    for v in spec["violations"]:
+        print(f"  {v}")
+    print(json.dumps(spec, indent=2, sort_keys=True))
+    if args.out:
+        _fz.save_spec(spec, args.out)
+        print(f"spec written to {args.out} "
+              f"(replay: paddle-tpu fuzz --replay {args.out})")
+    return 1
+
+
 _COMMANDS = {
     "train": cmd_train,
     "version": cmd_version,
@@ -2064,6 +2314,7 @@ _COMMANDS = {
     "plotcurve": cmd_plotcurve,
     "lint": cmd_lint,
     "explore": cmd_explore,
+    "fuzz": cmd_fuzz,
     "cache": cmd_cache,
     "serve": cmd_serve,
     "route": cmd_route,
@@ -2091,6 +2342,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("                      router/master/HA state machines on a")
         print("                      virtual clock, hunt protocol-invariant")
         print("                      violations, shrink + replay specs")
+        print("    fuzz              chaos-composition fuzzer: seeded fault")
+        print("                      cocktails (arrival x chaos x netem x")
+        print("                      torn checkpoints) vs the invariant set;")
+        print("                      shrink + replay violation specs")
         print("    cache             AOT executable cache: ls / warm / prune /")
         print("                      clear a persistent compile cache dir")
         print("    serve             continuous-batching serving plane over")

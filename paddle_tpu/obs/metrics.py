@@ -146,6 +146,21 @@ def render_prometheus(stats=None) -> str:
             f'paddle_tpu_serving_requests_total{{status="{status}"}} '
             f"{int(count)}"
         )
+    # the per-class ledger: the scheduler increments
+    # serving/class/<class>/<status> on every finalization (ALL
+    # statuses, served included) — rendered as class-labeled series of
+    # the same family, labels sorted (class before status) like every
+    # series key this module emits
+    for name in sorted(summary):
+        parts = name.split("/")
+        if (len(parts) == 4 and parts[0] == "serving"
+                and parts[1] == "class"):
+            cls, status = parts[2], parts[3]
+            lines.append(
+                "paddle_tpu_serving_requests_total"
+                f'{{class="{_escape(cls)}",status="{_escape(status)}"}} '
+                f"{int(summary[name]['count'])}"
+            )
 
     lines.append(
         "# HELP paddle_tpu_fleet_requests_total requests finalized by the "

@@ -135,6 +135,16 @@ class OpenLoopLoadGen:
     :meth:`PrefixMixer.session_of`); a non-None id is stamped on the
     built request (``request.session_id``) before submission — the
     fleet router's affinity-routing key.
+
+    ``priority_of``: optional ``i -> priority class`` callable; a
+    non-None value is stamped (``request.priority``) before submission
+    — the per-class admission input (serving/scheduler.py).
+
+    Stamping never CLOBBERS a value the built request already carries:
+    a request whose deadline/session/priority was derived from a
+    recorded trace (robustness/traces.py replay) keeps the recorded
+    values — the replayed day must reproduce the recorded affinity
+    keys, not re-derive them from a live RNG.
     """
 
     def __init__(
@@ -147,6 +157,7 @@ class OpenLoopLoadGen:
         seed: int = 0,
         deadline_s: Optional[float] = None,
         session_of: Optional[Callable[[int], Optional[str]]] = None,
+        priority_of: Optional[Callable[[int], Optional[int]]] = None,
         burst_factor: float = 3.0,
         burst_fraction: float = 0.2,
         clock=time.perf_counter,
@@ -161,6 +172,7 @@ class OpenLoopLoadGen:
         self.make_request = make_request
         self.deadline_s = deadline_s
         self.session_of = session_of
+        self.priority_of = priority_of
         self._clock = clock
         self._sleep = sleep
         rng = np.random.RandomState(seed)
@@ -241,11 +253,21 @@ class OpenLoopLoadGen:
                     break
                 self._sleep(min(delay, 0.05))
             req = self.make_request(i)
-            if self.deadline_s is not None:
+            # stamp-if-absent: a request already carrying a deadline/
+            # session/priority (a trace-replay factory derived them from
+            # the RECORD) keeps it — the live RNG must not re-derive
+            # affinity keys a recorded day already fixed
+            if (self.deadline_s is not None
+                    and getattr(req, "deadline_s", None) is None):
                 req.deadline_s = self.deadline_s
-            if self.session_of is not None:
+            if (self.session_of is not None
+                    and getattr(req, "session_id", None) is None):
                 sid = self.session_of(i)
                 if sid is not None:
                     req.session_id = sid
+            if self.priority_of is not None:
+                pri = self.priority_of(i)
+                if pri is not None:
+                    req.priority = int(pri)
             submitted.append(submit(req))
         return submitted

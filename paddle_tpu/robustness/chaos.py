@@ -83,6 +83,8 @@ __all__ = [
     "fire",
     "active_spec",
     "armed_points",
+    "consult_report",
+    "write_report",
     "poison_batch",
     "tear_file",
     "KNOWN_POINTS",
@@ -108,10 +110,22 @@ KNOWN_POINTS = frozenset(
 _armed: Dict[str, Optional[int]] = {}
 # point -> how many times it has been consulted
 _counts: Dict[str, int] = {}
+# point -> how many times it actually FIRED (the arming-audit ledger:
+# an armed point with zero fires at process exit is a drill that
+# silently tested nothing — exactly the skew that forced
+# fleet_kill_worker's blind auto-retry loop before PR 20)
+_fired: Dict[str, int] = {}
 # points that already dumped a flight-recorder postmortem (an unoccurrenced
 # point fires every consultation; one postmortem per arming is the record)
 _flight_dumped: set = set()
 _env_loaded = False
+_atexit_hooked = False
+
+# a drill parent that SIGKILLs (or expects) its child reads the child's
+# consultation report from this file: SIGKILL skips atexit, so a report
+# that EXISTS proves the child exited normally — armed-but-unfired in a
+# normally-exited victim is the drill failure the audit exists to catch
+_REPORT_ENV = "PADDLE_TPU_CHAOS_REPORT"
 
 
 def _parse(spec: str) -> Dict[str, Optional[int]]:
@@ -130,21 +144,26 @@ def _parse(spec: str) -> Dict[str, Optional[int]]:
 
 
 def arm(spec: str) -> None:
-    """Arm fault points from a spec string (replaces any previous arming)."""
+    """Arm fault points from a spec string (replaces any previous arming).
+    Unknown point names raise HERE — a typo'd drill fails at arming, it
+    never runs silently testing nothing."""
     global _env_loaded
     _env_loaded = True  # an explicit arm overrides the environment
     _armed.clear()
     _counts.clear()
+    _fired.clear()
     _flight_dumped.clear()
     _armed.update(_parse(spec))
     if _armed:
         _log.warning("chaos armed: %s", spec)
+        _hook_exit_report()
 
 
 def disarm() -> None:
     global _env_loaded
     _armed.clear()
     _counts.clear()
+    _fired.clear()
     _flight_dumped.clear()
     _env_loaded = True  # stay disarmed even if the env var is set
 
@@ -181,6 +200,7 @@ def _load_env() -> None:
     if spec:
         _armed.update(_parse(spec))
         _log.warning("chaos armed from %s: %s", _ENV, spec)
+        _hook_exit_report()
 
 
 def fire(point: str) -> bool:
@@ -193,6 +213,7 @@ def fire(point: str) -> bool:
     occ = _armed[point]
     hit = occ is None or _counts[point] == occ
     if hit:
+        _fired[point] = _fired.get(point, 0) + 1
         _log.warning(
             "chaos point %r firing (consultation %d)", point, _counts[point]
         )
@@ -207,6 +228,94 @@ def fire(point: str) -> bool:
 
             _obs.flight_dump(f"chaos:{point}@{_counts[point]}")
     return hit
+
+
+# ---------------------------------------------------------------------------
+# Arming audit: every armed point accounts for itself at process exit
+# ---------------------------------------------------------------------------
+
+def consult_report() -> Dict[str, dict]:
+    """Per-armed-point accounting: ``{point: {occurrence, consultations,
+    fired}}``.  An armed point with ``consultations == 0`` means the
+    code path the drill meant to fault NEVER RAN — the silent skew that
+    makes a green drill meaningless; the scenario harness treats it as
+    a test failure (robustness/scenarios.py)."""
+    _load_env()
+    return {
+        point: {
+            "occurrence": occ,
+            "consultations": _counts.get(point, 0),
+            "fired": _fired.get(point, 0),
+        }
+        for point, occ in sorted(_armed.items())
+    }
+
+
+def write_report(path: str) -> Dict[str, dict]:
+    """Write :func:`consult_report` as one JSON document (atomic
+    replace) — the cross-process face of the audit: a drill parent
+    points the child at a path via ``PADDLE_TPU_CHAOS_REPORT`` and
+    reads what the child actually consulted after it exits."""
+    import json
+
+    report = consult_report()
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(report, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+    return report
+
+
+def _exit_report() -> None:
+    """atexit: count fired/unfired armed points into the StatSet plane
+    (``chaos/fired`` / ``chaos/unfired`` + per-point counters — the
+    stats-out line every CLI summary prints), log one audit line, and
+    write the report file when ``PADDLE_TPU_CHAOS_REPORT`` names one.
+    A SIGKILL'd process never gets here — an ABSENT report after a
+    kill-point drill is the expected signature of a successful kill."""
+    if not _armed:
+        return
+    report = consult_report()
+    try:
+        from paddle_tpu.utils.timers import global_stats
+
+        for point, rec in report.items():
+            if rec["fired"]:
+                global_stats.incr("chaos/fired")
+                global_stats.incr(f"chaos/fired/{point}")
+            else:
+                global_stats.incr("chaos/unfired")
+                global_stats.incr(f"chaos/unfired/{point}")
+    except Exception:  # noqa: BLE001 — exit reporting must never raise
+        pass
+    unfired = sorted(p for p, rec in report.items() if not rec["fired"])
+    _log.warning(
+        "chaos exit report: %s%s",
+        ",".join(
+            f"{p}@{rec['occurrence']}:consulted={rec['consultations']}"
+            f":fired={rec['fired']}"
+            if rec["occurrence"] is not None else
+            f"{p}:consulted={rec['consultations']}:fired={rec['fired']}"
+            for p, rec in report.items()
+        ),
+        f" UNFIRED={unfired}" if unfired else "",
+    )
+    path = os.environ.get(_REPORT_ENV)
+    if path:
+        try:
+            write_report(path)
+        except OSError:
+            _log.exception("chaos report %s unwritable", path)
+
+
+def _hook_exit_report() -> None:
+    global _atexit_hooked
+    if _atexit_hooked:
+        return
+    _atexit_hooked = True
+    import atexit
+
+    atexit.register(_exit_report)
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,13 @@ seconds each, in-process):
   reported and bounded, final params are bit-identical to an unfaulted
   reference leg, the surviving journal lints clean, and the co-located
   serving SLO holds.
+* ``trace_replay_drift`` — the scenario-realism gate (robustness/
+  traces.py): record a mixed two-class overload window to a ``.ptt``
+  trace while serving it live, replay the trace bit-deterministically
+  against a fresh scheduler, and gate replay-vs-live drift plus the
+  per-class SLO contract (the interactive class holds goodput, the
+  batch class sheds first at 2x saturation — committed as
+  SCENARIO_r20.json).
 
 Slow scenarios (``SLOW_SCENARIOS`` — tests/test_scenarios_e2e.py,
 `make chaos`; real process fleets):
@@ -92,6 +99,7 @@ __all__ = [
     "scenario_chaos_under_load",
     "scenario_mixed_train_serve",
     "scenario_partition_under_load",
+    "scenario_trace_replay_drift",
     "fleet_reference",
     "run_fleet_chaos",
     "run_fleet_serving",
@@ -744,6 +752,211 @@ def scenario_partition_under_load(slo_ms: Optional[float] = None,
     }
 
 
+def _class_ledger(reqs, slo_s: float) -> Dict[str, Dict[str, Any]]:
+    """Per-priority-class SLO ledger: offered/served/in-SLO/failed counts
+    and goodput per ``class_label`` — the observable the per-class
+    admission gate asserts on (high classes keep goodput while low
+    classes shed first at overload)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for r in reqs:
+        c = getattr(r, "class_label", "p1")
+        d = out.setdefault(
+            c, {"offered": 0, "served": 0, "in_slo": 0, "failed": 0}
+        )
+        d["offered"] += 1
+        if r.status == "served":
+            d["served"] += 1
+            lat = (
+                r.t_done - r.t_submit
+                if r.t_done is not None and r.t_submit is not None
+                else None
+            )
+            if lat is not None and (slo_s <= 0 or lat <= slo_s):
+                d["in_slo"] += 1
+        else:
+            d["failed"] += 1
+    for d in out.values():
+        d["goodput_frac"] = round(d["in_slo"] / d["offered"], 4)
+        d["failed_frac"] = round(d["failed"] / d["offered"], 4)
+    return out
+
+
+def scenario_trace_replay_drift(slo_ms: Optional[float] = None,
+                                n_requests: int = 72, seed: int = 0,
+                                engine=None,
+                                trace_path: Optional[str] = None,
+                                ) -> Dict[str, Any]:
+    """The scenario-realism gate (robustness/traces.py): RECORD a mixed
+    two-class overload window to a ``.ptt`` trace while serving it live,
+    then REPLAY the trace against a fresh scheduler and gate the drift.
+
+    The live window offers 2x the calibrated saturation rate with
+    PrefixMixer sessions and two priority classes (p0 interactive every
+    4th request, p2 batch otherwise); every submitted request is
+    appended to the trace.  The replay rebuilds every request purely
+    from the records (prompts, sessions, deadlines, priorities — never a
+    live RNG) on the recorded arrival offsets.  Gates: the replayed
+    offer is BIT-IDENTICAL to the live one (same src ids, sessions,
+    classes, deadlines, in order), replay-vs-live p99 and goodput drift
+    stay inside tolerance (wide — the 2-core container is noisy; the
+    gate catches replays that collapse, not scheduler jitter), the high
+    class beats both the aggregate and the batch class (nonzero) in
+    BOTH windows, and the low class sheds first at 2x saturation in
+    BOTH windows."""
+    import tempfile
+
+    from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+    from paddle_tpu.robustness import traces as _traces
+    from paddle_tpu.serving import Request, ServingScheduler
+
+    engine = engine if engine is not None else make_serving_engine(seed)
+    wave = _serve_window(engine, _srcs(seed, 24), None, 0.0, seed)
+    saturation_rps = engine.max_slots / (wave["mean_service_ms"] / 1e3)
+    slo_s = _resolve_slo_s(slo_ms, wave)
+    if trace_path is None:
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="paddle-tpu-trace-"), "window.ptt"
+        )
+    mixer = PrefixMixer(_V, pool_size=3, prefix_frac=0.5, seed=seed,
+                        sessions=4)
+    # policy: the batch class sheds EARLIER (slack > 1 inflates its
+    # predicted-wait margin), the interactive class holds on LONGER
+    shed_slack = {0: 0.7, 2: 1.5}
+
+    def _ledger(reqs, wall):
+        served = [r for r in reqs if r.status == "served"]
+        lat = [r.t_done - r.t_submit for r in served]
+        in_slo = [x for x in lat if x <= slo_s]
+        return {
+            "n_offered": len(reqs),
+            "wall_s": round(wall, 3),
+            "statuses": _status_counts(reqs),
+            "goodput_frac": round(len(in_slo) / len(reqs), 4),
+            "p50_ms": _ms(_pct(lat, 0.50)),
+            "p99_ms": _ms(_pct(lat, 0.99)),
+            "classes": _class_ledger(reqs, slo_s),
+        }
+
+    # --- live window, recorded ------------------------------------------
+    live_reqs: List[Any] = []
+
+    def mk(i):
+        r = Request(
+            mixer.source(i), req_id=f"trace-{seed}-{i}",
+            session_id=mixer.session_of(i),
+        )
+        live_reqs.append(r)
+        return r
+
+    writer = _traces.TraceWriter(trace_path, meta={
+        "scenario": "trace_replay_drift", "seed": seed,
+        "slo_ms": round(slo_s * 1e3, 3),
+    })
+    with ServingScheduler(engine, class_shed_slack=shed_slack) as sched:
+        for s in _srcs(seed, 3):
+            sched.generate(s, timeout=60.0)
+        t0 = time.perf_counter()
+        OpenLoopLoadGen(
+            2.0 * saturation_rps, n_requests, mk, seed=seed + 1,
+            deadline_s=slo_s,
+            priority_of=lambda i: 0 if i % 4 == 0 else 2,
+        ).run(lambda r: (writer.record_request(r), sched.submit(r))[-1])
+        for r in live_reqs:
+            if not r.wait(300):
+                raise RuntimeError(f"request {r.req_id} never finalized")
+        live_wall = time.perf_counter() - t0
+    writer.close()
+
+    # --- replay from the artifact ---------------------------------------
+    trace = _traces.read_trace(trace_path)
+    replay_reqs: List[Any] = []
+
+    def factory(rec):
+        r = Request(
+            list(rec["src"]), rec.get("mnt"), req_id=str(rec["id"]),
+            deadline_s=rec.get("dl"), session_id=rec.get("sess"),
+            priority=int(rec.get("prio", 1)),
+        )
+        replay_reqs.append(r)
+        return r
+
+    with ServingScheduler(engine, class_shed_slack=shed_slack) as sched:
+        for s in _srcs(seed, 3):
+            sched.generate(s, timeout=60.0)
+        t0 = time.perf_counter()
+        _traces.TraceReplayLoadGen(trace, request_factory=factory).run(
+            sched.submit
+        )
+        for r in replay_reqs:
+            if not r.wait(300):
+                raise RuntimeError(f"request {r.req_id} never finalized")
+        replay_wall = time.perf_counter() - t0
+
+    live = _ledger(live_reqs, live_wall)
+    replay = _ledger(replay_reqs, replay_wall)
+    identical_offer = (
+        len(replay_reqs) == len(live_reqs)
+        and all(
+            a.src_ids == b.src_ids
+            and a.session_id == b.session_id
+            and a.priority == b.priority
+            and a.deadline_s == b.deadline_s
+            for a, b in zip(live_reqs, replay_reqs)
+        )
+    )
+    g_live, g_rep = live["goodput_frac"], replay["goodput_frac"]
+    p99_live, p99_rep = live["p99_ms"], replay["p99_ms"]
+    hi_live = live["classes"].get("p0", {})
+    lo_live = live["classes"].get("p2", {})
+    hi_rep = replay["classes"].get("p0", {})
+    lo_rep = replay["classes"].get("p2", {})
+    gates = {
+        "gate_offer_bit_identical": bool(identical_offer),
+        "gate_goodput_drift": bool(abs(g_rep - g_live) <= 0.35),
+        "gate_p99_drift": bool(
+            p99_live is not None and p99_rep is not None
+            and p99_rep <= 3.0 * p99_live + 250.0
+        ),
+        # RELATIVE on purpose (like the overload scenario's 2x/1x ratio):
+        # an absolute floor dies under the lock sanitizer's per-lock
+        # overhead, where effective capacity lands far below the wave-
+        # calibrated saturation.  The interactive class must beat both
+        # the window aggregate and the batch class — and hold NONZERO
+        # goodput (a collapsed replay fails here) — in both windows.
+        "gate_high_class_goodput": bool(
+            hi_live.get("goodput_frac", 0.0)
+            >= max(g_live, lo_live.get("goodput_frac", 0.0)) - 1e-9
+            and hi_live.get("goodput_frac", 0.0) > 0.0
+            and hi_rep.get("goodput_frac", 0.0)
+            >= max(g_rep, lo_rep.get("goodput_frac", 0.0)) - 1e-9
+            and hi_rep.get("goodput_frac", 0.0) > 0.0
+        ),
+        # the BATCH class carries the overload: its failure fraction must
+        # be at least the interactive class's in both windows
+        "gate_low_class_sheds_first": bool(
+            hi_live.get("failed_frac", 1.0)
+            <= lo_live.get("failed_frac", 0.0) + 1e-9
+            and hi_rep.get("failed_frac", 1.0)
+            <= lo_rep.get("failed_frac", 0.0) + 1e-9
+        ),
+    }
+    return {
+        "scenario": "trace_replay_drift",
+        "slo_ms": round(slo_s * 1e3, 3),
+        "offered_rps": round(2.0 * saturation_rps, 2),
+        "trace_path": trace_path,
+        "trace_records": len(trace),
+        "arrival": {
+            k: round(float(v), 4)
+            for k, v in trace.arrival_stats().items()
+        },
+        "live": live,
+        "replay": replay,
+        **gates,
+        "passed": all(gates.values()),
+    }
+
+
 # ---------------------------------------------------------------------------
 # fleet scenarios — real process groups (slow; tests/test_scenarios_e2e.py)
 # ---------------------------------------------------------------------------
@@ -855,10 +1068,16 @@ def fleet_reference(workdir: str, n_workers: int = 4,
     }
 
 
-class _ChaosNeverFired(RuntimeError):
-    """The armed fault point was never consulted (e.g. scheduling skew
-    starved the armed worker of every task) — the drill proved nothing
-    and should retry, not fail."""
+def _load_chaos_report(path: str) -> Optional[Dict[str, Any]]:
+    """The victim's chaos arming-audit report (robustness/chaos.py writes
+    it at process exit when ``PADDLE_TPU_CHAOS_REPORT`` names a path) —
+    None when the process died before atexit ran (SIGKILL: expected) or
+    never wrote one."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def run_fleet_chaos(workdir: str, kill: str = "kill_master",
@@ -866,7 +1085,7 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
                     n_workers: int = 4, passes: int = 2,
                     slo_ms: Optional[float] = None, seed: int = 0,
                     serve_requests: int = 64,
-                    engine=None, _attempt: int = 0) -> Dict[str, Any]:
+                    engine=None) -> Dict[str, Any]:
     """The headline drill: a live train+serve mix with a fault fired under
     load.  An elastic fleet trains over the HA master plane; the PARENT
     process serves open-loop traffic with deadlines the whole time;
@@ -887,10 +1106,9 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
         reference = fleet_reference(
             os.path.join(d, "reference"), n_workers, passes
         )
-    drill = os.path.join(
-        d, kill if _attempt == 0 else f"{kill}-retry{_attempt}"
-    )
+    drill = os.path.join(d, kill)
     os.makedirs(drill, exist_ok=True)
+    chaos_report = os.path.join(drill, "chaos-report.json")
     data = os.path.join(drill, "data.rio")
     _write_linear_dataset(data)
     hadir = os.path.join(drill, "ha")
@@ -916,7 +1134,9 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
                  "--chunks-per-task", "2", "--timeout-s", "30",
                  "--worker-timeout-s", "10", "--lease-timeout", "6",
                  "--chaos", "kill_master@8"],
-                env=_fleet_env(), stdout=subprocess.PIPE,
+                env=dict(_fleet_env(),
+                         PADDLE_TPU_CHAOS_REPORT=chaos_report),
+                stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True,
             )
             deadline = time.time() + 60
@@ -943,7 +1163,8 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
             standby.start()
             if not standby.wait_leader(30):
                 raise RuntimeError("drill master never took leadership")
-            chaos_env = {1: {"PADDLE_TPU_CHAOS": "kill_worker@1"}}
+            chaos_env = {1: {"PADDLE_TPU_CHAOS": "kill_worker@1",
+                             "PADDLE_TPU_CHAOS_REPORT": chaos_report}}
 
         procs = _spawn_workers(drill, n_workers, passes, chaos_env)
 
@@ -997,11 +1218,18 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
         t_kill = kill_stamp["t"]
         if victim.returncode != -signal.SIGKILL:
             if victim.returncode == 0:
-                # the armed process finished CLEAN: the fault point was
-                # never consulted (kill_worker@1 needs the victim to lease
-                # at least one task; on a loaded box scheduling skew can
-                # starve it) — retried below with a fresh drill dir
-                raise _ChaosNeverFired(kill)
+                # the armed process finished CLEAN: SIGKILL never landed,
+                # so the armed point was never consulted.  The victim's
+                # exit report (robustness/chaos.py arming audit, written
+                # because PADDLE_TPU_CHAOS_REPORT was set) proves it —
+                # and an armed-but-never-consulted fault point is a drill
+                # FAILURE (the kill coverage silently became a no-op: the
+                # drill "passed" without ever exercising the fault), not
+                # a scheduling flake to retry away.
+                raise RuntimeError(
+                    f"{kill} armed but never fired: victim exited 0; "
+                    f"chaos report: {_load_chaos_report(chaos_report)!r}"
+                )
             raise RuntimeError(
                 f"{kill} victim exited {victim.returncode}, not SIGKILL"
             )
@@ -1028,15 +1256,6 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
             # timeout; recovery = kill -> fleet completion (upper bound)
             recovery_s = t_done - t_kill
         master_stats = standby.service.stats() if standby.service else None
-    except _ChaosNeverFired:
-        if _attempt >= 2:
-            raise
-        return run_fleet_chaos(
-            workdir, kill=kill, reference=reference, n_workers=n_workers,
-            passes=passes, slo_ms=slo_ms, seed=seed + 11,
-            serve_requests=serve_requests, engine=engine,
-            _attempt=_attempt + 1,
-        )
     finally:
         if standby is not None:
             standby.stop()
@@ -1085,6 +1304,10 @@ def run_fleet_chaos(workdir: str, kill: str = "kill_master",
             "p99_ms": _ms(_pct(lat, 0.99)),
         },
         "recovery_after_fault_s": round(recovery_s, 3),
+        # SIGKILL skips atexit, so the victim's arming-audit report being
+        # ABSENT here is the expected post-kill state — a present report
+        # with zero consultations is the failure raised above
+        "chaos_report_after_kill": _load_chaos_report(chaos_report),
         "total_task_acks": total_acks,
         "expected_task_acks": expected_acks,
         "zero_recomputed_tasks": bool(zero_recompute),
@@ -1495,6 +1718,7 @@ FAST_SCENARIOS = {
     ),
     "mixed_train_serve": lambda **kw: scenario_mixed_train_serve(**kw),
     "partition_under_load": lambda **kw: scenario_partition_under_load(**kw),
+    "trace_replay_drift": lambda **kw: scenario_trace_replay_drift(**kw),
 }
 
 SLOW_SCENARIOS = {

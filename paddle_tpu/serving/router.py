@@ -469,6 +469,7 @@ class Router:
         deadline_s: Optional[float] = None,
         beam_size: Optional[int] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> Dict[str, Any]:
         """One request through the fleet: dedup (idempotent ack plane) ->
         frontend validation -> bounded-queue admission -> deadline shed ->
@@ -492,7 +493,7 @@ class Router:
         # frontend validation BEFORE any network hop (satellite: reject
         # at the router with the same disjoint ledger semantics)
         err = _validate_frontend(src_ids, max_new_tokens, deadline_s,
-                                 beam_size)
+                                 beam_size, priority)
         if err is not None:
             return self._finalize(req_id, "rejected", error=err, t0=t0)
         refuse = None
@@ -511,14 +512,14 @@ class Router:
         try:
             return self._dispatch(
                 req_id, src_ids, max_new_tokens, deadline_s, beam_size,
-                session_id, t0,
+                session_id, priority, t0,
             )
         finally:
             with self._lock:
                 self._depth -= 1
 
     def _dispatch(self, req_id, src_ids, max_new_tokens, deadline_s,
-                  beam_size, session_id, t0) -> Dict[str, Any]:
+                  beam_size, session_id, priority, t0) -> Dict[str, Any]:
         key = affinity_key(src_ids, session_id, self._block_tokens)
         t_deadline = (
             t0 + float(deadline_s)
@@ -616,6 +617,7 @@ class Router:
                         req_id, list(src_ids), max_new_tokens,
                         None if deadline_s is None else float(deadline_s),
                         beam_size, session_id,
+                        None if priority is None else int(priority),
                     )
                 finally:
                     try:
@@ -911,7 +913,7 @@ class Router:
 
 
 def _validate_frontend(src_ids, max_new_tokens, deadline_s,
-                       beam_size) -> Optional[str]:
+                       beam_size, priority=None) -> Optional[str]:
     """Router-side admission validation — the subset of the scheduler's
     ``_validate`` that needs no engine (vocab/page bounds re-check
     engine-side): a malformed request is rejected BEFORE paying a network
@@ -951,6 +953,16 @@ def _validate_frontend(src_ids, max_new_tokens, deadline_s,
             return (
                 f"deadline_s must be a finite non-negative number, got "
                 f"{deadline_s!r}"
+            )
+    if priority is not None:
+        f = (
+            float(priority)
+            if isinstance(priority, (int, float, np.floating, np.integer))
+            else None
+        )
+        if f is None or not np.isfinite(f) or f != int(f) or int(f) < 0:
+            return (
+                f"priority must be a non-negative integer, got {priority!r}"
             )
     return None
 
@@ -1011,7 +1023,8 @@ class EngineAgent:
 
     # -- RPC surface (the router calls these) ------------------------------
     def serve(self, req_id, src_ids, max_new_tokens=None, deadline_s=None,
-              beam_size=None, session_id=None) -> Dict[str, Any]:
+              beam_size=None, session_id=None,
+              priority=None) -> Dict[str, Any]:
         """One request end-to-end on this engine: submit to the scheduler,
         wait out finalization (bounded by the deadline + grace), return
         the terminal record.  A request the wait outlives is CANCELED —
@@ -1020,7 +1033,7 @@ class EngineAgent:
         r = Request(
             src_ids, max_new_tokens, req_id=str(req_id),
             deadline_s=deadline_s, beam_size=beam_size,
-            session_id=session_id,
+            session_id=session_id, priority=priority,
         )
         try:
             self._sched.submit(r)
@@ -1195,6 +1208,7 @@ class FleetClient:
                 res = client.serve(
                     r.req_id, list(r.src_ids), r.max_new_tokens,
                     r.deadline_s, r.beam_size, r.session_id,
+                    getattr(r, "priority", None),
                 )
             finally:
                 client.close()

@@ -86,6 +86,28 @@ _EWMA_DECAY = 0.8  # weight of history in the step-time/token-count EWMAs
 _SERVICE_SAFETY = 1.5
 
 
+def _parse_class_spec(spec: str) -> dict:
+    """Parse a per-class spec string ``"0:0.25,2:1.5"`` (priority ->
+    float) — the grammar of ``serving_class_deadline_s`` and
+    ``serving_class_shed_slack``."""
+    out: dict = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        prio, _, val = part.partition(":")
+        out[int(prio)] = float(val)
+    return out
+
+
+def _rung_of(n_live: int) -> int:
+    """Concurrency ladder rung of a batch: the smallest power of two
+    >= ``n_live`` — the same rung the engine's compiled decode variants
+    quantize to, so one EWMA per rung observes one compiled shape."""
+    n = max(1, int(n_live))
+    return 1 << (n - 1).bit_length()
+
+
 def percentile(xs, p: float):
     """Nearest-rank percentile (None when empty) — the ONE indexing rule
     every serving/bench/scenario latency metric shares, so p50/p95/p99
@@ -136,12 +158,19 @@ class Request:
         deadline_s: Optional[float] = None,
         beam_size: Optional[int] = None,
         session_id: Optional[str] = None,
+        priority: Optional[int] = None,
     ):
         self.req_id = req_id if req_id is not None else f"r{next(_req_counter)}"
         self.src_ids = list(src_ids)
         self.max_new_tokens = max_new_tokens
         self.callback = callback
         self.deadline_s = deadline_s
+        # priority class: LOWER numbers are more urgent (0 = interactive,
+        # 1 = the default, bigger = batch/background).  The scheduler
+        # dequeues strict-priority-with-aging and sheds per class; the
+        # class label ``p<priority>`` keys the per-class ledger counters
+        # and Prometheus labels.
+        self.priority = 1 if priority is None else int(priority)
         # conversation/session handle: the fleet router's affinity key —
         # requests sharing a session (and so, in production, a prompt
         # head) concentrate on the engine whose prefix cache already
@@ -164,6 +193,12 @@ class Request:
         self.token_times: List[float] = []
         self._resume = None  # engine preemption save-state
         self._event = threading.Event()
+
+    @property
+    def class_label(self) -> str:
+        """The priority class label (``p0``/``p1``/...) — the ``class``
+        dimension of the per-class ledger and Prometheus series."""
+        return f"p{self.priority}"
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -203,6 +238,9 @@ class ServingScheduler:
         idle_poll_s: float = 0.02,
         queue_limit: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
+        priority_aging_s: Optional[float] = None,
+        class_deadline_s: Optional[dict] = None,
+        class_shed_slack: Optional[dict] = None,
         stats=None,
     ):
         from paddle_tpu.utils import flags as _flags
@@ -221,6 +259,27 @@ class ServingScheduler:
             default_deadline_s if default_deadline_s is not None
             else _flags.get_flag("serving_default_deadline_s")
         )
+        # per-class SLO policy: default deadline and shed-safety slack
+        # per priority class (flag spec "prio:value,..."), plus the aging
+        # rate of the strict-priority-with-aging dequeue — every
+        # ``priority_aging_s`` seconds of queue wait promote a request
+        # one priority level, so batch traffic ages into urgency instead
+        # of starving behind a steady interactive stream (0 = pure
+        # strict priority, starvation is the operator's explicit choice)
+        self.priority_aging_s = float(
+            priority_aging_s if priority_aging_s is not None
+            else _flags.get_flag("serving_priority_aging_s")
+        )
+        self.class_deadline_s = dict(
+            class_deadline_s if class_deadline_s is not None
+            else _parse_class_spec(_flags.get_flag(
+                "serving_class_deadline_s"))
+        )
+        self.class_shed_slack = dict(
+            class_shed_slack if class_shed_slack is not None
+            else _parse_class_spec(_flags.get_flag(
+                "serving_class_shed_slack"))
+        )
         self._q: "queue.Queue[Request]" = queue.Queue()
         self._deliver_q: "queue.Queue[Request]" = queue.Queue()
         self._cancel_q: "queue.Queue[tuple]" = queue.Queue()
@@ -230,9 +289,18 @@ class ServingScheduler:
         self._closed = False  # guarded by _lock
         self._depth = 0  # pre-admission queue depth; guarded by _lock
         # step-thread-only SLO predictor state (never shared, no lock):
-        self._ewma_token_s: Optional[float] = None
+        # per-ladder-rung step-time EWMAs (rung = smallest power of two
+        # >= live batch size, the compiled-shape quantization) replace
+        # the single global EWMA — a step at batch 8 and a step at batch
+        # 1 are different compiled programs with different per-token
+        # costs, and folding them into one average mispredicts BOTH
+        self._rung_token_s: dict = {}  # rung -> EWMA per-token seconds
         self._ewma_tokens: Optional[float] = None
         self._pending_cancels: dict = {}  # req_id -> (reason, ttl)
+        # advisory per-class waiting-depth snapshot (step thread writes a
+        # fresh dict each iteration; gauge/stats reads are point-in-time)
+        self._class_waiting: dict = {}
+        self._class_gauges: dict = {}  # label -> gauge fn (for unregister)
         self._step_thread = threading.Thread(
             target=self._loop, name=THREAD_PREFIX + "serve-step", daemon=True
         )
@@ -301,8 +369,16 @@ class ServingScheduler:
         if chaos.fire("nan_request"):
             request.src_ids = list(request.src_ids) + [float("nan")]
         request.t_submit = self._clock()
-        if request.deadline_s is None and self.default_deadline_s > 0:
-            request.deadline_s = self.default_deadline_s
+        if request.deadline_s is None:
+            # per-class default first (serving_class_deadline_s), then
+            # the global serving_default_deadline_s fallback
+            cls_dl = self.class_deadline_s.get(
+                int(getattr(request, "priority", 1))
+            )
+            if cls_dl is not None and cls_dl > 0:
+                request.deadline_s = cls_dl
+            elif self.default_deadline_s > 0:
+                request.deadline_s = self.default_deadline_s
         if request.deadline_s is not None and request.deadline_s > 0:
             request.t_deadline = request.t_submit + float(request.deadline_s)
         # AFTER deadline defaulting: the timeline must show the EFFECTIVE
@@ -391,6 +467,13 @@ class ServingScheduler:
             "n_free_slots": int(eng.n_free_slots),
             "max_slots": int(eng.max_slots),
             "draining": bool(self._draining.is_set()),
+            # per-class queue depths + the per-rung service model — the
+            # router's dispatch scores stay on the scalar fields above;
+            # these ride along for dashboards and the scenario gates
+            "class_waiting": dict(self._class_waiting),
+            "rung_token_s": {
+                str(k): float(v) for k, v in self._rung_token_s.items()
+            },
         }
 
     def drain(self, timeout: float = 60.0) -> bool:
@@ -424,6 +507,15 @@ class ServingScheduler:
 
         for name, (fn, _help) in self._gauges.items():
             unregister_gauge(name, fn)
+        for label, (depth_fn, wait_fn) in self._class_gauges.items():
+            unregister_gauge(
+                "paddle_tpu_serving_class_queue_depth", depth_fn,
+                labels={"class": label},
+            )
+            unregister_gauge(
+                "paddle_tpu_serving_class_predicted_wait_seconds",
+                wait_fn, labels={"class": label},
+            )
         with self._lock:
             self._closed = True
         self._stop.set()
@@ -482,6 +574,14 @@ class ServingScheduler:
             )
             if f is None or not np.isfinite(f) or f != int(f) or int(f) < 1:
                 return f"max_new_tokens must be a positive integer, got {m!r}"
+        p = getattr(r, "priority", 1)
+        f = (
+            float(p)
+            if isinstance(p, (int, float, np.floating, np.integer))
+            else None
+        )
+        if f is None or not np.isfinite(f) or f != int(f) or int(f) < 0:
+            return f"priority must be a non-negative integer, got {p!r}"
         if r.beam_size is not None:
             b = r.beam_size
             f = (
@@ -499,17 +599,39 @@ class ServingScheduler:
         return None
 
     # -- SLO predictor (step thread only) --------------------------------
-    def _est_service_s(self) -> Optional[float]:
+    def _token_s_at(self, rung: int) -> Optional[float]:
+        """Per-token step time at a concurrency rung: the rung's own
+        EWMA, else the NEAREST calibrated rung (log-distance) — a cold
+        rung borrows its neighbor's estimate instead of predicting
+        blind.  None until any rung calibrates."""
+        if not self._rung_token_s:
+            return None
+        got = self._rung_token_s.get(rung)
+        if got is not None:
+            return got
+        nearest = min(
+            self._rung_token_s,
+            key=lambda k: (abs(k.bit_length() - rung.bit_length()), k),
+        )
+        return self._rung_token_s[nearest]
+
+    def _est_service_s(self, rung: Optional[int] = None) -> Optional[float]:
         """Expected wall service time of one request once admitted: EWMA
-        generated-token count x EWMA per-token step time.  None until the
-        first decode dispatch calibrates the EWMAs (no shedding blind)."""
-        if self._ewma_token_s is None:
+        generated-token count x the per-token step time AT the rung the
+        request will decode in (default: the full house — under queueing
+        pressure admission happens into a saturated batch).  None until
+        the first decode dispatch calibrates the model (no shedding
+        blind)."""
+        if rung is None:
+            rung = _rung_of(self._engine.max_slots)
+        token_s = self._token_s_at(rung)
+        if token_s is None:
             return None
         est_tokens = (
             self._ewma_tokens if self._ewma_tokens is not None
             else float(self._engine.default_max_new_tokens)
         )
-        return max(est_tokens, 1.0) * self._ewma_token_s
+        return max(est_tokens, 1.0) * token_s
 
     def _predicted_wait_s(self, n_ahead: int) -> Optional[float]:
         """Predicted queue wait for a request with ``n_ahead`` requests
@@ -525,17 +647,46 @@ class ServingScheduler:
             backlog += self._engine.n_live + self._engine.n_prefilling
         return per_req * backlog / max(1, self._engine.max_slots)
 
+    def _eff_priority(self, r: Request, now: float) -> float:
+        """Effective priority under aging: every ``priority_aging_s``
+        seconds of queue wait promote one level (smaller = served
+        sooner).  0 disables aging — pure strict priority."""
+        p = float(getattr(r, "priority", 1))
+        if self.priority_aging_s > 0 and r.t_submit is not None:
+            p -= (now - r.t_submit) / self.priority_aging_s
+        return p
+
+    def _n_ahead_of(self, r: Request, waiting: List[Request],
+                    now: float) -> int:
+        """How many waiting requests dequeue BEFORE ``r`` under the
+        priority-with-aging order — the per-class replacement for the
+        FIFO queue position the shed predictor used to read."""
+        pr = self._eff_priority(r, now)
+        n = 0
+        for w in waiting:
+            wp = self._eff_priority(w, now)
+            if wp < pr or (wp == pr and (w.t_submit or 0.0)
+                           <= (r.t_submit or 0.0)):
+                n += 1
+        return n
+
     def _shed_verdict(self, r: Request, n_ahead: int,
                       now: float) -> Optional[str]:
         """The deadline-aware admission decision: shed when the predicted
         queue wait plus the request's own expected service already lands
-        past its deadline."""
+        past its deadline.  ``n_ahead`` counts only the requests that
+        would dequeue before this one, so a high-priority arrival is
+        judged against ITS queue, not the whole backlog — at 2x
+        saturation the low classes shed first, by construction."""
         if r.t_deadline is None:
             return None
         wait = self._predicted_wait_s(n_ahead)
         if wait is None:
             return None
-        per_req = (self._est_service_s() or 0.0) * _SERVICE_SAFETY
+        slack = self.class_shed_slack.get(
+            int(getattr(r, "priority", 1)), 1.0
+        )
+        per_req = (self._est_service_s() or 0.0) * _SERVICE_SAFETY * slack
         eta = now + wait + per_req
         if eta > r.t_deadline:
             # the predictor's INPUTS ride the shed instant: a merged
@@ -545,9 +696,13 @@ class ServingScheduler:
                 predicted_wait_s=round(wait, 6),
                 est_service_s=round(per_req, 6),
                 n_ahead=n_ahead,
-                ewma_token_s=self._ewma_token_s,
+                rung_token_s={
+                    str(k): round(v, 6)
+                    for k, v in self._rung_token_s.items()
+                },
                 ewma_tokens=self._ewma_tokens,
                 deadline_s=r.deadline_s,
+                priority=getattr(r, "priority", 1),
             )
             return (
                 f"shed: predicted completion {eta - r.t_submit:.3f}s after "
@@ -558,6 +713,58 @@ class ServingScheduler:
         return None
 
     # -- step thread -----------------------------------------------------
+    def _class_wait_s(self, priority: int) -> float:
+        """Advisory per-class predicted wait: the backlog a NEW arrival
+        of this class would dequeue behind (classes at or above its
+        urgency), through the same rung-model predictor — the per-class
+        Prometheus gauge callback."""
+        ahead = 0
+        for label, n in dict(self._class_waiting).items():
+            try:
+                p = int(label[1:])
+            except (ValueError, IndexError):
+                continue
+            if p <= priority:
+                ahead += int(n)
+        return float(self._predicted_wait_s(ahead) or 0.0)
+
+    def _snapshot_classes(self, waiting: List[Request]) -> None:
+        """Publish the per-class waiting depths (fresh dict per
+        iteration — advisory reads see one consistent snapshot) and
+        lazily register the per-class labeled gauges the first time a
+        class appears (unregistered by close)."""
+        snap: dict = {}
+        for r in waiting:
+            label = getattr(r, "class_label", "p1")
+            snap[label] = snap.get(label, 0) + 1
+        self._class_waiting = snap
+        from paddle_tpu.obs.metrics import register_gauge
+
+        for label in snap:
+            if label in self._class_gauges:
+                continue
+            try:
+                prio = int(label[1:])
+            except ValueError:
+                continue
+            depth_fn = (
+                lambda lbl=label: int(self._class_waiting.get(lbl, 0))
+            )
+            wait_fn = (lambda p=prio: self._class_wait_s(p))
+            register_gauge(
+                "paddle_tpu_serving_class_queue_depth", depth_fn,
+                "requests queued ahead of admission, by priority class",
+                labels={"class": label},
+            )
+            register_gauge(
+                "paddle_tpu_serving_class_predicted_wait_seconds",
+                wait_fn,
+                "predicted queue wait of a new arrival, by priority "
+                "class (the per-class shed predictor's own estimate)",
+                labels={"class": label},
+            )
+            self._class_gauges[label] = (depth_fn, wait_fn)
+
     def _finalize(self, r: Request, error: Optional[str] = None,
                   status: Optional[str] = None) -> None:
         # idempotent: a crash between engine registration and the waiting-
@@ -573,6 +780,12 @@ class ServingScheduler:
         )
         if r.status != "served":
             self._stats.incr("serving/" + r.status)
+        # the per-class ledger: serving/class/<label>/<status> counters
+        # (EVERY status including served) feed the class-labeled
+        # paddle_tpu_serving_requests_total series (obs/metrics.py)
+        self._stats.incr(
+            f"serving/class/{getattr(r, 'class_label', 'p1')}/{r.status}"
+        )
         if r.tokens is None:
             r.tokens = []
         _obs.instant(
@@ -600,7 +813,7 @@ class ServingScheduler:
         while True:
             err = self._validate(got)
             shed = None if err is not None else self._shed_verdict(
-                got, len(waiting), now
+                got, self._n_ahead_of(got, waiting, now), now
             )
             if err is not None:
                 self._finalize(got, error=err, status="rejected")
@@ -696,12 +909,15 @@ class ServingScheduler:
                         status="timeout",
                     )
 
-    def _observe_step(self, dt: float, finished) -> None:
-        """Feed the SLO predictor: per-token step time from this dispatch,
-        generated-token counts from the requests it finished."""
+    def _observe_step(self, dt: float, n_live: int, finished) -> None:
+        """Feed the SLO predictor: per-token step time from this dispatch
+        folded into ITS concurrency rung's EWMA, generated-token counts
+        from the requests it finished."""
         per_token = dt / max(1, getattr(self._engine, "block_steps", 1))
-        self._ewma_token_s = per_token if self._ewma_token_s is None else (
-            _EWMA_DECAY * self._ewma_token_s + (1 - _EWMA_DECAY) * per_token
+        rung = _rung_of(n_live)
+        prev = self._rung_token_s.get(rung)
+        self._rung_token_s[rung] = per_token if prev is None else (
+            _EWMA_DECAY * prev + (1 - _EWMA_DECAY) * per_token
         )
         for r in finished:
             n = float(len(r.tokens or [])) or 1.0
@@ -727,12 +943,21 @@ class ServingScheduler:
                 )
                 self._process_cancels(waiting)
                 self._sweep_deadlines(waiting)
+                self._snapshot_classes(waiting)
                 if waiting:
+                    # strict-priority-with-aging dequeue: the engine
+                    # admits a strict prefix, so ORDERING the waiting
+                    # list IS the dequeue policy (sort is stable —
+                    # submit order breaks ties within a class)
+                    now = self._clock()
+                    waiting.sort(key=lambda r: self._eff_priority(r, now))
                     admitted = self._engine.admit(waiting)
                     if admitted:
                         for r in admitted:
                             _obs.instant(
-                                "serving/admit", cat="serving", req=r.req_id,
+                                "serving/admit", cat="serving",
+                                req=r.req_id,
+                                priority=getattr(r, "priority", 1),
                             )
                         del waiting[: len(admitted)]
                         self._dec_depth(len(admitted))
@@ -744,16 +969,17 @@ class ServingScheduler:
                     # would poison the shed predictor into shedding
                     # feasible requests until the outlier washes out
                     clean_sample = self._engine.n_prefilling == 0
+                    n_live0 = self._engine.n_live
                     t0 = self._clock()
                     with _obs.span(
                         "decode_step", cat="serving",
-                        live=self._engine.n_live,
+                        live=n_live0,
                         prefilling=self._engine.n_prefilling,
                     ):
                         finished = self._engine.step()
                     dt = self._clock() - t0
                     if clean_sample and self._engine.trace_counts == traces0:
-                        self._observe_step(dt, finished)
+                        self._observe_step(dt, n_live0, finished)
                     for r in finished:
                         self._finalize(r)
         except Exception as e:  # engine bug: fail loudly, strand NO client

@@ -310,6 +310,25 @@ define_flag("serving_max_new_tokens", 32,
             "default per-request decode cap of the serving plane (a "
             "request's own max_new_tokens overrides; the generator's "
             "max_length stays the compiled ceiling)")
+define_flag("serving_priority_aging_s", 2.0,
+            "aging rate of the strict-priority-with-aging dequeue "
+            "(serving/scheduler.py): every this-many seconds of queue "
+            "wait promote a waiting request one priority level, so "
+            "batch-class traffic ages into urgency instead of starving "
+            "behind a steady interactive stream; 0 = pure strict "
+            "priority (starvation becomes the operator's choice)")
+define_flag("serving_class_deadline_s", "",
+            "per-class default end-to-end deadlines, 'prio:seconds' "
+            "pairs e.g. '0:0.25,2:1.5' (priority 0 is most urgent): a "
+            "request of that class submitted without its own deadline "
+            "gets the class default; unlisted classes fall back to "
+            "serving_default_deadline_s")
+define_flag("serving_class_shed_slack", "",
+            "per-class multiplier on the shed predictor's service-"
+            "safety headroom, 'prio:factor' pairs e.g. '2:2.0': >1 "
+            "sheds that class EARLIER under pressure (more headroom "
+            "demanded), <1 lets it gamble closer to its deadline; "
+            "unlisted classes use 1.0")
 define_flag("trace_dir", "",
             "obs plane (paddle_tpu/obs/): arm Chrome-trace export — every "
             "process dumps its span timeline to trace-<role>-<pid>.json "

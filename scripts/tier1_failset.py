@@ -62,6 +62,7 @@ SLOW_ONLY_FILES = [
     "tests/test_decode_speed_e2e.py",
     "tests/test_fleet_serving_e2e.py",
     "tests/test_explore_e2e.py",
+    "tests/test_fuzz_e2e.py",
 ]
 
 

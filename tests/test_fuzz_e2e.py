@@ -1,0 +1,126 @@
+"""End-to-end `paddle-tpu fuzz` + trace record/replay CLI (subprocess):
+the make-chaos batch contract — a clean seeded composition batch, and
+the full planted-canary loop: detect (exit 1), shrink to a replayable
+spec file, replay from disk and reproduce (exit 0).  Plus the serve
+CLI's record->replay loop: a recorded day replays through a fresh
+process with the identical per-class status ledger.  Subprocess-level
+so the exit-code contracts are what's tested."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(*argv, timeout=600):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_fuzz_clean_seeded_batch():
+    p = _cli("fuzz", "--count", "5", "--seed", "0")
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    assert "clean: 5 compositions" in p.stdout
+
+
+def test_fuzz_canary_detect_shrink_replay(tmp_path):
+    spec_path = str(tmp_path / "ledger_skew.spec.json")
+    p = _cli("fuzz", "--count", "10", "--seed", "7",
+             "--plant", "ledger_skew", "--out", spec_path)
+    assert p.returncode == 1, (p.stdout, p.stderr)
+    assert "VIOLATION" in p.stdout
+    assert "ledger_sum_mismatch" in p.stdout
+
+    with open(spec_path, encoding="utf-8") as fh:
+        spec = json.load(fh)
+    assert spec["kind"] == "chaos-fuzz"
+    assert spec["planted"] == "ledger_skew"
+    # ddmin left only what the planted bug needs (arrival overload)
+    assert len(spec["items"]) <= 2, spec["items"]
+
+    r = _cli("fuzz", "--replay", spec_path)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "reproduced" in r.stdout
+
+
+def test_fuzz_replay_of_clean_spec_fails(tmp_path):
+    """A spec whose composition no longer violates must exit 1 — the
+    regression-test contract's other half."""
+    spec_path = str(tmp_path / "clean.spec.json")
+    with open(spec_path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "version": 1, "kind": "chaos-fuzz", "seed": 0, "index": 0,
+            "items": [{"axis": "arrival", "process": "uniform",
+                       "rate_factor": 0.5}],
+            "planted": None, "violations": ["stale"],
+        }, fh)
+    r = _cli("fuzz", "--replay", spec_path)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "did NOT reproduce" in r.stderr
+
+
+def _serve(*extra, timeout=300):
+    return _cli(
+        "serve", "--src-vocab", "60", "--trg-vocab", "60",
+        "--word-dim", "32", "--hidden-dim", "64", "--max-length", "16",
+        *extra, timeout=timeout,
+    )
+
+
+def test_serve_record_then_replay_reproduces_class_ledger(tmp_path):
+    """The tentpole loop at the CLI: record a priority-stamped open-loop
+    day, replay it through a FRESH process, and get the identical
+    per-class status ledger (the recorded identity is authoritative —
+    no live flag re-derives it)."""
+    trace = str(tmp_path / "day.ptt")
+    rec = _serve("--synthetic", "10", "--rate", "40", "--seed", "3",
+                 "--priority-every", "4", "--sessions", "3",
+                 "--deadline-s", "10", "--record-trace", trace)
+    assert rec.returncode == 0, (rec.stdout, rec.stderr)
+    rec_summary = json.loads(rec.stdout.strip().splitlines()[-1])
+    assert rec_summary["recorded_trace"] == trace
+    assert set(rec_summary["classes"]) == {"p0", "p2"}
+
+    # the trace is a valid, byte-stable artifact
+    from paddle_tpu.robustness.traces import read_trace
+
+    t = read_trace(trace)
+    assert len(t.requests()) == 10
+    assert t.serialize().encode() == open(trace, "rb").read()
+
+    rep = _serve("--replay", trace, "--seed", "99")
+    assert rep.returncode == 0, (rep.stdout, rep.stderr)
+    rep_summary = json.loads(rep.stdout.strip().splitlines()[-1])
+    assert rep_summary["replayed_trace"] == trace
+    assert rep_summary["classes"] == rec_summary["classes"]
+    for k in ("served", "shed", "rejected", "timeout", "unfinished"):
+        assert rep_summary[k] == rec_summary[k], (k, rep_summary)
+    # replayed per-request ids are the RECORDED ids
+    rep_ids = [json.loads(line)["req"]
+               for line in rep.stdout.strip().splitlines()[:-1]
+               if line.startswith("{")]
+    assert sorted(rep_ids) == sorted(r["id"] for r in t.requests())
+
+
+def test_serve_replay_rejects_torn_trace(tmp_path):
+    """A truncated recording must fail loudly, not replay short."""
+    trace = str(tmp_path / "torn.ptt")
+    rec = _serve("--synthetic", "4", "--rate", "50", "--seed", "1",
+                 "--record-trace", trace)
+    assert rec.returncode == 0, (rec.stdout, rec.stderr)
+    with open(trace) as f:
+        lines = f.read().splitlines()
+    with open(trace, "w") as f:
+        f.write("\n".join(lines[:-1]) + "\n")  # drop the footer
+    rep = _serve("--replay", trace)
+    assert rep.returncode != 0
+    assert "ptt-end" in (rep.stderr + rep.stdout)

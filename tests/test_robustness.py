@@ -652,3 +652,70 @@ def test_stale_lease_chaos_allows_takeover(tmp_path):
     assert standby.try_acquire()  # takeover
     chaos.disarm()
     assert not leader.renew()  # deposed side detects the usurper
+
+
+def test_chaos_consult_report_accounts_fired_and_unfired(tmp_path):
+    """The arming audit: every armed point accounts for consultations
+    and fires; an armed-never-consulted point shows up as exactly that
+    (the silent skew that made green drills meaningless)."""
+    import json
+
+    chaos.arm("nan_batch@2,kill_worker@5")
+    try:
+        assert not chaos.fire("nan_batch")   # consultation 1
+        assert chaos.fire("nan_batch")       # occurrence 2 fires
+        rep = chaos.consult_report()
+        assert rep["nan_batch"] == {
+            "occurrence": 2, "consultations": 2, "fired": 1,
+        }
+        # armed but the faulted code path never ran
+        assert rep["kill_worker"] == {
+            "occurrence": 5, "consultations": 0, "fired": 0,
+        }
+        out = tmp_path / "chaos-report.json"
+        written = chaos.write_report(str(out))
+        assert json.loads(out.read_text()) == written == rep
+    finally:
+        chaos.disarm()
+
+
+def test_chaos_rearm_clears_audit_counters():
+    chaos.arm("nan_batch")
+    assert chaos.fire("nan_batch")
+    chaos.arm("nan_batch@3")  # re-arm: fresh audit, fresh occurrences
+    try:
+        rep = chaos.consult_report()
+        assert rep["nan_batch"]["consultations"] == 0
+        assert rep["nan_batch"]["fired"] == 0
+    finally:
+        chaos.disarm()
+
+
+def test_chaos_exit_report_counts_and_writes(tmp_path, monkeypatch):
+    """The atexit leg of the audit: fired/unfired StatSet counters and
+    the PADDLE_TPU_CHAOS_REPORT file a drill parent reads after the
+    child exits (a SIGKILL'd child leaves NO file — that absence is the
+    expected signature of a successful kill)."""
+    import json
+
+    from paddle_tpu.utils.timers import global_stats
+
+    report_path = tmp_path / "exit-report.json"
+    monkeypatch.setenv("PADDLE_TPU_CHAOS_REPORT", str(report_path))
+    chaos.arm("nan_batch,stale_lease@9")
+    try:
+        assert chaos.fire("nan_batch")
+
+        def count(name):
+            return global_stats.summary().get(name, {}).get("count", 0)
+
+        before_fired = count("chaos/fired/nan_batch")
+        before_unfired = count("chaos/unfired/stale_lease")
+        chaos._exit_report()
+        assert count("chaos/fired/nan_batch") == before_fired + 1
+        assert count("chaos/unfired/stale_lease") == before_unfired + 1
+        rep = json.loads(report_path.read_text())
+        assert rep["nan_batch"]["fired"] == 1
+        assert rep["stale_lease"]["consultations"] == 0
+    finally:
+        chaos.disarm()

@@ -47,7 +47,7 @@ class FakeEngineClient:
         self._addr = (str(address[0]), int(address[1]))
 
     def serve(self, req_id, src_ids, max_new_tokens=None, deadline_s=None,
-              beam_size=None, session_id=None):
+              beam_size=None, session_id=None, priority=None):
         self._book.setdefault("serves", []).append((self._addr, str(req_id)))
         fn = self._book.get("serve")
         if fn is not None:

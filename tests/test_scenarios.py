@@ -15,13 +15,17 @@ def test_new_flags_registered_with_defaults():
     assert _flags.get_flag("serving_queue_limit") == 0
     assert _flags.get_flag("serving_prefill_chunk_tokens") == 0
     assert _flags.get_flag("scenario_slo_ms") == 0.0
+    # the per-class SLO admission flags (PR 20)
+    assert _flags.get_flag("serving_priority_aging_s") == 2.0
+    assert _flags.get_flag("serving_class_deadline_s") == ""
+    assert _flags.get_flag("serving_class_shed_slack") == ""
 
 
 def test_registry_names_and_unknown():
     assert set(scenarios.FAST_SCENARIOS) == {
         "overload", "burst_overload", "nan_request_under_load",
         "slow_client_under_load", "mixed_train_serve",
-        "partition_under_load",
+        "partition_under_load", "trace_replay_drift",
     }
     assert set(scenarios.SLOW_SCENARIOS) == {
         "fleet_kill_worker", "fleet_kill_master",

@@ -391,8 +391,8 @@ def test_scheduler_shed_verdict_uses_predictor(small_gen):
     eng = make_engine(small_gen)
     sched = ServingScheduler(eng)
     sched.close()  # predictor methods are pure reads after close
-    sched._ewma_token_s = 0.01  # 10 ms/token
-    sched._ewma_tokens = 8.0    # 80 ms expected service
+    sched._rung_token_s = {4: 0.01}  # 10 ms/token at the full house
+    sched._ewma_tokens = 8.0         # 80 ms expected service
     now = 1000.0
     tight = Request([2, 3], deadline_s=0.05)
     tight.t_submit, tight.t_deadline = now, now + 0.05
@@ -402,7 +402,7 @@ def test_scheduler_shed_verdict_uses_predictor(small_gen):
     wide.t_submit, wide.t_deadline = now, now + 10.0
     assert sched._shed_verdict(wide, n_ahead=4, now=now) is None
     # uncalibrated predictor never sheds blind
-    sched._ewma_token_s = None
+    sched._rung_token_s = {}
     assert sched._shed_verdict(tight, n_ahead=100, now=now) is None
 
 
@@ -652,3 +652,156 @@ def test_loadgen_stamps_deadlines_and_honors_stop():
     out = gen.run(seen.append, stop=lambda: len(seen) >= 3)
     assert len(out) == len(seen) == 3  # stop truncated the schedule
     assert all(r.deadline_s == 1.5 for r in seen)
+
+
+# ---------------------------------------------------------------------------
+# per-class SLO admission (Request.priority, strict-priority-with-aging,
+# per-class shed slack — the PR-20 service-class plane)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_class_spec_grammar():
+    from paddle_tpu.serving.scheduler import _parse_class_spec
+
+    assert _parse_class_spec("0:0.25,2:1.5") == {0: 0.25, 2: 1.5}
+    assert _parse_class_spec(" 1:2 ") == {1: 2.0}
+    assert _parse_class_spec("") == {}
+    assert _parse_class_spec(None) == {}
+
+
+def test_request_priority_default_and_class_label():
+    assert Request([2, 3]).priority == 1
+    assert Request([2, 3]).class_label == "p1"
+    assert Request([2, 3], priority=0).class_label == "p0"
+    assert Request([2, 3], priority=7).class_label == "p7"
+
+
+def test_eff_priority_aging_promotes(small_gen):
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng, priority_aging_s=2.0)
+    sched.close()
+    r = Request([2, 3], priority=4)
+    r.t_submit = 100.0
+    assert sched._eff_priority(r, 100.0) == pytest.approx(4.0)
+    # 4 seconds of wait at 2 s/level promote two levels
+    assert sched._eff_priority(r, 104.0) == pytest.approx(2.0)
+    # aging off: pure strict priority (starvation is explicit)
+    sched.priority_aging_s = 0.0
+    assert sched._eff_priority(r, 104.0) == pytest.approx(4.0)
+
+
+def test_n_ahead_counts_the_priority_queue_not_the_backlog(small_gen):
+    """A high-priority arrival is judged against ITS queue: waiting
+    batch requests do not count ahead of it, but earlier same-class
+    submits do (stable FIFO within a class)."""
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng, priority_aging_s=0.0)
+    sched.close()
+    now = 50.0
+
+    def req(prio, t):
+        r = Request([2, 3], priority=prio)
+        r.t_submit = t
+        return r
+
+    batch = [req(2, 10.0), req(2, 11.0), req(2, 12.0)]
+    high = req(0, 13.0)
+    # the admission loop judges a request against the OTHER waiters
+    assert sched._n_ahead_of(high, batch, now) == 0
+    assert sched._n_ahead_of(
+        batch[0], [batch[1], batch[2], high], now) == 1  # just high
+    assert sched._n_ahead_of(
+        batch[2], [batch[0], batch[1], high], now) == 3
+
+
+def test_class_shed_slack_sheds_batch_first(small_gen):
+    """With a calibrated predictor and a borderline deadline, the batch
+    class (slack > 1, sheds early) is refused while the interactive
+    class (slack < 1, holds longer) admits — low classes shed FIRST at
+    the same offered deadline, by construction."""
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng, class_shed_slack={0: 0.25, 2: 4.0})
+    sched.close()
+    sched._rung_token_s = {4: 0.01}  # est service 0.08 s at full house
+    sched._ewma_tokens = 8.0
+    now = 1000.0
+
+    def req(prio, deadline):
+        r = Request([2, 3], priority=prio, deadline_s=deadline)
+        r.t_submit, r.t_deadline = now, now + deadline
+        return r
+
+    # per-class shed floor = 0.08 * 1.5 * slack: p0 -> 0.03s, p2 -> 0.48s
+    assert sched._shed_verdict(req(0, 0.2), n_ahead=0, now=now) is None
+    v = sched._shed_verdict(req(2, 0.2), n_ahead=0, now=now)
+    assert v is not None and v.startswith("shed:")
+    # an unconfigured class falls back to slack 1.0 (0.12s floor)
+    assert sched._shed_verdict(req(1, 0.2), n_ahead=0, now=now) is None
+
+
+def test_priority_dequeue_order_end_to_end(small_gen):
+    """Strict-priority dequeue through the REAL engine: with one slot
+    occupied, a later-submitted interactive request is served before the
+    earlier batch backlog; ties within a class stay FIFO."""
+    eng = make_engine(small_gen, max_slots=1)
+    sched = ServingScheduler(eng)
+    order = []
+    note = lambda r: order.append(r.req_id)  # noqa: E731
+    blocker = sched.submit(Request(srcs_of(31, (4,))[0], req_id="blk"))
+    lows = [
+        Request(s, priority=5, req_id=f"low{i}", callback=note)
+        for i, s in enumerate(srcs_of(32, (4, 4)))
+    ]
+    high = Request(srcs_of(33, (4,))[0], priority=0, req_id="hi",
+                   callback=note)
+    for r in lows:
+        sched.submit(r)
+    sched.submit(high)
+    for r in [blocker, *lows, high]:
+        assert r.wait(60.0), r
+    sched.close()
+    assert order == ["hi", "low0", "low1"]
+    assert all(r.status == "served" for r in [blocker, *lows, high])
+
+
+def test_finalize_counts_per_class_ledger(small_gen):
+    from paddle_tpu.utils.timers import StatSet
+
+    stats = StatSet()
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng, stats=stats)
+    a = sched.submit(Request(srcs_of(34, (4,))[0], priority=0))
+    b = sched.submit(Request(srcs_of(35, (4,))[0]))
+    assert a.wait(60.0) and b.wait(60.0)
+    sched.close()
+    s = stats.summary()
+    # EVERY status lands in the class ledger, served included — the
+    # class-labeled paddle_tpu_serving_requests_total series' source
+    assert s["serving/class/p0/served"]["count"] == 1
+    assert s["serving/class/p1/served"]["count"] == 1
+
+
+def test_class_gauges_register_and_unregister(small_gen):
+    from paddle_tpu.obs.metrics import _registry
+
+    eng = make_engine(small_gen)
+    sched = ServingScheduler(eng)
+    # a blocker holds the slot so a priority-stamped waiter sits in the
+    # queue long enough for the step loop to snapshot its class
+    blk = sched.submit(Request(srcs_of(36, (4,))[0]))
+    for _ in range(40):
+        sched.submit(Request(srcs_of(37, (4,))[0], priority=3,
+                             deadline_s=60.0))
+        keys = set(_registry.snapshot())
+        if any("paddle_tpu_serving_class_queue_depth" in k
+               and 'class="p3"' in k for k in keys):
+            break
+        blk.wait(0.05)
+    else:
+        pytest.fail("per-class gauges never registered")
+    sched.close()
+    keys = set(_registry.snapshot())
+    assert not any("paddle_tpu_serving_class_queue_depth" in k
+                   and 'class="p3"' in k for k in keys), keys
+    assert not any("paddle_tpu_serving_class_predicted_wait" in k
+                   and 'class="p3"' in k for k in keys), keys

@@ -1,0 +1,309 @@
+"""Request-trace record/replay (robustness/traces.py) + the chaos-fuzz
+spec plumbing (robustness/fuzz.py pure logic — no engine).
+
+The load-bearing guarantees pinned here:
+
+* a recorded trace round-trips BYTE-IDENTICALLY (serialize == the file),
+  so a committed trace is a stable artifact, not a moving target;
+* torn / truncated / corrupted trace files are REJECTED with a
+  structured ``TraceError`` naming the defect — a crashed recorder can
+  never feed a silently-short workload to a drift gate;
+* arrival statistics survive the round-trip: a trace recorded from each
+  arrival process reconstructs that process's rate and CV signature;
+* replay reproduces the recorded identity — ids, deadlines, sessions,
+  priority classes — and therefore the router's rendezvous affinity
+  targets, even when the replayed requests pass through a LIVE loadgen
+  configured differently (the stamp-if-absent contract);
+* fuzz composition sampling is seed-deterministic and ddmin shrinking
+  minimizes (the `paddle-tpu fuzz` replay contract's foundations).
+"""
+
+import random
+
+import pytest
+
+from paddle_tpu.reader.loadgen import OpenLoopLoadGen, PrefixMixer
+from paddle_tpu.robustness.traces import (
+    TraceError,
+    TraceReplayLoadGen,
+    TraceWriter,
+    arrival_stats,
+    read_trace,
+    serialize_trace,
+)
+from paddle_tpu.serving import Request
+from paddle_tpu.serving.router import affinity_key, rendezvous_pick
+
+
+def _virtual_clock():
+    now = [0.0]
+    return (lambda: now[0]), (lambda s: now.__setitem__(0, now[0] + s))
+
+
+def _write_trace(path, reqs_with_offsets, meta=None, cancels=()):
+    clock, _ = _virtual_clock()
+    with TraceWriter(str(path), meta=meta or {"test": 1},
+                     clock=clock) as w:
+        for off, r in reqs_with_offsets:
+            w.record_request(r, offset_s=off)
+        for off, rid, reason in cancels:
+            w.record_cancel(rid, offset_s=off, reason=reason)
+    return str(path)
+
+
+# ---------------------------------------------------------------------------
+# round-trip + rejection
+# ---------------------------------------------------------------------------
+
+
+def test_trace_roundtrip_byte_identity(tmp_path):
+    reqs = [
+        Request([2, 3, 4], 6, req_id="a", deadline_s=0.25,
+                session_id="sess0", priority=0),
+        Request([5, 6], req_id="b"),
+    ]
+    p = _write_trace(tmp_path / "t.ptt",
+                     [(0.0, reqs[0]), (0.125, reqs[1])],
+                     cancels=[(0.5, "a", "client gave up")])
+    raw = open(p, "rb").read()
+    trace = read_trace(p)
+    # byte identity: what the reader validated re-serializes to EXACTLY
+    # the recorded file — a committed trace artifact is stable
+    assert trace.serialize().encode() == raw
+    assert len(trace) == 3
+    (r0, r1), (c0,) = trace.requests(), trace.cancels()
+    assert r0["id"] == "a" and r0["src"] == [2, 3, 4] and r0["mnt"] == 6
+    assert r0["dl"] == 0.25 and r0["sess"] == "sess0" and r0["prio"] == 0
+    assert r1["prio"] == 1 and r1["sess"] is None  # defaults recorded
+    assert c0["id"] == "a" and c0["reason"] == "client gave up"
+    # meta survives
+    assert trace.meta == {"test": 1}
+
+
+def test_trace_rejects_torn_truncated_and_corrupt(tmp_path):
+    reqs = [(0.0, Request([2, 3], req_id="a")),
+            (0.1, Request([4], req_id="b"))]
+    p = _write_trace(tmp_path / "ok.ptt", reqs)
+    lines = open(p).read().splitlines()
+
+    def _variant(name, content):
+        q = tmp_path / name
+        q.write_text(content)
+        with pytest.raises(TraceError) as ei:
+            read_trace(str(q))
+        return str(ei.value)
+
+    # writer never closed (crash mid-run): no footer
+    assert "footer" in _variant("nofoot.ptt",
+                                "\n".join(lines[:-1]) + "\n")
+    # crash mid-record: last line has no newline
+    assert "newline" in _variant("torn.ptt", "\n".join(lines))
+    # one flipped record byte: per-line crc catches it
+    bad = lines[1][:10] + ("0" if lines[1][10] != "0" else "1") + lines[1][11:]
+    assert "crc" in _variant(
+        "flip.ptt", "\n".join([lines[0], bad, *lines[2:]]) + "\n")
+    # a dropped record: footer count catches it
+    assert "truncated" in _variant(
+        "short.ptt", "\n".join([lines[0], lines[1], lines[-1]]) + "\n")
+    # not a trace at all / wrong version
+    assert "header" in _variant("junk.ptt", "hello\n")
+    assert "version" in _variant(
+        "vers.ptt",
+        '#ptt1 {"meta":{},"version":999}\n' + "\n".join(lines[1:]) + "\n")
+
+
+def test_trace_rejects_nonmonotonic_offsets(tmp_path):
+    text = serialize_trace(
+        [{"ev": "req", "o": 0.5, "id": "a", "src": [2]},
+         {"ev": "req", "o": 0.1, "id": "b", "src": [3]}], {})
+    q = tmp_path / "mono.ptt"
+    q.write_text(text)
+    with pytest.raises(TraceError, match="monotonic"):
+        read_trace(str(q))
+
+
+def test_writer_refuses_after_close(tmp_path):
+    w = TraceWriter(str(tmp_path / "c.ptt"))
+    w.record_request(Request([2]))
+    w.close()
+    with pytest.raises(TraceError, match="closed"):
+        w.record_request(Request([3]))
+
+
+# ---------------------------------------------------------------------------
+# arrival-process reconstruction
+# ---------------------------------------------------------------------------
+
+
+def _stats_for(tmp_path, process, n=600, rate=50.0):
+    gen = OpenLoopLoadGen(rate, n, lambda i: i, process=process, seed=9)
+    recs = [{"ev": "req", "o": round(a, 6), "id": f"r{i}", "src": [2]}
+            for i, a in enumerate(gen.arrivals)]
+    q = tmp_path / f"{process}.ptt"
+    q.write_text(serialize_trace(recs, {"process": process}))
+    return arrival_stats(read_trace(str(q)))
+
+
+def test_arrival_stats_reconstruct_each_process(tmp_path):
+    """A recorded day carries its arrival process's signature: the rate
+    comes back near nominal and the gap CV separates uniform (~0),
+    poisson (~1) and burst (overdispersed) — the realism evidence that a
+    replayed trace offers the RECORDED process, not a relabeled one."""
+    uni = _stats_for(tmp_path, "uniform")
+    poi = _stats_for(tmp_path, "poisson")
+    bur = _stats_for(tmp_path, "burst")
+    for s in (uni, poi, bur):
+        assert s["n"] == 600
+        assert 0.6 * 50.0 < s["rate_rps"] < 1.6 * 50.0, s
+    assert uni["cv"] == pytest.approx(0.0, abs=1e-6)
+    assert 0.7 < poi["cv"] < 1.3, poi
+    assert bur["cv"] > poi["cv"] * 1.1, (bur, poi)
+
+
+# ---------------------------------------------------------------------------
+# replay fidelity: identity, affinity pinning, stamp-if-absent
+# ---------------------------------------------------------------------------
+
+
+def _record_live_window(tmp_path, n=12):
+    """Drive a live loadgen window (virtual clock) and record it."""
+    mixer = PrefixMixer(50, pool_size=3, prefix_frac=0.6, seed=4,
+                        sessions=4)
+    live = [Request(mixer.source(i), req_id=f"live{i}") for i in range(n)]
+    clock, sleep = _virtual_clock()
+    gen = OpenLoopLoadGen(
+        100.0, n, lambda i: live[i], process="poisson", seed=2,
+        deadline_s=0.4, session_of=mixer.session_of,
+        priority_of=lambda i: 0 if i % 3 == 0 else 2,
+        clock=clock, sleep=sleep,
+    )
+    w = TraceWriter(str(tmp_path / "live.ptt"), clock=clock)
+    gen.run(lambda r: (w.record_request(r), r)[-1])
+    w.close()
+    return live, read_trace(str(tmp_path / "live.ptt"))
+
+
+def test_replay_reproduces_recorded_identity_and_rendezvous(tmp_path):
+    live, trace = _record_live_window(tmp_path)
+    clock, sleep = _virtual_clock()
+    replayed = TraceReplayLoadGen(trace, clock=clock, sleep=sleep).run(
+        lambda r: r)
+    assert len(replayed) == len(live)
+    engines = ["engine-a", "engine-b", "engine-c"]
+    for a, b in zip(live, replayed):
+        assert b.req_id == a.req_id
+        assert b.src_ids == a.src_ids
+        assert b.deadline_s == a.deadline_s
+        assert b.session_id == a.session_id
+        assert b.priority == a.priority
+        # the affinity key and the rendezvous target both pin: the
+        # replayed day lands on the SAME engines the recorded day did
+        ka = affinity_key(a.src_ids, a.session_id)
+        kb = affinity_key(b.src_ids, b.session_id)
+        assert ka == kb
+        if ka is not None:
+            assert (rendezvous_pick(ka, engines)
+                    == rendezvous_pick(kb, engines))
+
+
+def test_live_loadgen_never_clobbers_replayed_identity(tmp_path):
+    """The stamp-if-absent regression (PR 20 satellite): replay-built
+    requests passed through a DIFFERENTLY-configured live loadgen keep
+    their recorded deadline and session — the live RNG must not
+    re-derive affinity keys a recorded day already fixed."""
+    live, trace = _record_live_window(tmp_path)
+    clock, sleep = _virtual_clock()
+    replayed = TraceReplayLoadGen(trace, clock=clock, sleep=sleep).run(
+        lambda r: r)
+    clock2, sleep2 = _virtual_clock()
+    out = OpenLoopLoadGen(
+        100.0, len(replayed), lambda i: replayed[i], process="uniform",
+        deadline_s=99.0, session_of=lambda i: "sessCLOBBER",
+        clock=clock2, sleep=sleep2,
+    ).run(lambda r: r)
+    assert [r.session_id for r in out] == [a.session_id for a in live]
+    assert [r.deadline_s for r in out] == [a.deadline_s for a in live]
+
+
+def test_replay_fires_cancels_at_recorded_offsets(tmp_path):
+    p = _write_trace(
+        tmp_path / "c.ptt",
+        [(0.0, Request([2, 3], req_id="a")),
+         (0.1, Request([4, 5], req_id="b"))],
+        cancels=[(0.2, "a", "deadline blown")])
+    clock, sleep = _virtual_clock()
+    submitted, canceled = [], []
+    TraceReplayLoadGen(read_trace(p), clock=clock, sleep=sleep).run(
+        submitted.append,
+        cancel=lambda rid, reason: canceled.append((rid, reason, clock())))
+    assert [r.req_id for r in submitted] == ["a", "b"]
+    assert canceled == [("a", "deadline blown", pytest.approx(0.2))]
+
+
+def test_replay_speedup_compresses_the_clock(tmp_path):
+    _, trace = _record_live_window(tmp_path)
+    clock, sleep = _virtual_clock()
+    gen = TraceReplayLoadGen(trace, speedup=4.0, clock=clock, sleep=sleep)
+    gen.run(lambda r: r)
+    span = float(trace.records[-1]["o"])
+    assert clock() == pytest.approx(span / 4.0, rel=1e-3)
+    assert gen.offered_duration_s == pytest.approx(span / 4.0)
+
+
+# ---------------------------------------------------------------------------
+# chaos-fuzz spec plumbing (pure logic; the engine-driving path is
+# tests/test_fuzz_e2e.py, slow tier)
+# ---------------------------------------------------------------------------
+
+
+def test_fuzz_composition_sampling_deterministic():
+    from paddle_tpu.robustness import fuzz
+
+    a = fuzz.sample_composition(random.Random("7:3"))
+    b = fuzz.sample_composition(random.Random("7:3"))
+    assert a == b
+    axes = [it["axis"] for it in a]
+    assert axes[0] == "arrival"          # arrival is always present
+    assert len(axes) == len(set(axes))   # one item per axis
+    known = {"arrival", "serve_chaos", "netem", "train_chaos",
+             "checkpoint"}
+    assert set(axes) <= known
+    # different seeds eventually sample different cocktails
+    assert any(
+        fuzz.sample_composition(random.Random(f"8:{i}")) != a
+        for i in range(8)
+    )
+
+
+def test_fuzz_shrink_items_minimizes_and_keeps_irreproducible():
+    from paddle_tpu.robustness.fuzz import shrink_items
+
+    items = [{"axis": c} for c in "abcdef"]
+    shrunk = shrink_items(
+        items, lambda cand: any(it["axis"] == "d" for it in cand))
+    assert shrunk == [{"axis": "d"}]
+    # two-item violation shrinks to exactly the pair
+    pair = shrink_items(
+        items,
+        lambda cand: ({"axis": "b"} in cand and {"axis": "e"} in cand))
+    assert sorted(it["axis"] for it in pair) == ["b", "e"]
+    # a non-reproducible violation comes back untouched (caller decides)
+    assert shrink_items(items, lambda cand: False) == items
+
+
+def test_fuzz_spec_roundtrip_and_replay_validation(tmp_path):
+    from paddle_tpu.robustness import fuzz
+
+    spec = fuzz._spec(
+        7, 3, [{"axis": "arrival", "process": "burst",
+                "rate_factor": 2.0}],
+        "ledger_skew", ["ledger_sum_mismatch:offered=16:sum=17"])
+    assert spec["kind"] == "chaos-fuzz"
+    assert spec["version"] == fuzz.FUZZ_SPEC_VERSION
+    p = tmp_path / "spec.json"
+    fuzz.save_spec(spec, str(p))
+    assert fuzz.load_spec(str(p)) == spec
+    with pytest.raises(ValueError, match="chaos-fuzz"):
+        fuzz.replay_fuzz_spec({"kind": "nope", "version": 1})
+    with pytest.raises(ValueError, match="version"):
+        fuzz.replay_fuzz_spec({"kind": "chaos-fuzz", "version": 999})

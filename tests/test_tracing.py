@@ -653,3 +653,26 @@ def test_obs_package_lints_clean():
         if fn.endswith(".py"):
             diags.extend(lint_file(os.path.join(obs_dir, fn), root=root))
     assert diags == [], [str(d) for d in diags]
+
+
+def test_prometheus_per_class_ledger_series():
+    """The class-labeled requests_total series: the scheduler's
+    serving/class/<class>/<status> counters render as labeled series of
+    the same family, all statuses included (served too)."""
+    from paddle_tpu.obs.metrics import render_prometheus
+    from paddle_tpu.utils.timers import StatSet
+
+    stats = StatSet()
+    stats.incr("serving/class/p0/served", 3)
+    stats.incr("serving/class/p2/shed", 2)
+    stats.incr("serving/class/p2/served", 1)
+    samples = _parse_prometheus(render_prometheus(stats))
+    assert samples[
+        'paddle_tpu_serving_requests_total{class="p0",status="served"}'
+    ] == 3.0
+    assert samples[
+        'paddle_tpu_serving_requests_total{class="p2",status="shed"}'
+    ] == 2.0
+    assert samples[
+        'paddle_tpu_serving_requests_total{class="p2",status="served"}'
+    ] == 1.0
